@@ -1,0 +1,18 @@
+from fantoch_tpu.core.command import Command, CommandResult
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import (
+    AtomicIdGen,
+    ClientId,
+    Dot,
+    IdGen,
+    ProcessId,
+    Rifl,
+    RiflGen,
+    ShardId,
+    all_process_ids,
+    process_ids,
+)
+from fantoch_tpu.core.kvs import KVOp, KVOpKind, KVOpResult, KVStore, Key, Value
+from fantoch_tpu.core.metrics import Histogram, Metrics
+from fantoch_tpu.core.planet import Planet, Region
+from fantoch_tpu.core.timing import RunTime, SimTime, SysTime

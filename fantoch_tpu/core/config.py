@@ -1,0 +1,106 @@
+"""System configuration and per-protocol quorum-size formulas.
+
+Reference: fantoch/src/config.rs:7-317.  One flat config struct shared by all
+protocols, drivers, and executors.  Quorum-size formulas are protocol facts
+(from the EPaxos/Atlas/Tempo/Caesar papers) and must match the reference
+exactly — the reference's own formula tests (fantoch/src/config.rs:320-538)
+are mirrored in tests/test_config.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+from fantoch_tpu.core.ids import ProcessId
+
+
+@dataclass
+class Config:
+    """Flat system config (fantoch/src/config.rs:7-43).
+
+    Attributes mirror the reference's knobs; durations are in milliseconds.
+    """
+
+    # number of processes (per shard) and max tolerated faults
+    n: int
+    f: int
+    # number of shards (partial replication); 1 = full replication
+    shard_count: int = 1
+    # if True, commands are executed at commit time by the protocol itself
+    # (skipping the executor's ordering) — only safe for benchmarks
+    execute_at_commit: bool = False
+    # interval at which executors inform workers of executed commands
+    # (drives dot-based GC); None disables the notification
+    executor_executed_notification_interval_ms: Optional[int] = None
+    # interval at which executors clean up / retry cross-shard requests
+    executor_cleanup_interval_ms: Optional[int] = None
+    # interval at which executors check for stuck commands (liveness watchdog)
+    executor_monitor_pending_interval_ms: Optional[int] = None
+    # record per-key execution order for agreement checks in tests
+    executor_monitor_execution_order: bool = False
+    # garbage-collection interval; None disables GC
+    gc_interval_ms: Optional[int] = None
+    # leader process (leader-based protocols, i.e. FPaxos)
+    leader: Optional[ProcessId] = None
+    # Newt (Tempo) knobs
+    newt_tiny_quorums: bool = False
+    newt_clock_bump_interval_ms: Optional[int] = None
+    newt_detached_send_interval_ms: Optional[int] = None
+    # Caesar knob: wait-condition on (True = the full protocol)
+    caesar_wait_condition: bool = True
+    # skip sending MCollectAck to the coordinator when the process is in the
+    # fast quorum and the coordinator will ack anyway
+    skip_fast_ack: bool = False
+
+    def __post_init__(self) -> None:
+        # reference panics if f > n/2 only in specific protocols; the config
+        # itself only validates basic sanity (fantoch/src/config.rs:45-60)
+        if self.n == 0:
+            raise ValueError("n must be positive")
+        if self.f > self.n:
+            raise ValueError(f"f = {self.f} must not exceed n = {self.n}")
+
+    # --- quorum sizes (protocol facts; fantoch/src/config.rs:252-317) ---
+
+    def basic_quorum_size(self) -> int:
+        return self.f + 1
+
+    def fpaxos_quorum_size(self) -> int:
+        return self.f + 1
+
+    def atlas_quorum_sizes(self) -> Tuple[int, int]:
+        """(fast_quorum_size, write_quorum_size) = (n//2 + f, f + 1)."""
+        return (self.n // 2 + self.f, self.f + 1)
+
+    def epaxos_quorum_sizes(self) -> Tuple[int, int]:
+        """EPaxos always tolerates a minority: f = n//2.
+
+        fast quorum = f + floor((f+1)/2)  (i.e. f + ceil(f/2) for the paper's
+        3n/4-ish quorum), write quorum = f + 1.
+        """
+        f = self.n // 2
+        return (f + (f + 1) // 2, f + 1)
+
+    def caesar_quorum_sizes(self) -> Tuple[int, int]:
+        """(fast, write) = (3n//4 + 1, n//2 + 1)."""
+        return (3 * self.n // 4 + 1, self.n // 2 + 1)
+
+    def newt_quorum_sizes(self) -> Tuple[int, int, int]:
+        """(fast_quorum_size, write_quorum_size, stability_threshold).
+
+        Stability threshold is ``n - fast_quorum_size + f``: it plus the
+        minimum number of processes where clocks are computed
+        (fast_quorum_size - f + 1) must exceed n.  With tiny quorums the fast
+        quorum is 2f (clocks from f+1 processes), giving threshold n - f.
+        """
+        minority = self.n // 2
+        if self.newt_tiny_quorums:
+            fast, threshold = 2 * self.f, self.n - self.f
+        else:
+            fast, threshold = minority + self.f, minority + 1
+        return (fast, self.f + 1, threshold)
+
+    def with_(self, **kwargs) -> "Config":
+        """Functional update helper."""
+        return replace(self, **kwargs)

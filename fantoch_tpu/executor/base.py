@@ -1,0 +1,121 @@
+"""Executor interface: the pluggable ordering engine.
+
+Reference: fantoch/src/executor/mod.rs:27-183.  A protocol emits
+``ExecutionInfo`` values; an executor consumes them, decides when commands
+are safe to execute (total order, dependency order, timestamp stability...),
+runs them on the local KVStore and streams per-key ``ExecutorResult``s back
+to clients.  ``MessageKey`` routing hashes keys to executor indices so
+key-parallel executors scale across workers.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from enum import Enum
+from typing import Generic, Iterator, List, Optional, Tuple, TypeVar
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import ProcessId, Rifl, ShardId
+from fantoch_tpu.core.kvs import KVOpResult, Key
+from fantoch_tpu.core.timing import SysTime
+
+
+@dataclass(frozen=True)
+class ExecutorResult:
+    """Result of executing one key's ops of a command
+    (fantoch/src/executor/mod.rs:169-183)."""
+
+    rifl: Rifl
+    key: Key
+    op_results: Tuple[KVOpResult, ...]
+
+
+class ExecutorMetricsKind(Enum):
+    """Reference: fantoch/src/executor/mod.rs:123-145."""
+
+    EXECUTION_DELAY = "execution_delay"
+    CHAIN_SIZE = "chain_size"
+    OUT_REQUESTS = "out_requests"
+    IN_REQUESTS = "in_requests"
+    IN_REQUEST_REPLIES = "in_request_replies"
+
+
+# ExecutionInfo type produced by the protocol for this executor
+Info = TypeVar("Info")
+
+
+class Executor(ABC, Generic[Info]):
+    """Ordering engine interface (fantoch/src/executor/mod.rs:27-121).
+
+    Implementations: BasicExecutor (immediate), GraphExecutor (SCC/topo order
+    over the commit dependency graph — the TPU-accelerated one),
+    TableExecutor (timestamp stability), PredecessorsExecutor (Caesar
+    two-phase), SlotExecutor (total order by slot).
+    """
+
+    @abstractmethod
+    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config): ...
+
+    def set_executor_index(self, index: int) -> None:
+        """Executors are cloned per worker; each clone learns its index."""
+
+    def cleanup(self, time: SysTime) -> None:
+        """Periodic housekeeping (cross-shard request retries...)."""
+
+    def monitor_pending(self, time: SysTime) -> None:
+        """Liveness watchdog: check for stuck-but-satisfiable commands."""
+
+    @abstractmethod
+    def handle(self, info: Info, time: SysTime) -> None:
+        """Consume one ExecutionInfo from the protocol."""
+
+    @abstractmethod
+    def to_clients(self) -> Optional[ExecutorResult]:
+        """Pop one ready result (None when drained)."""
+
+    def to_clients_iter(self) -> Iterator[ExecutorResult]:
+        while True:
+            result = self.to_clients()
+            if result is None:
+                return
+            yield result
+
+    def to_executors(self) -> Optional[Tuple[ShardId, Info]]:
+        """Pop one cross-shard executor message (partial replication only)."""
+        return None
+
+    def to_executors_iter(self) -> Iterator[Tuple[ShardId, Info]]:
+        while True:
+            msg = self.to_executors()
+            if msg is None:
+                return
+            yield msg
+
+    def executed(self, time: SysTime):
+        """Committed-and-executed clock for GC (None if unsupported)."""
+        return None
+
+    @classmethod
+    def parallel(cls) -> bool:
+        """Whether this executor can run as multiple key-routed instances."""
+        return False
+
+    def metrics(self):
+        return getattr(self, "_metrics", None)
+
+    def monitor(self):
+        """Execution-order monitor (tests only)."""
+        return None
+
+
+class MessageKey:
+    """Key-based worker routing for execution infos
+    (fantoch/src/executor/mod.rs:147-166): route to
+    ``hash(key) % executors``."""
+
+    @staticmethod
+    def key_index(key: Key, executors: int) -> int:
+        from fantoch_tpu.utils import key_hash
+
+        return key_hash(key) % executors

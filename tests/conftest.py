@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip hardware is not available in CI; sharding tests run on a virtual
+8-device CPU mesh (jax.sharding semantics are identical; only perf differs).
+Must run before jax initializes its backends.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,230 @@
+"""Core-layer unit tests, mirroring the reference's colocated unit tests:
+config quorum formulas (fantoch/src/config.rs:449-537), id layout
+(fantoch/src/util.rs:196+), planet loading/sorting
+(fantoch/src/planet/mod.rs:180-301), kvs flow (fantoch/src/kvs.rs:71-138),
+histograms and command semantics.
+"""
+
+import pytest
+
+from fantoch_tpu.core import (
+    Command,
+    CommandResult,
+    Config,
+    Dot,
+    Histogram,
+    IdGen,
+    KVOp,
+    KVStore,
+    Planet,
+    Region,
+    Rifl,
+    SimTime,
+)
+from fantoch_tpu.core.ids import all_process_ids, process_ids
+from fantoch_tpu.utils import (
+    closest_process_per_shard,
+    key_hash,
+    sort_processes_by_distance,
+)
+
+
+# --- config quorum formulas (reference: fantoch/src/config.rs:449-537) ---
+
+
+def test_basic_parameters():
+    assert Config(7, 1).basic_quorum_size() == 2
+    assert Config(7, 2).basic_quorum_size() == 3
+    assert Config(7, 3).basic_quorum_size() == 4
+
+
+def test_atlas_parameters():
+    assert Config(7, 1).atlas_quorum_sizes() == (4, 2)
+    assert Config(7, 2).atlas_quorum_sizes() == (5, 3)
+    assert Config(7, 3).atlas_quorum_sizes() == (6, 4)
+
+
+def test_epaxos_parameters():
+    ns = [3, 5, 7, 9, 11, 13, 15, 17]
+    expected = [(2, 2), (3, 3), (5, 4), (6, 5), (8, 6), (9, 7), (11, 8), (12, 9)]
+    assert [Config(n, 0).epaxos_quorum_sizes() for n in ns] == expected
+
+
+def test_caesar_parameters():
+    ns = [3, 5, 7, 9, 11]
+    expected = [(3, 2), (4, 3), (6, 4), (7, 5), (9, 6)]
+    assert [Config(n, 0).caesar_quorum_sizes() for n in ns] == expected
+
+
+def test_newt_parameters():
+    assert Config(7, 1, newt_tiny_quorums=False).newt_quorum_sizes() == (4, 2, 4)
+    assert Config(7, 2, newt_tiny_quorums=False).newt_quorum_sizes() == (5, 3, 4)
+    assert Config(7, 1, newt_tiny_quorums=True).newt_quorum_sizes() == (2, 2, 6)
+    assert Config(7, 2, newt_tiny_quorums=True).newt_quorum_sizes() == (4, 3, 5)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        Config(3, 4)
+
+
+# --- ids (reference: fantoch/src/id.rs, fantoch/src/util.rs:196+) ---
+
+
+def test_process_id_layout():
+    assert list(process_ids(0, 3)) == [1, 2, 3]
+    assert list(process_ids(1, 3)) == [4, 5, 6]
+    assert list(all_process_ids(2, 2)) == [(1, 0), (2, 0), (3, 1), (4, 1)]
+
+
+def test_dot_target_shard():
+    n = 3
+    assert Dot(1, 10).target_shard(n) == 0
+    assert Dot(3, 10).target_shard(n) == 0
+    assert Dot(4, 10).target_shard(n) == 1
+    assert Dot(6, 10).target_shard(n) == 1
+
+
+def test_dot_ordering_and_packing():
+    assert Dot(1, 2) < Dot(1, 3) < Dot(2, 1)
+    d = Dot(200, 123456789)
+    assert Dot.unpack(d.packed()) == d
+
+
+def test_id_gen():
+    gen = IdGen(7)
+    assert gen.next_id() == Dot(7, 1)
+    assert gen.next_id() == Dot(7, 2)
+
+
+# --- kvs (reference: fantoch/src/kvs.rs:71-138) ---
+
+
+def test_kvs_flow():
+    store = KVStore()
+    rifl = Rifl(1, 1)
+    key = "key"
+    assert store.execute(key, KVOp.get(), rifl) is None
+    assert store.execute(key, KVOp.put("x"), rifl) is None
+    assert store.execute(key, KVOp.get(), rifl) == "x"
+    assert store.execute(key, KVOp.put("y"), rifl) == "x"
+    assert store.execute(key, KVOp.delete(), rifl) == "y"
+    assert store.execute(key, KVOp.get(), rifl) is None
+
+
+# --- commands ---
+
+
+def test_command_conflicts():
+    a = Command.from_single(Rifl(1, 1), 0, "k1", KVOp.put("v"))
+    b = Command.from_single(Rifl(1, 2), 0, "k1", KVOp.get())
+    c = Command.from_single(Rifl(1, 3), 0, "k2", KVOp.get())
+    assert a.conflicts(b)
+    assert not a.conflicts(c)
+    # same key on different shards does not conflict
+    d = Command.from_single(Rifl(1, 4), 1, "k1", KVOp.get())
+    assert not a.conflicts(d)
+
+
+def test_command_read_only():
+    ro = Command.from_keys(Rifl(1, 1), 0, {"a": (KVOp.get(),), "b": (KVOp.get(),)})
+    rw = Command.from_keys(Rifl(1, 2), 0, {"a": (KVOp.put("v"),), "b": (KVOp.delete(),)})
+    assert ro.read_only
+    assert not rw.read_only
+
+
+def test_command_result_aggregation():
+    rifl = Rifl(9, 1)
+    res = CommandResult(rifl, 2)
+    assert not res.add_partial("a", (None,))
+    assert not res.ready
+    assert res.add_partial("b", ("v",))
+    assert res.ready
+
+
+# --- planet (reference: fantoch/src/planet/mod.rs:180-301, dat.rs:124-154) ---
+
+
+def test_planet_gcp_dataset():
+    planet = Planet.new("gcp")
+    assert len(planet.regions()) == 20
+    w1, w2 = Region("us-west1"), Region("us-west2")
+    # floor of measured avg ping; intra-region latency is 0
+    assert planet.ping_latency(w1, w2) == 25
+    assert planet.ping_latency(w1, w1) == 0
+
+
+def test_planet_aws_dataset():
+    planet = Planet.new("aws")
+    assert len(planet.regions()) == 19
+    assert planet.ping_latency(Region("eu-west-1"), Region("eu-west-2")) == 10
+
+
+def test_planet_sorted_by_distance():
+    planet = Planet.new("gcp")
+    sorted_regions = planet.sorted_by_distance(Region("us-west1"))
+    # first entry is always the region itself at distance 0
+    assert sorted_regions[0] == (0, Region("us-west1"))
+    # distances ascend
+    dists = [d for d, _ in sorted_regions]
+    assert dists == sorted(dists)
+
+
+def test_planet_equidistant():
+    regions, planet = Planet.equidistant(10, 5)
+    assert len(regions) == 5
+    assert planet.ping_latency(regions[0], regions[1]) == 10
+    assert planet.ping_latency(regions[2], regions[2]) == 0
+
+
+def test_sort_processes_by_distance():
+    planet = Planet.new("gcp")
+    processes = [
+        (1, 0, Region("asia-east1")),
+        (2, 0, Region("us-west1")),
+        (3, 0, Region("europe-west3")),
+    ]
+    ordered = sort_processes_by_distance(Region("us-west1"), planet, processes)
+    assert ordered[0] == (2, 0)  # colocated first
+
+
+def test_closest_process_per_shard():
+    planet = Planet.new("gcp")
+    processes = [
+        (1, 0, Region("asia-east1")),
+        (2, 1, Region("us-west1")),
+        (3, 0, Region("us-west2")),
+        (4, 1, Region("europe-west3")),
+    ]
+    closest = closest_process_per_shard(Region("us-west1"), planet, processes)
+    assert closest == {1: 2, 0: 3}
+
+
+# --- misc ---
+
+
+def test_key_hash_stable():
+    assert key_hash("CONFLICT") == key_hash("CONFLICT")
+    assert key_hash("a") != key_hash("b")
+
+
+def test_sim_time_monotonic():
+    t = SimTime()
+    t.set_millis(10)
+    assert t.millis() == 10 and t.micros() == 10_000
+    with pytest.raises(AssertionError):
+        t.set_millis(5)
+
+
+def test_histogram():
+    h = Histogram()
+    for v in [1, 2, 2, 3, 100]:
+        h.increment(v)
+    assert h.count == 5
+    assert h.mean() == pytest.approx(21.6)
+    assert h.percentile(0.5) == 2
+    assert h.min() == 1 and h.max() == 100
+    h2 = Histogram()
+    h2.increment(7)
+    h.merge(h2)
+    assert h.count == 6
