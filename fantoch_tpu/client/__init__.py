@@ -1,0 +1,5 @@
+from fantoch_tpu.client.client import Client
+from fantoch_tpu.client.data import ClientData
+from fantoch_tpu.client.key_gen import CONFLICT_COLOR, ConflictRateKeyGen, KeyGen, KeyGenState, ZipfKeyGen
+from fantoch_tpu.client.pending import Pending
+from fantoch_tpu.client.workload import Workload

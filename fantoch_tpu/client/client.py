@@ -1,0 +1,81 @@
+"""Closed-loop client state machine.
+
+Reference: fantoch/src/client/mod.rs:27-170.  A client generates commands
+from its workload, targets the closest process of the target shard, and
+records end-to-end latency per command.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Tuple
+
+from fantoch_tpu.client.data import ClientData
+from fantoch_tpu.client.pending import Pending
+from fantoch_tpu.client.workload import Workload
+from fantoch_tpu.core.command import Command, CommandResult
+from fantoch_tpu.core.ids import ClientId, ProcessId, RiflGen, ShardId
+from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.utils import logger
+
+
+class Client:
+    def __init__(
+        self,
+        client_id: ClientId,
+        workload: Workload,
+        status_frequency: Optional[int] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        self._client_id = client_id
+        self._processes: Dict[ShardId, ProcessId] = {}
+        self._rifl_gen = RiflGen(client_id)
+        # each client gets its own copy of the workload progress counter
+        self._workload = dataclasses.replace(workload)
+        self._key_gen_state = workload.initial_key_gen_state(client_id, rng)
+        self._pending = Pending()
+        self._data = ClientData()
+        self._status_frequency = status_frequency
+
+    @property
+    def id(self) -> ClientId:
+        return self._client_id
+
+    def connect(self, processes: Dict[ShardId, ProcessId]) -> None:
+        """Learn the closest process of each shard."""
+        self._processes = processes
+
+    def shard_process(self, shard_id: ShardId) -> ProcessId:
+        return self._processes[shard_id]
+
+    def next_cmd(self, time: SysTime) -> Optional[Tuple[ShardId, Command]]:
+        nxt = self._workload.next_cmd(self._rifl_gen, self._key_gen_state)
+        if nxt is not None:
+            _, cmd = nxt
+            self._pending.start(cmd.rifl, time)
+        return nxt
+
+    def handle(self, cmd_results: List[CommandResult], time: SysTime) -> bool:
+        """Record completion of one command (possibly split over shards);
+        returns True once the whole workload is generated and completed."""
+        rifls = {r.rifl for r in cmd_results}
+        assert len(rifls) == 1, "all results must belong to the same rifl"
+        rifl = rifls.pop()
+        latency, end_time = self._pending.end(rifl, time)
+        self._data.record(latency, end_time)
+        if self._status_frequency and self._workload.issued_commands % self._status_frequency == 0:
+            logger.info(
+                "c%s: %s of %s",
+                self._client_id,
+                self._workload.issued_commands,
+                self._workload.commands_per_client,
+            )
+        return self._workload.finished() and self._pending.is_empty()
+
+    def data(self) -> ClientData:
+        return self._data
+
+    @property
+    def issued_commands(self) -> int:
+        return self._workload.issued_commands

@@ -1,0 +1,48 @@
+"""Per-client latency/throughput records.
+
+Reference: fantoch/src/client/data.rs:6-157 — a map from end-time (ms) to
+the latencies (µs) of commands that finished then, with merge/prune and
+latency & throughput iterators.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+
+class ClientData:
+    def __init__(self) -> None:
+        # end time (ms) -> list of latencies (µs)
+        self._data: Dict[int, List[int]] = {}
+
+    def record(self, latency_micros: int, end_time_millis: int) -> None:
+        self._data.setdefault(end_time_millis, []).append(latency_micros)
+
+    def merge(self, other: "ClientData") -> None:
+        for end_time, latencies in other._data.items():
+            self._data.setdefault(end_time, []).extend(latencies)
+
+    def prune(self, start_millis: int, end_millis: int) -> None:
+        """Keep only commands that ended within [start, end] (warmup/cooldown
+        trimming in experiments)."""
+        self._data = {
+            t: ls for t, ls in self._data.items() if start_millis <= t <= end_millis
+        }
+
+    def latency_data(self) -> Iterator[int]:
+        """All latencies in µs."""
+        for latencies in self._data.values():
+            yield from latencies
+
+    def throughput_data(self) -> Iterator[Tuple[int, int]]:
+        """(end_time_ms, commands finished at that ms)."""
+        for end_time in sorted(self._data):
+            yield end_time, len(self._data[end_time])
+
+    def start_and_end(self) -> Tuple[int, int]:
+        assert self._data, "no data recorded"
+        times = self._data.keys()
+        return min(times), max(times)
+
+    def command_count(self) -> int:
+        return sum(len(ls) for ls in self._data.values())

@@ -84,6 +84,10 @@ class Command:
         """Keys accessed on a given shard (fantoch/src/command.rs:97-103)."""
         return iter(self._shard_to_ops.get(shard_id, {}).keys())
 
+    def iter_ops(self, shard_id: ShardId) -> Iterator[Tuple[Key, Tuple[KVOp, ...]]]:
+        """(key, ops) pairs for one shard (fantoch/src/command.rs into_iter)."""
+        return iter(self._shard_to_ops.get(shard_id, {}).items())
+
     def all_keys(self) -> Iterator[Tuple[ShardId, Key]]:
         for shard_id, ops in self._shard_to_ops.items():
             for key in ops:
@@ -147,6 +151,10 @@ class CommandResult:
         assert key not in self._results, f"duplicate partial result for {key}"
         self._results[key] = result
         return self.ready
+
+    def increment_key_count(self, by: int = 1) -> None:
+        """Raise the number of expected partials (fantoch/src/command.rs:203)."""
+        self._key_count += by
 
     @property
     def ready(self) -> bool:

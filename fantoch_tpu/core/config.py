@@ -31,10 +31,11 @@ class Config:
     # (skipping the executor's ordering) — only safe for benchmarks
     execute_at_commit: bool = False
     # interval at which executors inform workers of executed commands
-    # (drives dot-based GC); None disables the notification
-    executor_executed_notification_interval_ms: Optional[int] = None
+    # (drives dot-based GC); None disables the notification (default 5ms,
+    # fantoch/src/config.rs:58-61)
+    executor_executed_notification_interval_ms: Optional[int] = 5
     # interval at which executors clean up / retry cross-shard requests
-    executor_cleanup_interval_ms: Optional[int] = None
+    executor_cleanup_interval_ms: Optional[int] = 5
     # interval at which executors check for stuck commands (liveness watchdog)
     executor_monitor_pending_interval_ms: Optional[int] = None
     # record per-key execution order for agreement checks in tests

@@ -1,0 +1,4 @@
+from fantoch_tpu.executor.aggregate import AggregatePending
+from fantoch_tpu.executor.base import Executor, ExecutorMetricsKind, ExecutorResult, MessageKey
+from fantoch_tpu.executor.basic import BasicExecutionInfo, BasicExecutor
+from fantoch_tpu.executor.monitor import ExecutionOrderMonitor

@@ -1,0 +1,49 @@
+"""Aggregation of per-key executor results into full command results.
+
+Reference: fantoch/src/executor/aggregate.rs:9-98.  The server side of the
+client plane: a command touching k keys produces k partial results (possibly
+from different key-parallel executors); the pending tracker releases one
+``CommandResult`` once all partials arrive.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from fantoch_tpu.core.command import Command, CommandResult
+from fantoch_tpu.core.ids import ProcessId, Rifl, ShardId
+from fantoch_tpu.executor.base import ExecutorResult
+
+
+class AggregatePending:
+    def __init__(self, process_id: ProcessId, shard_id: ShardId):
+        self._process_id = process_id
+        self._shard_id = shard_id
+        self._pending: Dict[Rifl, CommandResult] = {}
+
+    def wait_for(self, cmd: Command) -> bool:
+        """Track a command submitted by a connected client."""
+        rifl = cmd.rifl
+        key_count = cmd.key_count(self._shard_id)
+        existed = rifl in self._pending
+        self._pending[rifl] = CommandResult(rifl, key_count)
+        return not existed
+
+    def wait_for_rifl(self, rifl: Rifl) -> None:
+        """Increase expected partials for `rifl` by one (used by executors
+        that produce one notification per key without seeing the command)."""
+        result = self._pending.get(rifl)
+        if result is None:
+            result = CommandResult(rifl, 0)
+            self._pending[rifl] = result
+        result.increment_key_count()
+
+    def add_executor_result(self, executor_result: ExecutorResult) -> Optional[CommandResult]:
+        """Add one partial; returns the CommandResult once complete.  Partials
+        for unknown rifls are ignored (clients of other processes)."""
+        cmd_result = self._pending.get(executor_result.rifl)
+        if cmd_result is None:
+            return None
+        if cmd_result.add_partial(executor_result.key, executor_result.op_results):
+            return self._pending.pop(executor_result.rifl)
+        return None

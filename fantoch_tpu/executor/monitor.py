@@ -1,0 +1,46 @@
+"""Execution-order monitor: records per-key execution order so tests can
+assert that all processes agree (the linearizable-agreement check).
+
+Reference: fantoch/src/executor/monitor.rs:8-58.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+from fantoch_tpu.core.ids import Rifl
+from fantoch_tpu.core.kvs import Key
+
+
+class ExecutionOrderMonitor:
+    def __init__(self) -> None:
+        self._order_per_key: Dict[Key, List[Rifl]] = {}
+
+    def add(self, key: Key, rifl: Rifl) -> None:
+        self._order_per_key.setdefault(key, []).append(rifl)
+
+    def merge(self, other: "ExecutionOrderMonitor") -> None:
+        """Merge a disjoint-key monitor (multiple key-parallel executors)."""
+        for key, rifls in other._order_per_key.items():
+            assert key not in self._order_per_key, (
+                "different monitors should operate on different keys"
+            )
+            self._order_per_key[key] = rifls
+
+    def get_order(self, key: Key) -> Optional[List[Rifl]]:
+        return self._order_per_key.get(key)
+
+    def keys(self) -> Iterator[Key]:
+        return iter(self._order_per_key.keys())
+
+    def __len__(self) -> int:
+        return len(self._order_per_key)
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, ExecutionOrderMonitor)
+            and self._order_per_key == other._order_per_key
+        )
+
+    def __repr__(self) -> str:
+        return f"ExecutionOrderMonitor({self._order_per_key})"

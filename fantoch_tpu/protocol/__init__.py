@@ -1,0 +1,12 @@
+from fantoch_tpu.protocol.base import (
+    Action,
+    BaseProcess,
+    Executed,
+    Protocol,
+    ProtocolMetricsKind,
+    ToForward,
+    ToSend,
+)
+from fantoch_tpu.protocol.basic import Basic
+from fantoch_tpu.protocol.gc import GCTrack
+from fantoch_tpu.protocol.info import CommandsInfo

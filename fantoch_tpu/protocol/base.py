@@ -1,0 +1,257 @@
+"""Protocol interface: pure state machines with pulled outputs.
+
+Reference: fantoch/src/protocol/mod.rs:42-186.  A protocol handles submits,
+messages and periodic events, and exposes two output queues that drivers
+pull: ``to_processes`` (network actions) and ``to_executors`` (execution
+info for the ordering engine).  ``BaseProcess``
+(fantoch/src/protocol/base.rs) carries the plumbing shared by all
+protocols: quorums from a distance-sorted process list, dot generation, and
+fast/slow/stable metrics.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import (
+    Any,
+    Dict,
+    FrozenSet,
+    Generic,
+    Iterator,
+    List,
+    Optional,
+    Set,
+    Tuple,
+    TypeVar,
+)
+
+from fantoch_tpu.core.clocks import AEClock
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Dot, IdGen, ProcessId, ShardId
+from fantoch_tpu.core.metrics import Metrics
+from fantoch_tpu.core.timing import SysTime
+
+# Compact representation of which dots have been executed
+# (fantoch/src/protocol/mod.rs:40).
+Executed = AEClock[ProcessId]
+
+
+class ProtocolMetricsKind(Enum):
+    """Reference: fantoch/src/protocol/mod.rs:147-161."""
+
+    FAST_PATH = "fast_path"
+    SLOW_PATH = "slow_path"
+    STABLE = "stable"
+
+
+ProtocolMetrics = Metrics  # keyed by ProtocolMetricsKind
+
+Msg = TypeVar("Msg")
+
+
+@dataclass
+class ToSend(Generic[Msg]):
+    """Send `msg` to every process in `target`
+    (fantoch/src/protocol/mod.rs:177-182)."""
+
+    target: Set[ProcessId]
+    msg: Msg
+
+
+@dataclass
+class ToForward(Generic[Msg]):
+    """Forward `msg` to another worker of the same process
+    (fantoch/src/protocol/mod.rs:183-185)."""
+
+    msg: Msg
+
+
+Action = Any  # ToSend | ToForward
+
+
+class Protocol(ABC):
+    """Protocol state-machine interface (fantoch/src/protocol/mod.rs:42-112).
+
+    Subclasses must also define, for the runner's worker routing, a
+    ``message_index(msg)`` / ``event_index(event)`` pair returning
+    :data:`fantoch_tpu.run.routing.WorkerIndex` values.
+    """
+
+    # Executor class used by this protocol
+    Executor: type
+
+    @abstractmethod
+    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config): ...
+
+    @classmethod
+    def new(
+        cls, process_id: ProcessId, shard_id: ShardId, config: Config
+    ) -> Tuple["Protocol", List[Tuple[Any, int]]]:
+        """Create a protocol instance plus its periodic events
+        ``[(event, interval_ms)]``."""
+        protocol = cls(process_id, shard_id, config)
+        return protocol, protocol.periodic_events()
+
+    def periodic_events(self) -> List[Tuple[Any, int]]:
+        return []
+
+    @property
+    @abstractmethod
+    def id(self) -> ProcessId: ...
+
+    @property
+    @abstractmethod
+    def shard_id(self) -> ShardId: ...
+
+    @abstractmethod
+    def discover(
+        self, processes: List[Tuple[ProcessId, ShardId]]
+    ) -> Tuple[bool, Dict[ShardId, ProcessId]]: ...
+
+    @abstractmethod
+    def submit(self, dot: Optional[Dot], cmd: Command, time: SysTime) -> None: ...
+
+    @abstractmethod
+    def handle(
+        self, from_: ProcessId, from_shard_id: ShardId, msg: Any, time: SysTime
+    ) -> None: ...
+
+    def handle_event(self, event: Any, time: SysTime) -> None:
+        raise NotImplementedError(f"unhandled periodic event {event}")
+
+    def handle_executed(self, executed: Executed, time: SysTime) -> None:
+        """Notification of executed dots (GC worker only); default no-op."""
+
+    @abstractmethod
+    def to_processes(self) -> Optional[Action]: ...
+
+    def to_processes_iter(self) -> Iterator[Action]:
+        while True:
+            action = self.to_processes()
+            if action is None:
+                return
+            yield action
+
+    @abstractmethod
+    def to_executors(self) -> Optional[Any]: ...
+
+    def to_executors_iter(self) -> Iterator[Any]:
+        while True:
+            info = self.to_executors()
+            if info is None:
+                return
+            yield info
+
+    @classmethod
+    def parallel(cls) -> bool: ...
+
+    @classmethod
+    def leaderless(cls) -> bool: ...
+
+    @abstractmethod
+    def metrics(self) -> ProtocolMetrics: ...
+
+    # --- worker routing (MessageIndex trait, fantoch/src/protocol/mod.rs:163) ---
+
+    @staticmethod
+    def message_index(msg: Any):
+        """Worker index for a message; None broadcasts to all workers."""
+        return getattr(msg, "INDEX", None)
+
+    @staticmethod
+    def event_index(event: Any):
+        return getattr(event, "INDEX", None)
+
+
+class BaseProcess:
+    """Shared protocol plumbing (fantoch/src/protocol/base.rs:10-199)."""
+
+    def __init__(
+        self,
+        process_id: ProcessId,
+        shard_id: ShardId,
+        config: Config,
+        fast_quorum_size: int,
+        write_quorum_size: int,
+    ):
+        # ballots lead with `id` on the slow path and accepted-ballot 0 means
+        # "never been through phase-2", so ids must be non-zero
+        assert process_id != 0
+        self.process_id = process_id
+        self.shard_id = shard_id
+        self.config = config
+        self.fast_quorum_size = fast_quorum_size
+        self.write_quorum_size = write_quorum_size
+        self._all: Optional[List[ProcessId]] = None
+        self._all_but_me: Optional[List[ProcessId]] = None
+        self._fast_quorum: Optional[List[ProcessId]] = None
+        self._write_quorum: Optional[List[ProcessId]] = None
+        self._closest_shard_process: Dict[ShardId, ProcessId] = {}
+        self._dot_gen = IdGen(process_id)
+        self._metrics: Metrics = Metrics()
+
+    def discover(self, all_processes: List[Tuple[ProcessId, ShardId]]) -> bool:
+        """Learn the (distance-sorted) process list; quorums are the closest
+        `fast_quorum_size` / `write_quorum_size` same-shard processes.
+
+        Reference: fantoch/src/protocol/base.rs:59-131.
+        """
+        self._closest_shard_process = {}
+        processes: List[ProcessId] = []
+        for process_id, shard_id in all_processes:
+            if shard_id == self.shard_id:
+                processes.append(process_id)
+            else:
+                # must be the closest process of that shard
+                assert shard_id not in self._closest_shard_process, (
+                    "process should only connect to the closest process of each shard"
+                )
+                self._closest_shard_process[shard_id] = process_id
+
+        fast = processes[: self.fast_quorum_size]
+        write = processes[: self.write_quorum_size]
+        self._all = list(processes)
+        self._all_but_me = [p for p in processes if p != self.process_id]
+        self._fast_quorum = fast if len(fast) == self.fast_quorum_size else None
+        self._write_quorum = write if len(write) == self.write_quorum_size else None
+        return self._fast_quorum is not None and self._write_quorum is not None
+
+    def next_dot(self) -> Dot:
+        return self._dot_gen.next_id()
+
+    def all(self) -> Set[ProcessId]:
+        assert self._all is not None, "the set of all processes should be known"
+        return set(self._all)
+
+    def all_but_me(self) -> Set[ProcessId]:
+        assert self._all_but_me is not None
+        return set(self._all_but_me)
+
+    def fast_quorum(self) -> Set[ProcessId]:
+        assert self._fast_quorum is not None, "the fast quorum should be known"
+        return set(self._fast_quorum)
+
+    def write_quorum(self) -> Set[ProcessId]:
+        assert self._write_quorum is not None, "the write quorum should be known"
+        return set(self._write_quorum)
+
+    def closest_process(self, shard_id: ShardId) -> ProcessId:
+        return self._closest_shard_process[shard_id]
+
+    def closest_shard_process(self) -> Dict[ShardId, ProcessId]:
+        return self._closest_shard_process
+
+    def metrics(self) -> Metrics:
+        return self._metrics
+
+    def fast_path(self) -> None:
+        self._metrics.aggregate(ProtocolMetricsKind.FAST_PATH, 1)
+
+    def slow_path(self) -> None:
+        self._metrics.aggregate(ProtocolMetricsKind.SLOW_PATH, 1)
+
+    def stable(self, count: int) -> None:
+        self._metrics.aggregate(ProtocolMetricsKind.STABLE, count)

@@ -1,0 +1,228 @@
+"""Basic protocol: store at f+1 replicas, then commit.
+
+Reference: fantoch/src/protocol/basic.rs:20-395.  Deliberately inconsistent
+(no real consensus) — it exists to exercise the full machinery end-to-end:
+submit -> MStore to fast quorum -> f+1 MStoreAck -> MCommit to all ->
+per-key execution info, plus the complete GC message set
+(MCommitDot/MGarbageCollection/MStable) shared by all leaderless protocols.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from fantoch_tpu.core.clocks import VClock
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
+from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.executor.basic import BasicExecutionInfo, BasicExecutor
+from fantoch_tpu.protocol.base import (
+    Action,
+    BaseProcess,
+    Protocol,
+    ProtocolMetrics,
+    ToForward,
+    ToSend,
+)
+from fantoch_tpu.protocol.gc import GCTrack
+from fantoch_tpu.protocol.info import CommandsInfo
+from fantoch_tpu.run.routing import (
+    GC_WORKER_INDEX,
+    worker_dot_index_shift,
+    worker_index_no_shift,
+)
+
+
+# --- messages ---
+
+
+@dataclass
+class MStore:
+    dot: Dot
+    cmd: Command
+
+
+@dataclass
+class MStoreAck:
+    dot: Dot
+
+
+@dataclass
+class MCommit:
+    dot: Dot
+    cmd: Command
+
+
+@dataclass
+class MCommitDot:
+    dot: Dot
+
+
+@dataclass
+class MGarbageCollection:
+    committed: VClock
+
+
+@dataclass
+class MStable:
+    stable: List[Tuple[ProcessId, int, int]]
+
+
+@dataclass
+class GarbageCollectionEvent:
+    """Periodic event triggering a GC round."""
+
+
+@dataclass
+class BasicInfo:
+    """Per-dot lifecycle info (basic.rs:318-341)."""
+
+    cmd: Optional[Command] = None
+    acks: Set[ProcessId] = field(default_factory=set)
+
+
+class Basic(Protocol):
+    Executor = BasicExecutor
+
+    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
+        fast_quorum_size = config.basic_quorum_size()
+        write_quorum_size = 0  # 100% fast paths: no write quorum
+        self.bp = BaseProcess(process_id, shard_id, config, fast_quorum_size, write_quorum_size)
+        self._cmds: CommandsInfo[BasicInfo] = CommandsInfo(
+            process_id,
+            shard_id,
+            config,
+            fast_quorum_size,
+            write_quorum_size,
+            lambda *_: BasicInfo(),
+        )
+        self._gc_track = GCTrack(process_id, shard_id, config.n)
+        self._to_processes: deque = deque()
+        self._to_executors: deque = deque()
+
+    def periodic_events(self):
+        if self.bp.config.gc_interval_ms is not None:
+            return [(GarbageCollectionEvent(), self.bp.config.gc_interval_ms)]
+        return []
+
+    @property
+    def id(self) -> ProcessId:
+        return self.bp.process_id
+
+    @property
+    def shard_id(self) -> ShardId:
+        return self.bp.shard_id
+
+    def discover(self, processes):
+        connect_ok = self.bp.discover(processes)
+        return connect_ok, dict(self.bp.closest_shard_process())
+
+    def submit(self, dot: Optional[Dot], cmd: Command, time: SysTime) -> None:
+        dot = dot if dot is not None else self.bp.next_dot()
+        self._to_processes.append(ToSend(self.bp.fast_quorum(), MStore(dot, cmd)))
+
+    def handle(self, from_, from_shard_id, msg, time):
+        if isinstance(msg, MStore):
+            self._handle_mstore(from_, msg.dot, msg.cmd)
+        elif isinstance(msg, MStoreAck):
+            self._handle_mstoreack(from_, msg.dot)
+        elif isinstance(msg, MCommit):
+            self._handle_mcommit(from_, msg.dot, msg.cmd)
+        elif isinstance(msg, MCommitDot):
+            self._handle_mcommit_dot(from_, msg.dot)
+        elif isinstance(msg, MGarbageCollection):
+            self._handle_mgc(from_, msg.committed)
+        elif isinstance(msg, MStable):
+            self._handle_mstable(from_, msg.stable)
+        else:
+            raise AssertionError(f"unknown message {msg}")
+
+    def handle_event(self, event, time):
+        assert isinstance(event, GarbageCollectionEvent)
+        self._handle_event_garbage_collection()
+
+    def to_processes(self) -> Optional[Action]:
+        return self._to_processes.popleft() if self._to_processes else None
+
+    def to_executors(self):
+        return self._to_executors.popleft() if self._to_executors else None
+
+    @classmethod
+    def parallel(cls) -> bool:
+        return True
+
+    @classmethod
+    def leaderless(cls) -> bool:
+        return True
+
+    def metrics(self) -> ProtocolMetrics:
+        return self.bp.metrics()
+
+    # --- handlers ---
+
+    def _handle_mstore(self, from_: ProcessId, dot: Dot, cmd: Command) -> None:
+        info = self._cmds.get(dot)
+        info.cmd = cmd
+        self._to_processes.append(ToSend({from_}, MStoreAck(dot)))
+
+    def _handle_mstoreack(self, from_: ProcessId, dot: Dot) -> None:
+        info = self._cmds.get(dot)
+        info.acks.add(from_)
+        if len(info.acks) == self.bp.config.basic_quorum_size():
+            assert info.cmd is not None, "command should exist"
+            self._to_processes.append(ToSend(self.bp.all(), MCommit(dot, info.cmd)))
+
+    def _handle_mcommit(self, _from: ProcessId, dot: Dot, cmd: Command) -> None:
+        info = self._cmds.get(dot)
+        info.cmd = cmd
+        # one execution info per key: lets the basic executor run key-parallel
+        rifl = cmd.rifl
+        for key, ops in cmd.iter_ops(self.bp.shard_id):
+            self._to_executors.append(BasicExecutionInfo(rifl, key, ops))
+        if self._gc_running():
+            self._to_processes.append(ToForward(MCommitDot(dot)))
+        else:
+            self._cmds.gc_single(dot)
+
+    def _handle_mcommit_dot(self, from_: ProcessId, dot: Dot) -> None:
+        assert from_ == self.bp.process_id
+        self._gc_track.add_to_clock(dot)
+
+    def _handle_mgc(self, from_: ProcessId, committed: VClock) -> None:
+        self._gc_track.update_clock_of(from_, committed)
+        stable = self._gc_track.stable()
+        if stable:
+            self._to_processes.append(ToForward(MStable(stable)))
+
+    def _handle_mstable(self, from_: ProcessId, stable) -> None:
+        assert from_ == self.bp.process_id
+        stable_count = self._cmds.gc(stable)
+        self.bp.stable(stable_count)
+
+    def _handle_event_garbage_collection(self) -> None:
+        committed = self._gc_track.clock()
+        self._to_processes.append(
+            ToSend(self.bp.all_but_me(), MGarbageCollection(committed))
+        )
+
+    def _gc_running(self) -> bool:
+        return self.bp.config.gc_interval_ms is not None
+
+    # --- worker routing (basic.rs:354-384) ---
+
+    @staticmethod
+    def message_index(msg):
+        if isinstance(msg, (MStore, MStoreAck, MCommit)):
+            return worker_dot_index_shift(msg.dot)
+        if isinstance(msg, (MCommitDot, MGarbageCollection)):
+            return worker_index_no_shift(GC_WORKER_INDEX)
+        if isinstance(msg, MStable):
+            return None  # broadcast to all workers
+        raise AssertionError(f"unknown message {msg}")
+
+    @staticmethod
+    def event_index(event):
+        return worker_index_no_shift(GC_WORKER_INDEX)

@@ -1,0 +1,3 @@
+from fantoch_tpu.sim.runner import Runner
+from fantoch_tpu.sim.schedule import Schedule
+from fantoch_tpu.sim.simulation import Simulation

@@ -1,0 +1,308 @@
+"""Deterministic discrete-event simulator over Planet latencies.
+
+Reference: fantoch/src/sim/runner.rs:33-700.  Processes live in regions;
+message delivery takes half the ping latency between regions; periodic
+events (protocol events + executor executed-notifications) are rescheduled
+forever, so the loop ends when clients finish (plus optional extra time).
+Optional adversity: symmetric distances, and random message reordering
+(delivery delay multiplied by U(0, 10)) to stress executor ordering.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from fantoch_tpu.client.client import Client
+from fantoch_tpu.client.workload import Workload
+from fantoch_tpu.core.command import Command, CommandResult
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import ClientId, ProcessId, ShardId, process_ids
+from fantoch_tpu.core.metrics import Histogram, Metrics
+from fantoch_tpu.core.planet import Planet, Region
+from fantoch_tpu.executor.monitor import ExecutionOrderMonitor
+from fantoch_tpu.protocol.base import Protocol, ToForward, ToSend
+from fantoch_tpu.sim.schedule import Schedule
+from fantoch_tpu.sim.simulation import Simulation
+from fantoch_tpu.utils import closest_process_per_shard, sort_processes_by_distance
+
+
+# schedule actions (runner.rs:20-26)
+@dataclass
+class SubmitToProc:
+    process_id: ProcessId
+    cmd: Command
+
+
+@dataclass
+class SendToProc:
+    from_: ProcessId
+    from_shard_id: ShardId
+    to: ProcessId
+    msg: Any
+
+
+@dataclass
+class SendToClient:
+    client_id: ClientId
+    cmd_result: CommandResult
+
+
+@dataclass
+class PeriodicProcessEvent:
+    process_id: ProcessId
+    event: Any
+    delay_ms: int
+
+
+@dataclass
+class PeriodicExecutedNotification:
+    process_id: ProcessId
+    delay_ms: int
+
+
+class Runner:
+    def __init__(
+        self,
+        protocol_cls: type,
+        planet: Planet,
+        config: Config,
+        workload: Workload,
+        clients_per_process: int,
+        process_regions: List[Region],
+        client_regions: List[Region],
+        seed: Optional[int] = None,
+    ):
+        assert len(process_regions) == config.n, "one region per process"
+        assert config.gc_interval_ms is not None, "sim requires gc running"
+        self._protocol_cls = protocol_cls
+        self._planet = planet
+        self._config = config
+        self._simulation = Simulation()
+        self._schedule: Schedule = Schedule()
+        self._rng = random.Random(seed)
+        self._make_distances_symmetric = False
+        self._reorder_messages = False
+
+        # a single shard in simulation
+        shard_id = 0
+        to_discover: List[Tuple[ProcessId, ShardId, Region]] = []
+        processes: List[Tuple[Region, Protocol]] = []
+        periodic_events: List[Tuple[ProcessId, Any, int]] = []
+        periodic_executed: List[Tuple[ProcessId, int]] = []
+        for region, process_id in zip(process_regions, process_ids(shard_id, config.n)):
+            process, events = protocol_cls.new(process_id, shard_id, config)
+            processes.append((region, process))
+            periodic_events.extend((process_id, ev, delay) for ev, delay in events)
+            interval = config.executor_executed_notification_interval_ms
+            if interval is not None:
+                periodic_executed.append((process_id, interval))
+            to_discover.append((process_id, shard_id, region))
+
+        self._process_to_region: Dict[ProcessId, Region] = {
+            pid: region for pid, _, region in to_discover
+        }
+
+        # register processes (discover with distance-sorted lists)
+        for region, process in processes:
+            sorted_processes = sort_processes_by_distance(region, planet, to_discover)
+            connect_ok, _ = process.discover(sorted_processes)
+            assert connect_ok
+            executor = protocol_cls.Executor(process.id, process.shard_id, config)
+            self._simulation.register_process(process, executor)
+
+        # register clients
+        client_id = 0
+        self._client_to_region: Dict[ClientId, Region] = {}
+        for region in client_regions:
+            for _ in range(clients_per_process):
+                client_id += 1
+                client = Client(client_id, workload, rng=random.Random(self._rng.random()))
+                closest = closest_process_per_shard(region, planet, to_discover)
+                client.connect(closest)
+                self._simulation.register_client(client)
+                self._client_to_region[client_id] = region
+        self._client_count = client_id
+
+        # schedule periodic events
+        for process_id, event, delay in periodic_events:
+            self._schedule.schedule(
+                self._simulation.time, delay, PeriodicProcessEvent(process_id, event, delay)
+            )
+        for process_id, delay in periodic_executed:
+            self._schedule.schedule(
+                self._simulation.time, delay, PeriodicExecutedNotification(process_id, delay)
+            )
+
+    # --- adversity knobs (runner.rs:192-198) ---
+
+    def make_distances_symmetric(self) -> None:
+        self._make_distances_symmetric = True
+
+    def reorder_messages(self) -> None:
+        self._reorder_messages = True
+
+    # --- main loop ---
+
+    def run(
+        self, extra_sim_time_ms: Optional[int] = None
+    ) -> Tuple[
+        Dict[ProcessId, Metrics],
+        Dict[ProcessId, Optional[ExecutionOrderMonitor]],
+        Dict[Region, Tuple[int, Histogram]],
+    ]:
+        """Run to completion; returns (process metrics, executor monitors,
+        per-region (issued commands, latency histogram ms))."""
+        for client_id, process_id, cmd in self._simulation.start_clients():
+            self._schedule_submit(("client", client_id), process_id, cmd)
+        self._simulation_loop(extra_sim_time_ms)
+        return (
+            {pid: p.metrics() for pid, (p, _, _) in self._simulation.processes()},
+            {pid: e.monitor() for pid, (_, e, _) in self._simulation.processes()},
+            self._clients_latencies(),
+        )
+
+    def _simulation_loop(self, extra_sim_time_ms: Optional[int]) -> None:
+        clients_done = 0
+        extra_phase = False
+        final_time = 0
+        while True:
+            action = self._schedule.next_action(self._simulation.time)
+            assert action is not None, "there should be a next action (periodics always run)"
+            if isinstance(action, PeriodicProcessEvent):
+                self._handle_periodic_process_event(action)
+            elif isinstance(action, PeriodicExecutedNotification):
+                self._handle_periodic_executed_notification(action)
+            elif isinstance(action, SubmitToProc):
+                self._handle_submit_to_proc(action.process_id, action.cmd)
+            elif isinstance(action, SendToProc):
+                self._handle_send_to_proc(action.from_, action.from_shard_id, action.to, action.msg)
+            elif isinstance(action, SendToClient):
+                submit = self._simulation.forward_to_client(action.cmd_result)
+                if submit is not None:
+                    process_id, cmd = submit
+                    self._schedule_submit(("client", action.client_id), process_id, cmd)
+                else:
+                    clients_done += 1
+                    if clients_done == self._client_count:
+                        if extra_sim_time_ms is None:
+                            return
+                        extra_phase = True
+                        final_time = self._simulation.time.millis() + extra_sim_time_ms
+            else:
+                raise AssertionError(f"unknown action {action}")
+            if extra_phase and self._simulation.time.millis() > final_time:
+                return
+
+    # --- handlers ---
+
+    def _handle_periodic_process_event(self, ev: PeriodicProcessEvent) -> None:
+        process, _, _ = self._simulation.get_process(ev.process_id)
+        process.handle_event(ev.event, self._simulation.time)
+        self._send_to_processes_and_executors(ev.process_id)
+        self._schedule.schedule(self._simulation.time, ev.delay_ms, ev)
+
+    def _handle_periodic_executed_notification(self, ev: PeriodicExecutedNotification) -> None:
+        process, executor, _ = self._simulation.get_process(ev.process_id)
+        executed = executor.executed(self._simulation.time)
+        if executed is not None:
+            process.handle_executed(executed, self._simulation.time)
+            self._send_to_processes_and_executors(ev.process_id)
+        self._schedule.schedule(self._simulation.time, ev.delay_ms, ev)
+
+    def _handle_submit_to_proc(self, process_id: ProcessId, cmd: Command) -> None:
+        process, _, pending = self._simulation.get_process(process_id)
+        pending.wait_for(cmd)
+        process.submit(None, cmd, self._simulation.time)
+        self._send_to_processes_and_executors(process_id)
+
+    def _handle_send_to_proc(
+        self, from_: ProcessId, from_shard_id: ShardId, to: ProcessId, msg: Any
+    ) -> None:
+        process, _, _ = self._simulation.get_process(to)
+        process.handle(from_, from_shard_id, msg, self._simulation.time)
+        self._send_to_processes_and_executors(to)
+
+    def _send_to_processes_and_executors(self, process_id: ProcessId) -> None:
+        """Drain a process's outputs: schedule network actions, feed execution
+        infos to the executor, complete pending commands
+        (runner.rs:396-435)."""
+        process, executor, pending = self._simulation.get_process(process_id)
+        shard_id = process.shard_id
+        protocol_actions = list(process.to_processes_iter())
+        ready: List[CommandResult] = []
+        for info in process.to_executors_iter():
+            executor.handle(info, self._simulation.time)
+            for executor_result in executor.to_clients_iter():
+                cmd_result = pending.add_executor_result(executor_result)
+                if cmd_result is not None:
+                    ready.append(cmd_result)
+        self._schedule_protocol_actions(process_id, shard_id, protocol_actions)
+        for cmd_result in ready:
+            self._schedule_to_client(("process", process_id), cmd_result)
+
+    def _schedule_protocol_actions(
+        self, process_id: ProcessId, shard_id: ShardId, actions: List[Any]
+    ) -> None:
+        for action in actions:
+            if isinstance(action, ToSend):
+                for to in action.target:
+                    if to == process_id:
+                        # message to self: deliver immediately
+                        self._handle_send_to_proc(process_id, shard_id, process_id, action.msg)
+                    else:
+                        self._schedule_message(
+                            ("process", process_id),
+                            ("process", to),
+                            SendToProc(process_id, shard_id, to, action.msg),
+                        )
+            elif isinstance(action, ToForward):
+                # forwards are worker-to-worker: deliver immediately
+                self._handle_send_to_proc(process_id, shard_id, process_id, action.msg)
+            else:
+                raise AssertionError(f"unknown action {action}")
+
+    def _schedule_submit(self, from_region_key, process_id: ProcessId, cmd: Command) -> None:
+        self._schedule_message(
+            from_region_key, ("process", process_id), SubmitToProc(process_id, cmd)
+        )
+
+    def _schedule_to_client(self, from_region_key, cmd_result: CommandResult) -> None:
+        client_id = cmd_result.rifl.source
+        self._schedule_message(
+            from_region_key, ("client", client_id), SendToClient(client_id, cmd_result)
+        )
+
+    def _schedule_message(self, from_key, to_key, action: Any) -> None:
+        distance = self._distance(self._region_of(from_key), self._region_of(to_key))
+        if self._reorder_messages:
+            distance = int(distance * self._rng.uniform(0.0, 10.0))
+        self._schedule.schedule(self._simulation.time, distance, action)
+
+    def _region_of(self, key) -> Region:
+        kind, id_ = key
+        if kind == "process":
+            return self._process_to_region[id_]
+        return self._client_to_region[id_]
+
+    def _distance(self, from_: Region, to: Region) -> int:
+        """Distance = half the ping latency (runner.rs:568-589)."""
+        ping = self._planet.ping_latency(from_, to)
+        assert ping is not None, "both regions should exist on the planet"
+        if self._make_distances_symmetric:
+            back = self._planet.ping_latency(to, from_)
+            assert back is not None
+            ping = (ping + back) // 2
+        return ping // 2
+
+    def _clients_latencies(self) -> Dict[Region, Tuple[int, Histogram]]:
+        out: Dict[Region, Tuple[int, Histogram]] = {}
+        for client_id, region in self._client_to_region.items():
+            client = self._simulation.get_client(client_id)
+            commands, histogram = out.setdefault(region, (0, Histogram()))
+            commands += client.issued_commands
+            for latency_micros in client.data().latency_data():
+                histogram.increment(latency_micros // 1000)  # ms precision (WAN)
+            out[region] = (commands, histogram)
+        return out

@@ -1,0 +1,93 @@
+"""Clock lattice + GC tracking tests (reference: threshold crate semantics,
+fantoch/src/protocol/gc.rs:145-224)."""
+
+from fantoch_tpu.core.clocks import AboveExSet, AEClock, VClock
+from fantoch_tpu.core.ids import Dot
+from fantoch_tpu.protocol.gc import GCTrack
+
+
+def test_above_ex_set():
+    s = AboveExSet()
+    assert not s.contains(1)
+    assert s.add(1)
+    assert s.frontier == 1
+    # above-frontier exception
+    assert s.add(3)
+    assert s.frontier == 1
+    assert s.contains(3) and not s.contains(2)
+    # filling the gap absorbs the exception
+    assert s.add(2)
+    assert s.frontier == 3
+    # duplicates are no-ops
+    assert not s.add(2)
+    assert list(s.events()) == [1, 2, 3]
+
+
+def test_aeclock_frontier_and_join():
+    c = AEClock([1, 2, 3])
+    c.add(1, 1)
+    c.add(1, 2)
+    c.add(2, 1)
+    c.add(2, 5)
+    f = c.frontier()
+    assert f.get(1) == 2 and f.get(2) == 1 and f.get(3) == 0
+
+    other = AEClock([1, 2, 3])
+    for seq in range(1, 5):
+        other.add(2, seq)
+    c.join(other)
+    assert c.frontier().get(2) == 5  # 1-4 joined + existing 5
+
+
+def test_vclock_join_meet():
+    a = VClock([1, 2])
+    a.set(1, 5)
+    a.set(2, 3)
+    b = VClock([1, 2])
+    b.set(1, 2)
+    b.set(2, 7)
+    a_join = a.copy()
+    a_join.join(b)
+    assert a_join.get(1) == 5 and a_join.get(2) == 7
+    a.meet(b)
+    assert a.get(1) == 2 and a.get(2) == 3
+
+
+def test_gc_track_stable_flow():
+    n = 3
+    gc = GCTrack(process_id=1, shard_id=0, n=n)
+    # locally commit 1.1, 1.2, 2.1
+    gc.add_to_clock(Dot(1, 1))
+    gc.add_to_clock(Dot(1, 2))
+    gc.add_to_clock(Dot(2, 1))
+
+    # no stable dots until all peers report
+    assert gc.stable() == []
+
+    # peer 2 reports committed {1.1, 1.2, 2.1}
+    peer2 = VClock([1, 2, 3])
+    peer2.set(1, 2)
+    peer2.set(2, 1)
+    gc.update_clock_of(2, peer2)
+    assert gc.stable() == []
+
+    # peer 3 reports committed {1.1}
+    peer3 = VClock([1, 2, 3])
+    peer3.set(1, 1)
+    gc.update_clock_of(3, peer3)
+    # meet = {1: 1, 2: 0, 3: 0} -> dot 1.1 newly stable
+    assert gc.stable() == [(1, 1, 1)]
+    # calling again: nothing new
+    assert gc.stable() == []
+
+    # peer 3 catches up on 1.2 and 2.1
+    peer3b = VClock([1, 2, 3])
+    peer3b.set(1, 2)
+    peer3b.set(2, 1)
+    gc.update_clock_of(3, peer3b)
+    assert sorted(gc.stable()) == [(1, 2, 2), (2, 1, 1)]
+
+    # reordered stale message: clock knowledge must not go backwards
+    stale = VClock([1, 2, 3])
+    gc.update_clock_of(3, stale)
+    assert gc.stable() == []

@@ -1,0 +1,104 @@
+"""Protocol-level message-walk test for Basic, driving Simulation directly
+and asserting each message/action hop (mirrors
+fantoch/src/protocol/basic.rs:397-598)."""
+
+from fantoch_tpu.client import Client, ConflictRateKeyGen, Workload
+from fantoch_tpu.core import Config, Dot, Planet, Region
+from fantoch_tpu.protocol import Basic, ToForward, ToSend
+from fantoch_tpu.protocol.basic import MCommit, MCommitDot, MStore, MStoreAck
+from fantoch_tpu.sim import Simulation
+from fantoch_tpu.utils import closest_process_per_shard, sort_processes_by_distance
+
+
+def test_basic_flow():
+    simulation = Simulation()
+    shard_id = 0
+    region = Region("europe-west2")  # all colocated, like the reference test
+    processes = [(1, shard_id, region), (2, shard_id, region), (3, shard_id, region)]
+    planet = Planet.new("gcp")
+    n, f = 3, 1
+    config = Config(n, f)
+
+    for process_id in (1, 2, 3):
+        protocol, _events = Basic.new(process_id, shard_id, config)
+        sorted_ps = sort_processes_by_distance(region, planet, processes)
+        protocol.discover(sorted_ps)
+        executor = Basic.Executor(process_id, shard_id, config)
+        simulation.register_process(protocol, executor)
+
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(100),
+        keys_per_command=1,
+        commands_per_client=10,
+        payload_size=100,
+    )
+    client = Client(1, workload)
+    client.connect(closest_process_per_shard(region, planet, processes))
+
+    nxt = client.next_cmd(simulation.time)
+    assert nxt is not None
+    target_shard, cmd = nxt
+    target = client.shard_process(target_shard)
+    assert target == 1  # ties break by process id
+    simulation.register_client(client)
+
+    # submit at process 1
+    process, _, pending = simulation.get_process(1)
+    pending.wait_for(cmd)
+    process.submit(None, cmd, simulation.time)
+    actions = list(process.to_processes_iter())
+    assert len(actions) == 1
+    mstore = actions.pop()
+    # MStore goes to the fast quorum of size 2f (f+1 = 2 here)
+    assert isinstance(mstore, ToSend) and isinstance(mstore.msg, MStore)
+    assert mstore.target == {1, 2}
+
+    # handle mstores -> 2 MStoreAcks
+    mstoreacks = simulation.forward_to_processes(1, mstore)
+    assert len(mstoreacks) == 2 * f
+    assert all(isinstance(a.msg, MStoreAck) for _, a in mstoreacks)
+
+    # first ack: no commit yet
+    pid, ack = mstoreacks.pop()
+    mcommits = simulation.forward_to_processes(pid, ack)
+    assert mcommits == []
+
+    # second ack: commit to everyone
+    pid, ack = mstoreacks.pop()
+    mcommits = simulation.forward_to_processes(pid, ack)
+    assert len(mcommits) == 1
+    pid, mcommit = mcommits.pop()
+    assert isinstance(mcommit, ToSend) and isinstance(mcommit.msg, MCommit)
+    assert len(mcommit.target) == n
+
+    # all processes handle the commit; gc is off (gc_interval None) so no
+    # MCommitDot forwards are produced
+    to_sends = simulation.forward_to_processes(pid, mcommit)
+    assert all(
+        isinstance(a, ToForward) and isinstance(a.msg, MCommitDot) for _, a in to_sends
+    )
+
+    # process 1 has execution info -> executor -> client result
+    process, executor, pending = simulation.get_process(1)
+    to_executor = list(process.to_executors_iter())
+    assert len(to_executor) == 1
+    ready = []
+    for info in to_executor:
+        executor.handle(info, simulation.time)
+        ready.extend(executor.to_clients_iter())
+    assert len(ready) == 1
+    cmd_result = pending.add_executor_result(ready.pop())
+    assert cmd_result is not None
+
+    # client gets the result and submits the next command (dot 1.2)
+    submit = simulation.forward_to_client(cmd_result)
+    assert submit is not None
+    target, cmd = submit
+    process, _, _ = simulation.get_process(target)
+    process.submit(None, cmd, simulation.time)
+    actions = list(process.to_processes_iter())
+    assert len(actions) == 1
+    mstore = actions.pop()
+    assert isinstance(mstore, ToSend) and isinstance(mstore.msg, MStore)
+    assert mstore.msg.dot == Dot(1, 2)

@@ -1,0 +1,105 @@
+"""Simulator golden tests for the Basic protocol, mirroring
+fantoch/src/sim/runner.rs:726-866 (exact mean latencies per f) and
+fantoch/src/sim/schedule.rs:63-120 (schedule flow).
+
+These pin the same numbers as the reference: Basic on 3 GCP regions
+(asia-east1, us-central1, us-west1) with clients in us-west1/us-west2 must
+see mean latencies 0/24 (f=0), 34/58 (f=1), 118/142 (f=2) ms, and latency
+must be invariant to client count (infinite-CPU simulator assumption).
+"""
+
+import os
+
+import pytest
+
+from fantoch_tpu.client import ConflictRateKeyGen, Workload
+from fantoch_tpu.core import Config, Planet, Region, SimTime
+from fantoch_tpu.protocol import Basic, ProtocolMetricsKind
+from fantoch_tpu.sim import Runner, Schedule
+
+COMMANDS_PER_CLIENT = 100 if os.environ.get("CI") else 1000
+
+
+def run_basic(f: int, clients_per_process: int):
+    planet = Planet.new("gcp")
+    config = Config(n=3, f=f, gc_interval_ms=100)
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(100),
+        keys_per_command=1,
+        commands_per_client=COMMANDS_PER_CLIENT,
+        payload_size=100,
+    )
+    process_regions = [Region("asia-east1"), Region("us-central1"), Region("us-west1")]
+    client_regions = [Region("us-west1"), Region("us-west2")]
+    runner = Runner(
+        Basic, planet, config, workload, clients_per_process, process_regions, client_regions
+    )
+    metrics, _monitors, latencies = runner.run(extra_sim_time_ms=1000)
+
+    west1_issued, west1 = latencies[Region("us-west1")]
+    west2_issued, west2 = latencies[Region("us-west2")]
+    expected = COMMANDS_PER_CLIENT * clients_per_process
+    assert west1_issued == expected
+    assert west2_issued == expected
+
+    # all commands must be gc-ed everywhere (2 client regions)
+    for process_metrics in metrics.values():
+        stable = process_metrics.get_aggregated(ProtocolMetricsKind.STABLE)
+        assert stable == expected * 2, "all commands should be stable"
+    return west1, west2
+
+
+def test_runner_single_client_per_process():
+    # us-west1 client is colocated with a process: coordinator access is free;
+    # us-west2's closest process is us-west1 at 12+12 ms round trip
+    west1, west2 = run_basic(f=0, clients_per_process=1)
+    assert west1.mean() == 0.0
+    assert west2.mean() == 24.0
+
+    west1, west2 = run_basic(f=1, clients_per_process=1)
+    assert west1.mean() == 34.0
+    assert west2.mean() == 58.0
+
+    west1, west2 = run_basic(f=2, clients_per_process=1)
+    assert west1.mean() == 118.0
+    assert west2.mean() == 142.0
+
+
+def test_runner_multiple_clients_per_process():
+    # the simulator assumes infinite CPU: latency must not depend on load
+    one_w1, one_w2 = run_basic(f=1, clients_per_process=1)
+    ten_w1, ten_w2 = run_basic(f=1, clients_per_process=10)
+    assert one_w1.mean() == ten_w1.mean()
+    assert one_w1.cov() == ten_w1.cov()
+    assert one_w2.mean() == ten_w2.mean()
+    assert one_w2.cov() == ten_w2.cov()
+
+
+def test_schedule_flow():
+    # mirrors fantoch/src/sim/schedule.rs:63-120
+    time = SimTime()
+    schedule = Schedule()
+    assert schedule.next_action(time) is None
+
+    schedule.schedule(time, 10, "a")
+    assert schedule.next_action(time) == "a"
+    assert time.millis() == 10
+    assert schedule.next_action(time) is None
+
+    schedule.schedule(time, 7, "b")
+    schedule.schedule(time, 2, "c")
+    assert schedule.next_action(time) == "c"
+    assert time.millis() == 12
+
+    schedule.schedule(time, 2, "d")
+    schedule.schedule(time, 5, "e")
+    assert schedule.next_action(time) == "d"
+    assert time.millis() == 14
+
+    nxt = schedule.next_action(time)
+    assert nxt in ("b", "e")
+    assert time.millis() == 17
+    nxt = schedule.next_action(time)
+    assert nxt in ("b", "e")
+    assert time.millis() == 17
