@@ -2,3 +2,4 @@ from fantoch_tpu.executor.aggregate import AggregatePending
 from fantoch_tpu.executor.base import Executor, ExecutorMetricsKind, ExecutorResult, MessageKey
 from fantoch_tpu.executor.basic import BasicExecutionInfo, BasicExecutor
 from fantoch_tpu.executor.monitor import ExecutionOrderMonitor
+from fantoch_tpu.executor.graph.executor import GraphExecutor

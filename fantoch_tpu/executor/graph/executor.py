@@ -1,0 +1,146 @@
+"""GraphExecutor: the executor wrapper around DependencyGraph.
+
+Reference: fantoch_ps/src/executor/graph/executor.rs.  Two-executor split:
+the main executor (index 0) orders and executes commands; the secondary
+(index 1) answers remote dependency requests and absorbs Executed
+broadcasts — so cross-shard request serving never blocks ordering.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, List, Optional, Set, Tuple
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
+from fantoch_tpu.core.kvs import KVStore
+from fantoch_tpu.core.metrics import Metrics
+from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.executor.base import Executor, ExecutorResult
+from fantoch_tpu.executor.graph.deps_graph import DependencyGraph, RequestReply
+from fantoch_tpu.protocol.common.graph_deps import Dependency
+
+
+# --- execution info variants (executor.rs:205-222) ---
+
+
+@dataclass
+class GraphAdd:
+    dot: Dot
+    cmd: Command
+    deps: Set[Dependency]
+
+
+@dataclass
+class GraphRequest:
+    from_shard: ShardId
+    dots: Set[Dot]
+
+
+@dataclass
+class GraphRequestReply:
+    infos: List[RequestReply]
+
+
+@dataclass
+class GraphExecuted:
+    dots: Set[Dot]
+
+
+GraphExecutionInfo = object  # union of the above
+
+_MAIN_EXECUTOR_INDEX = 0
+_SECONDARY_EXECUTOR_INDEX = 1
+
+
+class GraphExecutor(Executor):
+    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config,
+                 graph_cls: type = DependencyGraph):
+        self._process_id = process_id
+        self._shard_id = shard_id
+        self._config = config
+        self.graph = graph_cls(process_id, shard_id, config)
+        self._store = KVStore(config.executor_monitor_execution_order)
+        self._to_clients: Deque[ExecutorResult] = deque()
+        self._to_executors: List[Tuple[ShardId, GraphExecutionInfo]] = []
+
+    def set_executor_index(self, index: int) -> None:
+        self.graph.executor_index = index
+
+    def cleanup(self, time: SysTime) -> None:
+        if self._config.shard_count > 1:
+            self.graph.cleanup(time)
+            self._fetch_actions(time)
+
+    def monitor_pending(self, time: SysTime) -> None:
+        self.graph.monitor_pending(time)
+
+    def handle(self, info: GraphExecutionInfo, time: SysTime) -> None:
+        if isinstance(info, GraphAdd):
+            if self._config.execute_at_commit:
+                self._execute(info.cmd)
+            else:
+                self.graph.handle_add(info.dot, info.cmd, list(info.deps), time)
+                self._fetch_actions(time)
+        elif isinstance(info, GraphRequest):
+            self.graph.handle_request(info.from_shard, info.dots, time)
+            self._fetch_actions(time)
+        elif isinstance(info, GraphRequestReply):
+            self.graph.handle_request_reply(info.infos, time)
+            self._fetch_actions(time)
+        elif isinstance(info, GraphExecuted):
+            self.graph.handle_executed(info.dots, time)
+        else:
+            raise AssertionError(f"unknown execution info {info}")
+
+    def to_clients(self) -> Optional[ExecutorResult]:
+        return self._to_clients.popleft() if self._to_clients else None
+
+    def to_executors(self) -> Optional[Tuple[ShardId, GraphExecutionInfo]]:
+        return self._to_executors.pop() if self._to_executors else None
+
+    def executed(self, time: SysTime):
+        """Executed clock consumed by the protocol's GC (non-standard in the
+        reference's GraphExecutor — EPaxos/Atlas GC is driven by MCommitDot
+        instead; kept for parity with Executor API)."""
+        return None
+
+    @classmethod
+    def parallel(cls) -> bool:
+        return True
+
+    def metrics(self) -> Metrics:
+        return self.graph.metrics()
+
+    def monitor(self):
+        return self._store.monitor
+
+    # --- internals (executor.rs:124-196) ---
+
+    def _fetch_actions(self, time: SysTime) -> None:
+        while True:
+            cmd = self.graph.command_to_execute()
+            if cmd is None:
+                break
+            self._execute(cmd)
+        if self._config.shard_count > 1:
+            added = self.graph.to_executors()
+            if added:
+                self._to_executors.append((self._shard_id, GraphExecuted(added)))
+            for to_shard, dots in self.graph.requests().items():
+                self._to_executors.append((to_shard, GraphRequest(self._shard_id, dots)))
+            for to_shard, infos in self.graph.request_replies().items():
+                self._to_executors.append((to_shard, GraphRequestReply(infos)))
+
+    def _execute(self, cmd: Command) -> None:
+        self._to_clients.extend(cmd.execute(self._shard_id, self._store))
+
+    # --- executor routing (executor.rs:242-262) ---
+
+    @staticmethod
+    def executor_index_of(info: GraphExecutionInfo):
+        if isinstance(info, (GraphAdd, GraphRequestReply)):
+            return (0, _MAIN_EXECUTOR_INDEX)
+        return (0, _SECONDARY_EXECUTOR_INDEX)

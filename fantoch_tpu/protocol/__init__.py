@@ -7,6 +7,22 @@ from fantoch_tpu.protocol.base import (
     ToForward,
     ToSend,
 )
-from fantoch_tpu.protocol.basic import Basic
 from fantoch_tpu.protocol.gc import GCTrack
 from fantoch_tpu.protocol.info import CommandsInfo
+
+_LAZY = {
+    "Basic": "fantoch_tpu.protocol.basic",
+    "EPaxos": "fantoch_tpu.protocol.graph_protocol",
+    "Atlas": "fantoch_tpu.protocol.graph_protocol",
+}
+
+
+def __getattr__(name):
+    # lazy protocol exports (PEP 562): protocols import executors, which
+    # import protocol commons — eager imports here would be circular
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)

@@ -13,7 +13,6 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from fantoch_tpu.core.clocks import VClock
 from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
@@ -27,13 +26,16 @@ from fantoch_tpu.protocol.base import (
     ToForward,
     ToSend,
 )
+from fantoch_tpu.protocol.commit_gc import (
+    CommitGCMixin,
+    GarbageCollectionEvent,
+    MCommitDot,
+    MGarbageCollection,
+    MStable,
+)
 from fantoch_tpu.protocol.gc import GCTrack
 from fantoch_tpu.protocol.info import CommandsInfo
-from fantoch_tpu.run.routing import (
-    GC_WORKER_INDEX,
-    worker_dot_index_shift,
-    worker_index_no_shift,
-)
+from fantoch_tpu.run.routing import worker_dot_index_shift
 
 
 # --- messages ---
@@ -57,26 +59,6 @@ class MCommit:
 
 
 @dataclass
-class MCommitDot:
-    dot: Dot
-
-
-@dataclass
-class MGarbageCollection:
-    committed: VClock
-
-
-@dataclass
-class MStable:
-    stable: List[Tuple[ProcessId, int, int]]
-
-
-@dataclass
-class GarbageCollectionEvent:
-    """Periodic event triggering a GC round."""
-
-
-@dataclass
 class BasicInfo:
     """Per-dot lifecycle info (basic.rs:318-341)."""
 
@@ -84,7 +66,7 @@ class BasicInfo:
     acks: Set[ProcessId] = field(default_factory=set)
 
 
-class Basic(Protocol):
+class Basic(CommitGCMixin, Protocol):
     Executor = BasicExecutor
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
@@ -104,9 +86,7 @@ class Basic(Protocol):
         self._to_executors: deque = deque()
 
     def periodic_events(self):
-        if self.bp.config.gc_interval_ms is not None:
-            return [(GarbageCollectionEvent(), self.bp.config.gc_interval_ms)]
-        return []
+        return self.gc_periodic_events()
 
     @property
     def id(self) -> ProcessId:
@@ -131,18 +111,12 @@ class Basic(Protocol):
             self._handle_mstoreack(from_, msg.dot)
         elif isinstance(msg, MCommit):
             self._handle_mcommit(from_, msg.dot, msg.cmd)
-        elif isinstance(msg, MCommitDot):
-            self._handle_mcommit_dot(from_, msg.dot)
-        elif isinstance(msg, MGarbageCollection):
-            self._handle_mgc(from_, msg.committed)
-        elif isinstance(msg, MStable):
-            self._handle_mstable(from_, msg.stable)
-        else:
+        elif not self.handle_gc_message(from_, msg):
             raise AssertionError(f"unknown message {msg}")
 
     def handle_event(self, event, time):
         assert isinstance(event, GarbageCollectionEvent)
-        self._handle_event_garbage_collection()
+        self.handle_gc_event()
 
     def to_processes(self) -> Optional[Action]:
         return self._to_processes.popleft() if self._to_processes else None
@@ -187,40 +161,15 @@ class Basic(Protocol):
         else:
             self._cmds.gc_single(dot)
 
-    def _handle_mcommit_dot(self, from_: ProcessId, dot: Dot) -> None:
-        assert from_ == self.bp.process_id
-        self._gc_track.add_to_clock(dot)
-
-    def _handle_mgc(self, from_: ProcessId, committed: VClock) -> None:
-        self._gc_track.update_clock_of(from_, committed)
-        stable = self._gc_track.stable()
-        if stable:
-            self._to_processes.append(ToForward(MStable(stable)))
-
-    def _handle_mstable(self, from_: ProcessId, stable) -> None:
-        assert from_ == self.bp.process_id
-        stable_count = self._cmds.gc(stable)
-        self.bp.stable(stable_count)
-
-    def _handle_event_garbage_collection(self) -> None:
-        committed = self._gc_track.clock()
-        self._to_processes.append(
-            ToSend(self.bp.all_but_me(), MGarbageCollection(committed))
-        )
-
-    def _gc_running(self) -> bool:
-        return self.bp.config.gc_interval_ms is not None
-
     # --- worker routing (basic.rs:354-384) ---
 
     @staticmethod
     def message_index(msg):
         if isinstance(msg, (MStore, MStoreAck, MCommit)):
             return worker_dot_index_shift(msg.dot)
-        if isinstance(msg, (MCommitDot, MGarbageCollection)):
-            return worker_index_no_shift(GC_WORKER_INDEX)
-        if isinstance(msg, MStable):
-            return None  # broadcast to all workers
+        gc_index = CommitGCMixin.gc_message_index(msg)
+        if gc_index is not None:
+            return gc_index[0]
         raise AssertionError(f"unknown message {msg}")
 
     @staticmethod

@@ -1,0 +1,137 @@
+"""Dependency tracking commons for graph-based protocols (EPaxos, Atlas).
+
+Reference: fantoch_ps/src/protocol/common/graph/deps/keys/{mod,sequential}.rs
+and .../deps/quorum.rs.  ``KeyDeps`` tracks, per key, the latest command that
+touched it — a new command's dependencies are those latest conflicting
+commands.  ``QuorumDeps`` aggregates dependency sets reported by fast-quorum
+processes with per-dependency report counts, deciding the fast-path
+condition (union == reported-by-all for EPaxos, threshold union for Atlas).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, Optional, Set, Tuple
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
+from fantoch_tpu.core.kvs import Key
+
+
+@dataclass(frozen=True)
+class Dependency:
+    """A dependency: the dot plus the shards that replicate it (None for
+    noops).  Reference: deps/keys/mod.rs:18-35."""
+
+    dot: Dot
+    shards: Optional[FrozenSet[ShardId]]
+
+    @staticmethod
+    def from_cmd(dot: Dot, cmd: Command) -> "Dependency":
+        return Dependency(dot, frozenset(cmd.shards()))
+
+    @staticmethod
+    def from_noop(dot: Dot) -> "Dependency":
+        return Dependency(dot, None)
+
+
+class KeyDeps:
+    """Latest-per-key conflict index (deps/keys/sequential.rs:8-145).
+
+    The reference has Sequential (plain map) and Locked (per-key RwLock)
+    variants for worker parallelism; here one implementation serves both
+    (see fantoch_tpu/protocol/info.py for the rationale).  The batched device
+    counterpart — segment-max over pre-hashed keys — lives in
+    fantoch_tpu/ops/clocks.py.
+    """
+
+    def __init__(self, shard_id: ShardId):
+        self._shard_id = shard_id
+        self._latest: Dict[Key, Dependency] = {}
+        self._noop_latest: Optional[Dependency] = None
+
+    def add_cmd(
+        self, dot: Dot, cmd: Command, past: Optional[Set[Dependency]] = None
+    ) -> Set[Dependency]:
+        """Record `dot` as the latest on each of `cmd`'s keys; returns its
+        dependencies (latest prior commands on those keys + latest noop),
+        seeded with `past` (remote deps) if given."""
+        deps: Set[Dependency] = set(past) if past else set()
+        new_dep = Dependency.from_cmd(dot, cmd)
+        for key in cmd.keys(self._shard_id):
+            prev = self._latest.get(key)
+            if prev is not None:
+                deps.add(prev)
+            self._latest[key] = new_dep
+        if self._noop_latest is not None:
+            deps.add(self._noop_latest)
+        return deps
+
+    def add_noop(self, dot: Dot) -> Set[Dependency]:
+        """A noop conflicts with everything: depends on every key's latest
+        plus the previous noop."""
+        deps: Set[Dependency] = set()
+        prev = self._noop_latest
+        self._noop_latest = Dependency.from_noop(dot)
+        if prev is not None:
+            deps.add(prev)
+        deps.update(self._latest.values())
+        return deps
+
+    # test-only queries (deps/keys/sequential.rs:44-58)
+    def cmd_deps(self, cmd: Command) -> Set[Dot]:
+        deps: Set[Dot] = set()
+        if self._noop_latest is not None:
+            deps.add(self._noop_latest.dot)
+        for key in cmd.keys(self._shard_id):
+            dep = self._latest.get(key)
+            if dep is not None:
+                deps.add(dep.dot)
+        return deps
+
+    def noop_deps(self) -> Set[Dot]:
+        deps = {d.dot for d in self._latest.values()}
+        if self._noop_latest is not None:
+            deps.add(self._noop_latest.dot)
+        return deps
+
+    @classmethod
+    def parallel(cls) -> bool:
+        return True
+
+
+class QuorumDeps:
+    """Per-dependency report counts over a fast quorum (deps/quorum.rs:8-100)."""
+
+    def __init__(self, fast_quorum_size: int):
+        self._fast_quorum_size = fast_quorum_size
+        self._participants: Set[ProcessId] = set()
+        self._threshold_deps: Dict[Dependency, int] = {}
+
+    def add(self, process_id: ProcessId, deps: Set[Dependency]) -> None:
+        assert len(self._participants) < self._fast_quorum_size
+        self._participants.add(process_id)
+        for dep in deps:
+            self._threshold_deps[dep] = self._threshold_deps.get(dep, 0) + 1
+
+    def all(self) -> bool:
+        return len(self._participants) == self._fast_quorum_size
+
+    def check_threshold_union(self, threshold: int) -> Tuple[Set[Dependency], bool]:
+        """(union, every dep reported >= threshold times) — Atlas fast path."""
+        assert self.all()
+        equal = all(count >= threshold for count in self._threshold_deps.values())
+        return set(self._threshold_deps), equal
+
+    def check_union(self) -> Tuple[Set[Dependency], bool]:
+        """(union, all quorum processes reported identical deps) — EPaxos
+        fast path."""
+        assert self.all()
+        counts = set(self._threshold_deps.values())
+        if not counts:
+            equal = True  # no deps reported: trivially all equal
+        elif len(counts) == 1:
+            equal = counts.pop() == self._fast_quorum_size
+        else:
+            equal = False
+        return set(self._threshold_deps), equal

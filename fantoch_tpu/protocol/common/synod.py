@@ -1,0 +1,197 @@
+"""Single-decree Flexible Paxos (Synod): phase-1 waits n-f promises, phase-2
+waits f+1 accepts.  Embedded in every per-dot info for slow paths.
+
+Reference: fantoch_ps/src/protocol/common/synod/single.rs.  The coordinator
+ballot trick: ballot 0 means "never accepted"; the original coordinator can
+skip the prepare phase with ballot = its own id, because any prepared ballot
+is > n and thus nothing can have been accepted below it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Generic, Optional, Set, Tuple, TypeVar
+
+from fantoch_tpu.core.ids import ProcessId
+
+V = TypeVar("V")
+Ballot = int
+
+
+# Synod messages (single.rs:10-20)
+@dataclass
+class MChosen(Generic[V]):
+    value: V
+
+
+@dataclass
+class MPrepare:
+    ballot: Ballot
+
+
+@dataclass
+class MAccept(Generic[V]):
+    ballot: Ballot
+    value: V
+
+
+@dataclass
+class MPromise(Generic[V]):
+    ballot: Ballot
+    accepted: Tuple[Ballot, V]
+
+
+@dataclass
+class MAccepted:
+    ballot: Ballot
+
+
+SynodMessage = object  # union of the above
+
+
+class Synod(Generic[V]):
+    def __init__(
+        self,
+        process_id: ProcessId,
+        n: int,
+        f: int,
+        proposal_gen: Callable[[Dict[ProcessId, V]], V],
+        initial_value: V,
+    ):
+        self._proposer = _Proposer(process_id, n, f, proposal_gen)
+        self._acceptor = _Acceptor(initial_value)
+        self._chosen = False
+
+    def set_if_not_accepted(self, value_gen: Callable[[], V]) -> bool:
+        """Set the consensus value if nothing has been accepted yet (ballot
+        still 0)."""
+        return self._acceptor.set_if_not_accepted(value_gen)
+
+    def value(self) -> V:
+        return self._acceptor.value()
+
+    def new_prepare(self) -> MPrepare:
+        return self._proposer.new_prepare(self._acceptor)
+
+    def skip_prepare(self) -> Ballot:
+        """First-ballot shortcut for the original coordinator (single.rs:86-92)."""
+        return self._proposer.skip_prepare(self._acceptor)
+
+    def handle(self, from_: ProcessId, msg) -> Optional[SynodMessage]:
+        if isinstance(msg, MChosen):
+            self._chosen = True
+            self._acceptor.set_value(msg.value)
+            return None
+        if isinstance(msg, MPrepare):
+            return self._chosen_msg() or self._acceptor.handle_prepare(msg.ballot)
+        if isinstance(msg, MAccept):
+            return self._chosen_msg() or self._acceptor.handle_accept(msg.ballot, msg.value)
+        if isinstance(msg, MPromise):
+            return self._proposer.handle_promise(from_, msg.ballot, msg.accepted)
+        if isinstance(msg, MAccepted):
+            return self._proposer.handle_accepted(from_, msg.ballot, self._acceptor)
+        raise AssertionError(f"unknown synod message {msg}")
+
+    def _chosen_msg(self) -> Optional[MChosen]:
+        if self._chosen:
+            return MChosen(self._acceptor.value())
+        return None
+
+
+class _Proposer(Generic[V]):
+    def __init__(self, process_id, n, f, proposal_gen):
+        self._process_id = process_id
+        self._n = n
+        self._f = f
+        self._ballot: Ballot = 0
+        self._proposal_gen = proposal_gen
+        self._promises: Dict[ProcessId, Tuple[Ballot, V]] = {}
+        self._accepts: Set[ProcessId] = set()
+        self._proposal: Optional[V] = None
+
+    def new_prepare(self, acceptor: "_Acceptor[V]") -> MPrepare:
+        assert acceptor.ballot >= self._ballot
+        # ballot owned by this process in the next round: id + n * round
+        round_ = acceptor.ballot // self._n
+        self._ballot = self._process_id + self._n * (round_ + 1)
+        assert acceptor.ballot < self._ballot
+        self._reset_state()
+        return MPrepare(self._ballot)
+
+    def skip_prepare(self, acceptor: "_Acceptor[V]") -> Ballot:
+        assert acceptor.ballot == 0
+        self._ballot = self._process_id
+        return self._ballot
+
+    def _reset_state(self) -> Tuple[Dict[ProcessId, Tuple[Ballot, V]], Optional[V]]:
+        promises, self._promises = self._promises, {}
+        self._accepts = set()
+        proposal, self._proposal = self._proposal, None
+        return promises, proposal
+
+    def handle_promise(self, from_, ballot, accepted) -> Optional[MAccept]:
+        if ballot != self._ballot:
+            return None
+        self._promises[from_] = accepted
+        if len(self._promises) != self._n - self._f:
+            return None
+        promises, _ = self._reset_state()
+        # pick the value accepted at the highest ballot; if none was accepted
+        # (all ballot 0), ask the proposal generator
+        highest_from = max(promises, key=lambda p: promises[p][0])
+        highest_ballot = promises[highest_from][0]
+        if highest_ballot == 0:
+            values = {p: v for p, (_b, v) in promises.items()}
+            proposal = self._proposal_gen(values)
+        else:
+            proposal = promises[highest_from][1]
+        self._proposal = proposal
+        return MAccept(ballot, proposal)
+
+    def handle_accepted(self, from_, ballot, acceptor: "_Acceptor[V]") -> Optional[MChosen]:
+        if ballot != self._ballot:
+            return None
+        self._accepts.add(from_)
+        if len(self._accepts) != self._f + 1:
+            return None
+        _, proposal = self._reset_state()
+        if proposal is None:
+            # first-ballot shortcut: the accepted value at our own ballot
+            acc_ballot, acc_value = acceptor.accepted
+            assert acc_ballot == self._process_id, (
+                "there should have been a proposal before a value can be "
+                "chosen (or we should still be at the first ballot)"
+            )
+            proposal = acc_value
+        return MChosen(proposal)
+
+
+class _Acceptor(Generic[V]):
+    def __init__(self, initial_value: V):
+        self.ballot: Ballot = 0
+        self.accepted: Tuple[Ballot, V] = (0, initial_value)
+
+    def set_if_not_accepted(self, value_gen: Callable[[], V]) -> bool:
+        if self.ballot == 0:
+            self.accepted = (0, value_gen())
+            return True
+        return False
+
+    def set_value(self, value: V) -> None:
+        self.accepted = (0, value)
+
+    def value(self) -> V:
+        return self.accepted[1]
+
+    def handle_prepare(self, ballot: Ballot) -> Optional[MPromise]:
+        if ballot > self.ballot:
+            self.ballot = ballot
+            return MPromise(ballot, self.accepted)
+        return None
+
+    def handle_accept(self, ballot: Ballot, value: V) -> Optional[MAccepted]:
+        if ballot >= self.ballot:
+            self.ballot = ballot
+            self.accepted = (ballot, value)
+            return MAccepted(ballot)
+        return None

@@ -1,0 +1,394 @@
+"""Shared machinery for dependency-graph consensus protocols (EPaxos, Atlas).
+
+The reference implements EPaxos (fantoch_ps/src/protocol/epaxos.rs) and
+Atlas (fantoch_ps/src/protocol/atlas.rs) as two nearly-identical ~1000-line
+files; here the shared collect/commit/consensus/GC skeleton lives once and
+the protocols specialize three points:
+- quorum sizes (EPaxos: minority-tolerating fixed f; Atlas: n//2 + f),
+- the fast-path condition over reported deps (union equality vs threshold
+  union),
+- whether the coordinator acks itself (EPaxos skips self-acks and sizes the
+  quorum-deps tracker at fast_quorum_size - 1; Atlas counts itself).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Deque, Dict, Optional, Set, Tuple
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
+from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.executor.graph.executor import GraphAdd, GraphExecutor
+from fantoch_tpu.protocol.base import (
+    Action,
+    BaseProcess,
+    Protocol,
+    ProtocolMetrics,
+    ToForward,
+    ToSend,
+)
+from fantoch_tpu.protocol.commit_gc import (
+    CommitGCMixin,
+    GarbageCollectionEvent,
+    MCommitDot,
+    MGarbageCollection,
+    MStable,
+)
+from fantoch_tpu.protocol.common.graph_deps import Dependency, KeyDeps, QuorumDeps
+from fantoch_tpu.protocol.common.synod import (
+    MAccept,
+    MAccepted as SynodMAccepted,
+    MChosen,
+    Synod,
+)
+from fantoch_tpu.protocol.gc import GCTrack
+from fantoch_tpu.protocol.info import CommandsInfo
+from fantoch_tpu.run.routing import worker_dot_index_shift
+
+
+# --- messages (epaxos.rs:675-702 / atlas.rs:836-871) ---
+
+
+@dataclass
+class MCollect:
+    dot: Dot
+    cmd: Command
+    deps: Set[Dependency]
+    quorum: Set[ProcessId]
+
+
+@dataclass
+class MCollectAck:
+    dot: Dot
+    deps: Set[Dependency]
+
+
+@dataclass
+class ConsensusValue:
+    """(is_noop, deps) — the value agreed on per dot (epaxos.rs:602-621)."""
+
+    deps: Set[Dependency]
+    is_noop: bool = False
+
+    @staticmethod
+    def bottom() -> "ConsensusValue":
+        return ConsensusValue(set())
+
+
+@dataclass
+class MCommit:
+    dot: Dot
+    value: ConsensusValue
+
+
+@dataclass
+class MConsensus:
+    dot: Dot
+    ballot: int
+    value: ConsensusValue
+
+
+@dataclass
+class MConsensusAck:
+    dot: Dot
+    ballot: int
+
+
+class Status:
+    START = "start"
+    PAYLOAD = "payload"
+    COLLECT = "collect"
+    COMMIT = "commit"
+
+
+def _proposal_gen(_values):
+    raise NotImplementedError("recovery not implemented yet")
+
+
+class GraphCommandInfo:
+    """Per-dot lifecycle info (epaxos.rs:628-668)."""
+
+    __slots__ = ("status", "quorum", "synod", "cmd", "quorum_deps")
+
+    def __init__(self, process_id: ProcessId, n: int, f: int, quorum_deps_size: int):
+        self.status = Status.START
+        self.quorum: Set[ProcessId] = set()
+        self.synod: Synod[ConsensusValue] = Synod(
+            process_id, n, f, _proposal_gen, ConsensusValue.bottom()
+        )
+        self.cmd: Optional[Command] = None
+        self.quorum_deps = QuorumDeps(quorum_deps_size)
+
+
+class GraphProtocol(CommitGCMixin, Protocol):
+    """Common skeleton; see module docstring for the specialization points."""
+
+    Executor = GraphExecutor
+
+    # --- subclass hooks ---
+
+    @classmethod
+    def quorum_sizes(cls, config: Config) -> Tuple[int, int]:
+        raise NotImplementedError
+
+    @classmethod
+    def consensus_f(cls, config: Config) -> int:
+        """The f used by the embedded synod."""
+        raise NotImplementedError
+
+    @classmethod
+    def coordinator_self_ack(cls) -> bool:
+        """Whether the coordinator's own deps join the quorum-deps tracker."""
+        raise NotImplementedError
+
+    def fast_path_condition(self, info: GraphCommandInfo) -> Tuple[Set[Dependency], bool]:
+        raise NotImplementedError
+
+    # --- construction ---
+
+    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
+        fast_quorum_size, write_quorum_size = self.quorum_sizes(config)
+        self.bp = BaseProcess(process_id, shard_id, config, fast_quorum_size, write_quorum_size)
+        self.key_deps = KeyDeps(shard_id)
+        f = self.consensus_f(config)
+        quorum_deps_size = (
+            fast_quorum_size if self.coordinator_self_ack() else fast_quorum_size - 1
+        )
+        self._cmds: CommandsInfo[GraphCommandInfo] = CommandsInfo(
+            process_id,
+            shard_id,
+            config,
+            fast_quorum_size,
+            write_quorum_size,
+            lambda pid, _sid, _cfg, _fq, _wq: GraphCommandInfo(
+                pid, config.n, f, quorum_deps_size
+            ),
+        )
+        self._gc_track = GCTrack(process_id, shard_id, config.n)
+        self._to_processes: Deque[Action] = deque()
+        self._to_executors: Deque[Any] = deque()
+        # commit notifications that arrived before the MCollect (possible
+        # even without failures, due to connection multiplexing)
+        self._buffered_commits: Dict[Dot, Tuple[ProcessId, ConsensusValue]] = {}
+
+    def periodic_events(self):
+        return self.gc_periodic_events()
+
+    @property
+    def id(self) -> ProcessId:
+        return self.bp.process_id
+
+    @property
+    def shard_id(self) -> ShardId:
+        return self.bp.shard_id
+
+    def discover(self, processes):
+        connect_ok = self.bp.discover(processes)
+        return connect_ok, dict(self.bp.closest_shard_process())
+
+    def submit(self, dot: Optional[Dot], cmd: Command, time: SysTime) -> None:
+        self._handle_submit(dot, cmd)
+
+    def handle(self, from_, from_shard_id, msg, time):
+        if isinstance(msg, MCollect):
+            self._handle_mcollect(from_, msg.dot, msg.cmd, msg.quorum, msg.deps, time)
+        elif isinstance(msg, MCollectAck):
+            self._handle_mcollectack(from_, msg.dot, msg.deps)
+        elif isinstance(msg, MCommit):
+            self._handle_mcommit(from_, msg.dot, msg.value, time)
+        elif isinstance(msg, MConsensus):
+            self._handle_mconsensus(from_, msg.dot, msg.ballot, msg.value)
+        elif isinstance(msg, MConsensusAck):
+            self._handle_mconsensusack(from_, msg.dot, msg.ballot)
+        elif not self.handle_gc_message(from_, msg):
+            raise AssertionError(f"unknown message {msg}")
+
+    def handle_event(self, event, time):
+        assert isinstance(event, GarbageCollectionEvent)
+        self.handle_gc_event()
+
+    def to_processes(self) -> Optional[Action]:
+        return self._to_processes.popleft() if self._to_processes else None
+
+    def to_executors(self):
+        return self._to_executors.popleft() if self._to_executors else None
+
+    @classmethod
+    def parallel(cls) -> bool:
+        return KeyDeps.parallel()
+
+    @classmethod
+    def leaderless(cls) -> bool:
+        return True
+
+    def metrics(self) -> ProtocolMetrics:
+        return self.bp.metrics()
+
+    # --- handlers ---
+
+    def _handle_submit(self, dot: Optional[Dot], cmd: Command) -> None:
+        dot = dot if dot is not None else self.bp.next_dot()
+        deps = self.key_deps.add_cmd(dot, cmd, None)
+        mcollect = MCollect(dot, cmd, deps, self.bp.fast_quorum())
+        self._to_processes.append(ToSend(self.bp.all(), mcollect))
+
+    def _handle_mcollect(self, from_, dot, cmd, quorum, remote_deps, time) -> None:
+        info = self._cmds.get(dot)
+        if info.status != Status.START:
+            return
+        if self.bp.process_id not in quorum:
+            # not in the fast quorum: just store the payload; replay any
+            # buffered commit now that we have it
+            info.status = Status.PAYLOAD
+            info.cmd = cmd
+            buffered = self._buffered_commits.pop(dot, None)
+            if buffered is not None:
+                buf_from, buf_value = buffered
+                self._handle_mcommit(buf_from, dot, buf_value, time)
+            return
+
+        message_from_self = from_ == self.bp.process_id
+        if message_from_self:
+            # coordinator already computed deps at submit
+            deps = remote_deps
+        else:
+            deps = self.key_deps.add_cmd(dot, cmd, remote_deps)
+
+        info.status = Status.COLLECT
+        info.quorum = set(quorum)
+        info.cmd = cmd
+        value = ConsensusValue(set(deps))
+        was_set = info.synod.set_if_not_accepted(lambda: value)
+        assert was_set, "consensus value should not have been accepted yet"
+
+        if self.coordinator_self_ack() or not message_from_self:
+            self._to_processes.append(ToSend({from_}, MCollectAck(dot, deps)))
+
+    def _handle_mcollectack(self, from_, dot, deps) -> None:
+        if not self.coordinator_self_ack():
+            assert from_ != self.bp.process_id
+        info = self._cmds.get(dot)
+        if info.status != Status.COLLECT:
+            return
+        info.quorum_deps.add(from_, deps)
+        if not info.quorum_deps.all():
+            return
+        final_deps, fast_path = self.fast_path_condition(info)
+        value = ConsensusValue(final_deps)
+        if fast_path:
+            self.bp.fast_path()
+            self._to_processes.append(ToSend(self.bp.all(), MCommit(dot, value)))
+        else:
+            self.bp.slow_path()
+            ballot = info.synod.skip_prepare()
+            self._to_processes.append(
+                ToSend(self.bp.write_quorum(), MConsensus(dot, ballot, value))
+            )
+
+    def _handle_mcommit(self, from_, dot, value, time) -> None:
+        info = self._cmds.get(dot)
+        if info.status == Status.START:
+            # MCollect may arrive after MCommit (multiplexing): buffer
+            self._buffered_commits[dot] = (from_, value)
+            return
+        if info.status == Status.COMMIT:
+            return
+        assert not value.is_noop, "handling noops is not implemented yet"
+        cmd = info.cmd
+        assert cmd is not None, "there should be a command payload"
+        self._to_executors.append(GraphAdd(dot, cmd, set(value.deps)))
+        info.status = Status.COMMIT
+        out = info.synod.handle(from_, MChosen(value))
+        assert out is None
+        if self._gc_running() and self._dot_in_my_shard(dot):
+            self._to_processes.append(ToForward(MCommitDot(dot)))
+        else:
+            self._cmds.gc_single(dot)
+
+    def _handle_mconsensus(self, from_, dot, ballot, value) -> None:
+        info = self._cmds.get(dot)
+        out = info.synod.handle(from_, MAccept(ballot, value))
+        if out is None:
+            return  # ballot too low
+        if isinstance(out, SynodMAccepted):
+            msg = MConsensusAck(dot, out.ballot)
+        elif isinstance(out, MChosen):
+            msg = MCommit(dot, out.value)
+        else:
+            raise AssertionError(f"unexpected synod output {out}")
+        self._to_processes.append(ToSend({from_}, msg))
+
+    def _handle_mconsensusack(self, from_, dot, ballot) -> None:
+        info = self._cmds.get(dot)
+        out = info.synod.handle(from_, SynodMAccepted(ballot))
+        if out is None:
+            return
+        assert isinstance(out, MChosen), f"unexpected synod output {out}"
+        self._to_processes.append(ToSend(self.bp.all(), MCommit(dot, out.value)))
+
+    def _dot_in_my_shard(self, dot: Dot) -> bool:
+        return dot.target_shard(self.bp.config.n) == self.bp.shard_id
+
+    # --- worker routing (epaxos.rs:704-740) ---
+
+    @staticmethod
+    def message_index(msg):
+        if isinstance(msg, (MCollect, MCollectAck, MCommit, MConsensus, MConsensusAck)):
+            return worker_dot_index_shift(msg.dot)
+        gc_index = CommitGCMixin.gc_message_index(msg)
+        if gc_index is not None:
+            return gc_index[0]
+        raise AssertionError(f"unknown message {msg}")
+
+    @staticmethod
+    def event_index(event):
+        return worker_index_no_shift(GC_WORKER_INDEX)
+
+
+class EPaxos(GraphProtocol):
+    """EPaxos: fast path iff *all* fast-quorum deps are equal; always
+    tolerates a minority of faults (epaxos.rs:27-972)."""
+
+    @classmethod
+    def allowed_faults(cls, n: int) -> int:
+        return n // 2
+
+    @classmethod
+    def quorum_sizes(cls, config: Config) -> Tuple[int, int]:
+        return config.epaxos_quorum_sizes()
+
+    @classmethod
+    def consensus_f(cls, config: Config) -> int:
+        return cls.allowed_faults(config.n)
+
+    @classmethod
+    def coordinator_self_ack(cls) -> bool:
+        # the coordinator's deps don't join the fast-path check: the tracker
+        # is sized fast_quorum_size - 1 and self-acks are never produced
+        return False
+
+    def fast_path_condition(self, info):
+        return info.quorum_deps.check_union()
+
+
+class Atlas(GraphProtocol):
+    """Atlas: fast quorum n//2 + f; fast path via threshold union — every
+    dependency reported at least f times (atlas.rs:28-1143)."""
+
+    @classmethod
+    def quorum_sizes(cls, config: Config) -> Tuple[int, int]:
+        return config.atlas_quorum_sizes()
+
+    @classmethod
+    def consensus_f(cls, config: Config) -> int:
+        return config.f
+
+    @classmethod
+    def coordinator_self_ack(cls) -> bool:
+        return True
+
+    def fast_path_condition(self, info):
+        return info.quorum_deps.check_threshold_union(self.bp.config.f)

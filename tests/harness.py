@@ -1,0 +1,119 @@
+"""Whole-system simulator test harness, mirroring the reference's `sim_test`
+(fantoch_ps/src/protocol/mod.rs:835-1080): run a protocol under message
+reordering, then assert (a) identical per-key execution order on every
+process (linearizable agreement via ExecutionOrderMonitor) and (b) commit/GC
+accounting (min <= fast+slow <= max commits; gc_at * commits == stable).
+"""
+
+from typing import Dict, Tuple
+
+from fantoch_tpu.client import ConflictRateKeyGen, Workload
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.protocol import ProtocolMetricsKind
+from fantoch_tpu.sim import Runner
+
+COMMANDS_PER_CLIENT = 10
+CLIENTS_PER_PROCESS = 3
+CONFLICT_RATE = 50
+
+
+def sim_test(
+    protocol_cls,
+    config: Config,
+    commands_per_client: int = COMMANDS_PER_CLIENT,
+    clients_per_process: int = CLIENTS_PER_PROCESS,
+    seed: int = 0,
+    keys_per_command: int = 2,
+    conflict_rate: int = CONFLICT_RATE,
+) -> int:
+    """Returns the total number of slow paths taken."""
+    config = config.with_(
+        executor_monitor_execution_order=True,
+        gc_interval_ms=100,
+        executor_executed_notification_interval_ms=100,
+        shard_count=1,
+    )
+    planet = Planet.new("gcp")
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(conflict_rate),
+        keys_per_command=keys_per_command,
+        commands_per_client=commands_per_client,
+        payload_size=1,
+    )
+    regions = sorted(planet.regions())[: config.n]
+    runner = Runner(
+        protocol_cls,
+        planet,
+        config,
+        workload,
+        clients_per_process,
+        process_regions=list(regions),
+        client_regions=list(regions),
+        seed=seed,
+    )
+    runner.reorder_messages()
+    metrics, monitors, _latencies = runner.run(extra_sim_time_ms=10_000)
+
+    # agreement: all processes execute conflicting commands in the same order
+    check_monitors(monitors)
+
+    extracted = {
+        pid: (
+            m.get_aggregated(ProtocolMetricsKind.FAST_PATH) or 0,
+            m.get_aggregated(ProtocolMetricsKind.SLOW_PATH) or 0,
+            m.get_aggregated(ProtocolMetricsKind.STABLE) or 0,
+        )
+        for pid, m in metrics.items()
+    }
+    return check_metrics(config, commands_per_client, clients_per_process, extracted)
+
+
+def check_monitors(monitors: Dict) -> None:
+    monitors = dict(monitors)
+    assert monitors, "there should be monitors"
+    items = list(monitors.items())
+    pid_a, monitor_a = items[0]
+    assert monitor_a is not None, "processes should be monitoring execution order"
+    for pid_b, monitor_b in items[1:]:
+        assert monitor_b is not None
+        assert len(monitor_a) == len(monitor_b), (
+            f"p{pid_a} and p{pid_b} monitors have different key counts"
+        )
+        for key in monitor_a.keys():
+            order_a = monitor_a.get_order(key)
+            order_b = monitor_b.get_order(key)
+            assert order_a == order_b, (
+                f"different execution orders on key {key!r}:\n"
+                f"  p{pid_a}: {order_a}\n  p{pid_b}: {order_b}"
+            )
+
+
+def check_metrics(
+    config: Config,
+    commands_per_client: int,
+    clients_per_process: int,
+    metrics: Dict[int, Tuple[int, int, int]],
+) -> int:
+    total_fast = sum(f for f, _, _ in metrics.values())
+    total_slow = sum(s for _, s, _ in metrics.values())
+    total_stable = sum(st for _, _, st in metrics.values())
+
+    total_processes = config.n * config.shard_count
+    total_clients = clients_per_process * total_processes
+    min_commits = commands_per_client * total_clients
+    max_commits = min_commits * config.shard_count
+
+    if config.leader is None:
+        total_commits = total_fast + total_slow
+        assert min_commits <= total_commits <= max_commits, (
+            f"number of committed commands out of bounds: "
+            f"{min_commits} <= {total_commits} <= {max_commits}"
+        )
+
+    # leader-based protocols only gc at f+1 acceptors; leaderless at all n
+    gc_at = (config.f + 1) if config.leader is not None else config.n
+    assert gc_at * min_commits == total_stable, (
+        f"not all processes gced: expected {gc_at * min_commits}, got {total_stable}"
+    )
+    return total_slow

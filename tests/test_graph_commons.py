@@ -1,0 +1,165 @@
+"""Unit tests for graph-protocol commons: KeyDeps conflict tracking
+(reference: deps/keys/mod.rs:79-470), QuorumDeps union checks
+(deps/quorum.rs:103-287), and the Synod flow (synod/single.rs:449-926).
+"""
+
+from fantoch_tpu.core import Command, Dot, IdGen, KVOp, Rifl
+from fantoch_tpu.protocol.common.graph_deps import Dependency, KeyDeps, QuorumDeps
+from fantoch_tpu.protocol.common.synod import (
+    MAccept,
+    MAccepted,
+    MChosen,
+    MPrepare,
+    MPromise,
+    Synod,
+)
+
+SHARD = 0
+
+
+def multi_put(rifl, keys):
+    return Command.from_keys(rifl, SHARD, {k: (KVOp.put(""),) for k in keys})
+
+
+def test_key_deps_flow():
+    key_deps = KeyDeps(SHARD)
+    dot_gen = IdGen(1)
+
+    cmd_a = multi_put(Rifl(100, 1), ["A"])
+    cmd_b = multi_put(Rifl(101, 1), ["B"])
+    cmd_ab = multi_put(Rifl(102, 1), ["A", "B"])
+    cmd_c = multi_put(Rifl(103, 1), ["C"])
+
+    assert key_deps.cmd_deps(cmd_a) == set()
+
+    d1 = dot_gen.next_id()
+    key_deps.add_cmd(d1, cmd_a)  # A -> 1.1
+    assert key_deps.cmd_deps(cmd_a) == {d1}
+    assert key_deps.cmd_deps(cmd_b) == set()
+    assert key_deps.cmd_deps(cmd_ab) == {d1}
+
+    d2 = dot_gen.next_id()
+    deps = key_deps.add_cmd(d2, cmd_b)  # B -> 1.2
+    assert deps == set()
+    assert key_deps.cmd_deps(cmd_ab) == {d1, d2}
+
+    d3 = dot_gen.next_id()
+    deps = key_deps.add_cmd(d3, cmd_ab)  # A,B -> 1.3; deps = {1.1, 1.2}
+    assert {d.dot for d in deps} == {d1, d2}
+    assert key_deps.cmd_deps(cmd_a) == {d3}
+    assert key_deps.cmd_deps(cmd_b) == {d3}
+
+    # noops conflict with everything
+    d4 = dot_gen.next_id()
+    noop_deps = key_deps.add_noop(d4)
+    assert {d.dot for d in noop_deps} == {d3}
+    assert key_deps.cmd_deps(cmd_c) == {d4}
+    d5 = dot_gen.next_id()
+    deps = key_deps.add_cmd(d5, cmd_c)
+    assert {d.dot for d in deps} == {d4}
+    assert key_deps.noop_deps() == {d3, d4, d5}
+
+
+def _dep(source, seq):
+    return Dependency(Dot(source, seq), None)
+
+
+def test_quorum_deps_check_union():
+    # all equal -> fast path (EPaxos)
+    q = QuorumDeps(2)
+    q.add(1, {_dep(1, 1)})
+    assert not q.all()
+    q.add(2, {_dep(1, 1)})
+    assert q.all()
+    deps, equal = q.check_union()
+    assert deps == {_dep(1, 1)} and equal
+
+    # different -> no fast path
+    q = QuorumDeps(2)
+    q.add(1, {_dep(1, 1)})
+    q.add(2, {_dep(1, 2)})
+    deps, equal = q.check_union()
+    assert deps == {_dep(1, 1), _dep(1, 2)} and not equal
+
+    # empty deps everywhere -> trivially equal
+    q = QuorumDeps(2)
+    q.add(1, set())
+    q.add(2, set())
+    deps, equal = q.check_union()
+    assert deps == set() and equal
+
+
+def test_quorum_deps_check_threshold_union():
+    # every dep reported >= f times -> fast path (Atlas)
+    f = 1
+    q = QuorumDeps(3)
+    q.add(1, {_dep(1, 1)})
+    q.add(2, {_dep(1, 1), _dep(2, 1)})
+    q.add(3, {_dep(1, 1)})
+    deps, equal = q.check_threshold_union(f)
+    assert deps == {_dep(1, 1), _dep(2, 1)} and equal
+
+    # with f=2, dep (2,1) reported once < f -> no fast path
+    q = QuorumDeps(3)
+    q.add(1, {_dep(1, 1)})
+    q.add(2, {_dep(1, 1), _dep(2, 1)})
+    q.add(3, {_dep(1, 1)})
+    _, equal = q.check_threshold_union(2)
+    assert not equal
+
+
+def test_synod_flow():
+    # 5 processes, f=1: phase-1 needs n-f=4 promises, phase-2 needs f+1=2 accepts
+    n, f = 5, 1
+
+    def proposal_gen(values):
+        out = 1
+        for v in values.values():
+            out *= v
+        return out
+
+    synods = {pid: Synod(pid, n, f, proposal_gen, prime) for pid, prime in
+              zip(range(1, 6), [2, 3, 5, 7, 11])}
+
+    # process 1 prepares
+    prepare = synods[1].new_prepare()
+    assert isinstance(prepare, MPrepare)
+
+    # promises from 4 acceptors (1..4)
+    accept_msg = None
+    for pid in (1, 2, 3, 4):
+        promise = synods[pid].handle(1, prepare)
+        assert isinstance(promise, MPromise)
+        out = synods[1].handle(pid, promise)
+        if pid < 4:
+            assert out is None
+        else:
+            accept_msg = out
+    assert isinstance(accept_msg, MAccept)
+    # nothing accepted before: proposal_gen multiplies the initial values
+    assert accept_msg.value == 2 * 3 * 5 * 7
+
+    # accepts from 2 acceptors choose the value
+    chosen = None
+    for pid in (1, 2):
+        accepted = synods[pid].handle(1, accept_msg)
+        assert isinstance(accepted, MAccepted)
+        chosen = synods[1].handle(pid, accepted)
+    assert isinstance(chosen, MChosen)
+    assert chosen.value == 210
+
+
+def test_synod_skip_prepare():
+    n, f = 3, 1
+    synods = {pid: Synod(pid, n, f, lambda v: 0, 0) for pid in (1, 2, 3)}
+    # coordinator 2 sets its value then skips prepare
+    assert synods[2].set_if_not_accepted(lambda: 42)
+    ballot = synods[2].skip_prepare()
+    assert ballot == 2
+    accept = MAccept(ballot, 42)
+    chosen = None
+    for pid in (2, 3):
+        accepted = synods[pid].handle(2, accept)
+        assert isinstance(accepted, MAccepted)
+        chosen = synods[2].handle(pid, accepted)
+    assert isinstance(chosen, MChosen) and chosen.value == 42

@@ -1,0 +1,140 @@
+"""Dependency-graph executor tests, mirroring
+fantoch_ps/src/executor/graph/mod.rs:713-1045: the simple two-command case,
+the two documented ordering-soundness regression tests, the 3-cycle under
+all delivery permutations, and randomized dep graphs with non-transitive
+conflicts where every permutation must yield the identical per-key order.
+"""
+
+import itertools
+import random
+
+import pytest
+
+from fantoch_tpu.core import Command, Config, Dot, KVOp, Rifl, RunTime
+from fantoch_tpu.core.ids import process_ids
+from fantoch_tpu.executor.graph.deps_graph import DependencyGraph
+from fantoch_tpu.protocol.common.graph_deps import Dependency
+
+TIME = RunTime()
+SHARD = 0
+
+
+def dep(dot):
+    return Dependency(dot, frozenset({SHARD}))
+
+
+def make_cmd(dot, keys):
+    rifl = Rifl(dot.source, dot.sequence)
+    return Command.from_keys(rifl, SHARD, {k: (KVOp.put(""),) for k in keys})
+
+
+def check_termination(n, args):
+    """Feed (dot, keys, dep_dots) adds in order; every command must execute;
+    returns the per-key execution order (mod.rs:1047-1110)."""
+    config = Config(n, 1)
+    graph = DependencyGraph(1, SHARD, config)
+    all_rifls = set()
+    sorted_order = {}
+    for dot, keys, dep_dots in args:
+        keys = keys if keys is not None else ["CONF"]
+        cmd = make_cmd(dot, keys)
+        assert cmd.rifl not in all_rifls
+        all_rifls.add(cmd.rifl)
+        graph.handle_add(dot, cmd, [dep(d) for d in dep_dots], TIME)
+        for ready in graph.commands_to_execute():
+            all_rifls.remove(ready.rifl)
+            for key in ready.keys(SHARD):
+                sorted_order.setdefault(key, []).append(ready.rifl)
+    assert not all_rifls, f"not all commands executed: {all_rifls}"
+    return sorted_order
+
+
+def shuffle_it(n, args):
+    expected = check_termination(n, list(args))
+    for perm in itertools.permutations(args):
+        assert check_termination(n, list(perm)) == expected
+
+
+def test_simple():
+    # two commands in a 2-cycle execute together, sorted by dot
+    dot_0, dot_1 = Dot(1, 1), Dot(2, 1)
+    config = Config(2, 1)
+    graph = DependencyGraph(1, SHARD, config)
+    cmd_0 = make_cmd(dot_0, ["A"])
+    cmd_1 = make_cmd(dot_1, ["A"])
+    graph.handle_add(dot_0, cmd_0, [dep(dot_1)], TIME)
+    assert graph.commands_to_execute() == []
+    graph.handle_add(dot_1, cmd_1, [dep(dot_0)], TIME)
+    assert graph.commands_to_execute() == [cmd_0, cmd_1]
+
+
+def test_transitive_conflicts_assumption_regression_1():
+    """Commands of one process executed out of submission order can diverge
+    across replicas (mod.rs:756-826): the executor is *expected* to produce
+    different orders here — the system relies on per-process worker routing
+    to make this arrival pattern impossible."""
+    n = 5
+    d1, d2, d3, d4, d5 = (Dot(1, s) for s in range(1, 6))
+    deps = {d1: {d4}, d2: {d4}, d3: {d5}, d4: {d3}, d5: {d4}}
+    order_a = [(d, None, deps[d]) for d in [d3, d4, d5, d1, d2]]
+    order_b = [(d, None, deps[d]) for d in [d3, d4, d5, d2, d1]]
+    assert check_termination(n, order_a) != check_termination(n, order_b)
+
+
+def test_transitive_conflicts_assumption_regression_2():
+    """Highest-conflict-per-replica dep encoding is order-sensitive
+    (mod.rs:828-896)."""
+    n = 3
+    d11, d12, d21 = Dot(1, 1), Dot(1, 2), Dot(2, 1)
+    args = {
+        d11: (["A"], set()),
+        d12: (["B"], set()),
+        d21: (["A", "B"], {d12}),
+    }
+    order_a = [(d, args[d][0], args[d][1]) for d in [d11, d12, d21]]
+    order_b = [(d, args[d][0], args[d][1]) for d in [d12, d21, d11]]
+    assert check_termination(n, order_a) != check_termination(n, order_b)
+
+
+def test_cycle():
+    d1, d2, d3 = Dot(1, 1), Dot(2, 1), Dot(3, 1)
+    args = [(d1, None, {d3}), (d2, None, {d1}), (d3, None, {d2})]
+    shuffle_it(1, args)
+
+
+def random_adds(n, events_per_process, rng):
+    """Random dep graphs with non-transitive conflicts (mod.rs:934-1033)."""
+    possible_keys = ["A", "B", "C", "D"]
+    dots = [
+        Dot(pid, seq)
+        for pid in process_ids(SHARD, n)
+        for seq in range(1, events_per_process + 1)
+    ]
+    keys = {}
+    deps = {dot: set() for dot in dots}
+    for dot in dots:
+        keys[dot] = set(rng.sample(possible_keys, 2))
+    for left, right in itertools.combinations(dots, 2):
+        if not (keys[left] & keys[right]):
+            continue
+        if left.source == right.source:
+            # same process: later depends on earlier
+            if left.sequence < right.sequence:
+                deps[right].add(left)
+            else:
+                deps[left].add(right)
+        else:
+            choice = rng.randrange(3)
+            if choice in (0, 2):
+                deps[left].add(right)
+            if choice in (1, 2):
+                deps[right].add(left)
+    return [(dot, sorted(keys[dot]), deps[dot]) for dot in dots]
+
+
+def test_add_random():
+    rng = random.Random(0)
+    n = 2
+    for _ in range(10):
+        args = random_adds(n, 3, rng)
+        shuffle_it(n, args)

@@ -1,0 +1,39 @@
+"""Whole-system simulator tests for EPaxos and Atlas under message
+reordering (reference: fantoch_ps/src/protocol/mod.rs:421-520).
+
+Slow-path expectations: with f=1 (and 50% conflicts), both protocols must
+commit everything on the fast path; with f=2 on n=5, slow paths must occur.
+"""
+
+import pytest
+
+from fantoch_tpu.core import Config
+from fantoch_tpu.protocol.graph_protocol import Atlas, EPaxos
+from harness import sim_test
+
+
+def test_sim_epaxos_3_1():
+    slow_paths = sim_test(EPaxos, Config(3, 1))
+    assert slow_paths == 0
+
+
+def test_sim_epaxos_5_2():
+    # EPaxos always tolerates a minority: with n=5 its fast quorum is 3 and
+    # conflicts among quorums cause slow paths
+    slow_paths = sim_test(EPaxos, Config(5, 2))
+    assert slow_paths > 0
+
+
+def test_sim_atlas_3_1():
+    slow_paths = sim_test(Atlas, Config(3, 1))
+    assert slow_paths == 0
+
+
+def test_sim_atlas_5_1():
+    slow_paths = sim_test(Atlas, Config(5, 1))
+    assert slow_paths == 0
+
+
+def test_sim_atlas_5_2():
+    slow_paths = sim_test(Atlas, Config(5, 2))
+    assert slow_paths > 0
