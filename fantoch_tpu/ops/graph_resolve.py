@@ -1,0 +1,314 @@
+"""Batched dependency-graph resolution on TPU — the north-star kernel.
+
+Replaces the reference's serial Tarjan walk
+(fantoch_ps/src/executor/graph/tarjan.rs:99-319) with a data-parallel
+resolver over a batch of committed commands.  The output contract is the
+one the reference's correctness argument actually needs (see
+fantoch/src/executor/monitor.rs and the sim_test agreement check
+fantoch_ps/src/protocol/mod.rs:924-1010):
+
+  * members of one SCC execute contiguously, ordered by dot
+    (tarjan.rs:15 — ``SCC = BTreeSet<Dot>``);
+  * if SCC A depends on SCC B, then B executes before A (topological
+    order of the condensation);
+  * independent SCCs may execute in any order (they share no keys, since
+    conflicting commands are always linked by dependencies), so only
+    *local* topological validity is required — no cross-process rank
+    agreement.
+
+Representation (device arrays over a batch of B command slots):
+
+  * ``dep[B]`` (functional path) or ``deps[B, D]`` (general path): batch
+    index of each dependency after pruning, with sentinels
+    ``TERMINAL = -1`` (no dependency / dependency already executed) and
+    ``MISSING = -2`` (dependency not yet committed here — the vertex and
+    everything that reaches it stays unresolved, mirroring the pending
+    index in fantoch_ps/src/executor/graph/index.rs:146).
+  * dots are carried as ``(dot_src[B], dot_seq[B])`` int32 pairs for the
+    intra-SCC sort.
+
+Why a functional fast path: with the reference's sequential ``KeyDeps``
+(fantoch_ps/src/protocol/common/graph/deps/keys/sequential.rs:8-11) each
+command picks up exactly one dependency per key — the latest.  A batch of
+single-key commands therefore forms a *functional graph* (out-degree <= 1)
+whose weakly-connected components are rho-shapes: cycles can only sit at
+the oldest end of a chain (a mid-chain cycle would need out-degree 2).
+Functional graphs admit an **exact O(log B)** resolution with pointer
+doubling:
+
+  1. doubling with distance accumulation ranks every chain (list ranking);
+  2. min-id accumulation along the jumped path identifies each cycle's
+     leader exactly (a 2^L >= 2B hop walk from any non-terminating vertex
+     wraps its cycle completely);
+  3. a binary-closure scatter from the leaders marks cycle membership;
+  4. a second doubling pass ranks the vertices that flow into cycles.
+
+Everything is gathers/scatters/min/max over int32[B] — no data-dependent
+shapes, fully jittable, MXU-free but HBM-friendly.  The general
+(multi-key, out-degree D) path uses affine-max pointer doubling with a
+relaxation floor and falls back to the host Tarjan oracle for the rare
+residue it cannot finish (see executor/graph/batched.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+TERMINAL = -1  # dependency executed / absent (pruned)
+MISSING = -2  # dependency not committed here yet: blocks resolution
+
+# rank assigned to unresolved vertices so they sort after all resolved ones
+_UNRESOLVED_RANK = jnp.iinfo(jnp.int32).max
+
+
+class Resolution(NamedTuple):
+    """Result of one batched resolve.
+
+    ``order`` is a permutation of batch indices: resolved vertices first in
+    execution order, unresolved vertices at the tail (use ``resolved`` to
+    cut).  ``rank``/``leader`` expose the condensation structure for tests.
+    """
+
+    order: jax.Array  # int32[B] permutation
+    resolved: jax.Array  # bool[B]
+    rank: jax.Array  # int32[B] topological level (condensation)
+    leader: jax.Array  # int32[B] SCC leader (batch index)
+    on_cycle: jax.Array  # bool[B]
+
+
+def _num_doubling_steps(batch: int) -> int:
+    """Steps so that 2^L >= 2*batch: a walk of 2^L hops from any vertex of a
+    non-terminating component has fully wrapped its cycle at least once."""
+    steps = 1
+    while (1 << steps) < 2 * max(batch, 2):
+        steps += 1
+    return steps
+
+
+@functools.partial(jax.jit, static_argnames=("return_order",))
+def resolve_functional(
+    dep: jax.Array,  # int32[B] — single dependency (TERMINAL/MISSING sentinels)
+    dot_src: jax.Array,  # int32[B]
+    dot_seq: jax.Array,  # int32[B]
+    *,
+    return_order: bool = True,
+) -> Resolution:
+    """Exact batched resolution of an out-degree-<=1 dependency graph."""
+    batch = dep.shape[0]
+    idx = jnp.arange(batch, dtype=jnp.int32)
+    steps = _num_doubling_steps(batch)
+
+    is_term = dep == TERMINAL
+    is_miss = dep == MISSING
+    absorbing = is_term | is_miss
+
+    # self-absorbing pointers: terminals/missing point at themselves with
+    # zero step cost, so doubling past them is a no-op.
+    jump = jnp.where(absorbing, idx, dep)
+    dist = jnp.where(absorbing, 0, 1).astype(jnp.int32)
+    # min id over the true path p^1..p^(2^t); init = id of first hop
+    acc = jnp.where(absorbing, jnp.int32(batch), jump)
+
+    jumps_log = []  # p^(2^t) for the closure scatter below
+    for _ in range(steps):
+        jumps_log.append(jump)
+        acc = jnp.minimum(acc, acc[jump])
+        dist = dist + dist[jump]
+        jump = jump[jump]
+
+    end = jump  # endpoint after 2^steps hops
+    end_term = is_term[end]
+    end_miss = is_miss[end]
+    nonterminating = ~(end_term | end_miss)
+
+    # --- cycles: every non-terminating walk has wrapped its cycle, so the
+    # path-min at the endpoint is exactly the cycle's smallest id.
+    cyc_leader = acc[end]
+    # seeds: the leaders themselves are cycle members by construction
+    on_cycle = nonterminating & (idx == cyc_leader)
+    # binary closure along p: orbit of each leader = its whole cycle (p maps
+    # cycle members to cycle members, so marks cannot leak off the cycle).
+    for hop in jumps_log:
+        contrib = jnp.zeros_like(on_cycle).at[hop].max(on_cycle)
+        on_cycle = on_cycle | (contrib & nonterminating)
+
+    # --- second doubling pass: rank = distance to a terminal or to the
+    # cycle boundary (cycle members themselves sit at rank 0 of their
+    # component, which is all local topological validity requires).
+    absorbing2 = absorbing | on_cycle
+    jump2 = jnp.where(absorbing2, idx, dep)
+    dist2 = jnp.where(absorbing2, 0, 1).astype(jnp.int32)
+    for _ in range(steps):
+        dist2 = dist2 + dist2[jump2]
+        jump2 = jump2[jump2]
+
+    resolved = jnp.where(on_cycle, True, is_term[jump2] | on_cycle[jump2])
+    rank = jnp.where(resolved, dist2, _UNRESOLVED_RANK).astype(jnp.int32)
+    leader = jnp.where(on_cycle, cyc_leader, idx).astype(jnp.int32)
+
+    if not return_order:
+        order = idx
+    else:
+        order = _order_from_ranks(rank, leader, dot_src, dot_seq)
+    return Resolution(order, resolved, rank, leader, on_cycle)
+
+
+def _order_from_ranks(rank, leader, dot_src, dot_seq) -> jax.Array:
+    """Execution order: (rank, SCC leader, dot) lexicographic.
+
+    Same-SCC members share (rank, leader) and are therefore contiguous and
+    dot-sorted (the reference's BTreeSet<Dot> order, tarjan.rs:15).  The
+    rank key makes every SCC follow all SCCs it depends on.  Unresolved
+    vertices carry rank INT32_MAX and sink to the tail.
+    """
+    return jnp.lexsort((dot_seq, dot_src, leader, rank)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# general path: out-degree up to D (multi-key commands)
+# ---------------------------------------------------------------------------
+
+
+class GeneralResolution(NamedTuple):
+    order: jax.Array  # int32[B]
+    resolved: jax.Array  # bool[B]
+    rank: jax.Array  # int32[B]
+    leader: jax.Array  # int32[B]
+    stuck: jax.Array  # bool[B] — not resolved and not missing-blocked:
+    # cycles the device pass could not collapse; host oracle finishes them.
+
+
+@functools.partial(jax.jit, static_argnames=("max_iters",))
+def resolve_general(
+    deps: jax.Array,  # int32[B, D]
+    dot_src: jax.Array,
+    dot_seq: jax.Array,
+    *,
+    max_iters: int = 0,  # 0 -> auto: 4 * log2(B) + 8
+) -> GeneralResolution:
+    """Batched resolution for out-degree-D graphs.
+
+    Affine-max pointer doubling: each dependency slot of vertex v is a
+    constraint ``rank[v] >= max(floor, add + rank[target])``.  A slot whose
+    target has finalized folds into the floor; a slot whose target has
+    exactly one live slot composes through it (chain doubling); any live
+    target always contributes its current floor (monotone relaxation), so
+    progress never stalls on merge vertices — worst case degrades to
+    frontier peeling, typical per-key-chain graphs finish in O(log depth).
+
+    Two-cycles (the dominant SCC shape: two concurrent conflicting
+    proposals, one per replica) are collapsed exactly by a mutual-edge
+    pre-pass.  Longer cycles surface as ``stuck`` and are finished by the
+    host Tarjan oracle — they cannot deadlock the device pass because
+    stuckness is detected by iteration budget, not by waiting.
+    """
+    batch, width = deps.shape
+    idx = jnp.arange(batch, dtype=jnp.int32)
+    if max_iters == 0:
+        max_iters = 4 * _num_doubling_steps(batch) + 8
+
+    # --- 2-cycle collapse: v and u mutually dependent -> same SCC.
+    # leader = min(v, u); edges into the pair are retargeted to the leader
+    # and the intra-pair edges are pruned.
+    tgt = deps  # int32[B, D]
+    valid = tgt >= 0
+    safe_tgt = jnp.where(valid, tgt, 0)
+    # reverse test: does any slot of target point back at v?
+    back = (tgt[safe_tgt] == idx[:, None, None]).any(axis=-1) & valid
+    pair_leader = jnp.where(
+        back, jnp.minimum(idx[:, None], safe_tgt), jnp.int32(batch)
+    ).min(axis=-1)
+    leader = jnp.where(pair_leader < batch, pair_leader, idx).astype(jnp.int32)
+    # path-compress leader chains (overlapping 2-cycles form a↔b↔c chains
+    # whose members must all agree on one leader)
+    for _ in range(_num_doubling_steps(batch)):
+        leader = jnp.minimum(leader, leader[leader])
+
+    # rewrite deps through leaders; drop intra-SCC edges
+    tgt = jnp.where(valid, leader[safe_tgt], tgt)
+    tgt = jnp.where(valid & (tgt == leader[:, None]), TERMINAL, tgt)
+    # non-leaders hand their external deps to... they keep them: every
+    # member's constraints apply to the SCC; members share the leader's
+    # rank at the end, so fold member floors via a segment-max on leader.
+
+    is_miss = tgt == MISSING
+    live = tgt >= 0
+    safe = jnp.where(live, tgt, 0)
+    add = jnp.where(live, 1, 0).astype(jnp.int32)
+    floor = jnp.zeros((batch, width), dtype=jnp.int32)
+    missing_blocked = is_miss.any(axis=-1)
+
+    member_count = jnp.zeros(batch, jnp.int32).at[leader].add(1)
+
+    def body(state):
+        it, tgt, add, floor, missing_blocked, _changed = state
+        live = tgt >= 0
+        safe = jnp.where(live, tgt, 0)
+        n_live = live.sum(axis=-1)  # live slots per vertex row
+        vfloor = floor.max(axis=-1)  # row lower bound
+
+        # SCC-aggregate view (live targets are always leaders): a slot on a
+        # multi-member SCC must fold the *aggregate* rank and wait for all
+        # members, or dependents would undercut 1 + scc_rank.
+        agg_floor = jnp.zeros(batch, jnp.int32).at[leader].max(vfloor)
+        agg_live = jnp.zeros(batch, jnp.int32).at[leader].add(n_live)
+        agg_miss = jnp.zeros(batch, bool).at[leader].max(missing_blocked)
+        agg_final = (agg_live == 0) & ~agg_miss
+
+        t_final = agg_final[safe]
+        t_miss = agg_miss[safe]
+        t_vfloor = agg_floor[safe]
+
+        # (a) finalized target SCC: fold into floor, close the slot
+        new_floor = jnp.where(live & t_final, jnp.maximum(floor, add + t_vfloor), floor)
+        new_tgt = jnp.where(live & t_final, TERMINAL, tgt)
+        new_add = add
+
+        # (b) missing-blocked target: vertex becomes missing-blocked
+        new_missing = missing_blocked | (live & t_miss).any(axis=-1)
+
+        # (c) live target: always absorb its floor (relaxation)...
+        still = live & ~t_final & ~t_miss
+        new_floor = jnp.where(still, jnp.maximum(new_floor, add + t_vfloor), new_floor)
+        # ...and compose through singleton-SCC targets with one live slot
+        # (chain doubling)
+        single = still & (agg_live[safe] == 1) & (member_count[safe] == 1)
+        t_live = (tgt >= 0)[safe]  # [B, D, D]
+        slot_of_t = jnp.argmax(t_live, axis=-1)  # [B, D]
+        t_slot_tgt = jnp.take_along_axis(tgt[safe], slot_of_t[..., None], axis=-1)[..., 0]
+        t_slot_add = jnp.take_along_axis(add[safe], slot_of_t[..., None], axis=-1)[..., 0]
+        new_tgt = jnp.where(single, t_slot_tgt, new_tgt)
+        new_add = jnp.where(single, add + t_slot_add, new_add)
+        # self-pointing slot after composition = wrapped a cycle the 2-cycle
+        # pass missed; freeze it (stays live, flagged stuck by the budget)
+
+        changed = (
+            (new_tgt != tgt).any() | (new_floor != floor).any() | (new_missing != missing_blocked).any()
+        )
+        return it + 1, new_tgt, new_add, new_floor, new_missing, changed
+
+    def cond(state):
+        it, _tgt, _add, _floor, _miss, changed = state
+        return (it < max_iters) & changed
+
+    state = (jnp.int32(0), tgt, add, floor, missing_blocked, jnp.bool_(True))
+    _, tgt, add, floor, missing_blocked, _ = jax.lax.while_loop(cond, body, state)
+
+    live = tgt >= 0
+    final = (live.sum(axis=-1) == 0) & ~missing_blocked
+    vrank = floor.max(axis=-1)
+
+    # fold SCC members onto their leader: shared rank = max member rank
+    scc_rank = jnp.zeros(batch, jnp.int32).at[leader].max(jnp.where(final, vrank, 0))
+    scc_final = jnp.ones(batch, bool).at[leader].min(final)
+    scc_missing = jnp.zeros(batch, bool).at[leader].max(missing_blocked)
+    resolved = scc_final[leader] & ~scc_missing[leader]
+    rank = jnp.where(resolved, scc_rank[leader], _UNRESOLVED_RANK).astype(jnp.int32)
+    stuck = ~resolved & ~(missing_blocked | scc_missing[leader])
+
+    order = _order_from_ranks(rank, leader, dot_src, dot_seq)
+    return GeneralResolution(order, resolved, rank, leader, stuck)

@@ -30,8 +30,6 @@ from fantoch_tpu.protocol.commit_gc import (
     CommitGCMixin,
     GarbageCollectionEvent,
     MCommitDot,
-    MGarbageCollection,
-    MStable,
 )
 from fantoch_tpu.protocol.gc import GCTrack
 from fantoch_tpu.protocol.info import CommandsInfo
@@ -171,7 +169,3 @@ class Basic(CommitGCMixin, Protocol):
         if gc_index is not None:
             return gc_index[0]
         raise AssertionError(f"unknown message {msg}")
-
-    @staticmethod
-    def event_index(event):
-        return worker_index_no_shift(GC_WORKER_INDEX)

@@ -77,6 +77,12 @@ class CommitGCMixin:
         return self.bp.config.gc_interval_ms is not None
 
     @staticmethod
+    def event_index(event):
+        """Periodic events (GC rounds) run on the reserved GC worker
+        (fantoch/src/run/prelude.rs:18)."""
+        return worker_index_no_shift(GC_WORKER_INDEX)
+
+    @staticmethod
     def gc_message_index(msg):
         """Worker routing for GC messages; None if `msg` is not one, and the
         MStable broadcast-to-all-workers is represented as (None,)."""

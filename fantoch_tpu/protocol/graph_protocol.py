@@ -34,8 +34,6 @@ from fantoch_tpu.protocol.commit_gc import (
     CommitGCMixin,
     GarbageCollectionEvent,
     MCommitDot,
-    MGarbageCollection,
-    MStable,
 )
 from fantoch_tpu.protocol.common.graph_deps import Dependency, KeyDeps, QuorumDeps
 from fantoch_tpu.protocol.common.synod import (
@@ -342,10 +340,6 @@ class GraphProtocol(CommitGCMixin, Protocol):
         if gc_index is not None:
             return gc_index[0]
         raise AssertionError(f"unknown message {msg}")
-
-    @staticmethod
-    def event_index(event):
-        return worker_index_no_shift(GC_WORKER_INDEX)
 
 
 class EPaxos(GraphProtocol):

@@ -5,7 +5,6 @@ Slow-path expectations: with f=1 (and 50% conflicts), both protocols must
 commit everything on the fast path; with f=2 on n=5, slow paths must occur.
 """
 
-import pytest
 
 from fantoch_tpu.core import Config
 from fantoch_tpu.protocol.graph_protocol import Atlas, EPaxos
