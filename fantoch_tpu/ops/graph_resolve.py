@@ -46,8 +46,9 @@ doubling:
 Everything is gathers/scatters/min/max over int32[B] — no data-dependent
 shapes, fully jittable, MXU-free but HBM-friendly.  The general
 (multi-key, out-degree D) path uses affine-max pointer doubling with a
-relaxation floor and falls back to the host Tarjan oracle for the rare
-residue it cannot finish (see executor/graph/batched.py).
+relaxation floor; the rare residue it cannot finish (3+-cycles) is
+reported via ``stuck`` so the caller can hand those vertices to the host
+Tarjan oracle (executor/graph/deps_graph.py).
 """
 
 from __future__ import annotations
@@ -109,7 +110,6 @@ def resolve_functional(
     # self-absorbing pointers: terminals/missing point at themselves with
     # zero step cost, so doubling past them is a no-op.
     jump = jnp.where(absorbing, idx, dep)
-    dist = jnp.where(absorbing, 0, 1).astype(jnp.int32)
     # min id over the true path p^1..p^(2^t); init = id of first hop
     acc = jnp.where(absorbing, jnp.int32(batch), jump)
 
@@ -117,7 +117,6 @@ def resolve_functional(
     for _ in range(steps):
         jumps_log.append(jump)
         acc = jnp.minimum(acc, acc[jump])
-        dist = dist + dist[jump]
         jump = jump[jump]
 
     end = jump  # endpoint after 2^steps hops
@@ -200,32 +199,36 @@ def resolve_general(
     progress never stalls on merge vertices — worst case degrades to
     frontier peeling, typical per-key-chain graphs finish in O(log depth).
 
-    Two-cycles (the dominant SCC shape: two concurrent conflicting
-    proposals, one per replica) are collapsed exactly by a mutual-edge
-    pre-pass.  Longer cycles surface as ``stuck`` and are finished by the
-    host Tarjan oracle — they cannot deadlock the device pass because
-    stuckness is detected by iteration budget, not by waiting.
+    SCCs whose vertices are connected by *mutual* edges (the dominant
+    shape: k concurrent conflicting proposals that all saw each other,
+    k = 2 being two replicas racing) are collapsed exactly by a
+    mutual-edge connected-components pre-pass.  Cycles with no mutual
+    edges (delivery orders where conflict visibility is strictly
+    one-directional around a ring) surface as ``stuck`` for the host
+    Tarjan oracle to finish — they cannot deadlock or spin the device
+    pass: floors/adds saturate at the batch size, after which the loop
+    settles and the budget check exits early.
     """
     batch, width = deps.shape
     idx = jnp.arange(batch, dtype=jnp.int32)
     if max_iters == 0:
         max_iters = 4 * _num_doubling_steps(batch) + 8
 
-    # --- 2-cycle collapse: v and u mutually dependent -> same SCC.
-    # leader = min(v, u); edges into the pair are retargeted to the leader
-    # and the intra-pair edges are pruned.
+    # --- mutual-edge SCC collapse: v and u mutually dependent -> same SCC,
+    # and so is the whole connected component of the (undirected) mutual-
+    # edge graph.  leader = min id of the component, found by min-label
+    # propagation over mutual neighbours with pointer jumping; intra-
+    # component edges are pruned and inbound edges retargeted.
     tgt = deps  # int32[B, D]
     valid = tgt >= 0
     safe_tgt = jnp.where(valid, tgt, 0)
     # reverse test: does any slot of target point back at v?
     back = (tgt[safe_tgt] == idx[:, None, None]).any(axis=-1) & valid
-    pair_leader = jnp.where(
-        back, jnp.minimum(idx[:, None], safe_tgt), jnp.int32(batch)
-    ).min(axis=-1)
-    leader = jnp.where(pair_leader < batch, pair_leader, idx).astype(jnp.int32)
-    # path-compress leader chains (overlapping 2-cycles form a↔b↔c chains
-    # whose members must all agree on one leader)
+    leader = idx
     for _ in range(_num_doubling_steps(batch)):
+        # min over mutual neighbours' leaders, then pointer jump
+        nbr_min = jnp.where(back, leader[safe_tgt], jnp.int32(batch)).min(axis=-1)
+        leader = jnp.minimum(leader, nbr_min)
         leader = jnp.minimum(leader, leader[leader])
 
     # rewrite deps through leaders; drop intra-SCC edges
@@ -236,9 +239,7 @@ def resolve_general(
     # rank at the end, so fold member floors via a segment-max on leader.
 
     is_miss = tgt == MISSING
-    live = tgt >= 0
-    safe = jnp.where(live, tgt, 0)
-    add = jnp.where(live, 1, 0).astype(jnp.int32)
+    add = jnp.where(tgt >= 0, 1, 0).astype(jnp.int32)
     floor = jnp.zeros((batch, width), dtype=jnp.int32)
     missing_blocked = is_miss.any(axis=-1)
 
@@ -246,7 +247,12 @@ def resolve_general(
 
     def body(state):
         it, tgt, add, floor, missing_blocked, _changed = state
-        live = tgt >= 0
+        # a slot that composed all the way around a 3+-cycle points at its
+        # own vertex: frozen — excluded from folding, absorption and
+        # composition so the loop settles and the budget exits early; the
+        # vertex stays live and surfaces as ``stuck``.
+        frozen = tgt == idx[:, None]
+        live = (tgt >= 0) & ~frozen
         safe = jnp.where(live, tgt, 0)
         n_live = live.sum(axis=-1)  # live slots per vertex row
         vfloor = floor.max(axis=-1)  # row lower bound
@@ -257,7 +263,8 @@ def resolve_general(
         agg_floor = jnp.zeros(batch, jnp.int32).at[leader].max(vfloor)
         agg_live = jnp.zeros(batch, jnp.int32).at[leader].add(n_live)
         agg_miss = jnp.zeros(batch, bool).at[leader].max(missing_blocked)
-        agg_final = (agg_live == 0) & ~agg_miss
+        agg_frozen = jnp.zeros(batch, bool).at[leader].max(frozen.any(axis=-1))
+        agg_final = (agg_live == 0) & ~agg_miss & ~agg_frozen
 
         t_final = agg_final[safe]
         t_miss = agg_miss[safe]
@@ -275,16 +282,29 @@ def resolve_general(
         still = live & ~t_final & ~t_miss
         new_floor = jnp.where(still, jnp.maximum(new_floor, add + t_vfloor), new_floor)
         # ...and compose through singleton-SCC targets with one live slot
-        # (chain doubling)
-        single = still & (agg_live[safe] == 1) & (member_count[safe] == 1)
-        t_live = (tgt >= 0)[safe]  # [B, D, D]
+        # (chain doubling); stop composing once ``add`` saturates — a legit
+        # chain has < batch hops, so only unwrapped cycles ever get there.
+        single = (
+            still
+            & (agg_live[safe] == 1)
+            & (member_count[safe] == 1)
+            & (add < jnp.int32(batch))
+        )
+        t_live = ((tgt >= 0) & ~frozen)[safe]  # [B, D, D]
         slot_of_t = jnp.argmax(t_live, axis=-1)  # [B, D]
         t_slot_tgt = jnp.take_along_axis(tgt[safe], slot_of_t[..., None], axis=-1)[..., 0]
         t_slot_add = jnp.take_along_axis(add[safe], slot_of_t[..., None], axis=-1)[..., 0]
         new_tgt = jnp.where(single, t_slot_tgt, new_tgt)
         new_add = jnp.where(single, add + t_slot_add, new_add)
-        # self-pointing slot after composition = wrapped a cycle the 2-cycle
-        # pass missed; freeze it (stays live, flagged stuck by the budget)
+        # a composition that lands on the vertex itself wrapped a cycle the
+        # mutual-edge pass missed; it becomes ``frozen`` next iteration
+
+        # saturate: legitimate ranks/hop-counts are < batch, so capping at
+        # batch only affects un-collapsible cycles — whose floors would
+        # otherwise grow (and overflow) forever, keeping ``changed`` true
+        # for the whole budget instead of settling in O(log batch) rounds.
+        new_floor = jnp.minimum(new_floor, jnp.int32(batch))
+        new_add = jnp.minimum(new_add, jnp.int32(batch))
 
         changed = (
             (new_tgt != tgt).any() | (new_floor != floor).any() | (new_missing != missing_blocked).any()

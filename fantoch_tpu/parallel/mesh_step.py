@@ -1,0 +1,231 @@
+"""Multi-chip SPMD protocol step: replica x batch sharding over a device mesh.
+
+The reference scales by (1) geo-replication — n processes each running the
+protocol state machine (fantoch/src/protocol/base.rs) — and (2) per-key /
+per-dot sharding inside each process (fantoch/src/run/pool.rs:115-124).
+The TPU-native equivalents are two mesh axes:
+
+  * ``replica`` — each mesh slice along this axis holds one (or a block of)
+    replica's protocol state: its key-clock table (the analog of
+    ``KeyDeps``, fantoch_ps/src/protocol/common/graph/deps/keys/sequential.rs)
+    and its executed frontier.  Quorum aggregation (the MCollectAck fan-in,
+    fantoch_ps/src/protocol/epaxos.rs:305-370) becomes ``pmax``/``pmin``
+    collectives along this axis — riding ICI instead of TCP.
+  * ``batch`` — commands of one round are sharded along this axis; per-key
+    conflict detection is local work + one ``all_gather`` (commands are
+    tiny: a key bucket and a dot), and the dependency-graph resolution
+    (fantoch_ps/src/executor/graph/tarjan.rs) runs batched via
+    :mod:`fantoch_tpu.ops.graph_resolve`.
+
+One :func:`protocol_step` is the analog of delivering a full
+MCollect -> MCollectAck -> MCommit -> execute round for B commands on all
+replicas at once:
+
+  1. per-replica dependency computation (scatter/gather over the replica's
+     key-clock shard) — each replica reports the latest conflicting command
+     it knows (``KeyDeps::add_cmd``);
+  2. fast-path check: EPaxos commits on the fast path iff *all* fast-quorum
+     replicas report identical deps (epaxos.rs:339-345) — here
+     ``pmax == pmin`` along ``replica``;
+  3. final deps = union = elementwise max along ``replica`` (with
+     latest-per-key sequential deps, union of singletons is the max dot);
+  4. batched SCC/topological resolution of the committed batch
+     (ops/graph_resolve.resolve_functional), shared across the ``batch``
+     axis via one small all_gather;
+  5. state update: scatter-max the new dots into every replica's key-clock
+     and advance the executed frontier.
+
+All state stays device-resident across steps (donated), so the host only
+feeds command batches and drains execution orders.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+shard_map = jax.shard_map
+
+from fantoch_tpu.ops.graph_resolve import TERMINAL, resolve_functional
+
+REPLICA_AXIS = "replica"
+BATCH_AXIS = "batch"
+
+
+class ReplicaState(NamedTuple):
+    """Per-replica device-resident protocol state.
+
+    ``key_clock[R, K]``: global id (see below) of the latest committed
+    command per key bucket, per replica; -1 when none.  The analog of the
+    per-process sequential ``KeyDeps`` map.
+
+    ``frontier[R]``: number of commands this replica has committed+executed
+    (the AEClock frontier of fantoch/src/protocol/gc.rs, collapsed to a
+    counter in this dense batched regime where execution is in rounds).
+    """
+
+    key_clock: jax.Array  # int32[R, K]
+    frontier: jax.Array  # int32[R]
+    next_gid: jax.Array  # int32[] — global id of the next batch's first cmd
+
+
+class StepOutput(NamedTuple):
+    order: jax.Array  # int32[B] execution order (batch indices)
+    resolved: jax.Array  # bool[B]
+    fast_path: jax.Array  # bool[B] — committed on the fast path
+    deps_gid: jax.Array  # int32[B] — final dependency (global id, -1 none)
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """Factor the device list into a (replica, batch) mesh.
+
+    Replica axis gets the smaller factor (real deployments have 3..11
+    replicas; batches are wide).
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    replica = 1
+    for cand in range(min(n, 8), 0, -1):
+        if n % cand == 0 and cand <= n // cand:
+            replica = cand
+            break
+    import numpy as np
+
+    dev_array = np.array(devices).reshape(replica, n // replica)
+    return Mesh(dev_array, (REPLICA_AXIS, BATCH_AXIS))
+
+
+def init_state(mesh: Mesh, num_replicas: int, key_buckets: int = 4096) -> ReplicaState:
+    """Device-resident initial state, sharded over the replica axis."""
+    sharding = NamedSharding(mesh, P(REPLICA_AXIS, None))
+    key_clock = jax.device_put(
+        jnp.full((num_replicas, key_buckets), -1, dtype=jnp.int32), sharding
+    )
+    frontier = jax.device_put(
+        jnp.zeros((num_replicas,), dtype=jnp.int32),
+        NamedSharding(mesh, P(REPLICA_AXIS)),
+    )
+    next_gid = jax.device_put(jnp.int32(0), NamedSharding(mesh, P()))
+    return ReplicaState(key_clock, frontier, next_gid)
+
+
+def _intra_batch_chain(key: jax.Array) -> jax.Array:
+    """dep_in_batch[i] = latest j < i with key[j] == key[i], else -1.
+
+    Stable-sort by key, then each element's predecessor within its key run
+    is its intra-batch dependency — the tensorized ``KeyDeps::add_cmd``
+    latest-per-key chain for commands of the same round.
+    """
+    batch = key.shape[0]
+    idx = jnp.arange(batch, dtype=jnp.int32)
+    perm = jnp.argsort(key, stable=True).astype(jnp.int32)
+    sorted_key = key[perm]
+    prev_same = jnp.where(
+        (idx > 0) & (sorted_key == jnp.roll(sorted_key, 1)),
+        jnp.roll(perm, 1),
+        jnp.int32(TERMINAL),
+    )
+    return jnp.zeros((batch,), jnp.int32).at[perm].set(prev_same)
+
+
+def protocol_step(
+    state: ReplicaState,
+    key: jax.Array,  # int32[B] key buckets, replicated
+    dot_src: jax.Array,  # int32[B]
+    dot_seq: jax.Array,  # int32[B]
+    *,
+    mesh: Mesh,
+) -> Tuple[ReplicaState, StepOutput]:
+    """One batched commit+execute round over the (replica, batch) mesh."""
+    num_replicas, key_buckets = state.key_clock.shape
+    batch = key.shape[0]
+
+    def step(key_clock, frontier, next_gid, key_l, dot_src_l, dot_seq_l):
+        # local blocks: key_clock [r_blk, K], key_l [b_blk] (sharded batch)
+        # 1. full batch view of the keys (commands are tiny; one gather)
+        key_full = jax.lax.all_gather(key_l, BATCH_AXIS, tiled=True)  # [B]
+        dot_src_f = jax.lax.all_gather(dot_src_l, BATCH_AXIS, tiled=True)
+        dot_seq_f = jax.lax.all_gather(dot_seq_l, BATCH_AXIS, tiled=True)
+
+        gid = next_gid + jnp.arange(batch, dtype=jnp.int32)  # global ids
+
+        # 2. per-replica deps: intra-batch chain, else the replica's
+        # key-clock entry (KeyDeps::add_cmd per replica)
+        chain = _intra_batch_chain(key_full)  # [B] batch index or -1
+        prior = key_clock[:, key_full]  # [r_blk, B] global id or -1
+        dep_gid = jnp.where(
+            chain >= 0, gid[jnp.maximum(chain, 0)], prior
+        )  # [r_blk, B]
+
+        # 3. quorum aggregation along the replica axis (the MCollectAck
+        # fan-in): fast path iff all replicas reported the same dep.
+        dep_max = jax.lax.pmax(dep_gid.max(axis=0), REPLICA_AXIS)  # [B]
+        dep_min = jax.lax.pmin(dep_gid.min(axis=0), REPLICA_AXIS)  # [B]
+        fast = dep_max == dep_min
+        final_gid = dep_max  # union of latest-per-key singletons = max
+
+        # 4. batched resolution of the committed round (all deps are within
+        # this batch or already executed, so prune pre-batch deps).
+        dep_idx = jnp.where(
+            final_gid >= next_gid, final_gid - next_gid, jnp.int32(TERMINAL)
+        )
+        res = resolve_functional(dep_idx, dot_src_f, dot_seq_f)
+
+        # 5. state update: every replica learns the committed dots
+        # (scatter-max by key; later commands in the batch win)
+        new_clock = key_clock.at[:, key_full].max(gid[None, :])
+        new_frontier = frontier + res.resolved.sum().astype(jnp.int32)
+        return (
+            new_clock,
+            new_frontier,
+            next_gid + batch,
+            res.order,
+            res.resolved,
+            fast,
+            final_gid,
+        )
+
+    specs_in = (
+        P(REPLICA_AXIS, None),  # key_clock
+        P(REPLICA_AXIS),  # frontier
+        P(),  # next_gid
+        P(BATCH_AXIS),  # key
+        P(BATCH_AXIS),  # dot_src
+        P(BATCH_AXIS),  # dot_seq
+    )
+    specs_out = (
+        P(REPLICA_AXIS, None),
+        P(REPLICA_AXIS),
+        P(),
+        P(),  # order (replicated full-batch)
+        P(),
+        P(),
+        P(),
+    )
+    # check_vma=False: outputs derived from all_gather/pmax results are
+    # replicated by construction, but the static VMA analysis cannot see
+    # through the gather+argsort chain.
+    fn = shard_map(
+        step, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False
+    )
+    new_clock, new_frontier, new_gid, order, resolved, fast, deps = fn(
+        state.key_clock, state.frontier, state.next_gid, key, dot_src, dot_seq
+    )
+    return (
+        ReplicaState(new_clock, new_frontier, new_gid),
+        StepOutput(order, resolved, fast, deps),
+    )
+
+
+def jit_protocol_step(mesh: Mesh):
+    """jit-compiled step with donated device-resident state."""
+    import functools
+
+    return jax.jit(
+        functools.partial(protocol_step, mesh=mesh), donate_argnums=(0,)
+    )

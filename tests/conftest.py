@@ -2,14 +2,22 @@
 
 Multi-chip hardware is not available in CI; sharding tests run on a virtual
 8-device CPU mesh (jax.sharding semantics are identical; only perf differs).
-Must run before jax initializes its backends.
+
+The ambient environment may have already imported jax pointed at a single
+real chip (a sitecustomize hook registers the TPU plugin at interpreter
+start), so env vars alone are too late — override through jax.config before
+any backend is initialized.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

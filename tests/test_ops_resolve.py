@@ -226,13 +226,35 @@ def test_general_two_cycle_collapse():
 
 
 def test_general_three_cycle_goes_stuck():
-    # 3-cycles have no mutual edge: the device pass flags them stuck for the
-    # host oracle instead of resolving them wrong
+    # a directed 3-ring has no mutual edge: the device pass flags it stuck
+    # for the host oracle instead of resolving it wrong
     d1, d2, d3 = Dot(1, 1), Dot(2, 1), Dot(3, 1)
     args = [(d1, ["A"], {d3}), (d2, ["A"], {d1}), (d3, ["A"], {d2})]
     _, count, res = resolver_per_key_order(args, functional=False)
     assert count == 0
     assert np.asarray(res.stuck).all()
+
+
+def test_general_three_way_mutual_conflict_collapses():
+    # k-way mutual visibility (all proposals saw each other) is one SCC even
+    # when not every pair is linked: 0<->2 and 1<->2 connect {0,1,2} through
+    # the mutual-edge component pass
+    d1, d2, d3 = Dot(1, 1), Dot(2, 1), Dot(3, 1)
+    args = [(d1, ["A"], {d3}), (d2, ["A"], {d3}), (d3, ["A"], {d1, d2})]
+    per_key, count, res = resolver_per_key_order(args, functional=False)
+    assert count == 3
+    assert not np.asarray(res.stuck).any()
+    assert per_key["A"] == [d1, d2, d3]
+
+
+def test_general_full_mutual_clique_collapses():
+    # every pair mutually dependent (simultaneous conflicting submits on all
+    # replicas): single SCC, dot-sorted execution
+    dots = [Dot(pid, 1) for pid in (1, 2, 3, 4)]
+    args = [(d, ["A"], set(dots) - {d}) for d in dots]
+    per_key, count, res = resolver_per_key_order(args, functional=False)
+    assert count == 4
+    assert per_key["A"] == sorted(dots)
 
 
 def test_general_random_vs_oracle():
@@ -248,8 +270,10 @@ def test_general_random_vs_oracle():
         ]
         keys = {dot: set(rng.sample(possible_keys, 2)) for dot in dots}
         deps = {dot: set() for dot in dots}
-        # same-process ordering + directed conflict edges (acyclic across
-        # processes by dot order -> only 2-cycles possible via mutual picks)
+        # same-process ordering + random directed conflict edges.  Cross-
+        # process picks can compose into directed 3+-cycles with no mutual
+        # edge; those trials exercise the weak (stuck-prefix) branch below,
+        # mutual-edge-only trials exercise the exact-match branch.
         import itertools as it
 
         for left, right in it.combinations(dots, 2):
