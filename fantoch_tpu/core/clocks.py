@@ -80,6 +80,70 @@ class AboveExSet:
         return AboveExSet(self._frontier, set(self._above))
 
 
+class RangeEventSet:
+    """Event set stored as sorted disjoint ranges — O(log r) adds for
+    arbitrarily wide ranges.
+
+    Newt vote ranges span real-time microsecond clocks (ranges of millions
+    of events per bump, fantoch_ps/src/protocol/newt.rs clock-bump), so the
+    per-event ``AboveExSet`` representation is unusable there; this is the
+    analog of the threshold crate's ``ARClock`` event sets.
+    """
+
+    __slots__ = ("_ranges",)
+
+    def __init__(self) -> None:
+        # sorted, disjoint, non-adjacent [start, end] (inclusive) ranges
+        self._ranges: list = []
+
+    def add_range(self, start: int, end: int) -> bool:
+        """Union [start, end] in; returns True if any event was new."""
+        assert start <= end
+        import bisect
+
+        ranges = self._ranges
+        # first range that could touch [start, end]: rightmost with
+        # range_start <= end + 1, scanning left while overlapping/adjacent
+        lo = bisect.bisect_left(ranges, (start,))
+        if lo > 0 and ranges[lo - 1][1] >= start - 1:
+            lo -= 1
+        hi = lo
+        new_start, new_end = start, end
+        while hi < len(ranges) and ranges[hi][0] <= end + 1:
+            r_start, r_end = ranges[hi]
+            new_start = min(new_start, r_start)
+            new_end = max(new_end, r_end)
+            hi += 1
+        if hi == lo:
+            ranges.insert(lo, (start, end))
+            return True
+        covered = hi - lo == 1 and ranges[lo][0] <= start and ranges[lo][1] >= end
+        ranges[lo:hi] = [(new_start, new_end)]
+        return not covered
+
+    def contains(self, event: int) -> bool:
+        import bisect
+
+        i = bisect.bisect_right(self._ranges, (event, float("inf")))
+        return i > 0 and self._ranges[i - 1][1] >= event
+
+    @property
+    def frontier(self) -> int:
+        """Highest event e with 1..=e all present."""
+        if self._ranges and self._ranges[0][0] == 1:
+            return self._ranges[0][1]
+        return 0
+
+    def event_count(self) -> int:
+        return sum(end - start + 1 for start, end in self._ranges)
+
+    def ranges(self):
+        return list(self._ranges)
+
+    def __repr__(self) -> str:
+        return f"RangeEventSet({self._ranges})"
+
+
 class AEClock(Generic[A]):
     """Above-exception clock: actor -> AboveExSet."""
 

@@ -1,0 +1,213 @@
+"""TableExecutor: timestamp-stability ordering for Newt/Tempo.
+
+Reference: fantoch_ps/src/executor/table/{mod,executor}.rs.  Commands carry
+a timestamp (clock) and the votes consumed while computing it; a per-key
+``VotesTable`` buffers ops sorted by ``(clock, dot)`` and executes every op
+whose sort id is below the *stable clock* — the
+``(n - stability_threshold)``-th smallest per-process vote frontier, i.e.
+the timestamp such that at least ``stability_threshold`` processes have
+voted all timestamps up to it, so no new command can be assigned a lower
+one (mod.rs:247-270).
+
+Tensor note: per-key frontiers are one ``int32[K, n]`` array on device and
+the stable clock one ``jnp.sort`` along the process axis (see
+fantoch_tpu/ops); this host twin keys tables lazily for the simulator and
+runner control plane.
+"""
+
+from __future__ import annotations
+
+from bisect import insort
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from fantoch_tpu.core.clocks import RangeEventSet
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Dot, ProcessId, Rifl, ShardId, process_ids
+from fantoch_tpu.core.kvs import Key, KVOp, KVStore
+from fantoch_tpu.executor.base import Executor, ExecutorResult
+from fantoch_tpu.protocol.common.table_clocks import VoteRange
+
+# ops with equal clocks are tie-broken by dot (mod.rs:18 ``SortId``)
+SortId = Tuple[int, Dot]
+
+
+@dataclass
+class TableVotes:
+    """TableExecutionInfo::Votes (executor.rs:121-129)."""
+
+    dot: Dot
+    clock: int
+    rifl: Rifl
+    key: Key
+    ops: Tuple[KVOp, ...]
+    votes: List[VoteRange]
+
+
+@dataclass
+class TableDetachedVotes:
+    """TableExecutionInfo::DetachedVotes (executor.rs:130-133)."""
+
+    key: Key
+    votes: List[VoteRange]
+
+
+TableExecutionInfo = object  # TableVotes | TableDetachedVotes
+
+
+class VotesTable:
+    """Single-key table: vote frontiers per process + clock-sorted op buffer
+    (mod.rs:104-270)."""
+
+    __slots__ = ("key", "process_id", "n", "stability_threshold", "_votes", "_ops")
+
+    def __init__(
+        self,
+        key: Key,
+        process_id: ProcessId,
+        shard_id: ShardId,
+        n: int,
+        stability_threshold: int,
+    ):
+        assert stability_threshold <= n, (
+            "stability threshold must always be at most the number of processes"
+        )
+        self.key = key
+        self.process_id = process_id
+        self.n = n
+        self.stability_threshold = stability_threshold
+        self._votes: Dict[ProcessId, RangeEventSet] = {
+            pid: RangeEventSet() for pid in process_ids(shard_id, n)
+        }
+        self._ops: List[Tuple[SortId, Rifl, Tuple[KVOp, ...]]] = []
+
+    def add(
+        self,
+        dot: Dot,
+        clock: int,
+        rifl: Rifl,
+        ops: Tuple[KVOp, ...],
+        votes: List[VoteRange],
+    ) -> None:
+        sort_id = (clock, dot)
+        assert all(entry[0] != sort_id for entry in self._ops), (
+            "two commands cannot occupy the same (clock, dot) slot"
+        )
+        insort(self._ops, (sort_id, rifl, ops))
+        self.add_votes(votes)
+
+    def add_votes(self, votes: List[VoteRange]) -> None:
+        for vote in votes:
+            self._votes[vote.by].add_range(vote.start, vote.end)
+
+    def stable_ops(self) -> List[Tuple[Rifl, Tuple[KVOp, ...]]]:
+        """Pop every op with sort id strictly below
+        ``(stable_clock + 1, first dot)`` — i.e. with clock <= stable_clock
+        (mod.rs:200-244; the reference's split_off keeps ops at the bound
+        buffered)."""
+        from bisect import bisect_left
+
+        stable_clock = self.stable_clock()
+        next_stable: SortId = (stable_clock + 1, Dot(1, 1))
+        cut = bisect_left(self._ops, (next_stable,))
+        stable = [(rifl, ops) for _, rifl, ops in self._ops[:cut]]
+        del self._ops[:cut]
+        return stable
+
+    def stable_clock(self) -> int:
+        """(n - threshold)-th smallest per-process vote frontier
+        (mod.rs:247-270)."""
+        frontiers = sorted(es.frontier for es in self._votes.values())
+        return frontiers[self.n - self.stability_threshold]
+
+
+class MultiVotesTable:
+    """Lazily-keyed map of VotesTable (mod.rs:21-102)."""
+
+    __slots__ = ("process_id", "shard_id", "n", "stability_threshold", "_tables")
+
+    def __init__(
+        self, process_id: ProcessId, shard_id: ShardId, n: int, stability_threshold: int
+    ):
+        self.process_id = process_id
+        self.shard_id = shard_id
+        self.n = n
+        self.stability_threshold = stability_threshold
+        self._tables: Dict[Key, VotesTable] = {}
+
+    def add_votes(
+        self,
+        dot: Dot,
+        clock: int,
+        rifl: Rifl,
+        key: Key,
+        ops: Tuple[KVOp, ...],
+        votes: List[VoteRange],
+    ) -> List[Tuple[Rifl, Tuple[KVOp, ...]]]:
+        table = self._table(key)
+        table.add(dot, clock, rifl, ops, votes)
+        return table.stable_ops()
+
+    def add_detached_votes(
+        self, key: Key, votes: List[VoteRange]
+    ) -> List[Tuple[Rifl, Tuple[KVOp, ...]]]:
+        table = self._table(key)
+        table.add_votes(votes)
+        return table.stable_ops()
+
+    def _table(self, key: Key) -> VotesTable:
+        table = self._tables.get(key)
+        if table is None:
+            table = VotesTable(
+                key, self.process_id, self.shard_id, self.n, self.stability_threshold
+            )
+            self._tables[key] = table
+        return table
+
+
+class TableExecutor(Executor):
+    """Executes ops as their timestamps become stable (executor.rs:14-120)."""
+
+    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
+        _, _, stability_threshold = config.newt_quorum_sizes()
+        self._execute_at_commit = config.execute_at_commit
+        self._table = MultiVotesTable(process_id, shard_id, config.n, stability_threshold)
+        self._store = KVStore(config.executor_monitor_execution_order)
+        self._to_clients: Deque[ExecutorResult] = deque()
+
+    def handle(self, info, time) -> None:
+        if isinstance(info, TableVotes):
+            if self._execute_at_commit:
+                self._execute(info.key, [(info.rifl, info.ops)])
+            else:
+                ready = self._table.add_votes(
+                    info.dot, info.clock, info.rifl, info.key, info.ops, info.votes
+                )
+                self._execute(info.key, ready)
+        elif isinstance(info, TableDetachedVotes):
+            if not self._execute_at_commit:
+                ready = self._table.add_detached_votes(info.key, info.votes)
+                self._execute(info.key, ready)
+        else:
+            raise AssertionError(f"unknown table execution info {info}")
+
+    def _execute(self, key: Key, to_execute: List[Tuple[Rifl, Tuple[KVOp, ...]]]) -> None:
+        for rifl, ops in to_execute:
+            results = tuple(self._store.execute(key, op, rifl) for op in ops)
+            self._to_clients.append(ExecutorResult(rifl, key, results))
+
+    def to_clients(self) -> Optional[ExecutorResult]:
+        return self._to_clients.popleft() if self._to_clients else None
+
+    @classmethod
+    def parallel(cls) -> bool:
+        return True
+
+    def monitor(self):
+        return self._store.monitor
+
+    @staticmethod
+    def key_of(info) -> Key:
+        """MessageKey routing (executor.rs:163-170)."""
+        return info.key

@@ -1,0 +1,95 @@
+"""Batched device kernels for the Newt/Tempo timestamp path.
+
+The two hot loops of the table protocol/executor become array ops:
+
+* ``batched_clock_proposal`` — the tensor twin of
+  ``SequentialKeyClocks::proposal`` (fantoch_ps/src/protocol/common/table/
+  clocks/keys/sequential.rs:36-47) for a batch of single-key commands:
+  commands on the same key receive consecutive clocks continuing from the
+  key's prior clock, each lower-bounded by its ``min_clock``.  Within one
+  key group ordered j = 0..m-1::
+
+      clock_j = max(min_j, clock_{j-1} + 1)
+              = rank_j + max_{i <= j}(max(prior+1, min_i) - rank_i)
+
+  a segmented max-scan of ``max(prior+1, min) - rank`` — one sort, one
+  cummax, one scatter.  Vote ranges are born compressed: process p votes
+  ``(prev_end + 1, clock_j)`` per command (votes.rs try_compress shapes).
+
+* ``stable_clocks`` — the tensor twin of ``VotesTable::stable_clock``
+  (fantoch_ps/src/executor/table/mod.rs:247-270) over all key tables at
+  once: sort the per-process vote frontiers along the process axis and take
+  the ``(n - threshold)``-th column.
+
+Both are shape-static, fully jittable, and batch-friendly: one kernel
+launch replaces B hash-map bumps / K BTree walks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def batched_clock_proposal(
+    prior: jax.Array,  # int32[K] — key clock before the batch
+    key: jax.Array,  # int32[B] — key bucket per command
+    min_clock: jax.Array,  # int32[B] — proposal lower bound (0 if none)
+):
+    """Returns ``(clock[B], vote_start[B], new_prior[K])``.
+
+    ``clock`` is the proposed timestamp per command; the voter's consumed
+    range for command i is ``(vote_start[i], clock[i])``; ``new_prior`` is
+    the key-clock table after the whole batch (== the last clock per key).
+    Batch order is proposal order within each key (the worker's arrival
+    order, as in the sequential reference).
+    """
+    batch = key.shape[0]
+    idx = jnp.arange(batch, dtype=jnp.int32)
+
+    # group commands by key, preserving batch order inside groups
+    perm = jnp.argsort(key, stable=True).astype(jnp.int32)
+    k_sorted = key[perm]
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), k_sorted[1:] != k_sorted[:-1]]
+    )
+    # rank within the key group
+    seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+    group_first = jnp.where(seg_start, idx, 0)
+    group_first = jax.lax.associative_scan(jnp.maximum, group_first)
+    rank = idx - group_first
+
+    base = jnp.maximum(prior[k_sorted] + 1, min_clock[perm])  # max(prior+1, min)
+    # segmented running max of (base - rank), resetting at segment starts:
+    # scan (seg_id, value) pairs where the combiner keeps the right operand's
+    # value unless both sides share a segment — associative, no magic
+    # offsets, no overflow for any clock magnitude.
+    def seg_max(a, b):
+        a_seg, a_val = a
+        b_seg, b_val = b
+        return b_seg, jnp.where(a_seg == b_seg, jnp.maximum(a_val, b_val), b_val)
+
+    _, running = jax.lax.associative_scan(seg_max, (seg_id, base - rank))
+    clock_sorted = rank + running
+
+    clock = jnp.zeros((batch,), jnp.int32).at[perm].set(clock_sorted)
+    # voter's range start: previous clock on this key + 1
+    prev_clock_sorted = jnp.where(
+        seg_start, prior[k_sorted], jnp.roll(clock_sorted, 1)
+    )
+    vote_start = jnp.zeros((batch,), jnp.int32).at[perm].set(prev_clock_sorted + 1)
+
+    new_prior = prior.at[key].max(clock)
+    return clock, vote_start, new_prior
+
+
+@functools.partial(jax.jit, static_argnames=("threshold",))
+def stable_clocks(frontiers: jax.Array, *, threshold: int) -> jax.Array:
+    """Stable clock per key: the ``(n - threshold)``-th smallest of the n
+    per-process vote frontiers (``int32[K, n] -> int32[K]``)."""
+    n = frontiers.shape[1]
+    assert threshold <= n
+    return jnp.sort(frontiers, axis=1)[:, n - threshold]

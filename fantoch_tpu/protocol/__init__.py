@@ -14,6 +14,7 @@ _LAZY = {
     "Basic": "fantoch_tpu.protocol.basic",
     "EPaxos": "fantoch_tpu.protocol.graph_protocol",
     "Atlas": "fantoch_tpu.protocol.graph_protocol",
+    "Newt": "fantoch_tpu.protocol.newt",
 }
 
 

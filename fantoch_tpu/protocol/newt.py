@@ -1,0 +1,470 @@
+"""Newt (Tempo): timestamp consensus with per-key clock votes.
+
+Reference: fantoch_ps/src/protocol/newt.rs (1535 LoC).  Every command gets a
+timestamp; the coordinator proposes ``max`` over its key clocks + 1, fast-
+quorum members counter-propose considering the remote clock as a minimum,
+and the command commits at the max reported clock — on the fast path iff
+that max was reported by at least ``f`` quorum members (newt.rs:527-546),
+else through a Synod round on the clock value (``ConsensusValue = u64``,
+newt.rs:1107).  Execution is delegated to the TableExecutor: votes consumed
+while proposing prove that no lower timestamp can ever be assigned, making
+timestamps *stable* once enough frontiers pass them.
+
+Extras mirrored here:
+- tiny quorums (fast quorum ``2f``, stability ``n - f``) and
+  ``skip_fast_ack`` (fast-quorum members commit directly when ``q == 2``,
+  newt.rs:95-97,313,451);
+- real-time clock bump: a periodic event votes all keys up to
+  ``max(max_commit_clock, time.micros())`` so stability tracks wall time
+  under low load (newt.rs:983-1006);
+- detached-vote batching via the periodic ``SendDetached`` event.
+
+Multi-shard commands (MForwardSubmit/MBump/MShardCommit, partial
+replication) are wired through fantoch_tpu.protocol.partial.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, Optional, Set, Tuple
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
+from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.executor.table import TableDetachedVotes, TableExecutor, TableVotes
+from fantoch_tpu.protocol.base import (
+    Action,
+    BaseProcess,
+    Protocol,
+    ProtocolMetrics,
+    ToForward,
+    ToSend,
+)
+from fantoch_tpu.protocol.commit_gc import (
+    CommitGCMixin,
+    GarbageCollectionEvent,
+    MCommitDot,
+    MGarbageCollection,
+    MStable,
+)
+from fantoch_tpu.protocol.common.synod import (
+    MAccept,
+    MAccepted as SynodMAccepted,
+    MChosen,
+    Synod,
+)
+from fantoch_tpu.protocol.common.table_clocks import (
+    KeyClocks,
+    QuorumClocks,
+    Votes,
+)
+from fantoch_tpu.protocol.gc import GCTrack
+from fantoch_tpu.protocol.info import CommandsInfo
+from fantoch_tpu.run.routing import (
+    worker_dot_index_shift,
+    worker_index_no_shift,
+)
+
+
+# --- messages (newt.rs:1173-1233) ---
+
+
+@dataclass
+class MCollect:
+    dot: Dot
+    cmd: Command
+    quorum: Set[ProcessId]
+    clock: int
+    coordinator_votes: Votes
+
+
+@dataclass
+class MCollectAck:
+    dot: Dot
+    clock: int
+    process_votes: Votes
+
+
+@dataclass
+class MCommit:
+    dot: Dot
+    clock: int
+    votes: Votes
+
+
+@dataclass
+class MCommitClock:
+    """Notify the clock-bump worker of a commit clock (newt.rs:660-676)."""
+
+    clock: int
+
+
+@dataclass
+class MDetached:
+    detached: Votes
+
+
+@dataclass
+class MConsensus:
+    dot: Dot
+    ballot: int
+    clock: int
+
+
+@dataclass
+class MConsensusAck:
+    dot: Dot
+    ballot: int
+
+
+# --- periodic events ---
+
+
+@dataclass
+class ClockBumpEvent:
+    pass
+
+
+@dataclass
+class SendDetachedEvent:
+    pass
+
+
+class Status:
+    START = "start"
+    PAYLOAD = "payload"
+    COLLECT = "collect"
+    COMMIT = "commit"
+
+
+def _proposal_gen(_values):
+    raise NotImplementedError("recovery not implemented yet")
+
+
+class NewtInfo:
+    """Per-dot lifecycle info (newt.rs:1117-1170)."""
+
+    __slots__ = ("status", "quorum", "synod", "cmd", "votes", "quorum_clocks")
+
+    def __init__(self, process_id: ProcessId, n: int, f: int, fast_quorum_size: int):
+        self.status = Status.START
+        self.quorum: Set[ProcessId] = set()
+        self.synod: Synod[int] = Synod(process_id, n, f, _proposal_gen, 0)
+        self.cmd: Optional[Command] = None
+        # coordinator-side aggregation of fast-quorum votes
+        self.votes = Votes()
+        self.quorum_clocks = QuorumClocks(fast_quorum_size)
+
+
+# the clock-bump worker owns all key clocks under worker parallelism
+# (newt.rs:1236 CLOCK_BUMP_WORKER_INDEX)
+CLOCK_BUMP_WORKER_INDEX = 1
+
+
+class Newt(CommitGCMixin, Protocol):
+    Executor = TableExecutor
+
+    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
+        fast_quorum_size, write_quorum_size, _ = config.newt_quorum_sizes()
+        self.bp = BaseProcess(
+            process_id, shard_id, config, fast_quorum_size, write_quorum_size
+        )
+        self.key_clocks = KeyClocks(process_id, shard_id)
+        self._cmds: CommandsInfo[NewtInfo] = CommandsInfo(
+            process_id,
+            shard_id,
+            config,
+            fast_quorum_size,
+            write_quorum_size,
+            lambda pid, _sid, cfg, fq, _wq: NewtInfo(pid, cfg.n, cfg.f, fq),
+        )
+        self._gc_track = GCTrack(process_id, shard_id, config.n)
+        self._to_processes: Deque[Action] = deque()
+        self._to_executors: Deque[Any] = deque()
+        # accumulated detached votes, flushed by SendDetachedEvent
+        self._detached = Votes()
+        # MCommit before MCollect (multiplexing reorders): buffer
+        self._buffered_mcommits: Dict[Dot, Tuple[ProcessId, int, Votes]] = {}
+        # highest committed clock: the floor for real-time clock bumps
+        # (traceical clocks can run ahead of a simulated wall clock)
+        self._max_commit_clock = 0
+        self._skip_fast_ack = config.skip_fast_ack and fast_quorum_size == 2
+        # liveness requires flushing detached votes: proposals consume vote
+        # ranges beyond a command's final clock, and if those never reach the
+        # other replicas' vote tables, their frontiers stall below the gap
+        # and stability stops advancing.  The reference leaves this implicit
+        # (its test macro "always set newt_detached_send_interval",
+        # fantoch_ps/src/protocol/mod.rs:65); we make it explicit.
+        assert config.newt_detached_send_interval_ms is not None, (
+            "Newt requires newt_detached_send_interval_ms: without it, "
+            "detached votes are never sent and timestamp stability stalls"
+        )
+
+    def periodic_events(self):
+        events = list(self.gc_periodic_events())
+        if self.bp.config.newt_clock_bump_interval_ms is not None:
+            events.append((ClockBumpEvent(), self.bp.config.newt_clock_bump_interval_ms))
+        if self.bp.config.newt_detached_send_interval_ms is not None:
+            events.append(
+                (SendDetachedEvent(), self.bp.config.newt_detached_send_interval_ms)
+            )
+        return events
+
+    @property
+    def id(self) -> ProcessId:
+        return self.bp.process_id
+
+    @property
+    def shard_id(self) -> ShardId:
+        return self.bp.shard_id
+
+    def discover(self, processes):
+        connect_ok = self.bp.discover(processes)
+        return connect_ok, dict(self.bp.closest_shard_process())
+
+    def submit(self, dot: Optional[Dot], cmd: Command, time: SysTime) -> None:
+        self._handle_submit(dot, cmd)
+
+    def handle(self, from_, from_shard_id, msg, time):
+        if isinstance(msg, MCollect):
+            self._handle_mcollect(
+                from_, msg.dot, msg.cmd, msg.quorum, msg.clock, msg.coordinator_votes, time
+            )
+        elif isinstance(msg, MCollectAck):
+            self._handle_mcollectack(from_, msg.dot, msg.clock, msg.process_votes)
+        elif isinstance(msg, MCommit):
+            self._handle_mcommit(from_, msg.dot, msg.clock, msg.votes)
+        elif isinstance(msg, MCommitClock):
+            assert from_ == self.bp.process_id
+            self._max_commit_clock = max(self._max_commit_clock, msg.clock)
+        elif isinstance(msg, MDetached):
+            self._handle_mdetached(msg.detached)
+        elif isinstance(msg, MConsensus):
+            self._handle_mconsensus(from_, msg.dot, msg.ballot, msg.clock)
+        elif isinstance(msg, MConsensusAck):
+            self._handle_mconsensusack(from_, msg.dot, msg.ballot)
+        elif not self.handle_gc_message(from_, msg):
+            raise AssertionError(f"unknown message {msg}")
+
+    def handle_event(self, event, time):
+        if isinstance(event, GarbageCollectionEvent):
+            self.handle_gc_event()
+        elif isinstance(event, ClockBumpEvent):
+            self._handle_event_clock_bump(time)
+        elif isinstance(event, SendDetachedEvent):
+            self._handle_event_send_detached()
+        else:
+            raise AssertionError(f"unknown event {event}")
+
+    def to_processes(self) -> Optional[Action]:
+        return self._to_processes.popleft() if self._to_processes else None
+
+    def to_executors(self):
+        return self._to_executors.popleft() if self._to_executors else None
+
+    @classmethod
+    def parallel(cls) -> bool:
+        return KeyClocks.parallel()
+
+    @classmethod
+    def leaderless(cls) -> bool:
+        return True
+
+    def metrics(self) -> ProtocolMetrics:
+        return self.bp.metrics()
+
+    # --- handlers ---
+
+    def _handle_submit(self, dot: Optional[Dot], cmd: Command) -> None:
+        dot = dot if dot is not None else self.bp.next_dot()
+        assert cmd.shard_count == 1, "multi-shard commands arrive in the partial layer"
+        # propose: bump key clocks, consuming votes; those votes are either
+        # shipped in the MCollect (skip_fast_ack: quorum members can commit
+        # without the ack round) or kept for the MCollectAck aggregation
+        clock, process_votes = self.key_clocks.proposal(cmd, 0)
+        if self._skip_fast_ack:
+            coordinator_votes = process_votes
+        else:
+            info = self._cmds.get(dot)
+            info.votes = process_votes
+            coordinator_votes = Votes()
+        mcollect = MCollect(dot, cmd, self.bp.fast_quorum(), clock, coordinator_votes)
+        self._to_processes.append(ToSend(self.bp.all(), mcollect))
+
+    def _handle_mcollect(self, from_, dot, cmd, quorum, remote_clock, votes, time) -> None:
+        info = self._cmds.get(dot)
+        if info.status != Status.START:
+            return
+
+        if self.bp.process_id not in quorum:
+            # not in the fast quorum: store the payload only; pre-create the
+            # key clocks so periodic bumps cover these keys too
+            if self.bp.config.newt_clock_bump_interval_ms is not None:
+                self.key_clocks.init_clocks(cmd)
+            info.status = Status.PAYLOAD
+            info.cmd = cmd
+            buffered = self._buffered_mcommits.pop(dot, None)
+            if buffered is not None:
+                buf_from, buf_clock, buf_votes = buffered
+                self._handle_mcommit(buf_from, dot, buf_clock, buf_votes)
+            return
+
+        message_from_self = from_ == self.bp.process_id
+        if message_from_self:
+            # votes already consumed at submit time; don't double-vote
+            clock, process_votes = remote_clock, Votes()
+        else:
+            clock, process_votes = self.key_clocks.proposal(cmd, remote_clock)
+
+        info.status = Status.COLLECT
+        info.cmd = cmd
+        info.quorum = set(quorum)
+        was_set = info.synod.set_if_not_accepted(lambda: clock)
+        assert was_set
+
+        if not message_from_self and self._skip_fast_ack:
+            # tiny-quorums shortcut (q=2): this quorum member holds both the
+            # coordinator's votes and its own — commit directly.  Count the
+            # fast path here: exactly one non-coordinator member exists, so
+            # commands are counted once (the reference skips accounting on
+            # this path entirely, newt.rs:451-462, leaving commit totals
+            # unverifiable under skip_fast_ack).
+            self.bp.fast_path()
+            votes.merge(process_votes)
+            self._mcommit_actions(info, dot, clock, votes)
+        else:
+            self._to_processes.append(
+                ToSend({from_}, MCollectAck(dot, clock, process_votes))
+            )
+
+    def _handle_mcollectack(self, from_, dot, clock, remote_votes) -> None:
+        info = self._cmds.get(dot)
+        if info.status != Status.COLLECT:
+            return
+        info.votes.merge(remote_votes)
+        max_clock, max_count = info.quorum_clocks.add(from_, clock)
+
+        # detached-bump optimization (newt.rs:506-521): raise our own key
+        # clocks to the highest clock seen so far, so later proposals can't
+        # undercut this command's likely final timestamp.  When the ack is
+        # from self the votes would never ride an MCommit — skip.
+        cmd = info.cmd
+        assert cmd is not None
+        if from_ != self.bp.process_id:
+            self.key_clocks.detached(cmd, max_clock, self._detached)
+
+        if not info.quorum_clocks.all():
+            return
+        if max_count >= self.bp.config.f:
+            self.bp.fast_path()
+            votes, info.votes = info.votes, Votes()
+            self._mcommit_actions(info, dot, max_clock, votes)
+        else:
+            self.bp.slow_path()
+            ballot = info.synod.skip_prepare()
+            self._to_processes.append(
+                ToSend(self.bp.write_quorum(), MConsensus(dot, ballot, max_clock))
+            )
+
+    def _mcommit_actions(self, info: NewtInfo, dot: Dot, clock: int, votes: Votes) -> None:
+        self._to_processes.append(ToSend(self.bp.all(), MCommit(dot, clock, votes)))
+
+    def _handle_mcommit(self, from_, dot, clock, votes: Votes) -> None:
+        info = self._cmds.get(dot)
+        if info.status == Status.START:
+            self._buffered_mcommits[dot] = (from_, clock, votes)
+            return
+        if info.status == Status.COMMIT:
+            return
+
+        cmd = info.cmd
+        assert cmd is not None, "there should be a command payload"
+        for key, ops in cmd.iter_ops(self.bp.shard_id):
+            key_votes = votes.remove(key)
+            self._to_executors.append(
+                TableVotes(dot, clock, cmd.rifl, key, ops, key_votes)
+            )
+
+        info.status = Status.COMMIT
+        out = info.synod.handle(from_, MChosen(clock))
+        assert out is None
+
+        if self.bp.config.newt_clock_bump_interval_ms is not None:
+            # real-time mode: the clock-bump worker generates detached votes
+            # periodically; just teach it the commit clock
+            self._to_processes.append(ToForward(MCommitClock(clock)))
+        else:
+            self.key_clocks.detached(cmd, clock, self._detached)
+
+        if self._gc_running() and self._dot_in_my_shard(dot):
+            self._to_processes.append(ToForward(MCommitDot(dot)))
+        else:
+            self._cmds.gc_single(dot)
+
+    def _handle_mdetached(self, detached: Votes) -> None:
+        for key, key_votes in detached:
+            self._to_executors.append(TableDetachedVotes(key, key_votes))
+
+    def _handle_mconsensus(self, from_, dot, ballot, clock) -> None:
+        info = self._cmds.get(dot)
+        out = info.synod.handle(from_, MAccept(ballot, clock))
+        if out is None:
+            return
+        if isinstance(out, SynodMAccepted):
+            msg: Any = MConsensusAck(dot, out.ballot)
+        elif isinstance(out, MChosen):
+            # already chosen: answer with a commit carrying our local votes
+            msg = MCommit(dot, out.value, info.votes)
+        else:
+            raise AssertionError(f"unexpected synod output {out}")
+        self._to_processes.append(ToSend({from_}, msg))
+
+    def _handle_mconsensusack(self, from_, dot, ballot) -> None:
+        info = self._cmds.get(dot)
+        out = info.synod.handle(from_, SynodMAccepted(ballot))
+        if out is None:
+            return
+        assert isinstance(out, MChosen), f"unexpected synod output {out}"
+        votes, info.votes = info.votes, Votes()
+        self._mcommit_actions(info, dot, out.value, votes)
+
+    # --- periodic events ---
+
+    def _handle_event_clock_bump(self, time: SysTime) -> None:
+        # vote every key up to max(highest committed clock, now): stability
+        # then tracks real time even for idle keys (newt.rs:983-1006; micros
+        # because millis lack precision at high client counts)
+        min_clock = max(self._max_commit_clock, time.micros())
+        self.key_clocks.detached_all(min_clock, self._detached)
+
+    def _handle_event_send_detached(self) -> None:
+        if not self._detached.is_empty():
+            detached, self._detached = self._detached, Votes()
+            self._to_processes.append(ToSend(self.bp.all(), MDetached(detached)))
+
+    def _dot_in_my_shard(self, dot: Dot) -> bool:
+        return dot.target_shard(self.bp.config.n) == self.bp.shard_id
+
+    # --- worker routing (newt.rs:1236-1284) ---
+
+    @staticmethod
+    def message_index(msg):
+        if isinstance(msg, (MCollect, MCollectAck, MCommit, MConsensus, MConsensusAck)):
+            return worker_dot_index_shift(msg.dot)
+        if isinstance(msg, MCommitClock):
+            return worker_index_no_shift(CLOCK_BUMP_WORKER_INDEX)
+        if isinstance(msg, MDetached):
+            # any worker may feed detached votes to the executors
+            return worker_index_no_shift(0)
+        gc_index = CommitGCMixin.gc_message_index(msg)
+        if gc_index is not None:
+            return gc_index[0]
+        raise AssertionError(f"unknown message {msg}")
+
+    @staticmethod
+    def event_index(event):
+        if isinstance(event, (ClockBumpEvent, SendDetachedEvent)):
+            return worker_index_no_shift(CLOCK_BUMP_WORKER_INDEX)
+        return CommitGCMixin.event_index(event)

@@ -245,17 +245,27 @@ class Runner:
     def _schedule_protocol_actions(
         self, process_id: ProcessId, shard_id: ShardId, actions: List[Any]
     ) -> None:
+        import copy
+
         for action in actions:
             if isinstance(action, ToSend):
-                for to in action.target:
+                # each target gets its own copy — the real runner serializes
+                # per connection, so receivers may freely mutate payloads
+                # (e.g. Newt merges/strips Votes in place); aliasing one
+                # object across simulated processes would corrupt that
+                targets = sorted(action.target)
+                copies = [action.msg] + [
+                    copy.deepcopy(action.msg) for _ in range(len(targets) - 1)
+                ]
+                for to, msg in zip(targets, copies):
                     if to == process_id:
                         # message to self: deliver immediately
-                        self._handle_send_to_proc(process_id, shard_id, process_id, action.msg)
+                        self._handle_send_to_proc(process_id, shard_id, process_id, msg)
                     else:
                         self._schedule_message(
                             ("process", process_id),
                             ("process", to),
-                            SendToProc(process_id, shard_id, to, action.msg),
+                            SendToProc(process_id, shard_id, to, msg),
                         )
             elif isinstance(action, ToForward):
                 # forwards are worker-to-worker: deliver immediately

@@ -98,5 +98,5 @@ def test_state_carries_across_steps(mesh):
     state, out = step(state, key, src, seq)
     deps = np.asarray(out.deps_gid)
     # first command of round 2 depends on the last command of round 1
-    assert deps[np.argsort(np.asarray(out.order))[0] if False else 0] == batch - 1
+    assert deps[0] == batch - 1
     assert bool(out.resolved.all())

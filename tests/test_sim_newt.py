@@ -1,0 +1,55 @@
+"""Whole-system simulator tests for Newt, mirroring the reference matrix
+(fantoch_ps/src/protocol/mod.rs:62-166): `newt_config!` always sets
+``newt_detached_send_interval`` (without it, detached votes accumulate
+locally and timestamp stability stalls on any clock divergence); the
+real-time variants add tiny quorums + a clock-bump interval.  f=1 must
+commit everything on the fast path, f=2 must hit slow paths under
+conflicts."""
+
+import pytest
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.protocol import Newt
+
+from harness import sim_test
+
+
+def newt_config(n: int, f: int, clock_bump_interval_ms=None, **kwargs) -> Config:
+    """The reference's newt_config! macro (mod.rs:62-75)."""
+    config = Config(n=n, f=f, newt_detached_send_interval_ms=100, **kwargs)
+    if clock_bump_interval_ms is not None:
+        config = config.with_(
+            newt_tiny_quorums=True,
+            newt_clock_bump_interval_ms=clock_bump_interval_ms,
+        )
+    return config
+
+
+def test_newt_3_1():
+    slow = sim_test(Newt, newt_config(3, 1))
+    assert slow == 0, "with f=1 the max clock is always reported >= 1 time"
+
+
+def test_newt_5_1():
+    slow = sim_test(Newt, newt_config(5, 1))
+    assert slow == 0
+
+
+def test_newt_5_2():
+    slow = sim_test(Newt, newt_config(5, 2), seed=1)
+    assert slow > 0, "f=2 with 50% conflicts must take slow paths"
+
+
+def test_newt_3_1_skip_fast_ack():
+    slow = sim_test(Newt, newt_config(3, 1, newt_tiny_quorums=True, skip_fast_ack=True))
+    assert slow == 0
+
+
+def test_real_time_newt_3_1():
+    slow = sim_test(Newt, newt_config(3, 1, clock_bump_interval_ms=50))
+    assert slow == 0
+
+
+def test_real_time_newt_5_1():
+    slow = sim_test(Newt, newt_config(5, 1, clock_bump_interval_ms=50))
+    assert slow == 0

@@ -1,0 +1,133 @@
+"""Vote ranges, key clocks, quorum clocks and the range event set.
+
+Mirrors the reference's colocated tests:
+fantoch_ps/src/protocol/common/table/votes.rs:165-311 (compression),
+.../clocks/keys/mod.rs:104-180 (proposal flow / no double votes),
+.../clocks/quorum.rs:62-110 (max + count golden vectors).
+"""
+
+from fantoch_tpu.core.clocks import RangeEventSet
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.ids import Rifl
+from fantoch_tpu.core.kvs import KVOp
+from fantoch_tpu.protocol.common.table_clocks import (
+    QuorumClocks,
+    SequentialKeyClocks,
+    VoteRange,
+    Votes,
+)
+
+SHARD = 0
+
+
+def put_cmd(rifl: Rifl, keys) -> Command:
+    return Command.from_keys(rifl, SHARD, {k: (KVOp.put(k),) for k in keys})
+
+
+def test_vote_range_compress():
+    a = VoteRange(1, 1, 1)
+    assert a.try_compress(VoteRange(1, 2, 2))
+    assert a == VoteRange(1, 1, 2)
+    assert a.try_compress(VoteRange(1, 3, 6))
+    assert a == VoteRange(1, 1, 6)
+    assert not a.try_compress(VoteRange(1, 8, 8))
+    assert a == VoteRange(1, 1, 6)
+
+
+def test_votes_add_compresses_adjacent():
+    votes = Votes()
+    votes.add("A", VoteRange(1, 1, 3))
+    votes.add("A", VoteRange(1, 4, 6))
+    assert votes.get("A") == [VoteRange(1, 1, 6)]
+    votes.add("A", VoteRange(1, 8, 9))
+    assert votes.get("A") == [VoteRange(1, 1, 6), VoteRange(1, 8, 9)]
+
+
+def test_key_clocks_flow():
+    clocks = SequentialKeyClocks(1, SHARD)
+    cmd_a = put_cmd(Rifl(100, 1), ["A"])
+    cmd_b = put_cmd(Rifl(100, 2), ["B"])
+    cmd_ab = put_cmd(Rifl(100, 3), ["A", "B"])
+
+    clock, votes = clocks.proposal(cmd_a, 0)
+    assert clock == 1 and votes.get("A") == [VoteRange(1, 1, 1)]
+    clock, votes = clocks.proposal(cmd_b, 0)
+    assert clock == 1 and votes.get("B") == [VoteRange(1, 1, 1)]
+    # multi-key: bumps to max(key clocks) + 1 and votes each key's gap
+    clock, votes = clocks.proposal(cmd_ab, 0)
+    assert clock == 2
+    assert votes.get("A") == [VoteRange(1, 2, 2)]
+    assert votes.get("B") == [VoteRange(1, 2, 2)]
+    # min_clock dominates
+    clock, votes = clocks.proposal(cmd_a, 10)
+    assert clock == 10 and votes.get("A") == [VoteRange(1, 3, 10)]
+
+
+def test_key_clocks_no_double_votes():
+    """Across arbitrary proposals, each (process, key, clock-value) is voted
+    at most once (mod.rs:150-180)."""
+    clocks = SequentialKeyClocks(1, SHARD)
+    seen = {"A": set(), "B": set()}
+    for seq in range(1, 50):
+        keys = ["A"] if seq % 3 == 0 else (["B"] if seq % 3 == 1 else ["A", "B"])
+        _, votes = clocks.proposal(put_cmd(Rifl(100, seq), keys), seq % 7)
+        for key, ranges in votes:
+            for r in ranges:
+                for v in r.votes():
+                    assert v not in seen[key], f"double vote {v} on {key}"
+                    seen[key].add(v)
+
+
+def test_detached_votes_fill_gaps():
+    clocks = SequentialKeyClocks(1, SHARD)
+    cmd = put_cmd(Rifl(100, 1), ["A"])
+    clocks.proposal(cmd, 0)  # A at 1
+    votes = Votes()
+    clocks.detached(cmd, 5, votes)
+    assert votes.get("A") == [VoteRange(1, 2, 5)]
+    # detached_all bumps every known key
+    votes = Votes()
+    clocks.detached_all(9, votes)
+    assert votes.get("A") == [VoteRange(1, 6, 9)]
+
+
+def test_quorum_clocks_max_and_count():
+    q = QuorumClocks(3)
+    assert q.add(1, 10) == (10, 1)
+    assert q.add(2, 10) == (10, 2)
+    assert q.add(3, 10) == (10, 3)
+    assert q.all()
+
+    q = QuorumClocks(10)
+    assert q.add(1, 10) == (10, 1)
+    assert q.add(2, 9) == (10, 1)
+    assert q.add(3, 10) == (10, 2)
+    assert q.add(4, 9) == (10, 2)
+    assert q.add(5, 9) == (10, 2)
+    assert q.add(6, 12) == (12, 1)
+    assert q.add(7, 12) == (12, 2)
+    assert q.add(8, 10) == (12, 2)
+    assert q.add(9, 12) == (12, 3)
+    assert q.add(10, 13) == (13, 1)
+    assert q.all()
+
+
+def test_range_event_set():
+    s = RangeEventSet()
+    assert s.frontier == 0
+    assert s.add_range(2, 4)
+    assert s.frontier == 0  # 1 missing
+    assert s.add_range(1, 1)
+    assert s.frontier == 4
+    # overlapping add: only partially new
+    assert s.add_range(3, 6)
+    assert s.frontier == 6
+    # fully covered add: nothing new
+    assert not s.add_range(2, 5)
+    # wide ranges are O(1) in events
+    assert s.add_range(10, 10_000_000)
+    assert s.frontier == 6
+    assert s.add_range(7, 9)
+    assert s.frontier == 10_000_000
+    assert s.contains(123456) and not s.contains(10_000_001)
+    assert s.event_count() == 10_000_000

@@ -1,0 +1,98 @@
+"""VotesTable stability logic + TableExecutor flow, mirroring
+fantoch_ps/src/executor/table/mod.rs:273-450 (majority-quorum table tests:
+ops execute exactly when their timestamp is stable, in (clock, dot) order
+on every delivery permutation)."""
+
+import itertools
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Dot, Rifl
+from fantoch_tpu.core.kvs import KVOp
+from fantoch_tpu.executor.table import TableExecutor, TableVotes, VotesTable
+from fantoch_tpu.protocol.common.table_clocks import VoteRange
+
+SHARD = 0
+
+
+def table(n=5, threshold=3) -> VotesTable:
+    return VotesTable("K", 1, SHARD, n, threshold)
+
+
+def test_nothing_stable_without_threshold_frontiers():
+    t = table()
+    # n=5, threshold=3: frontiers [1,0,0,0,0] -> stable clock 0
+    t.add(Dot(1, 1), 1, Rifl(10, 1), (KVOp.put("x"),), [VoteRange(1, 1, 1)])
+    assert t.stable_clock() == 0
+    assert t.stable_ops() == []
+    # second frontier at 1: sorted [0,0,0,1,1] -> index 5-3=2 -> 0... still 0
+    t.add_votes([VoteRange(2, 1, 1)])
+    assert t.stable_clock() == 0
+    # third frontier at 1: sorted [0,0,1,1,1] -> stable 1 -> op executes
+    t.add_votes([VoteRange(3, 1, 1)])
+    assert t.stable_clock() == 1
+    assert [rifl for rifl, _ in t.stable_ops()] == [Rifl(10, 1)]
+    assert t.stable_ops() == []
+
+
+def test_equal_clocks_break_ties_by_dot():
+    t = table(n=3, threshold=2)
+    op = (KVOp.put("x"),)
+    t.add(Dot(2, 1), 1, Rifl(20, 1), op, [VoteRange(2, 1, 1)])
+    t.add(Dot(1, 1), 1, Rifl(10, 1), op, [VoteRange(1, 1, 1)])
+    assert [r for r, _ in t.stable_ops()] == [Rifl(10, 1), Rifl(20, 1)]
+
+
+def test_ops_above_stable_clock_stay_buffered():
+    # only ops with clock <= stable_clock execute; an op at stable+1 stays
+    # buffered until stability advances (mod.rs:200-244 split_off bound)
+    t = table(n=3, threshold=2)
+    op = (KVOp.put("x"),)
+    t.add(Dot(1, 1), 1, Rifl(10, 1), op, [VoteRange(1, 1, 1), VoteRange(2, 1, 1)])
+    t.add(Dot(1, 2), 2, Rifl(10, 2), op, [VoteRange(1, 2, 2)])
+    assert [r for r, _ in t.stable_ops()] == [Rifl(10, 1)]
+
+
+def test_permutations_agree():
+    """All vote-delivery permutations execute the same final order.
+
+    The history is protocol-consistent (every command at clock c carries a
+    fast quorum's votes covering c): B@1 voted by {p2,p3}, A@2 by {p1,p2},
+    C@3 by {p3,p1} — a command's own votes pin the frontier gap below its
+    clock, so no permutation can stabilize a higher clock early.
+    """
+    op = (KVOp.put("x"),)
+    adds = [
+        (Dot(1, 1), 2, Rifl(10, 1), [VoteRange(1, 1, 2), VoteRange(2, 2, 2)]),
+        (Dot(2, 1), 1, Rifl(20, 1), [VoteRange(2, 1, 1), VoteRange(3, 1, 1)]),
+        (Dot(3, 1), 3, Rifl(30, 1), [VoteRange(3, 2, 3), VoteRange(1, 3, 3)]),
+    ]
+    expected = None
+    for perm in itertools.permutations(range(3)):
+        t = table(n=3, threshold=2)
+        executed = []
+        for i in perm:
+            dot, clock, rifl, votes = adds[i]
+            t.add(dot, clock, rifl, op, votes)
+            executed.extend(r for r, _ in t.stable_ops())
+        assert len(executed) == 3, f"all ops stable: {perm} -> {executed}"
+        if expected is None:
+            expected = executed
+        assert executed == expected, f"order differs for permutation {perm}"
+    assert [r.source for r in expected] == [20, 10, 30]  # by (clock, dot)
+
+
+def test_table_executor_end_to_end():
+    config = Config(n=3, f=1)
+    ex = TableExecutor(1, SHARD, config)
+    rifl = Rifl(10, 1)
+    ex.handle(
+        TableVotes(
+            Dot(1, 1), 1, rifl, "K", (KVOp.put("v"),),
+            [VoteRange(1, 1, 1), VoteRange(2, 1, 1)],
+        ),
+        None,
+    )
+    result = ex.to_clients()
+    assert result is not None and result.rifl == rifl and result.key == "K"
+    assert result.op_results == (None,)
+    assert ex.to_clients() is None

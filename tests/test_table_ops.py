@@ -1,0 +1,91 @@
+"""Device table ops vs the host SequentialKeyClocks / VotesTable oracles."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.ids import Rifl
+from fantoch_tpu.core.kvs import KVOp
+from fantoch_tpu.executor.table import VotesTable
+from fantoch_tpu.ops.table_ops import batched_clock_proposal, stable_clocks
+from fantoch_tpu.protocol.common.table_clocks import SequentialKeyClocks, VoteRange
+
+SHARD = 0
+
+
+def oracle_proposals(prior, keys, mins):
+    clocks = SequentialKeyClocks(1, SHARD)
+    clocks._clocks = {str(k): int(v) for k, v in enumerate(prior)}
+    out_clock, out_start = [], []
+    for seq, (k, m) in enumerate(zip(keys, mins), start=1):
+        cmd = Command.from_single(Rifl(9, seq), SHARD, str(k), KVOp.put("x"))
+        clock, votes = clocks.proposal(cmd, int(m))
+        ranges = votes.get(str(k))
+        assert len(ranges) == 1
+        out_clock.append(clock)
+        out_start.append(ranges[0].start)
+    return out_clock, out_start, [clocks._clocks[str(k)] for k in range(len(prior))]
+
+
+def test_batched_proposal_matches_oracle():
+    rng = random.Random(7)
+    for trial in range(20):
+        n_keys, batch = 5, 40
+        prior = [rng.randrange(0, 10) for _ in range(n_keys)]
+        keys = [rng.randrange(n_keys) for _ in range(batch)]
+        mins = [rng.choice([0, 0, 0, rng.randrange(30)]) for _ in range(batch)]
+        want_clock, want_start, want_prior = oracle_proposals(prior, keys, mins)
+        clock, start, new_prior = batched_clock_proposal(
+            jnp.asarray(prior, jnp.int32),
+            jnp.asarray(keys, jnp.int32),
+            jnp.asarray(mins, jnp.int32),
+        )
+        assert clock.tolist() == want_clock, f"trial {trial}"
+        assert start.tolist() == want_start, f"trial {trial}"
+        assert new_prior.tolist() == want_prior, f"trial {trial}"
+
+
+def test_batched_proposal_large_clocks_many_keys():
+    """Overflow regression: micros-scale priors across tens of thousands of
+    keys must not corrupt the segmented scan."""
+    n_keys = 40_000
+    prior = np.full((n_keys,), 60_000_000, dtype=np.int32)
+    keys = np.arange(n_keys, dtype=np.int32)
+    mins = np.zeros((n_keys,), dtype=np.int32)
+    clock, start, new_prior = batched_clock_proposal(
+        jnp.asarray(prior), jnp.asarray(keys), jnp.asarray(mins)
+    )
+    assert clock.tolist() == [60_000_001] * n_keys
+    assert start.tolist() == [60_000_001] * n_keys
+    assert new_prior.tolist() == [60_000_001] * n_keys
+
+
+def test_batched_proposal_hot_key_chain():
+    # every command on one key: consecutive clocks, compressed ranges
+    batch = 64
+    clock, start, new_prior = batched_clock_proposal(
+        jnp.zeros((4,), jnp.int32),
+        jnp.full((batch,), 2, jnp.int32),
+        jnp.zeros((batch,), jnp.int32),
+    )
+    assert clock.tolist() == list(range(1, batch + 1))
+    assert start.tolist() == list(range(1, batch + 1))
+    assert int(new_prior[2]) == batch and int(new_prior[0]) == 0
+
+
+def test_stable_clocks_matches_votes_table():
+    rng = random.Random(11)
+    n, threshold = 5, 3
+    k = 8
+    frontiers = np.array(
+        [[rng.randrange(0, 20) for _ in range(n)] for _ in range(k)], dtype=np.int32
+    )
+    got = stable_clocks(jnp.asarray(frontiers), threshold=threshold)
+    for key in range(k):
+        table = VotesTable(str(key), 1, SHARD, n, threshold)
+        for pid, frontier in enumerate(frontiers[key], start=1):
+            if frontier > 0:
+                table.add_votes([VoteRange(pid, 1, int(frontier))])
+        assert int(got[key]) == table.stable_clock(), f"key {key}"
