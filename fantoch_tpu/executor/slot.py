@@ -1,0 +1,62 @@
+"""SlotExecutor: total-order execution by consecutive slot numbers.
+
+Reference: fantoch_ps/src/executor/slot.rs.  Commands arrive tagged with
+their consensus slot; execution simply buffers out-of-order slots and
+drains while ``next_slot`` is present.  Sequential (not key-parallel): the
+total order is global, not per-key.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, Optional
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import ProcessId, ShardId
+from fantoch_tpu.core.kvs import KVStore
+from fantoch_tpu.executor.base import Executor, ExecutorResult
+
+
+@dataclass
+class SlotExecutionInfo:
+    slot: int
+    cmd: Command
+
+
+class SlotExecutor(Executor):
+    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
+        self._shard_id = shard_id
+        self._execute_at_commit = config.execute_at_commit
+        self._store = KVStore(config.executor_monitor_execution_order)
+        self._next_slot = 1
+        self._to_execute: Dict[int, Command] = {}
+        self._to_clients: Deque[ExecutorResult] = deque()
+
+    def handle(self, info: SlotExecutionInfo, time) -> None:
+        assert info.slot >= self._next_slot, "slots execute exactly once"
+        if self._execute_at_commit:
+            self._execute(info.cmd)
+            return
+        assert info.slot not in self._to_execute
+        self._to_execute[info.slot] = info.cmd
+        while True:
+            cmd = self._to_execute.pop(self._next_slot, None)
+            if cmd is None:
+                return
+            self._execute(cmd)
+            self._next_slot += 1
+
+    def _execute(self, cmd: Command) -> None:
+        self._to_clients.extend(cmd.execute(self._shard_id, self._store))
+
+    def to_clients(self) -> Optional[ExecutorResult]:
+        return self._to_clients.popleft() if self._to_clients else None
+
+    @classmethod
+    def parallel(cls) -> bool:
+        return False
+
+    def monitor(self):
+        return self._store.monitor

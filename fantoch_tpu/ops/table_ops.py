@@ -23,6 +23,15 @@ The two hot loops of the table protocol/executor become array ops:
 
 Both are shape-static, fully jittable, and batch-friendly: one kernel
 launch replaces B hash-map bumps / K BTree walks.
+
+Clock width: device clocks are **31-bit windowed**.  Raw wall-clock micros
+(Newt's real-time mode) overflow int32 after ~35 minutes, so callers must
+rebase device clocks against a window floor before the kernel — the
+natural floor is the GC'd stable clock the protocol already tracks, and
+timestamps are only ever compared within a window (votes below the stable
+floor are collected; proposals are bounded by floor + in-flight commands).
+The host twins (table_clocks.py) use unbounded Python ints and need no
+rebasing.
 """
 
 from __future__ import annotations

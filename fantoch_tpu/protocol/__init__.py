@@ -15,6 +15,7 @@ _LAZY = {
     "EPaxos": "fantoch_tpu.protocol.graph_protocol",
     "Atlas": "fantoch_tpu.protocol.graph_protocol",
     "Newt": "fantoch_tpu.protocol.newt",
+    "FPaxos": "fantoch_tpu.protocol.fpaxos",
 }
 
 

@@ -1,0 +1,237 @@
+"""FPaxos: leader-based Flexible Paxos over the MultiSynod agents.
+
+Reference: fantoch_ps/src/protocol/fpaxos.rs.  Clients submit anywhere;
+non-leaders forward to the leader, which allocates (ballot, slot) pairs and
+drives phase-2 through per-slot commanders (spawned via a self-forward so
+they can run slot-sharded across workers); acceptors sit at a fixed worker.
+Chosen commands broadcast as MChosen and execute in slot order.  GC is
+slot-watermark based — no MStable round: the acceptor worker both tracks
+watermarks and holds the slots to collect (fpaxos.rs:419-447).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Deque, Optional
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
+from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.executor.slot import SlotExecutionInfo, SlotExecutor
+from fantoch_tpu.protocol.base import (
+    Action,
+    BaseProcess,
+    Protocol,
+    ProtocolMetrics,
+    ToForward,
+    ToSend,
+)
+from fantoch_tpu.protocol.common.multi_synod import (
+    MAccept as SynodMAccept,
+    MAccepted as SynodMAccepted,
+    MChosen as SynodMChosen,
+    MForwardSubmit as SynodMForwardSubmit,
+    MSpawnCommander as SynodMSpawnCommander,
+    MultiSynod,
+    SlotGCTrack,
+)
+from fantoch_tpu.run.routing import (
+    LEADER_WORKER_INDEX,
+    worker_index_no_shift,
+    worker_index_shift,
+)
+
+# the acceptor owns ballot/accepted state and must be a single worker
+# (fpaxos.rs:417)
+ACCEPTOR_WORKER_INDEX = 1
+
+
+# --- messages (fpaxos.rs:389-414) ---
+
+
+@dataclass
+class MForwardSubmit:
+    cmd: Command
+
+
+@dataclass
+class MSpawnCommander:
+    ballot: int
+    slot: int
+    cmd: Command
+
+
+@dataclass
+class MAccept:
+    ballot: int
+    slot: int
+    cmd: Command
+
+
+@dataclass
+class MAccepted:
+    ballot: int
+    slot: int
+
+
+@dataclass
+class MChosen:
+    slot: int
+    cmd: Command
+
+
+@dataclass
+class MGarbageCollection:
+    committed: int
+
+
+@dataclass
+class GarbageCollectionEvent:
+    pass
+
+
+class FPaxos(Protocol):
+    Executor = SlotExecutor
+
+    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
+        # no fast quorum — there are no fast paths
+        self.bp = BaseProcess(process_id, shard_id, config, 0, config.fpaxos_quorum_size())
+        initial_leader = config.leader
+        assert initial_leader is not None, (
+            "in a leader-based protocol, the initial leader should be defined"
+        )
+        self._leader = initial_leader
+        self._multi_synod: MultiSynod[Command] = MultiSynod(
+            process_id, initial_leader, config.n, config.f
+        )
+        self._gc_track = SlotGCTrack(process_id, config.n)
+        self._to_processes: Deque[Action] = deque()
+        self._to_executors: Deque[SlotExecutionInfo] = deque()
+
+    def periodic_events(self):
+        if self.bp.config.gc_interval_ms is not None:
+            return [(GarbageCollectionEvent(), self.bp.config.gc_interval_ms)]
+        return []
+
+    @property
+    def id(self) -> ProcessId:
+        return self.bp.process_id
+
+    @property
+    def shard_id(self) -> ShardId:
+        return self.bp.shard_id
+
+    def discover(self, processes):
+        connect_ok = self.bp.discover(processes)
+        return connect_ok, dict(self.bp.closest_shard_process())
+
+    def submit(self, dot: Optional[Dot], cmd: Command, time: SysTime) -> None:
+        self._handle_submit(cmd)
+
+    def handle(self, from_, from_shard_id, msg, time):
+        if isinstance(msg, MForwardSubmit):
+            self._handle_submit(msg.cmd)
+        elif isinstance(msg, MSpawnCommander):
+            self._handle_mspawn_commander(from_, msg.ballot, msg.slot, msg.cmd)
+        elif isinstance(msg, MAccept):
+            self._handle_maccept(from_, msg.ballot, msg.slot, msg.cmd)
+        elif isinstance(msg, MAccepted):
+            self._handle_maccepted(from_, msg.ballot, msg.slot)
+        elif isinstance(msg, MChosen):
+            self._handle_mchosen(msg.slot, msg.cmd)
+        elif isinstance(msg, MGarbageCollection):
+            self._handle_mgc(from_, msg.committed)
+        else:
+            raise AssertionError(f"unknown message {msg}")
+
+    def handle_event(self, event, time):
+        assert isinstance(event, GarbageCollectionEvent)
+        self._to_processes.append(
+            ToSend(self.bp.all_but_me(), MGarbageCollection(self._gc_track.committed()))
+        )
+
+    def to_processes(self) -> Optional[Action]:
+        return self._to_processes.popleft() if self._to_processes else None
+
+    def to_executors(self):
+        return self._to_executors.popleft() if self._to_executors else None
+
+    @classmethod
+    def parallel(cls) -> bool:
+        return True
+
+    @classmethod
+    def leaderless(cls) -> bool:
+        return False
+
+    def metrics(self) -> ProtocolMetrics:
+        return self.bp.metrics()
+
+    # --- handlers ---
+
+    def _handle_submit(self, cmd: Command) -> None:
+        out = self._multi_synod.submit(cmd)
+        if isinstance(out, SynodMSpawnCommander):
+            # we're the leader: spawn the commander via a self-forward so it
+            # can land on a slot-sharded worker
+            self._to_processes.append(
+                ToForward(MSpawnCommander(out.ballot, out.slot, out.value))
+            )
+        elif isinstance(out, SynodMForwardSubmit):
+            self._to_processes.append(ToSend({self._leader}, MForwardSubmit(out.value)))
+        else:
+            raise AssertionError(f"can't handle {out} in submit")
+
+    def _handle_mspawn_commander(self, from_, ballot, slot, cmd) -> None:
+        assert from_ == self.id, "spawn commander messages come from self"
+        out = self._multi_synod.handle(from_, SynodMSpawnCommander(ballot, slot, cmd))
+        assert isinstance(out, SynodMAccept)
+        self._to_processes.append(
+            ToSend(self.bp.write_quorum(), MAccept(out.ballot, out.slot, out.value))
+        )
+
+    def _handle_maccept(self, from_, ballot, slot, cmd) -> None:
+        out = self._multi_synod.handle(from_, SynodMAccept(ballot, slot, cmd))
+        if out is None:
+            return  # ballot too low: this leader was superseded
+        assert isinstance(out, SynodMAccepted)
+        self._to_processes.append(ToSend({from_}, MAccepted(out.ballot, out.slot)))
+
+    def _handle_maccepted(self, from_, ballot, slot) -> None:
+        out = self._multi_synod.handle(from_, SynodMAccepted(ballot, slot))
+        if out is None:
+            return
+        assert isinstance(out, SynodMChosen)
+        self._to_processes.append(ToSend(self.bp.all(), MChosen(out.slot, out.value)))
+
+    def _handle_mchosen(self, slot: int, cmd: Command) -> None:
+        self._to_executors.append(SlotExecutionInfo(slot, cmd))
+        if self.bp.config.gc_interval_ms is not None:
+            self._gc_track.commit(slot)
+        else:
+            self._multi_synod.gc_single(slot)
+
+    def _handle_mgc(self, from_: ProcessId, committed: int) -> None:
+        self._gc_track.committed_by(from_, committed)
+        start, end = self._gc_track.stable()
+        if start <= end:
+            self.bp.stable(self._multi_synod.gc(start, end))
+
+    # --- worker routing (fpaxos.rs:416-465) ---
+
+    @staticmethod
+    def message_index(msg):
+        if isinstance(msg, MForwardSubmit):
+            return worker_index_no_shift(LEADER_WORKER_INDEX)
+        if isinstance(msg, (MAccept, MChosen, MGarbageCollection)):
+            # the acceptor also learns chosen slots and runs gc tracking
+            return worker_index_no_shift(ACCEPTOR_WORKER_INDEX)
+        if isinstance(msg, (MSpawnCommander, MAccepted)):
+            return worker_index_shift(msg.slot)
+        raise AssertionError(f"unknown message {msg}")
+
+    @staticmethod
+    def event_index(event):
+        return worker_index_no_shift(ACCEPTOR_WORKER_INDEX)

@@ -73,6 +73,9 @@ from fantoch_tpu.run.routing import (
 
 @dataclass
 class MCollect:
+    # receivers merge into coordinator_votes (skip_fast_ack path): the sim
+    # must hand each target its own copy
+    MUTABLE_PAYLOAD = True
     dot: Dot
     cmd: Command
     quorum: Set[ProcessId]
@@ -82,6 +85,7 @@ class MCollect:
 
 @dataclass
 class MCollectAck:
+    MUTABLE_PAYLOAD = True  # coordinator merges process_votes in place
     dot: Dot
     clock: int
     process_votes: Votes
@@ -89,6 +93,7 @@ class MCollectAck:
 
 @dataclass
 class MCommit:
+    MUTABLE_PAYLOAD = True  # receivers strip votes per key in place
     dot: Dot
     clock: int
     votes: Votes
@@ -310,6 +315,12 @@ class Newt(CommitGCMixin, Protocol):
                 buf_from, buf_clock, buf_votes = buffered
                 self._handle_mcommit(buf_from, dot, buf_clock, buf_votes)
             return
+
+        # a fast-quorum member can never see MCommit before MCollect: the
+        # commit requires this member's own ack (or, under skip_fast_ack,
+        # is generated by this very handler), so buffering only ever happens
+        # on the not-in-quorum path above
+        assert dot not in self._buffered_mcommits
 
         message_from_self = from_ == self.bp.process_id
         if message_from_self:

@@ -10,6 +10,7 @@ Optional adversity: symmetric distances, and random message reordering
 
 from __future__ import annotations
 
+import copy
 import random
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
@@ -245,18 +246,20 @@ class Runner:
     def _schedule_protocol_actions(
         self, process_id: ProcessId, shard_id: ShardId, actions: List[Any]
     ) -> None:
-        import copy
-
         for action in actions:
             if isinstance(action, ToSend):
-                # each target gets its own copy — the real runner serializes
-                # per connection, so receivers may freely mutate payloads
-                # (e.g. Newt merges/strips Votes in place); aliasing one
-                # object across simulated processes would corrupt that
+                # messages whose receivers mutate the payload in place (e.g.
+                # Newt merges/strips Votes) declare MUTABLE_PAYLOAD; each
+                # target then gets its own copy, matching the real runner's
+                # serialize-per-connection semantics.  Immutable-payload
+                # messages are shared — receivers only read them.
                 targets = sorted(action.target)
-                copies = [action.msg] + [
-                    copy.deepcopy(action.msg) for _ in range(len(targets) - 1)
-                ]
+                if getattr(action.msg, "MUTABLE_PAYLOAD", False):
+                    copies = [action.msg] + [
+                        copy.deepcopy(action.msg) for _ in range(len(targets) - 1)
+                    ]
+                else:
+                    copies = [action.msg] * len(targets)
                 for to, msg in zip(targets, copies):
                     if to == process_id:
                         # message to self: deliver immediately

@@ -1,0 +1,96 @@
+"""MultiSynod agents, SlotExecutor ordering, and FPaxos whole-system sim
+tests (reference: fantoch_ps/src/protocol/mod.rs fpaxos rows + the slot
+executor permutation test, fantoch_ps/src/executor/slot.rs:184-212)."""
+
+import itertools
+import random
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Rifl
+from fantoch_tpu.core.kvs import KVOp
+from fantoch_tpu.executor.slot import SlotExecutionInfo, SlotExecutor
+from fantoch_tpu.protocol import FPaxos
+from fantoch_tpu.protocol.common.multi_synod import (
+    MAccept,
+    MAccepted,
+    MChosen,
+    MForwardSubmit,
+    MSpawnCommander,
+    MultiSynod,
+    SlotGCTrack,
+)
+
+from harness import sim_test
+
+SHARD = 0
+
+
+def cmd(seq: int) -> Command:
+    return Command.from_single(Rifl(9, seq), SHARD, f"K{seq}", KVOp.put(str(seq)))
+
+
+def test_multi_synod_happy_path():
+    # n=3, f=1, leader=1
+    synods = {pid: MultiSynod(pid, 1, 3, 1) for pid in (1, 2, 3)}
+    out = synods[1].submit(cmd(1))
+    assert isinstance(out, MSpawnCommander) and out.slot == 1 and out.ballot == 1
+    maccept = synods[1].handle(1, out)
+    assert isinstance(maccept, MAccept)
+    # acceptors 1 and 2 (write quorum f+1=2) accept
+    chosen = None
+    for pid in (1, 2):
+        maccepted = synods[pid].handle(1, maccept)
+        assert isinstance(maccepted, MAccepted)
+        result = synods[1].handle(pid, maccepted)
+        if result is not None:
+            chosen = result
+    assert isinstance(chosen, MChosen) and chosen.slot == 1
+    assert chosen.value == cmd(1)
+
+
+def test_multi_synod_non_leader_forwards():
+    synod = MultiSynod(2, 1, 3, 1)
+    out = synod.submit(cmd(1))
+    assert isinstance(out, MForwardSubmit)
+
+
+def test_multi_synod_stale_ballot_rejected():
+    synod = MultiSynod(2, 1, 3, 1)
+    # acceptor joined ballot 1 at bootstrap; ballot 0 must be rejected
+    assert synod.handle(9, MAccept(0, 1, cmd(1))) is None
+    assert synod.handle(1, MAccept(1, 1, cmd(1))) is not None
+
+
+def test_slot_gc_track():
+    track = SlotGCTrack(1, 3)
+    track.commit(1)
+    track.commit(2)
+    assert track.committed() == 2
+    # no info from others yet: nothing stable
+    assert track.stable() == (1, 0)
+    track.committed_by(2, 1)
+    track.committed_by(3, 5)
+    assert track.stable() == (1, 1)  # min(2, 1, 5) = 1
+    track.committed_by(2, 2)
+    assert track.stable() == (2, 2)
+
+
+def test_slot_executor_all_permutations():
+    cmds = [cmd(seq) for seq in range(1, 5)]
+    expected = None
+    for perm in itertools.permutations(range(4)):
+        ex = SlotExecutor(1, SHARD, Config(n=3, f=1))
+        executed = []
+        for i in perm:
+            ex.handle(SlotExecutionInfo(i + 1, cmds[i]), None)
+            executed.extend(r.rifl for r in ex.to_clients_iter())
+        assert executed == [c.rifl for c in cmds], f"slot order broken for {perm}"
+
+
+def test_fpaxos_3_1():
+    sim_test(FPaxos, Config(n=3, f=1, leader=1))
+
+
+def test_fpaxos_5_2():
+    sim_test(FPaxos, Config(n=5, f=2, leader=1), seed=1)
