@@ -1,0 +1,201 @@
+"""PredecessorsExecutor: Caesar's two-phase readiness ordering.
+
+Reference: fantoch_ps/src/executor/pred/{mod,index,executor}.rs.  A
+committed command becomes executable in two phases:
+
+* phase 1 — wait until every dependency is *committed* (its final clock is
+  known, so the lower-clock comparison below is meaningful);
+* phase 2 — wait until every dependency with a *lower clock* is executed.
+
+Timestamps are unique and totally ordered, so unlike the SCC graph executor
+there are no cycles to collapse: execution order is exactly increasing
+commit timestamp among conflicts.
+
+Tensor note: both phases are countdown counters over a dependency relation
+— the device twin is two scatter-add passes over a batched (dot, dep)
+edge list (see ops/graph_resolve.py for the shared machinery); this host
+implementation drives the simulator and runner control plane.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, Optional, Set
+
+from fantoch_tpu.core.clocks import AEClock
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Dot, ProcessId, ShardId, all_process_ids
+from fantoch_tpu.core.metrics import Metrics
+from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.executor.base import Executor, ExecutorMetricsKind, ExecutorResult
+from fantoch_tpu.core.kvs import KVStore
+from fantoch_tpu.protocol.common.pred_clocks import Clock
+
+
+@dataclass
+class PredecessorsExecutionInfo:
+    dot: Dot
+    cmd: Command
+    clock: Clock
+    deps: Set[Dot]
+
+
+class _Vertex:
+    __slots__ = ("dot", "cmd", "clock", "deps", "missing_deps", "start_time_ms")
+
+    def __init__(self, dot: Dot, cmd: Command, clock: Clock, deps: Set[Dot], time: SysTime):
+        self.dot = dot
+        self.cmd = cmd
+        self.clock = clock
+        self.deps = deps
+        self.missing_deps = 0
+        self.start_time_ms = time.millis() if time is not None else 0
+
+
+class _PendingIndex:
+    """dep dot -> dots waiting on it (index.rs PendingIndex)."""
+
+    __slots__ = ("_index",)
+
+    def __init__(self) -> None:
+        self._index: Dict[Dot, Set[Dot]] = {}
+
+    def index(self, pending: Dot, dep: Dot) -> None:
+        self._index.setdefault(dep, set()).add(pending)
+
+    def remove(self, dep: Dot) -> Set[Dot]:
+        return self._index.pop(dep, set())
+
+
+class PredecessorsGraph:
+    def __init__(self, process_id: ProcessId, config: Config):
+        ids = [pid for pid, _ in all_process_ids(config.shard_count, config.n)]
+        self._process_id = process_id
+        self._committed_clock: AEClock = AEClock(ids)
+        self._executed_clock: AEClock = AEClock(ids)
+        self._vertices: Dict[Dot, _Vertex] = {}
+        self._phase_one_pending = _PendingIndex()
+        self._phase_two_pending = _PendingIndex()
+        self._metrics: Metrics = Metrics()
+        self._to_execute: Deque[Command] = deque()
+
+    def command_to_execute(self) -> Optional[Command]:
+        return self._to_execute.popleft() if self._to_execute else None
+
+    def executed(self) -> AEClock:
+        return self._executed_clock.copy()
+
+    def metrics(self) -> Metrics:
+        return self._metrics
+
+    def add(self, dot: Dot, cmd: Command, clock: Clock, deps: Set[Dot], time: SysTime) -> None:
+        # a command may report itself as a dependency (its own clock is in
+        # the key index when deps are recomputed); drop it up front
+        deps = set(deps)
+        deps.discard(dot)
+
+        # index: mark committed, create the vertex
+        added = self._committed_clock.add(dot.source, dot.sequence)
+        assert added, "commands are committed exactly once"
+        assert dot not in self._vertices
+        self._vertices[dot] = _Vertex(dot, cmd, clock, deps, time)
+
+        # commands blocked on this dot at phase one may advance
+        self._try_phase_one_pending(dot, time)
+        self._move_to_phase_one(dot, time)
+
+    def _move_to_phase_one(self, dot: Dot, time: SysTime) -> None:
+        vertex = self._vertices[dot]
+        non_committed = 0
+        for dep in vertex.deps:
+            if not self._committed_clock.contains(dep.source, dep.sequence):
+                non_committed += 1
+                self._phase_one_pending.index(dot, dep)
+        if non_committed > 0:
+            vertex.missing_deps = non_committed
+        else:
+            self._move_to_phase_two(dot, time)
+
+    def _move_to_phase_two(self, dot: Dot, time: SysTime) -> None:
+        vertex = self._vertices[dot]
+        non_executed = 0
+        for dep in vertex.deps:
+            if not self._executed_clock.contains(dep.source, dep.sequence):
+                # all deps are committed by now (phase 1 passed), so the
+                # dependency's final clock is known: only lower-clock deps
+                # must execute first
+                dep_vertex = self._vertices[dep]
+                if dep_vertex.clock < vertex.clock:
+                    non_executed += 1
+                    self._phase_two_pending.index(dot, dep)
+        if non_executed > 0:
+            vertex.missing_deps = non_executed
+        else:
+            self._save_to_execute(dot, time)
+
+    def _try_phase_one_pending(self, dot: Dot, time: SysTime) -> None:
+        for pending in self._phase_one_pending.remove(dot):
+            vertex = self._vertices[pending]
+            vertex.missing_deps -= 1
+            if vertex.missing_deps == 0:
+                self._move_to_phase_two(pending, time)
+
+    def _try_phase_two_pending(self, dot: Dot, time: SysTime) -> None:
+        for pending in self._phase_two_pending.remove(dot):
+            vertex = self._vertices[pending]
+            vertex.missing_deps -= 1
+            if vertex.missing_deps == 0:
+                self._save_to_execute(pending, time)
+
+    def _save_to_execute(self, dot: Dot, time: SysTime) -> None:
+        added = self._executed_clock.add(dot.source, dot.sequence)
+        assert added
+        vertex = self._vertices.pop(dot)
+        if time is not None:
+            self._metrics.collect(
+                ExecutorMetricsKind.EXECUTION_DELAY,
+                time.millis() - vertex.start_time_ms,
+            )
+        self._to_execute.append(vertex.cmd)
+        self._try_phase_two_pending(dot, time)
+
+
+class PredecessorsExecutor(Executor):
+    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
+        self._shard_id = shard_id
+        self._execute_at_commit = config.execute_at_commit
+        self._graph = PredecessorsGraph(process_id, config)
+        self._store = KVStore(config.executor_monitor_execution_order)
+        self._to_clients: Deque[ExecutorResult] = deque()
+
+    def handle(self, info: PredecessorsExecutionInfo, time) -> None:
+        if self._execute_at_commit:
+            self._execute(info.cmd)
+            return
+        self._graph.add(info.dot, info.cmd, info.clock, info.deps, time)
+        while True:
+            cmd = self._graph.command_to_execute()
+            if cmd is None:
+                return
+            self._execute(cmd)
+
+    def _execute(self, cmd: Command) -> None:
+        self._to_clients.extend(cmd.execute(self._shard_id, self._store))
+
+    def to_clients(self) -> Optional[ExecutorResult]:
+        return self._to_clients.popleft() if self._to_clients else None
+
+    def executed(self, time):
+        return self._graph.executed()
+
+    @classmethod
+    def parallel(cls) -> bool:
+        return True
+
+    def metrics(self):
+        return self._graph.metrics()
+
+    def monitor(self):
+        return self._store.monitor

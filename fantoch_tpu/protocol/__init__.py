@@ -16,6 +16,7 @@ _LAZY = {
     "Atlas": "fantoch_tpu.protocol.graph_protocol",
     "Newt": "fantoch_tpu.protocol.newt",
     "FPaxos": "fantoch_tpu.protocol.fpaxos",
+    "Caesar": "fantoch_tpu.protocol.caesar",
 }
 
 

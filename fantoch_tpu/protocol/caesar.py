@@ -1,0 +1,477 @@
+"""Caesar: timestamp + predecessors consensus with a wait condition.
+
+Reference: fantoch_ps/src/protocol/caesar.rs (1399 LoC).  The coordinator
+assigns a globally-unique lexicographic timestamp ``Clock(seq, pid)`` to
+each command and proposes it to everyone; each replica computes the
+conflicting commands with lower timestamps (the predecessors) and replies:
+
+* ACCEPT (ok) — no conflicting command with a *higher* timestamp blocks it;
+* WAIT — blocked by higher-timestamp conflicts whose fate is unknown: the
+  reply is delayed until they commit/accept (the wait condition,
+  caesar.rs:266-451);
+* REJECT (not ok) — some higher-timestamp conflict does not include this
+  command in its deps, so the proposed timestamp is too low; the replica
+  counter-proposes a higher one.
+
+Fast path iff the whole fast quorum (3n/4 + 1) said ok; otherwise the
+coordinator retries with the aggregated (clock, deps) through MRetry on the
+write quorum (majority), which yields extended deps and then commits.
+Execution is the PredecessorsExecutor: conflicts execute in timestamp
+order.  GC is driven by the *executed* clock reported back by the executor
+(handle_executed, caesar.rs:177-179).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Deque, Dict, Optional, Set, Tuple
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
+from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.executor.pred import PredecessorsExecutionInfo, PredecessorsExecutor
+from fantoch_tpu.protocol.base import (
+    Action,
+    BaseProcess,
+    Executed,
+    Protocol,
+    ProtocolMetrics,
+    ToSend,
+)
+from fantoch_tpu.protocol.commit_gc import MGarbageCollection
+from fantoch_tpu.protocol.common.pred_clocks import (
+    Clock,
+    KeyClocks,
+    QuorumClocks,
+    QuorumRetries,
+)
+from fantoch_tpu.protocol.gc import GCTrack
+from fantoch_tpu.protocol.info import CommandsInfo
+from fantoch_tpu.run.routing import (
+    GC_WORKER_INDEX,
+    worker_dot_index_shift,
+    worker_index_no_shift,
+)
+
+
+# --- messages (caesar.rs:1088-1117) ---
+
+
+@dataclass
+class MPropose:
+    dot: Dot
+    cmd: Command
+    clock: Clock
+
+
+@dataclass
+class MProposeAck:
+    dot: Dot
+    clock: Clock
+    deps: Set[Dot]
+    ok: bool
+
+
+@dataclass
+class MCommit:
+    dot: Dot
+    clock: Clock
+    deps: Set[Dot]
+
+
+@dataclass
+class MRetry:
+    dot: Dot
+    clock: Clock
+    deps: Set[Dot]
+
+
+@dataclass
+class MRetryAck:
+    dot: Dot
+    deps: Set[Dot]
+
+
+@dataclass
+class GarbageCollectionEvent:
+    pass
+
+
+class Status:
+    START = "start"
+    PROPOSE = "propose"
+    REJECT = "reject"
+    ACCEPT = "accept"
+    COMMIT = "commit"
+
+
+class CaesarInfo:
+    """Per-dot lifecycle info (caesar.rs:1039-1086)."""
+
+    __slots__ = (
+        "status",
+        "cmd",
+        "clock",
+        "deps",
+        "blocking",
+        "blocked_by",
+        "quorum_clocks",
+        "quorum_retries",
+    )
+
+    def __init__(self, process_id: ProcessId, fast_quorum_size: int, write_quorum_size: int):
+        self.status = Status.START
+        self.cmd: Optional[Command] = None
+        self.clock = Clock.zero(process_id)
+        self.deps: Set[Dot] = set()
+        # commands this command is blocking / blocked by (the wait condition)
+        self.blocking: Set[Dot] = set()
+        self.blocked_by: Set[Dot] = set()
+        self.quorum_clocks = QuorumClocks(process_id, fast_quorum_size, write_quorum_size)
+        self.quorum_retries = QuorumRetries(write_quorum_size)
+
+
+class Caesar(Protocol):
+    Executor = PredecessorsExecutor
+
+    @classmethod
+    def allowed_faults(cls, n: int) -> int:
+        return n // 2
+
+    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
+        fast_quorum_size, write_quorum_size = config.caesar_quorum_sizes()
+        self.bp = BaseProcess(process_id, shard_id, config, fast_quorum_size, write_quorum_size)
+        self.key_clocks = KeyClocks(process_id, shard_id)
+        self._cmds: CommandsInfo[CaesarInfo] = CommandsInfo(
+            process_id,
+            shard_id,
+            config,
+            fast_quorum_size,
+            write_quorum_size,
+            lambda pid, _sid, _cfg, fq, wq: CaesarInfo(pid, fq, wq),
+        )
+        self._gc_track = GCTrack(process_id, shard_id, config.n)
+        self._to_processes: Deque[Action] = deque()
+        self._to_executors: Deque[PredecessorsExecutionInfo] = deque()
+        # MRetry/MCommit that arrived before the MPropose (multiplexing)
+        self._buffered_retries: Dict[Dot, Tuple[ProcessId, Clock, Set[Dot]]] = {}
+        self._buffered_commits: Dict[Dot, Tuple[ProcessId, Clock, Set[Dot]]] = {}
+        self._wait_condition = config.caesar_wait_condition
+        # safety requires executed-everywhere GC: removing a command from the
+        # key-clock index at commit time (the reference's no-GC shortcut,
+        # caesar.rs:616-620, flagged unsafe by its own TODO at :840-842)
+        # lets later proposals miss it as a predecessor, so conflicting
+        # commands can execute in different orders on different replicas
+        assert config.gc_interval_ms is not None, (
+            "Caesar requires gc_interval_ms: commands may only leave the "
+            "key-clock index once executed everywhere"
+        )
+
+    def periodic_events(self):
+        if self.bp.config.gc_interval_ms is not None:
+            return [(GarbageCollectionEvent(), self.bp.config.gc_interval_ms)]
+        return []
+
+    @property
+    def id(self) -> ProcessId:
+        return self.bp.process_id
+
+    @property
+    def shard_id(self) -> ShardId:
+        return self.bp.shard_id
+
+    def discover(self, processes):
+        connect_ok = self.bp.discover(processes)
+        return connect_ok, dict(self.bp.closest_shard_process())
+
+    def submit(self, dot: Optional[Dot], cmd: Command, time: SysTime) -> None:
+        dot = dot if dot is not None else self.bp.next_dot()
+        clock = self.key_clocks.clock_next()
+        # send to everyone: due to the wait condition the fastest ok-quorum
+        # may not be the closest one
+        self._to_processes.append(ToSend(self.bp.all(), MPropose(dot, cmd, clock)))
+
+    def handle(self, from_, from_shard_id, msg, time):
+        if isinstance(msg, MPropose):
+            self._handle_mpropose(from_, msg.dot, msg.cmd, msg.clock, time)
+        elif isinstance(msg, MProposeAck):
+            self._handle_mproposeack(from_, msg.dot, msg.clock, msg.deps, msg.ok)
+        elif isinstance(msg, MCommit):
+            self._handle_mcommit(from_, msg.dot, msg.clock, msg.deps, time)
+        elif isinstance(msg, MRetry):
+            self._handle_mretry(from_, msg.dot, msg.clock, msg.deps, time)
+        elif isinstance(msg, MRetryAck):
+            self._handle_mretryack(from_, msg.dot, msg.deps)
+        elif isinstance(msg, MGarbageCollection):
+            self._handle_mgc(from_, msg.committed)
+        else:
+            raise AssertionError(f"unknown message {msg}")
+
+    def handle_event(self, event, time):
+        assert isinstance(event, GarbageCollectionEvent)
+        self._to_processes.append(
+            ToSend(self.bp.all_but_me(), MGarbageCollection(self._gc_track.clock()))
+        )
+
+    def handle_executed(self, executed: Executed, time: SysTime) -> None:
+        # GC is driven by the executor: a dot is collectable once *executed*
+        # everywhere (not just committed — the key-clock index must keep
+        # commands until no proposal can conflict with them)
+        self._gc_track.update_clock(executed)
+
+    def to_processes(self) -> Optional[Action]:
+        return self._to_processes.popleft() if self._to_processes else None
+
+    def to_executors(self):
+        return self._to_executors.popleft() if self._to_executors else None
+
+    @classmethod
+    def parallel(cls) -> bool:
+        return KeyClocks.parallel()
+
+    @classmethod
+    def leaderless(cls) -> bool:
+        return True
+
+    def metrics(self) -> ProtocolMetrics:
+        return self.bp.metrics()
+
+    # --- handlers ---
+
+    def _handle_mpropose(self, from_, dot, cmd, remote_clock: Clock, time) -> None:
+        assert dot.source == from_, "the coordinator is the dot source"
+        self.key_clocks.clock_join(remote_clock)
+
+        info = self._cmds.get(dot)
+        if info.status != Status.START:
+            return
+
+        # predecessors under the proposed timestamp; higher-timestamp
+        # conflicts block the reply (the wait condition's input)
+        blocked_by: Set[Dot] = set()
+        deps = self.key_clocks.predecessors(dot, cmd, remote_clock, blocked_by)
+
+        info.status = Status.PROPOSE
+        info.cmd = cmd
+        info.deps = deps
+        self._update_clock(dot, info, remote_clock)
+        info.blocked_by = set(blocked_by)
+
+        if not blocked_by:
+            self._accept_command(dot, info)
+        elif not self._wait_condition:
+            self._reject_command(dot, info)
+        else:
+            # check each blocker: ACCEPT/COMMIT blockers with a good-enough
+            # clock+deps can be ignored; an un-ignorable one rejects us right
+            # away; unknown-fate ones register us in their blocking set
+            reject = False
+            not_blocked_by: Set[Dot] = set()
+            for blocker in blocked_by:
+                blocker_info = self._cmds.get_existing(blocker)
+                if blocker_info is None:
+                    # GCed = executed everywhere: can be ignored
+                    not_blocked_by.add(blocker)
+                    continue
+                if blocker_info.status in (Status.ACCEPT, Status.COMMIT):
+                    if self._safe_to_ignore(
+                        dot, info.clock, blocker_info.clock, blocker_info.deps
+                    ):
+                        not_blocked_by.add(blocker)
+                    else:
+                        reject = True
+                        break
+                else:
+                    blocker_info.blocking.add(dot)
+            if reject:
+                self._reject_command(dot, info)
+            elif len(not_blocked_by) == len(blocked_by):
+                self._accept_command(dot, info)
+            else:
+                info.blocked_by -= not_blocked_by
+                assert info.blocked_by, "a waiting command must have blockers"
+
+        # replay any buffered retry/commit now that we have the payload
+        buffered = self._buffered_retries.pop(dot, None)
+        if buffered is not None:
+            self._handle_mretry(buffered[0], dot, buffered[1], buffered[2], time)
+        buffered = self._buffered_commits.pop(dot, None)
+        if buffered is not None:
+            self._handle_mcommit(buffered[0], dot, buffered[1], buffered[2], time)
+
+    def _handle_mproposeack(self, from_, dot, clock: Clock, deps, ok: bool) -> None:
+        # get_existing: a straggler ack (MPropose went to all n, only the
+        # fast quorum's replies matter) must not recreate a GCed info
+        info = self._cmds.get_existing(dot)
+        if info is None:
+            return
+        # the coordinator can end up rejecting its own command, hence REJECT
+        if info.status not in (Status.PROPOSE, Status.REJECT):
+            return
+        assert not info.quorum_clocks.all(), "acks after completion are impossible"
+
+        info.quorum_clocks.add(from_, clock, deps, ok)
+        if not info.quorum_clocks.all():
+            return
+
+        agg_clock, agg_deps, agg_ok = info.quorum_clocks.aggregated()
+        if agg_ok:
+            # everyone accepted the coordinator's proposal as-is
+            assert agg_clock == info.clock
+            self.bp.fast_path()
+            self._to_processes.append(
+                ToSend(self.bp.all(), MCommit(dot, agg_clock, agg_deps))
+            )
+        else:
+            self.bp.slow_path()
+            # sent to everyone: the new clock may unblock waiting commands
+            self._to_processes.append(
+                ToSend(self.bp.all(), MRetry(dot, agg_clock, agg_deps))
+            )
+
+    def _handle_mcommit(self, from_, dot, clock: Clock, deps, time) -> None:
+        self.key_clocks.clock_join(clock)
+        info = self._cmds.get(dot)
+        if info.status == Status.START:
+            self._buffered_commits[dot] = (from_, clock, deps)
+            return
+        if info.status == Status.COMMIT:
+            return
+
+        cmd = info.cmd
+        assert cmd is not None, "there should be a command payload"
+        self._to_executors.append(
+            PredecessorsExecutionInfo(dot, cmd, clock, set(deps))
+        )
+
+        info.status = Status.COMMIT
+        info.deps = set(deps)
+        self._update_clock(dot, info, clock)
+
+        blocking, info.blocking = info.blocking, set()
+        self._try_to_unblock(dot, clock, info.deps, blocking)
+
+    def _handle_mretry(self, from_, dot, clock: Clock, deps, time) -> None:
+        self.key_clocks.clock_join(clock)
+        info = self._cmds.get(dot)
+        if info.status == Status.START:
+            self._buffered_retries[dot] = (from_, clock, deps)
+            return
+        if info.status == Status.COMMIT:
+            return
+
+        info.status = Status.ACCEPT
+        info.deps = set(deps)
+        self._update_clock(dot, info, clock)
+
+        # reply with deps extended by our own lower-timestamp conflicts
+        cmd = info.cmd
+        assert cmd is not None
+        new_deps = self.key_clocks.predecessors(dot, cmd, clock)
+        new_deps.update(deps)
+        self._to_processes.append(ToSend({from_}, MRetryAck(dot, new_deps)))
+
+        blocking, info.blocking = info.blocking, set()
+        self._try_to_unblock(dot, clock, info.deps, blocking)
+
+    def _handle_mretryack(self, from_, dot, deps) -> None:
+        info = self._cmds.get_existing(dot)
+        if info is None or info.status != Status.ACCEPT:
+            return
+        assert not info.quorum_retries.all()
+
+        info.quorum_retries.add(from_, deps)
+        if not info.quorum_retries.all():
+            return
+        agg_deps = info.quorum_retries.aggregated()
+        self._to_processes.append(
+            ToSend(self.bp.all(), MCommit(dot, info.clock, agg_deps))
+        )
+
+    def _handle_mgc(self, from_: ProcessId, committed) -> None:
+        self._gc_track.update_clock_of(from_, committed)
+        stable = self._gc_track.stable()
+        count = 0
+        for process_id, start, end in stable:
+            for seq in range(start, end + 1):
+                self._gc_command(Dot(process_id, seq))
+                count += 1
+        if count:
+            self.bp.stable(count)
+
+    # --- wait-condition helpers (caesar.rs:826-1035) ---
+
+    def _safe_to_ignore(
+        self, my_dot: Dot, my_clock: Clock, their_clock: Clock, their_deps: Set[Dot]
+    ) -> bool:
+        # clocks only increase: the blocker's (ACCEPT/COMMIT) clock must
+        # still be higher than ours.  Ignoring it is safe only if it depends
+        # on us — then it executes after us despite the higher timestamp
+        assert my_clock < their_clock
+        return my_dot in their_deps
+
+    def _try_to_unblock(
+        self, dot: Dot, clock: Clock, deps: Set[Dot], blocking: Set[Dot]
+    ) -> None:
+        """`dot` gained a final-enough (clock, deps): re-examine every
+        command it was blocking."""
+        for blocked in blocking:
+            blocked_info = self._cmds.get_existing(blocked)
+            if blocked_info is None or blocked_info.status != Status.PROPOSE:
+                continue
+            if self._safe_to_ignore(blocked, blocked_info.clock, clock, deps):
+                blocked_info.blocked_by.discard(dot)
+                if not blocked_info.blocked_by:
+                    self._accept_command(blocked, blocked_info)
+            else:
+                # reject ASAP — no point waiting for the other blockers
+                self._reject_command(blocked, blocked_info)
+
+    def _accept_command(self, dot: Dot, info: CaesarInfo) -> None:
+        self._send_mpropose_ack(dot, info.clock, set(info.deps), True)
+
+    def _reject_command(self, dot: Dot, info: CaesarInfo) -> None:
+        info.status = Status.REJECT
+        # counter-propose: a fresh higher timestamp and its predecessors
+        new_clock = self.key_clocks.clock_next()
+        cmd = info.cmd
+        assert cmd is not None
+        new_deps = self.key_clocks.predecessors(dot, cmd, new_clock)
+        self._send_mpropose_ack(dot, new_clock, new_deps, False)
+
+    def _send_mpropose_ack(self, dot: Dot, clock: Clock, deps: Set[Dot], ok: bool) -> None:
+        self._to_processes.append(ToSend({dot.source}, MProposeAck(dot, clock, deps, ok)))
+
+    # --- clock index maintenance (caesar.rs:786-838) ---
+
+    def _update_clock(self, dot: Dot, info: CaesarInfo, new_clock: Clock) -> None:
+        cmd = info.cmd
+        assert cmd is not None
+        if not info.clock.is_zero():
+            self.key_clocks.remove(cmd, info.clock)
+        self.key_clocks.add(dot, cmd, new_clock)
+        info.clock = new_clock
+
+    def _gc_command(self, dot: Dot) -> None:
+        info = self._cmds.gc_single(dot)
+        assert info is not None, "the GC worker sees every command"
+        cmd = info.cmd
+        assert cmd is not None
+        if not info.clock.is_zero():
+            self.key_clocks.remove(cmd, info.clock)
+
+    # --- worker routing (caesar.rs:1119-1160) ---
+
+    @staticmethod
+    def message_index(msg):
+        if isinstance(msg, (MPropose, MProposeAck, MCommit, MRetry, MRetryAck)):
+            return worker_dot_index_shift(msg.dot)
+        if isinstance(msg, MGarbageCollection):
+            return worker_index_no_shift(GC_WORKER_INDEX)
+        raise AssertionError(f"unknown message {msg}")
+
+    @staticmethod
+    def event_index(event):
+        return worker_index_no_shift(GC_WORKER_INDEX)

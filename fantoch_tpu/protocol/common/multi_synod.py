@@ -99,12 +99,8 @@ class _Acceptor(Generic[V]):
         self.ballot: Ballot = initial_leader
         self.accepted: Dict[Slot, Tuple[Ballot, V]] = {}
 
-    def handle_prepare(self, ballot: Ballot):
-        if ballot <= self.ballot:
-            return None
-        self.ballot = ballot
-        # promise + the non-GCed accepted slots (recovery input)
-        return ballot, dict(self.accepted)
+    # leader recovery (prepare/promise over accepted slots) is unimplemented,
+    # mirroring the reference's todo!() at multi.rs:97-99
 
     def handle_accept(self, ballot: Ballot, slot: Slot, value: V) -> Optional[MAccepted]:
         if ballot < self.ballot:

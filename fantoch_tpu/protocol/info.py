@@ -52,6 +52,11 @@ class CommandsInfo(Generic[I]):
             self._infos[dot] = info
         return info
 
+    def get_existing(self, dot: Dot):
+        """Info for `dot` if present, without creating it (the Locked
+        variant's `get`, locked.rs:34-44)."""
+        return self._infos.get(dot)
+
     def contains(self, dot: Dot) -> bool:
         return dot in self._infos
 
@@ -65,8 +70,9 @@ class CommandsInfo(Generic[I]):
                     removed += 1
         return removed
 
-    def gc_single(self, dot: Dot) -> None:
-        self._infos.pop(dot, None)
+    def gc_single(self, dot: Dot):
+        """Remove and return the info for `dot` (None if absent)."""
+        return self._infos.pop(dot, None)
 
     def __len__(self) -> int:
         return len(self._infos)

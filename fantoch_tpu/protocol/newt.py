@@ -73,9 +73,6 @@ from fantoch_tpu.run.routing import (
 
 @dataclass
 class MCollect:
-    # receivers merge into coordinator_votes (skip_fast_ack path): the sim
-    # must hand each target its own copy
-    MUTABLE_PAYLOAD = True
     dot: Dot
     cmd: Command
     quorum: Set[ProcessId]
@@ -85,7 +82,6 @@ class MCollect:
 
 @dataclass
 class MCollectAck:
-    MUTABLE_PAYLOAD = True  # coordinator merges process_votes in place
     dot: Dot
     clock: int
     process_votes: Votes
@@ -93,7 +89,6 @@ class MCollectAck:
 
 @dataclass
 class MCommit:
-    MUTABLE_PAYLOAD = True  # receivers strip votes per key in place
     dot: Dot
     clock: int
     votes: Votes

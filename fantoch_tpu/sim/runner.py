@@ -248,18 +248,15 @@ class Runner:
     ) -> None:
         for action in actions:
             if isinstance(action, ToSend):
-                # messages whose receivers mutate the payload in place (e.g.
-                # Newt merges/strips Votes) declare MUTABLE_PAYLOAD; each
-                # target then gets its own copy, matching the real runner's
-                # serialize-per-connection semantics.  Immutable-payload
-                # messages are shared — receivers only read them.
+                # each target gets its own deep copy, matching the real
+                # runner's serialize-per-connection semantics: receivers may
+                # freely mutate payloads (Newt merges/strips Votes in place),
+                # and aliasing one object across simulated processes would
+                # silently leak state between them
                 targets = sorted(action.target)
-                if getattr(action.msg, "MUTABLE_PAYLOAD", False):
-                    copies = [action.msg] + [
-                        copy.deepcopy(action.msg) for _ in range(len(targets) - 1)
-                    ]
-                else:
-                    copies = [action.msg] * len(targets)
+                copies = [action.msg] + [
+                    copy.deepcopy(action.msg) for _ in range(len(targets) - 1)
+                ]
                 for to, msg in zip(targets, copies):
                     if to == process_id:
                         # message to self: deliver immediately

@@ -1,0 +1,118 @@
+"""Caesar commons + PredecessorsExecutor + whole-system sim tests
+(reference rows: fantoch_ps/src/protocol/mod.rs:557-590 — wait/no-wait
+n=3 f=1 and n=5 f=2 wait)."""
+
+import itertools
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Dot, Rifl
+from fantoch_tpu.core.kvs import KVOp
+from fantoch_tpu.executor.pred import PredecessorsExecutionInfo, PredecessorsExecutor
+from fantoch_tpu.protocol import Caesar
+from fantoch_tpu.protocol.common.pred_clocks import (
+    Clock,
+    QuorumClocks,
+    SequentialKeyClocks,
+)
+
+from harness import sim_test
+
+SHARD = 0
+
+
+def cmd(seq: int, keys) -> Command:
+    return Command.from_keys(
+        Rifl(9, seq), SHARD, {k: (KVOp.put(str(seq)),) for k in keys}
+    )
+
+
+def test_clock_lexicographic_order():
+    assert Clock(10, 1) < Clock(10, 2) < Clock(11, 1)
+    assert Clock(9, 5).join(Clock(10, 1)) == Clock(10, 1)
+    assert Clock(10, 1).join(Clock(10, 3)) == Clock(10, 3)
+    assert Clock(10, 3).join(Clock(9, 9)) == Clock(10, 3)
+
+
+def test_key_clocks_predecessors_split():
+    clocks = SequentialKeyClocks(1, SHARD)
+    a, b, c = Dot(1, 1), Dot(2, 1), Dot(3, 1)
+    clocks.add(a, cmd(1, ["K"]), Clock(1, 1))
+    clocks.add(b, cmd(2, ["K"]), Clock(3, 2))
+    # command c proposed at clock (2, 3): a is lower -> predecessor; b is
+    # higher -> blocks
+    higher = set()
+    deps = clocks.predecessors(c, cmd(3, ["K"]), Clock(2, 3), higher)
+    assert deps == {a}
+    assert higher == {b}
+    # remove a: no longer reported
+    clocks.remove(cmd(1, ["K"]), Clock(1, 1))
+    assert clocks.predecessors(c, cmd(3, ["K"]), Clock(2, 3)) == set()
+
+
+def test_quorum_clocks_early_slow_path():
+    # fq=3, majority=2: a majority with one not-ok completes early
+    q = QuorumClocks(1, 3, 2)
+    q.add(1, Clock(1, 1), {Dot(1, 1)}, True)
+    assert not q.all()
+    q.add(2, Clock(2, 2), {Dot(2, 1)}, False)
+    assert q.all(), "majority replied and someone rejected"
+    clock, deps, ok = q.aggregated()
+    assert clock == Clock(2, 2) and deps == {Dot(1, 1), Dot(2, 1)} and not ok
+
+
+def test_pred_executor_timestamp_order():
+    """Conflicting commands execute in clock order on every delivery
+    permutation; phase 1 (committed) gates phase 2 (lower-clock executed)."""
+    config = Config(n=3, f=1)
+    infos = [
+        PredecessorsExecutionInfo(Dot(1, 1), cmd(1, ["K"]), Clock(1, 1), set()),
+        PredecessorsExecutionInfo(
+            Dot(2, 1), cmd(2, ["K"]), Clock(2, 2), {Dot(1, 1)}
+        ),
+        PredecessorsExecutionInfo(
+            Dot(3, 1), cmd(3, ["K"]), Clock(3, 3), {Dot(1, 1), Dot(2, 1)}
+        ),
+    ]
+    for perm in itertools.permutations(range(3)):
+        ex = PredecessorsExecutor(1, SHARD, config)
+        executed = []
+        for i in perm:
+            ex.handle(infos[i], None)
+            executed.extend(r.rifl.sequence for r in ex.to_clients_iter())
+        assert executed == [1, 2, 3], f"wrong order for {perm}: {executed}"
+
+
+def test_pred_executor_higher_clock_dep_not_waited():
+    """A dep with a *higher* clock is not waited on in phase 2 (it waits for
+    us instead) — only committed-ness is required."""
+    config = Config(n=3, f=1)
+    ex = PredecessorsExecutor(1, SHARD, config)
+    # d2 at clock 5 depends on d1; d1 at clock 9 (higher) depends on d2
+    ex.handle(
+        PredecessorsExecutionInfo(Dot(1, 1), cmd(1, ["K"]), Clock(9, 1), {Dot(2, 1)}),
+        None,
+    )
+    assert [r.rifl.sequence for r in ex.to_clients_iter()] == []
+    ex.handle(
+        PredecessorsExecutionInfo(Dot(2, 1), cmd(2, ["K"]), Clock(5, 2), {Dot(1, 1)}),
+        None,
+    )
+    # d2 (lower clock) first, then d1
+    assert [r.rifl.sequence for r in ex.to_clients_iter()] == [2, 1]
+
+
+def caesar_config(n: int, f: int, wait: bool) -> Config:
+    return Config(n=n, f=f, caesar_wait_condition=wait)
+
+
+def test_caesar_wait_3_1():
+    sim_test(Caesar, caesar_config(3, 1, wait=True))
+
+
+def test_caesar_no_wait_3_1():
+    sim_test(Caesar, caesar_config(3, 1, wait=False))
+
+
+def test_caesar_wait_5_2():
+    sim_test(Caesar, caesar_config(5, 2, wait=True), seed=2)
