@@ -192,7 +192,10 @@ class PredecessorsExecutor(Executor):
 
     @classmethod
     def parallel(cls) -> bool:
-        return True
+        # single process-global dependency graph: key-hash routing cannot
+        # split it (the reference marks it parallel only because its infos
+        # broadcast to every clone; with one shared graph that is wrong)
+        return False
 
     def metrics(self):
         return self._graph.metrics()

@@ -170,9 +170,8 @@ class Caesar(Protocol):
         )
 
     def periodic_events(self):
-        if self.bp.config.gc_interval_ms is not None:
-            return [(GarbageCollectionEvent(), self.bp.config.gc_interval_ms)]
-        return []
+        # gc_interval_ms is mandatory (asserted in __init__)
+        return [(GarbageCollectionEvent(), self.bp.config.gc_interval_ms)]
 
     @property
     def id(self) -> ProcessId:

@@ -1,0 +1,97 @@
+"""Client side of the real runner: closed- and open-loop drivers.
+
+Reference: fantoch/src/run/mod.rs:448-832.  A client task pool shares one
+TCP connection per shard; a demux task routes CommandResults back to the
+issuing client by rifl source.  Closed-loop clients keep one outstanding
+command; open-loop clients submit on a fixed interval regardless of
+completions (mod.rs:526-664).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+from fantoch_tpu.client.client import Client
+from fantoch_tpu.client.workload import Workload
+from fantoch_tpu.core.ids import ClientId, ShardId
+from fantoch_tpu.core.timing import RunTime
+from fantoch_tpu.run.prelude import ClientHi, Submit, ToClient
+from fantoch_tpu.run.rw import Rw
+
+Address = Tuple[str, int]
+
+
+async def run_clients(
+    client_ids: List[ClientId],
+    shard_addresses: Dict[ShardId, Address],
+    workload: Workload,
+    open_loop_interval_ms: Optional[int] = None,
+    status_frequency: Optional[int] = None,
+) -> Dict[ClientId, Client]:
+    """Drive `client_ids` against the cluster; returns the finished clients
+    (latency data inside)."""
+    assert len(shard_addresses) == 1, "multi-shard clients arrive with the partial layer"
+    (shard_id, addr), = shard_addresses.items()
+    reader, writer = await asyncio.open_connection(*addr)
+    rw = Rw(reader, writer)
+    await rw.send(ClientHi(list(client_ids)))
+
+    time = RunTime()
+    clients = {
+        client_id: Client(client_id, workload, status_frequency=status_frequency)
+        for client_id in client_ids
+    }
+    for client in clients.values():
+        client.connect({shard_id: 0})
+
+    queues: Dict[ClientId, asyncio.Queue] = {cid: asyncio.Queue() for cid in client_ids}
+
+    async def demux() -> None:
+        while True:
+            msg = await rw.recv()
+            if msg is None:
+                return
+            assert isinstance(msg, ToClient)
+            queues[msg.cmd_result.rifl.source].put_nowait(msg.cmd_result)
+
+    demux_task = asyncio.ensure_future(demux())
+
+    async def closed_loop(client: Client) -> None:
+        while True:
+            nxt = client.next_cmd(time)
+            if nxt is None:
+                break
+            _shard, cmd = nxt
+            await rw.send(Submit(cmd))
+            cmd_result = await queues[client.id].get()
+            client.handle([cmd_result], time)
+
+    async def open_loop(client: Client) -> None:
+        pending = 0
+
+        async def collector() -> None:
+            nonlocal pending
+            while True:
+                cmd_result = await queues[client.id].get()
+                client.handle([cmd_result], time)
+                pending -= 1
+
+        collect = asyncio.ensure_future(collector())
+        while True:
+            nxt = client.next_cmd(time)
+            if nxt is None:
+                break
+            _shard, cmd = nxt
+            await rw.send(Submit(cmd))
+            pending += 1
+            await asyncio.sleep(open_loop_interval_ms / 1000)
+        while pending > 0:
+            await asyncio.sleep(0.01)
+        collect.cancel()
+
+    driver = open_loop if open_loop_interval_ms is not None else closed_loop
+    await asyncio.gather(*(driver(client) for client in clients.values()))
+    demux_task.cancel()
+    rw.close()
+    return clients

@@ -1,0 +1,99 @@
+"""Localhost whole-system harness: boots a full n-process TCP cluster plus
+clients inside one asyncio loop.
+
+Reference: fantoch/src/run/mod.rs:1030-1346 (`run_test_with_inspect_fun`) —
+the reference boots every server and client as tokio tasks in one runtime
+on random localhost ports; here they are asyncio tasks in one loop, and
+instead of shipping Inspect closures through the periodic task we keep
+direct references to the runtimes for post-run assertions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+from typing import Dict, List, Optional, Tuple
+
+from fantoch_tpu.client.client import Client
+from fantoch_tpu.client.workload import Workload
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import ClientId, ProcessId, process_ids
+from fantoch_tpu.run.client_runner import run_clients
+from fantoch_tpu.run.process_runner import ProcessRuntime
+
+
+def free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+async def run_localhost_cluster(
+    protocol_cls: type,
+    config: Config,
+    workload: Workload,
+    clients_per_process: int,
+    open_loop_interval_ms: Optional[int] = None,
+    extra_run_time_ms: int = 500,
+    workers: int = 1,
+    executors: int = 1,
+) -> Tuple[Dict[ProcessId, ProcessRuntime], Dict[ClientId, Client]]:
+    """Boot n processes + clients, run the workload to completion, keep the
+    cluster alive `extra_run_time_ms` (for GC rounds), then tear down."""
+    shard_id = 0
+    ids = list(process_ids(shard_id, config.n))
+    peer_ports = {pid: free_port() for pid in ids}
+    client_ports = {pid: free_port() for pid in ids}
+    runtimes: Dict[ProcessId, ProcessRuntime] = {}
+    for pid in ids:
+        # localhost processes are equidistant except to themselves: the
+        # distance-sorted list must lead with self (ping 0), like the
+        # reference's ping sort (run/task/ping.rs:144), or a process's fast
+        # quorum may exclude itself and its submits would rely on acks for
+        # payloads it never stored
+        sorted_processes = [(pid, shard_id)] + [
+            (peer, shard_id) for peer in ids if peer != pid
+        ]
+        runtimes[pid] = ProcessRuntime(
+            protocol_cls,
+            pid,
+            shard_id,
+            config,
+            listen_addr=("127.0.0.1", peer_ports[pid]),
+            client_addr=("127.0.0.1", client_ports[pid]),
+            peers={peer: ("127.0.0.1", peer_ports[peer]) for peer in ids if peer != pid},
+            sorted_processes=sorted_processes,
+            workers=workers,
+            executors=executors,
+        )
+
+    await asyncio.gather(*(runtime.start() for runtime in runtimes.values()))
+
+    # one client pool per process, connected to that process (mod.rs:1240-1290)
+    client_groups: List[Tuple[List[ClientId], ProcessId]] = []
+    next_client = 1
+    for pid in ids:
+        group = list(range(next_client, next_client + clients_per_process))
+        next_client += clients_per_process
+        client_groups.append((group, pid))
+
+    results = await asyncio.gather(
+        *(
+            run_clients(
+                group,
+                {shard_id: ("127.0.0.1", client_ports[pid])},
+                workload,
+                open_loop_interval_ms=open_loop_interval_ms,
+            )
+            for group, pid in client_groups
+        )
+    )
+
+    await asyncio.sleep(extra_run_time_ms / 1000)
+    for runtime in runtimes.values():
+        await runtime.stop()
+
+    clients: Dict[ClientId, Client] = {}
+    for group in results:
+        clients.update(group)
+    return runtimes, clients

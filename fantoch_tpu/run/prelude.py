@@ -1,0 +1,99 @@
+"""Wire messages and worker pools for the real runner.
+
+Reference: fantoch/src/run/prelude.rs (handshakes, client wire protocol,
+the POEMessage protocol/executor split) and fantoch/src/run/pool.rs
+(``ToPool``: a vector of channels with reserved-index routing).  Channels
+are asyncio queues; a pool's ``forward`` resolves a
+:data:`fantoch_tpu.run.routing.WorkerIndex` exactly like the reference's
+reserved-index arithmetic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any, List, Optional
+
+from fantoch_tpu.core.command import Command, CommandResult
+from fantoch_tpu.core.ids import ClientId, Dot, ProcessId, ShardId
+from fantoch_tpu.run.routing import WorkerIndex, resolve_index
+
+
+# --- handshakes (prelude.rs:38-50) ---
+
+
+@dataclass
+class ProcessHi:
+    process_id: ProcessId
+    shard_id: ShardId
+
+
+@dataclass
+class ClientHi:
+    client_ids: List[ClientId]
+
+
+# --- client wire protocol (prelude.rs:52-69) ---
+
+
+@dataclass
+class Register:
+    pass
+
+
+@dataclass
+class Submit:
+    cmd: Command
+
+
+@dataclass
+class ToClient:
+    cmd_result: CommandResult
+
+
+# --- process wire protocol: protocol/executor split (prelude.rs:71-77) ---
+
+
+@dataclass
+class POEProtocol:
+    msg: Any
+
+
+@dataclass
+class POEExecutor:
+    info: Any
+
+
+class ToPool:
+    """Vector of queues with WorkerIndex routing (pool.rs:11-138)."""
+
+    def __init__(self, name: str, size: int):
+        self.name = name
+        self._queues: List[asyncio.Queue] = [asyncio.Queue() for _ in range(size)]
+
+    @property
+    def size(self) -> int:
+        return len(self._queues)
+
+    def queue(self, position: int) -> asyncio.Queue:
+        return self._queues[position]
+
+    def forward(self, index: WorkerIndex, item: Any) -> None:
+        """Route `item` by worker index.
+
+        A None index means broadcast in the reference (each worker owns a
+        partition of protocol state, pool.rs:92); here worker tasks share
+        one protocol object, so broadcast messages need exactly one
+        handling — deliver to queue 0.
+        """
+        position = resolve_index(index, len(self._queues))
+        if position is None:
+            position = 0
+        self._queues[position].put_nowait(item)
+
+    def forward_to(self, position: int, item: Any) -> None:
+        self._queues[position % len(self._queues)].put_nowait(item)
+
+    def broadcast(self, item: Any) -> None:
+        for queue in self._queues:
+            queue.put_nowait(item)

@@ -1,0 +1,338 @@
+"""Server side of the real runner: one process = a TCP mesh endpoint plus
+worker / executor / client-session asyncio tasks.
+
+Reference: fantoch/src/run/task/{process,executor,client}.rs and
+fantoch/src/run/mod.rs:105-445.  Same architecture, asyncio-idiomatic:
+
+* a peer listener accepts inbound connections; a reader task per inbound
+  connection routes messages to workers by ``Protocol.message_index``
+  (process.rs:292-326);
+* outbound connections are opened to every peer (connect_to_all,
+  process.rs:21-111) with a writer task per peer draining a send queue;
+* ``workers`` protocol tasks pull tagged items from their own queue —
+  submits, peer messages, periodic events, executed notifications — call
+  into the (shared, cooperatively-scheduled) protocol state machine and
+  drain its outputs (the hot ``process_task`` select loop,
+  process.rs:467-678);
+* ``executors`` executor clones route execution infos by key hash
+  (executor.rs:14-120) and push per-key results to the client sessions
+  that own each client id;
+* client sessions perform the ClientHi handshake, assign dots for
+  leaderless protocols (AtomicDotGen, client.rs:221-223), aggregate
+  per-key results into CommandResults and stream them back.
+
+Intra-process parallelism note: the reference guards shared protocol state
+with Sequential/Atomic/Locked structure variants; here worker tasks share
+one protocol object under cooperative scheduling (handlers never await), so
+every variant's semantics collapse to the sequential one — the real
+parallelism axis on TPU is the batched device step, not threads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional, Tuple
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import AtomicIdGen, ClientId, ProcessId, Rifl, ShardId
+from fantoch_tpu.core.timing import RunTime
+from fantoch_tpu.executor.aggregate import AggregatePending
+from fantoch_tpu.executor.base import ExecutorResult
+from fantoch_tpu.protocol.base import Protocol, ToForward, ToSend
+from fantoch_tpu.run.prelude import (
+    ClientHi,
+    POEProtocol,
+    ProcessHi,
+    Register,
+    Submit,
+    ToClient,
+    ToPool,
+)
+from fantoch_tpu.run.routing import worker_dot_index_shift
+from fantoch_tpu.run.rw import Rw, serialize
+from fantoch_tpu.utils import key_hash, logger
+
+Address = Tuple[str, int]
+
+
+def executor_index(info: Any, size: int) -> Optional[int]:
+    """Executor routing: by key hash when the info names a key
+    (fantoch/src/executor/mod.rs:161-166), else executor 0."""
+    key = getattr(info, "key", None)
+    if key is not None:
+        return key_hash(key) % size
+    return 0
+
+
+class _ClientSession:
+    """Server side of one client connection (client.rs:79-260)."""
+
+    def __init__(self, runtime: "ProcessRuntime", rw: Rw):
+        self.runtime = runtime
+        self.rw = rw
+        self.pending = AggregatePending(runtime.process.id, runtime.process.shard_id)
+        self.client_ids: List[ClientId] = []
+
+    def deliver(self, result: ExecutorResult) -> None:
+        cmd_result = self.pending.add_executor_result(result)
+        if cmd_result is not None:
+            self.rw.write(ToClient(cmd_result))
+            self.runtime.spawn(self.rw.flush())
+
+    async def run(self) -> None:
+        hi = await self.rw.recv()
+        assert isinstance(hi, ClientHi)
+        self.client_ids = hi.client_ids
+        for client_id in self.client_ids:
+            self.runtime.client_sessions[client_id] = self
+        while True:
+            msg = await self.rw.recv()
+            if msg is None:
+                break
+            if isinstance(msg, Register):
+                continue  # multi-shard registration: handled in the partial layer
+            assert isinstance(msg, Submit)
+            cmd = msg.cmd
+            self.pending.wait_for(cmd)
+            dot = (
+                self.runtime.dot_gen.next_id()
+                if self.runtime.protocol_cls.leaderless()
+                else None
+            )
+            index = (
+                worker_dot_index_shift(dot)
+                if dot is not None
+                else (0, 0)  # leader-based: submit handled by any worker
+            )
+            self.runtime.workers.forward(index, ("submit", dot, cmd))
+        for client_id in self.client_ids:
+            self.runtime.client_sessions.pop(client_id, None)
+
+
+class ProcessRuntime:
+    def __init__(
+        self,
+        protocol_cls: type,
+        process_id: ProcessId,
+        shard_id: ShardId,
+        config: Config,
+        listen_addr: Address,
+        client_addr: Address,
+        peers: Dict[ProcessId, Address],
+        sorted_processes: List[Tuple[ProcessId, ShardId]],
+        workers: int = 1,
+        executors: int = 1,
+    ):
+        self.protocol_cls = protocol_cls
+        self.config = config
+        self.listen_addr = listen_addr
+        self.client_addr = client_addr
+        self.peers = peers
+        self.sorted_processes = sorted_processes
+        self.time = RunTime()
+
+        self.process: Protocol
+        self.process, self.periodic_events = protocol_cls.new(process_id, shard_id, config)
+        # sanity: non-parallel components can't be split across tasks
+        # (run/mod.rs:191-209)
+        if not protocol_cls.parallel():
+            workers = 1
+        if not protocol_cls.Executor.parallel():
+            executors = 1
+        self.workers = ToPool("workers", workers)
+        self.executor_pool = ToPool("executors", executors)
+        self.executors = [
+            protocol_cls.Executor(process_id, shard_id, config) for _ in range(executors)
+        ]
+        for index, executor in enumerate(self.executors):
+            executor.set_executor_index(index)
+        self.dot_gen = AtomicIdGen(process_id)
+        self.client_sessions: Dict[ClientId, _ClientSession] = {}
+        self._peer_writers: Dict[ProcessId, asyncio.Queue] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._servers: List[asyncio.base_events.Server] = []
+        self._connected = asyncio.Event()
+
+    # --- lifecycle ---
+
+    def spawn(self, coro) -> asyncio.Task:
+        task = asyncio.ensure_future(coro)
+        task.add_done_callback(self._on_task_done)
+        self._tasks.append(task)
+        return task
+
+    @staticmethod
+    def _on_task_done(task: asyncio.Task) -> None:
+        # a dead worker/reader/executor silently stalls the whole process
+        # (the reference logs and exits the task, process.rs:320-325); make
+        # failures loud instead
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            logger.error("runner task crashed: %r", exc)
+            raise exc
+
+    async def start(self) -> None:
+        """Listen, connect to all peers, then start worker/executor loops."""
+        peer_server = await asyncio.start_server(self._on_peer, *self.listen_addr)
+        client_server = await asyncio.start_server(self._on_client, *self.client_addr)
+        self._servers = [peer_server, client_server]
+
+        # connect to every peer, retrying while they boot (process.rs:71-111)
+        for peer_id, addr in self.peers.items():
+            rw = await self._connect_with_retry(addr)
+            await rw.send(ProcessHi(self.process.id, self.process.shard_id))
+            queue: asyncio.Queue = asyncio.Queue()
+            self._peer_writers[peer_id] = queue
+            self.spawn(self._writer_task(rw, queue))
+
+        connect_ok, _ = self.process.discover(self.sorted_processes)
+        assert connect_ok, "discover must succeed with a full process list"
+
+        for position in range(self.workers.size):
+            self.spawn(self._worker_task(position))
+        for position in range(self.executor_pool.size):
+            self.spawn(self._executor_task(position))
+        for event, interval_ms in self.periodic_events:
+            self.spawn(self._periodic_task(event, interval_ms))
+        interval = self.config.executor_executed_notification_interval_ms
+        if interval is not None:
+            self.spawn(self._executed_notification_task(interval))
+        self._connected.set()
+
+    async def stop(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        for server in self._servers:
+            server.close()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    @staticmethod
+    async def _connect_with_retry(addr: Address, attempts: int = 100) -> Rw:
+        for _ in range(attempts):
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                return Rw(reader, writer)
+            except OSError:
+                await asyncio.sleep(0.05)
+        raise ConnectionError(f"could not connect to {addr}")
+
+    # --- connection handlers ---
+
+    async def _on_peer(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        rw = Rw(reader, writer)
+        hi = await rw.recv()
+        assert isinstance(hi, ProcessHi), f"unexpected handshake {hi}"
+        self.spawn(self._reader_task(hi.process_id, hi.shard_id, rw))
+
+    async def _on_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        await self._connected.wait()
+        session = _ClientSession(self, Rw(reader, writer))
+        self.spawn(session.run())
+
+    # --- tasks ---
+
+    async def _reader_task(self, from_: ProcessId, from_shard: ShardId, rw: Rw) -> None:
+        """Route peer messages to workers by message index
+        (process.rs:292-326)."""
+        while True:
+            msg = await rw.recv()
+            if msg is None:
+                return
+            assert isinstance(msg, POEProtocol)
+            index = self.protocol_cls.message_index(msg.msg)
+            self.workers.forward(index, ("msg", from_, from_shard, msg.msg))
+
+    async def _writer_task(self, rw: Rw, queue: asyncio.Queue) -> None:
+        """Drains pre-serialized frames (serialization happens at enqueue
+        time: a message may also be self-delivered, and the local handler
+        can mutate it in place before this task would run)."""
+        while True:
+            frame = await queue.get()
+            rw.write_frame(frame)
+            # batch whatever accumulated while writing (flush coalescing,
+            # process.rs:329-385)
+            while not queue.empty():
+                rw.write_frame(queue.get_nowait())
+            await rw.flush()
+
+    async def _worker_task(self, position: int) -> None:
+        queue = self.workers.queue(position)
+        process = self.process
+        while True:
+            item = await queue.get()
+            kind = item[0]
+            if kind == "msg":
+                _, from_, from_shard, msg = item
+                process.handle(from_, from_shard, msg, self.time)
+            elif kind == "submit":
+                _, dot, cmd = item
+                process.submit(dot, cmd, self.time)
+            elif kind == "event":
+                process.handle_event(item[1], self.time)
+            elif kind == "executed":
+                process.handle_executed(item[1], self.time)
+            else:
+                raise AssertionError(f"unknown worker item {item}")
+            self._drain_protocol()
+
+    def _drain_protocol(self) -> None:
+        """Ship protocol outputs (the send_to_processes_and_executors analog,
+        process.rs:580-654)."""
+        process = self.process
+        for action in process.to_processes_iter():
+            if isinstance(action, ToSend):
+                # serialize once, NOW: the self-delivered copy is handled by
+                # a worker that may mutate the message in place (e.g. Newt
+                # strips MCommit votes), so peers must get bytes captured
+                # before any local handling
+                frame = None
+                for target in sorted(action.target):
+                    if target != process.id and frame is None:
+                        frame = serialize(POEProtocol(action.msg))
+                for target in sorted(action.target):
+                    if target == process.id:
+                        index = self.protocol_cls.message_index(action.msg)
+                        self.workers.forward(
+                            index, ("msg", process.id, process.shard_id, action.msg)
+                        )
+                    else:
+                        self._peer_writers[target].put_nowait(frame)
+            elif isinstance(action, ToForward):
+                index = self.protocol_cls.message_index(action.msg)
+                self.workers.forward(
+                    index, ("msg", process.id, process.shard_id, action.msg)
+                )
+            else:
+                raise AssertionError(f"unknown action {action}")
+        for info in process.to_executors_iter():
+            position = executor_index(info, self.executor_pool.size)
+            self.executor_pool.forward_to(position, info)
+
+    async def _executor_task(self, position: int) -> None:
+        queue = self.executor_pool.queue(position)
+        executor = self.executors[position]
+        while True:
+            info = await queue.get()
+            executor.handle(info, self.time)
+            for result in executor.to_clients_iter():
+                session = self.client_sessions.get(result.rifl.source)
+                if session is not None:
+                    session.deliver(result)
+
+    async def _periodic_task(self, event: Any, interval_ms: int) -> None:
+        while True:
+            await asyncio.sleep(interval_ms / 1000)
+            index = self.protocol_cls.event_index(event)
+            self.workers.forward(index, ("event", event))
+
+    async def _executed_notification_task(self, interval_ms: int) -> None:
+        """Collect executed clocks and notify the GC worker
+        (executor.rs:295-313)."""
+        while True:
+            await asyncio.sleep(interval_ms / 1000)
+            for executor in self.executors:
+                executed = executor.executed(self.time)
+                if executed is not None:
+                    self.workers.forward_to(0, ("executed", executed))

@@ -1,0 +1,64 @@
+"""Length-delimited framing over asyncio streams.
+
+Reference: fantoch/src/run/rw/{mod,connection}.rs — the reference frames
+with tokio's LengthDelimitedCodec + bincode; here frames are a u32
+big-endian length prefix + pickled payload.  ``write`` queues without
+flushing, ``send`` queues and flushes, mirroring the reference's explicit
+flush control (rw/mod.rs:55-84) that lets writers batch small protocol
+messages into one syscall.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import socket
+import struct
+from typing import Any, Optional
+
+_LEN = struct.Struct(">I")
+
+
+def serialize(value: Any) -> bytes:
+    return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+class Rw:
+    """Framed reader/writer over one TCP connection."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._reader = reader
+        self._writer = writer
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            # TCP_NODELAY, as the reference's Connection (connection.rs:46-51)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    async def recv(self) -> Optional[Any]:
+        """Read one frame; None on clean EOF."""
+        try:
+            header = await self._reader.readexactly(_LEN.size)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            return None
+        (length,) = _LEN.unpack(header)
+        payload = await self._reader.readexactly(length)
+        return pickle.loads(payload)
+
+    def write(self, value: Any) -> None:
+        """Queue one frame without flushing."""
+        self.write_frame(serialize(value))
+
+    def write_frame(self, payload: bytes) -> None:
+        """Queue one pre-serialized frame without flushing."""
+        self._writer.write(_LEN.pack(len(payload)) + payload)
+
+    async def send(self, value: Any) -> None:
+        """Queue one frame and flush."""
+        self.write(value)
+        await self.flush()
+
+    async def flush(self) -> None:
+        await self._writer.drain()
+
+    def close(self) -> None:
+        self._writer.close()

@@ -1,0 +1,143 @@
+"""Real-runner integration tests: full n-process TCP clusters on localhost
+inside one asyncio loop, mirroring the reference's run_test matrix
+(fantoch_ps/src/protocol/mod.rs:112-750 via fantoch/src/run/mod.rs:1030).
+"""
+
+import asyncio
+
+import pytest
+
+from fantoch_tpu.client import ConflictRateKeyGen, Workload
+from fantoch_tpu.core import Config
+from fantoch_tpu.protocol import Basic, Caesar, EPaxos, FPaxos, Newt, ProtocolMetricsKind
+from fantoch_tpu.run.harness import run_localhost_cluster
+
+COMMANDS_PER_CLIENT = 10
+CLIENTS_PER_PROCESS = 2
+
+
+def run_cluster(
+    protocol_cls,
+    config,
+    workers=1,
+    executors=1,
+    open_loop_interval_ms=None,
+    check_agreement=True,
+):
+    config = config.with_(
+        executor_monitor_execution_order=True,
+        gc_interval_ms=50,
+        executor_executed_notification_interval_ms=50,
+        shard_count=1,
+    )
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=2,
+        commands_per_client=COMMANDS_PER_CLIENT,
+        payload_size=1,
+    )
+    runtimes, clients = asyncio.run(
+        run_localhost_cluster(
+            protocol_cls,
+            config,
+            workload,
+            CLIENTS_PER_PROCESS,
+            workers=workers,
+            executors=executors,
+            open_loop_interval_ms=open_loop_interval_ms,
+            extra_run_time_ms=1000,
+        )
+    )
+
+    # every client finished its workload
+    total_clients = config.n * CLIENTS_PER_PROCESS
+    assert len(clients) == total_clients
+    for client in clients.values():
+        assert client.issued_commands == COMMANDS_PER_CLIENT
+        assert len(list(client.data().latency_data())) == COMMANDS_PER_CLIENT
+
+    # agreement: merge each process's executor monitors, then compare across
+    # processes (protocol/mod.rs:924-1010)
+    merged = {}
+    for pid, runtime in runtimes.items():
+        monitor = None
+        for executor in runtime.executors:
+            m = executor.monitor()
+            if m is None:
+                continue
+            if monitor is None:
+                monitor = m
+            else:
+                monitor.merge(m)
+        assert monitor is not None
+        merged[pid] = monitor
+    if check_agreement:
+        items = list(merged.items())
+        pid_a, monitor_a = items[0]
+        for pid_b, monitor_b in items[1:]:
+            for key in monitor_a.keys():
+                assert monitor_a.get_order(key) == monitor_b.get_order(key), (
+                    f"p{pid_a} and p{pid_b} disagree on {key!r}"
+                )
+
+    # commit + GC accounting (protocol/mod.rs:1015-1080)
+    min_commits = COMMANDS_PER_CLIENT * total_clients
+    total_fast = total_slow = total_stable = 0
+    for runtime in runtimes.items():
+        pass
+    for pid, runtime in runtimes.items():
+        m = runtime.process.metrics()
+        total_fast += m.get_aggregated(ProtocolMetricsKind.FAST_PATH) or 0
+        total_slow += m.get_aggregated(ProtocolMetricsKind.SLOW_PATH) or 0
+        total_stable += m.get_aggregated(ProtocolMetricsKind.STABLE) or 0
+    if protocol_cls.leaderless():
+        # Basic (check_agreement=False) has no fast/slow accounting
+        if check_agreement:
+            assert total_fast + total_slow == min_commits
+        gc_at = config.n
+    else:
+        gc_at = config.f + 1
+    assert total_stable == gc_at * min_commits, (
+        f"incomplete gc: {total_stable} != {gc_at} * {min_commits}"
+    )
+    return total_slow
+
+
+def test_run_basic_3_1():
+    # Basic is the reference's *inconsistent* protocol (fantoch/src/protocol/
+    # basic.rs): commands execute at commit without cross-process ordering,
+    # so only completion + GC accounting apply
+    run_cluster(Basic, Config(n=3, f=1), check_agreement=False)
+
+
+def test_run_epaxos_3_1():
+    slow = run_cluster(EPaxos, Config(n=3, f=1))
+    assert slow == 0, "f=1: everything commits on the fast path"
+
+
+def test_run_newt_3_1():
+    slow = run_cluster(Newt, Config(n=3, f=1, newt_detached_send_interval_ms=50))
+    assert slow == 0
+
+
+def test_run_newt_3_1_multi_executor():
+    run_cluster(
+        Newt,
+        Config(n=3, f=1, newt_detached_send_interval_ms=50),
+        executors=3,
+    )
+
+
+def test_run_fpaxos_3_1_multi_worker():
+    run_cluster(FPaxos, Config(n=3, f=1, leader=1), workers=3)
+
+
+def test_run_caesar_3_1():
+    run_cluster(Caesar, Config(n=3, f=1))
+
+
+def test_run_basic_3_1_open_loop():
+    run_cluster(
+        Basic, Config(n=3, f=1), open_loop_interval_ms=5, check_agreement=False
+    )
