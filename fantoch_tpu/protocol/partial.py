@@ -1,0 +1,138 @@
+"""Partial-replication (multi-shard) commit glue.
+
+Reference: fantoch_ps/src/protocol/partial.rs.  A multi-shard command runs
+the protocol *independently in each shard it touches*; commits are then
+aggregated: every shard sends an MShardCommit to the dot owner (the process
+in the client's target shard), which replies MShardAggregatedCommit with
+the joined data once all shards reported, and each shard then broadcasts
+the final MCommit internally.  Used by Atlas (deps union) and Newt (max
+clock + votes); EPaxos does not support partial replication.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, Optional, Set, TypeVar
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.ids import Dot, ProcessId
+from fantoch_tpu.protocol.base import BaseProcess, ToSend
+
+I = TypeVar("I")
+
+
+class ShardsCommits(Generic[I]):
+    """Aggregation of one commit notification per shard (partial.rs:206-246)."""
+
+    __slots__ = ("process_id", "shard_count", "participants", "info")
+
+    def __init__(self, process_id: ProcessId, shard_count: int, info: I):
+        self.process_id = process_id
+        self.shard_count = shard_count
+        self.participants: Set[ProcessId] = set()
+        self.info = info
+
+    def add(self, from_: ProcessId, add: Callable[[I], None]) -> bool:
+        assert from_ not in self.participants
+        self.participants.add(from_)
+        add(self.info)
+        return len(self.participants) == self.shard_count
+
+    def update(self, update: Callable[[I], None]) -> None:
+        update(self.info)
+
+
+def submit_actions(
+    bp: BaseProcess,
+    dot: Dot,
+    cmd: Command,
+    target_shard: bool,
+    create_mforward_submit,
+    to_processes,
+) -> None:
+    """Forward the submit to the closest process of every other shard the
+    command touches — only from the shard the client targeted
+    (partial.rs:8-35)."""
+    if not target_shard:
+        return
+    for shard_id in cmd.shards():
+        if shard_id != bp.shard_id:
+            to_processes.append(
+                ToSend({bp.closest_process(shard_id)}, create_mforward_submit(dot, cmd))
+            )
+
+
+def mcommit_actions(
+    bp: BaseProcess,
+    get_shards_commits: Callable[[], Optional[ShardsCommits]],
+    set_shards_commits: Callable[[ShardsCommits], None],
+    info_factory: Callable[[], I],
+    shard_count: int,
+    dot: Dot,
+    data1,
+    data2,
+    create_mcommit,
+    create_mshard_commit,
+    update_shards_commits_info: Callable[[I, object], None],
+    to_processes,
+) -> None:
+    """Single shard: broadcast the MCommit.  Multi-shard: record our own
+    data and send an MShardCommit to the dot owner (partial.rs:37-102)."""
+    if shard_count == 1:
+        to_processes.append(ToSend(bp.all(), create_mcommit(dot, data1, data2)))
+        return
+    shards_commits = _init(get_shards_commits, set_shards_commits, bp, shard_count, info_factory)
+    shards_commits.update(lambda info: update_shards_commits_info(info, data2))
+    to_processes.append(ToSend({dot.source}, create_mshard_commit(dot, data1)))
+
+
+def handle_mshard_commit(
+    bp: BaseProcess,
+    get_shards_commits: Callable[[], Optional[ShardsCommits]],
+    set_shards_commits: Callable[[ShardsCommits], None],
+    info_factory: Callable[[], I],
+    shard_count: int,
+    from_: ProcessId,
+    dot: Dot,
+    data,
+    add_shards_commits_info: Callable[[I, object], None],
+    create_mshard_aggregated_commit,
+    to_processes,
+) -> None:
+    """At the dot owner: aggregate per-shard commits; once all shards
+    reported, answer every participant (partial.rs:104-142)."""
+    shards_commits = _init(get_shards_commits, set_shards_commits, bp, shard_count, info_factory)
+    done = shards_commits.add(from_, lambda info: add_shards_commits_info(info, data))
+    if done:
+        to_processes.append(
+            ToSend(
+                set(shards_commits.participants),
+                create_mshard_aggregated_commit(dot, shards_commits.info),
+            )
+        )
+
+
+def handle_mshard_aggregated_commit(
+    bp: BaseProcess,
+    take_shards_commits: Callable[[], Optional[ShardsCommits]],
+    dot: Dot,
+    data1,
+    extract_mcommit_extra_data,
+    create_mcommit,
+    to_processes,
+) -> None:
+    """Back at each participant: broadcast the final MCommit within the
+    shard (partial.rs:144-177)."""
+    shards_commits = take_shards_commits()
+    assert shards_commits is not None, (
+        f"no shards commit info when handling MShardAggregatedCommit for {dot}"
+    )
+    data2 = extract_mcommit_extra_data(shards_commits.info)
+    to_processes.append(ToSend(bp.all(), create_mcommit(dot, data1, data2)))
+
+
+def _init(get, set_, bp: BaseProcess, shard_count: int, info_factory) -> ShardsCommits:
+    shards_commits = get()
+    if shards_commits is None:
+        shards_commits = ShardsCommits(bp.process_id, shard_count, info_factory())
+        set_(shards_commits)
+    return shards_commits
