@@ -18,22 +18,31 @@ The TPU-native equivalents are two mesh axes:
     :mod:`fantoch_tpu.ops.graph_resolve`.
 
 One :func:`protocol_step` is the analog of delivering a full
-MCollect -> MCollectAck -> MCommit -> execute round for B commands on all
-replicas at once:
+MCollect -> MCollectAck -> [MConsensus -> MConsensusAck] -> MCommit ->
+execute round for B commands on all replicas at once:
 
   1. per-replica dependency computation (scatter/gather over the replica's
      key-clock shard) — each replica reports the latest conflicting command
      it knows (``KeyDeps::add_cmd``);
-  2. fast-path check: EPaxos commits on the fast path iff *all* fast-quorum
-     replicas report identical deps (epaxos.rs:339-345) — here
-     ``pmax == pmin`` along ``replica``;
-  3. final deps = union = elementwise max along ``replica`` (with
-     latest-per-key sequential deps, union of singletons is the max dot);
+  2. fast-path check over the **fast quorum only** (the first
+     ``fast_quorum_size`` replicas, mirroring the distance-sorted quorum of
+     fantoch/src/protocol/base.rs:59-131): EPaxos commits on the fast path
+     iff all fast-quorum replicas report identical deps (epaxos.rs:339-345)
+     — here a masked ``pmax == pmin`` along ``replica``;
+  3. slow path (Synod accept round, fantoch_ps/src/protocol/common/synod/
+     single.rs): for fast-path misses the coordinator proposes the *union*
+     of fast-quorum deps (= masked max over singletons) at ballot 0 via the
+     skip-prepare trick (single.rs:86); replica accept indicators are
+     counted with a ``psum`` along ``replica`` and the command commits once
+     ``acks >= write_quorum_size`` (f + 1);
   4. batched SCC/topological resolution of the committed batch
      (ops/graph_resolve.resolve_functional), shared across the ``batch``
      axis via one small all_gather;
-  5. state update: scatter-max the new dots into every replica's key-clock
-     and advance the executed frontier.
+  5. state update: scatter-max the committed dots into every replica's
+     key-clock, advance the executed frontier, and compute the GC stability
+     watermark = ``pmin`` of all replicas' frontiers (the AEClock meet of
+     fantoch/src/protocol/gc.rs:72-116, collapsed to a counter in this
+     dense round-based regime).
 
 All state stays device-resident across steps (donated), so the host only
 feeds command batches and drains execution orders.
@@ -49,7 +58,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 shard_map = jax.shard_map
 
-from fantoch_tpu.ops.graph_resolve import TERMINAL, resolve_functional
+from fantoch_tpu.ops.graph_resolve import MISSING, TERMINAL, resolve_functional
 
 REPLICA_AXIS = "replica"
 BATCH_AXIS = "batch"
@@ -77,6 +86,18 @@ class StepOutput(NamedTuple):
     resolved: jax.Array  # bool[B]
     fast_path: jax.Array  # bool[B] — committed on the fast path
     deps_gid: jax.Array  # int32[B] — final dependency (global id, -1 none)
+    slow_paths: jax.Array  # int32[] — commands that took the Synod round
+    stable: jax.Array  # int32[] — GC watermark: min executed frontier
+
+
+def quorum_sizes(num_replicas: int) -> Tuple[int, int]:
+    """(fast_quorum_size, write_quorum_size) for EPaxos with minority f.
+
+    Delegates to the shared protocol-fact formula
+    (Config.epaxos_quorum_sizes; EPaxos ignores config.f)."""
+    from fantoch_tpu.core.config import Config
+
+    return Config(num_replicas, 0).epaxos_quorum_sizes()
 
 
 def make_mesh(n_devices: int | None = None) -> Mesh:
@@ -140,10 +161,24 @@ def protocol_step(
     dot_seq: jax.Array,  # int32[B]
     *,
     mesh: Mesh,
+    live_replicas: int | None = None,
 ) -> Tuple[ReplicaState, StepOutput]:
-    """One batched commit+execute round over the (replica, batch) mesh."""
+    """One batched commit+execute round over the (replica, batch) mesh.
+
+    ``live_replicas``: replicas (global rows) < this count respond to the
+    Synod accept round; the rest are crashed/partitioned for the round.
+    With fewer than write_quorum live replicas, slow-path commands do NOT
+    commit this round (and neither does anything depending on them).
+    Default: all replicas live.
+    """
     num_replicas, key_buckets = state.key_clock.shape
     batch = key.shape[0]
+    fast_quorum, write_quorum = quorum_sizes(num_replicas)
+    if live_replicas is None:
+        live_replicas = num_replicas
+    replica_blocks = num_replicas // mesh.shape[REPLICA_AXIS]
+    int_min = jnp.iinfo(jnp.int32).min
+    int_max = jnp.iinfo(jnp.int32).max
 
     def step(key_clock, frontier, next_gid, key_l, dot_src_l, dot_seq_l):
         # local blocks: key_clock [r_blk, K], key_l [b_blk] (sharded batch)
@@ -162,32 +197,71 @@ def protocol_step(
             chain >= 0, gid[jnp.maximum(chain, 0)], prior
         )  # [r_blk, B]
 
-        # 3. quorum aggregation along the replica axis (the MCollectAck
-        # fan-in): fast path iff all replicas reported the same dep.
-        dep_max = jax.lax.pmax(dep_gid.max(axis=0), REPLICA_AXIS)  # [B]
-        dep_min = jax.lax.pmin(dep_gid.min(axis=0), REPLICA_AXIS)  # [B]
-        fast = dep_max == dep_min
-        final_gid = dep_max  # union of latest-per-key singletons = max
+        # 3. MCollectAck fan-in over the *fast quorum* = the first
+        # fast_quorum global replica rows (distance-sorted quorum,
+        # base.rs:59-131).  Fast path iff all fast-quorum replicas
+        # reported the same dep (check_union, epaxos.rs:339-345).
+        row = (
+            jax.lax.axis_index(REPLICA_AXIS) * replica_blocks
+            + jnp.arange(replica_blocks, dtype=jnp.int32)
+        )  # global replica row ids of this block
+        in_fq = (row < fast_quorum)[:, None]  # [r_blk, 1]
+        fq_max = jax.lax.pmax(
+            jnp.where(in_fq, dep_gid, int_min).max(axis=0), REPLICA_AXIS
+        )  # [B]
+        fq_min = jax.lax.pmin(
+            jnp.where(in_fq, dep_gid, int_max).min(axis=0), REPLICA_AXIS
+        )  # [B]
+        fast = fq_max == fq_min
+        # slow-path proposal: union of fast-quorum deps (= max over
+        # latest-per-key singletons), Synod ballot 0 / skip-prepare
+        # (synod single.rs:86) — same value either way, so the committed
+        # dep is fq_max; what the slow path adds is the accept round.
+        final_gid = fq_max
+
+        # Synod accept round for fast-path misses: every *live* replica
+        # accepts the ballot-0 proposal (no competing coordinator within a
+        # round; crashed replicas don't respond); acks are counted with a
+        # psum and the command commits once acks >= write_quorum (f+1).
+        # This is the MConsensusAck fan-in.
+        live = (row < live_replicas)[:, None]  # [r_blk, 1]
+        accept = live & ~fast[None, :]
+        acks = jax.lax.psum(
+            accept.astype(jnp.int32).sum(axis=0), REPLICA_AXIS
+        )  # [B]
+        committed = fast | (acks >= write_quorum)
+        slow_paths = (~fast).sum().astype(jnp.int32)
 
         # 4. batched resolution of the committed round (all deps are within
         # this batch or already executed, so prune pre-batch deps).
+        # Uncommitted commands are marked MISSING: they stay unresolved and
+        # so does everything whose dependency chain reaches them.
         dep_idx = jnp.where(
             final_gid >= next_gid, final_gid - next_gid, jnp.int32(TERMINAL)
         )
+        dep_idx = jnp.where(committed, dep_idx, jnp.int32(MISSING))
         res = resolve_functional(dep_idx, dot_src_f, dot_seq_f)
+        executed = res.resolved & committed
 
         # 5. state update: every replica learns the committed dots
         # (scatter-max by key; later commands in the batch win)
-        new_clock = key_clock.at[:, key_full].max(gid[None, :])
-        new_frontier = frontier + res.resolved.sum().astype(jnp.int32)
+        new_clock = key_clock.at[:, key_full].max(
+            jnp.where(committed, gid, jnp.int32(-1))[None, :]
+        )
+        new_frontier = frontier + executed.sum().astype(jnp.int32)
+        # GC stability watermark: the meet of all replicas' executed
+        # frontiers (gc.rs stable()), here a pmin over the replica axis.
+        stable = jax.lax.pmin(new_frontier.min(), REPLICA_AXIS)
         return (
             new_clock,
             new_frontier,
             next_gid + batch,
             res.order,
-            res.resolved,
+            executed,
             fast,
             final_gid,
+            slow_paths,
+            stable,
         )
 
     specs_in = (
@@ -206,6 +280,8 @@ def protocol_step(
         P(),
         P(),
         P(),
+        P(),  # slow_paths
+        P(),  # stable
     )
     # check_vma=False: outputs derived from all_gather/pmax results are
     # replicated by construction, but the static VMA analysis cannot see
@@ -213,19 +289,20 @@ def protocol_step(
     fn = shard_map(
         step, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False
     )
-    new_clock, new_frontier, new_gid, order, resolved, fast, deps = fn(
+    new_clock, new_frontier, new_gid, order, executed, fast, deps, slow, stable = fn(
         state.key_clock, state.frontier, state.next_gid, key, dot_src, dot_seq
     )
     return (
         ReplicaState(new_clock, new_frontier, new_gid),
-        StepOutput(order, resolved, fast, deps),
+        StepOutput(order, executed, fast, deps, slow, stable),
     )
 
 
-def jit_protocol_step(mesh: Mesh):
+def jit_protocol_step(mesh: Mesh, live_replicas: int | None = None):
     """jit-compiled step with donated device-resident state."""
     import functools
 
     return jax.jit(
-        functools.partial(protocol_step, mesh=mesh), donate_argnums=(0,)
+        functools.partial(protocol_step, mesh=mesh, live_replicas=live_replicas),
+        donate_argnums=(0,),
     )

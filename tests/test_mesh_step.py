@@ -81,6 +81,39 @@ def test_protocol_step_fast_path_divergence(mesh):
     assert deps[0] == 7, "union of reported deps = max gid"
     # the rest of the batch chains on key 5: deterministic, fast path
     assert fast[1:].all()
+    # the Synod accept round committed the fast-path miss
+    assert int(out.slow_paths) == 1
+    assert bool(out.resolved.all()), "slow-path command still commits"
+    # GC watermark: all replicas executed the whole round
+    assert int(out.stable) == batch
+
+
+def test_slow_path_fails_without_write_quorum(mesh):
+    """With fewer live replicas than the write quorum, slow-path commands
+    do not commit — and neither does anything chained on them."""
+    num_replicas = mesh.shape["replica"] * 2  # n=4: f=2, write quorum 3
+    batch = mesh.shape["batch"] * 8
+    state = mesh_step.init_state(mesh, num_replicas, key_buckets=16)
+    kc = np.array(state.key_clock)
+    kc[0, 3] = 7  # replica 0 alone saw a prior commit on key 3
+    state = state._replace(
+        key_clock=jax.device_put(jnp.asarray(kc), state.key_clock.sharding),
+        next_gid=jnp.int32(100),
+    )
+    # only 2 live replicas < write quorum 3
+    step = mesh_step.jit_protocol_step(mesh, live_replicas=2)
+
+    key = jnp.full((batch,), 3, dtype=jnp.int32)  # all chained on key 3
+    src = jnp.ones((batch,), jnp.int32)
+    seq = jnp.arange(batch, dtype=jnp.int32)
+    state, out = step(state, key, src, seq)
+
+    resolved = np.asarray(out.resolved)
+    assert not np.asarray(out.fast_path)[0], "cmd 0 sees diverging views"
+    assert not resolved[0], "no write quorum -> slow-path cmd uncommitted"
+    # every later command chains (directly or transitively) on cmd 0
+    assert not resolved.any(), "dependents of an uncommitted cmd cannot run"
+    assert int(out.stable) == 0
 
 
 def test_state_carries_across_steps(mesh):
