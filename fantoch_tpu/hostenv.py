@@ -1,0 +1,45 @@
+"""Host-environment helpers that must run *before* jax backend init.
+
+On this machine a sitecustomize hook registers the TPU plugin at
+interpreter start, with two consequences (round-1 postmortem, reproduced):
+
+  * ``JAX_PLATFORMS=cpu`` set in the *parent environment* hangs interpreter
+    start, so CPU forcing cannot be done via env vars across a process
+    boundary;
+  * backend init on the TPU plugin can block indefinitely and
+    uninterruptibly, so the only safe point to force a platform is
+    in-Python, before the first backend touch.
+
+:func:`force_cpu_platform` is that single shared workaround — used by
+tests/conftest.py, __graft_entry__.dryrun_multichip and bench.py.  Keeping
+it in one place means a jax upgrade or hook change is fixed once.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_platform(n_devices: int | None = None) -> None:
+    """Force the CPU platform (optionally with n virtual devices).
+
+    Must be called before jax initializes a backend; a no-op guard is the
+    caller's job (see __graft_entry__.dryrun_multichip for the pattern of
+    checking ``jax._src.xla_bridge._backends`` and re-execing when too
+    late).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -243,12 +243,21 @@ def protocol_step(
         res = resolve_functional(dep_idx, dot_src_f, dot_seq_f)
         executed = res.resolved & committed
 
-        # 5. state update: every replica learns the committed dots
-        # (scatter-max by key; later commands in the batch win)
-        new_clock = key_clock.at[:, key_full].max(
-            jnp.where(committed, gid, jnp.int32(-1))[None, :]
+        # 5. state update: every *live* replica learns the *executed* dots
+        # (scatter-max by key; later commands in the batch win).  Only
+        # executed gids enter the key clock: the next round prunes
+        # pre-batch deps as already-executed (step 4), which is only sound
+        # if the clock never holds a committed-but-unexecuted gid.
+        # Commands left unexecuted by a failed slow path are dropped (the
+        # feeding layer re-submits); crashed replicas learn nothing, so the
+        # GC watermark lags them.
+        clock_upd = jnp.where(
+            live & executed[None, :], gid[None, :], jnp.int32(-1)
+        )  # [r_blk, B]
+        new_clock = key_clock.at[:, key_full].max(clock_upd)
+        new_frontier = frontier + jnp.where(
+            live[:, 0], executed.sum().astype(jnp.int32), 0
         )
-        new_frontier = frontier + executed.sum().astype(jnp.int32)
         # GC stability watermark: the meet of all replicas' executed
         # frontiers (gc.rs stable()), here a pmin over the replica axis.
         stable = jax.lax.pmin(new_frontier.min(), REPLICA_AXIS)

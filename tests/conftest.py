@@ -5,19 +5,16 @@ Multi-chip hardware is not available in CI; sharding tests run on a virtual
 
 The ambient environment may have already imported jax pointed at a single
 real chip (a sitecustomize hook registers the TPU plugin at interpreter
-start), so env vars alone are too late — override through jax.config before
-any backend is initialized.
+start), so env vars alone are too late — the shared
+fantoch_tpu.hostenv.force_cpu_platform helper overrides through jax.config
+before any backend is initialized.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from fantoch_tpu.hostenv import force_cpu_platform
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(n_devices=8)
