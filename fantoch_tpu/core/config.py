@@ -40,6 +40,11 @@ class Config:
     executor_monitor_pending_interval_ms: Optional[int] = None
     # record per-key execution order for agreement checks in tests
     executor_monitor_execution_order: bool = False
+    # order committed commands with the batched device resolver
+    # (fantoch_tpu/executor/graph/batched.py) instead of the host Tarjan
+    # walk — the TPU-native replacement for tarjan.rs:99-319 (new knob; no
+    # reference counterpart)
+    batched_graph_executor: bool = False
     # garbage-collection interval; None disables GC
     gc_interval_ms: Optional[int] = None
     # leader process (leader-based protocols, i.e. FPaxos)

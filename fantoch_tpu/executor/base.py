@@ -70,6 +70,16 @@ class Executor(ABC, Generic[Info]):
     def handle(self, info: Info, time: SysTime) -> None:
         """Consume one ExecutionInfo from the protocol."""
 
+    def handle_batch(self, infos, time: SysTime) -> None:
+        """Consume a drained queue of ExecutionInfos at once.
+
+        Drivers call this when several infos are available together (one
+        protocol step's output, a queue drain); batch-oriented executors
+        (GraphExecutor with the device resolver) override it to amortize
+        one device round-trip over the whole batch."""
+        for info in infos:
+            self.handle(info, time)
+
     @abstractmethod
     def to_clients(self) -> Optional[ExecutorResult]:
         """Pop one ready result (None when drained)."""

@@ -1,0 +1,228 @@
+"""Batched device-resolved DependencyGraph — the north-star integration.
+
+Replaces the per-add host Tarjan walk of
+fantoch_ps/src/executor/graph/mod.rs:215-644 + tarjan.rs:99-319 with the
+batched device resolver (fantoch_tpu/ops/graph_resolve.py) at the same
+seam: ``BatchedDependencyGraph`` is a drop-in for ``DependencyGraph``
+(select with ``Config.batched_graph_executor``), reusing its vertex /
+pending indexes, cross-shard request plumbing and GC bookkeeping, and
+overriding only the ordering core.
+
+How one ``handle_add`` resolves:
+
+  1. the whole committed-but-unexecuted backlog (arrival order from the
+     insertion-ordered VertexIndex) becomes one batch; each vertex's deps
+     are pruned against the executed clock (-> TERMINAL), mapped to batch
+     indices, or marked MISSING when not committed here yet (missing deps
+     are recorded in the PendingIndex, which also yields the cross-shard
+     info requests of mod.rs:300-375);
+  2. out-degree <= 1 batches take the exact O(log B) functional path
+     (resolve_functional); wider batches take resolve_general;
+  3. vertices the device resolved are executed in the returned
+     (rank, SCC leader, dot) order — SCCs contiguous and dot-sorted,
+     every SCC after all SCCs it depends on, matching the order contract
+     of the host oracle (tarjan.rs:15, mod.rs:490-525);
+  4. ``stuck`` residues (rare 3+-cycles with strictly one-directional
+     conflict visibility that the device pass cannot collapse) are closed
+     under dependencies, so they are handed to the host TarjanSCCFinder
+     oracle, in arrival order, after all device-resolved vertices.
+
+Per-key execution order is identical to the host oracle's: conflicting
+commands are always dependency-linked, so their relative order is forced
+by the condensation topology (or by dot order inside an SCC) — both of
+which the device order preserves.  Whole-batch order may interleave
+*independent* commands differently, which the correctness argument
+explicitly permits (fantoch/src/executor/monitor.rs agreement is per key).
+
+Batch shapes are padded to powers of two so XLA compiles O(log^2) distinct
+programs, and device results are fetched with one host sync per resolve.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.ids import Dot
+from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.executor.base import ExecutorMetricsKind
+from fantoch_tpu.executor.graph.deps_graph import DependencyGraph
+from fantoch_tpu.executor.graph.tarjan import FinderResult, Vertex
+from fantoch_tpu.ops.graph_resolve import (
+    MISSING,
+    TERMINAL,
+    resolve_functional,
+    resolve_general,
+)
+
+
+def _pad_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class BatchedDependencyGraph(DependencyGraph):
+    """DependencyGraph whose ordering core is the batched device resolver."""
+
+    def handle_add(self, dot: Dot, cmd: Command, deps, time: SysTime) -> None:
+        assert self.executor_index == 0
+        vertex = Vertex(dot, cmd, list(deps), time)
+        if self._vertex_index.index(vertex) is not None:
+            raise AssertionError(
+                f"p{self._process_id}: tried to index already indexed {dot}"
+            )
+        self._resolve_backlog(time)
+
+    def handle_add_batch(self, adds, time: SysTime) -> None:
+        """Bulk add: index the whole batch, then resolve once — one device
+        round-trip for the entire queue drain instead of one per add."""
+        assert self.executor_index == 0
+        for dot, cmd, deps in adds:
+            vertex = Vertex(dot, cmd, list(deps), time)
+            if self._vertex_index.index(vertex) is not None:
+                raise AssertionError(
+                    f"p{self._process_id}: tried to index already indexed {dot}"
+                )
+        self._resolve_backlog(time)
+
+    def _check_pending(self, dots, time: SysTime) -> None:
+        """Executed-dot notifications (request replies) re-resolve the
+        backlog as a whole; no per-dot cascade is needed.  The dots were
+        executed (possibly remotely — RequestReplyExecuted), so their
+        pending-index entries are dropped like the host cascade does
+        (deps_graph.py _check_pending's remove)."""
+        assert self.executor_index == 0
+        for dot in dots:
+            self._pending_index.remove(dot)
+        self._resolve_backlog(time)
+
+    # --- the batched ordering core ---
+
+    def _resolve_backlog(self, time: SysTime) -> None:
+        dots: List[Dot] = list(self._vertex_index.dots())  # arrival order
+        if not dots:
+            return
+        batch = len(dots)
+        index_of: Dict[Dot, int] = {d: i for i, d in enumerate(dots)}
+        vertices: List[Vertex] = [self._vertex_index.find(d) for d in dots]
+
+        rows: List[List[int]] = []
+        width = 1
+        for vertex in vertices:
+            row: List[int] = []
+            missing = set()
+            for dep in vertex.deps:
+                dep_dot = dep.dot
+                if dep_dot == vertex.dot or self._executed_clock.contains(
+                    dep_dot.source, dep_dot.sequence
+                ):
+                    continue
+                j = index_of.get(dep_dot)
+                if j is None:
+                    row.append(MISSING)
+                    missing.add(dep)
+                else:
+                    row.append(j)
+            if missing:
+                # PendingIndex dedupes re-sightings; first sighting of a
+                # non-replicated dep yields a cross-shard request
+                self._index_pending(vertex.dot, missing)
+            rows.append(row)
+            width = max(width, len(row))
+
+        padded_b = _pad_pow2(batch)
+        padded_w = _pad_pow2(width)
+        dot_src = np.zeros(padded_b, dtype=np.int32)
+        dot_seq = np.zeros(padded_b, dtype=np.int32)
+        for i, d in enumerate(dots):
+            dot_src[i] = d.source
+            dot_seq[i] = d.sequence
+
+        if width <= 1:
+            dep_arr = np.full(padded_b, TERMINAL, dtype=np.int32)
+            for i, row in enumerate(rows):
+                if row:
+                    dep_arr[i] = row[0]
+            res = resolve_functional(dep_arr, dot_src, dot_seq)
+            order = np.asarray(res.order)
+            resolved = np.asarray(res.resolved)
+            leader = np.asarray(res.leader)
+            stuck = np.zeros(padded_b, dtype=bool)  # functional path is exact
+        else:
+            deps_arr = np.full((padded_b, padded_w), TERMINAL, dtype=np.int32)
+            for i, row in enumerate(rows):
+                deps_arr[i, : len(row)] = row
+            res = resolve_general(deps_arr, dot_src, dot_seq)
+            order = np.asarray(res.order)
+            resolved = np.asarray(res.resolved)
+            leader = np.asarray(res.leader)
+            stuck = np.asarray(res.stuck)
+
+        # emit device-resolved vertices in device order; SCC boundaries
+        # (leader changes) drive the ChainSize metric like mod.rs:490-525
+        scc_size = 0
+        prev_leader = -1
+        for i in order:
+            if i >= batch or not resolved[i]:
+                continue
+            if leader[i] != prev_leader and scc_size:
+                self._metrics.collect(ExecutorMetricsKind.CHAIN_SIZE, scc_size)
+                scc_size = 0
+            prev_leader = leader[i]
+            scc_size += 1
+            self._emit(dots[i], time)
+        if scc_size:
+            self._metrics.collect(ExecutorMetricsKind.CHAIN_SIZE, scc_size)
+
+        # host-oracle fallback for stuck residues (closed under deps)
+        if stuck[:batch].any():
+            self._resolve_stuck([dots[i] for i in range(batch) if stuck[i]], time)
+
+    def _emit(self, dot: Dot, time: SysTime) -> None:
+        vertex = self._vertex_index.remove(dot)
+        assert vertex is not None, "resolved dot must be indexed"
+        self._executed_clock.add(dot.source, dot.sequence)
+        if self._config.shard_count > 1:
+            self._added_to_executed_clock.add(dot)
+        self._pending_index.remove(dot)
+        self._metrics.collect(
+            ExecutorMetricsKind.EXECUTION_DELAY, vertex.duration_ms(time)
+        )
+        self._to_execute.append(vertex.cmd)
+
+    def _resolve_stuck(self, stuck_dots: List[Dot], time: SysTime) -> None:
+        """Host Tarjan oracle over the stuck residue, in arrival order
+        (the ``stuck`` contract of ops/graph_resolve.resolve_general)."""
+        for dot in stuck_dots:
+            vertex = self._vertex_index.find(dot)
+            if vertex is None:
+                continue  # executed as part of an earlier stuck SCC
+            result, _missing, _count = self._finder.strong_connect(
+                True,
+                dot,
+                vertex,
+                self._executed_clock,
+                self._added_to_executed_clock,
+                self._vertex_index,
+            )
+            for scc in self._finder.sccs():
+                self._metrics.collect(ExecutorMetricsKind.CHAIN_SIZE, len(scc))
+                for member in scc:
+                    member_vertex = self._vertex_index.remove(member)
+                    assert member_vertex is not None
+                    self._pending_index.remove(member)
+                    self._metrics.collect(
+                        ExecutorMetricsKind.EXECUTION_DELAY,
+                        member_vertex.duration_ms(time),
+                    )
+                    self._to_execute.append(member_vertex.cmd)
+            self._finder.finalize(self._vertex_index)
+            # stuck vertices are not missing-blocked (resolve_general
+            # contract), so the oracle walk cannot hit a missing dep
+            assert result is not FinderResult.MISSING_DEPENDENCIES, (
+                f"stuck residue {dot} reached a missing dependency"
+            )

@@ -7,8 +7,9 @@ pending dependents; missing dependencies park the command in the pending
 index (and, under partial replication, produce cross-shard info requests).
 
 This is the *host oracle* implementation.  The batched TPU path
-(fantoch_tpu/ops/scc.py + executor/graph/batched.py) resolves the same
-graphs with identical output order; the permutation tests assert equality.
+(fantoch_tpu/ops/graph_resolve.py + executor/graph/batched.py) resolves the
+same graphs with identical per-key order; the permutation tests assert
+equality.
 """
 
 from __future__ import annotations
@@ -147,6 +148,16 @@ class DependencyGraph:
             raise AssertionError("just added dot must be pending")
 
         self._check_pending(dots, time)
+
+    def handle_add_batch(self, adds, time: SysTime) -> None:
+        """Bulk add: ``adds`` is an iterable of (dot, cmd, deps).
+
+        The host oracle processes them one by one; the batched subclass
+        overrides this to index everything first and resolve once — the
+        shape a queue-draining runner (and the bench) feeds.
+        """
+        for dot, cmd, deps in adds:
+            self.handle_add(dot, cmd, deps, time)
 
     def handle_request(self, from_shard: ShardId, dots: Set[Dot], time: SysTime) -> None:
         assert self.executor_index > 0

@@ -57,10 +57,19 @@ _SECONDARY_EXECUTOR_INDEX = 1
 
 class GraphExecutor(Executor):
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config,
-                 graph_cls: type = DependencyGraph):
+                 graph_cls: type | None = None):
         self._process_id = process_id
         self._shard_id = shard_id
         self._config = config
+        if graph_cls is None:
+            if config.batched_graph_executor:
+                from fantoch_tpu.executor.graph.batched import (
+                    BatchedDependencyGraph,
+                )
+
+                graph_cls = BatchedDependencyGraph
+            else:
+                graph_cls = DependencyGraph
         self.graph = graph_cls(process_id, shard_id, config)
         self._store = KVStore(config.executor_monitor_execution_order)
         self._to_clients: Deque[ExecutorResult] = deque()
@@ -76,6 +85,25 @@ class GraphExecutor(Executor):
 
     def monitor_pending(self, time: SysTime) -> None:
         self.graph.monitor_pending(time)
+
+    def handle_batch(self, infos, time: SysTime) -> None:
+        """Group runs of GraphAdds into one batched graph add (a single
+        device resolve with the batched resolver), preserving info order."""
+        adds = []
+
+        def flush():
+            if adds:
+                self.graph.handle_add_batch(adds, time)
+                adds.clear()
+                self._fetch_actions(time)
+
+        for info in infos:
+            if isinstance(info, GraphAdd) and not self._config.execute_at_commit:
+                adds.append((info.dot, info.cmd, list(info.deps)))
+            else:
+                flush()
+                self.handle(info, time)
+        flush()
 
     def handle(self, info: GraphExecutionInfo, time: SysTime) -> None:
         if isinstance(info, GraphAdd):

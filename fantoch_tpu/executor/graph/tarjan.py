@@ -15,7 +15,8 @@ a textbook Tarjan:
 The reference recurses; Python cannot recurse half-a-million deep chains, so
 ``strong_connect`` here runs an explicit-stack DFS with identical semantics.
 The TPU counterpart of this walk is the batched resolver in
-fantoch_tpu/ops/scc.py.
+fantoch_tpu/ops/graph_resolve.py, integrated at this seam by
+fantoch_tpu/executor/graph/batched.py.
 """
 
 from __future__ import annotations

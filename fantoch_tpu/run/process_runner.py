@@ -314,8 +314,15 @@ class ProcessRuntime:
         queue = self.executor_pool.queue(position)
         executor = self.executors[position]
         while True:
-            info = await queue.get()
-            executor.handle(info, self.time)
+            # drain the whole queue: batch-oriented executors (the batched
+            # graph resolver) amortize one device round-trip over the drain
+            infos = [await queue.get()]
+            while True:
+                try:
+                    infos.append(queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            executor.handle_batch(infos, self.time)
             for result in executor.to_clients_iter():
                 session = self.client_sessions.get(result.rifl.source)
                 if session is not None:

@@ -233,8 +233,11 @@ class Runner:
         shard_id = process.shard_id
         protocol_actions = list(process.to_processes_iter())
         ready: List[CommandResult] = []
-        for info in process.to_executors_iter():
-            executor.handle(info, self._simulation.time)
+        infos = list(process.to_executors_iter())
+        if infos:
+            # one protocol step's infos are handled as a batch so the
+            # batched graph executor amortizes a device resolve over them
+            executor.handle_batch(infos, self._simulation.time)
             for executor_result in executor.to_clients_iter():
                 cmd_result = pending.add_executor_result(executor_result)
                 if cmd_result is not None:

@@ -3,6 +3,11 @@ fantoch_ps/src/executor/graph/mod.rs:713-1045: the simple two-command case,
 the two documented ordering-soundness regression tests, the 3-cycle under
 all delivery permutations, and randomized dep graphs with non-transitive
 conflicts where every permutation must yield the identical per-key order.
+
+Every case runs against BOTH ordering cores — the host Tarjan oracle
+(DependencyGraph) and the batched device resolver (BatchedDependencyGraph)
+— and the permutation tests additionally assert that the two produce the
+identical per-key execution order on every delivery permutation.
 """
 
 import itertools
@@ -12,11 +17,14 @@ import pytest
 
 from fantoch_tpu.core import Command, Config, Dot, KVOp, Rifl, RunTime
 from fantoch_tpu.core.ids import process_ids
+from fantoch_tpu.executor.graph.batched import BatchedDependencyGraph
 from fantoch_tpu.executor.graph.deps_graph import DependencyGraph
 from fantoch_tpu.protocol.common.graph_deps import Dependency
 
 TIME = RunTime()
 SHARD = 0
+
+GRAPHS = [DependencyGraph, BatchedDependencyGraph]
 
 
 def dep(dot):
@@ -28,11 +36,11 @@ def make_cmd(dot, keys):
     return Command.from_keys(rifl, SHARD, {k: (KVOp.put(""),) for k in keys})
 
 
-def check_termination(n, args):
+def check_termination(n, args, graph_cls=DependencyGraph):
     """Feed (dot, keys, dep_dots) adds in order; every command must execute;
     returns the per-key execution order (mod.rs:1047-1110)."""
     config = Config(n, 1)
-    graph = DependencyGraph(1, SHARD, config)
+    graph = graph_cls(1, SHARD, config)
     all_rifls = set()
     sorted_order = {}
     for dot, keys, dep_dots in args:
@@ -52,14 +60,19 @@ def check_termination(n, args):
 def shuffle_it(n, args):
     expected = check_termination(n, list(args))
     for perm in itertools.permutations(args):
-        assert check_termination(n, list(perm)) == expected
+        perm = list(perm)
+        assert check_termination(n, perm) == expected
+        # the batched device resolver must agree with the host oracle on
+        # the per-key order, on every delivery permutation
+        assert check_termination(n, perm, BatchedDependencyGraph) == expected
 
 
-def test_simple():
+@pytest.mark.parametrize("graph_cls", GRAPHS)
+def test_simple(graph_cls):
     # two commands in a 2-cycle execute together, sorted by dot
     dot_0, dot_1 = Dot(1, 1), Dot(2, 1)
     config = Config(2, 1)
-    graph = DependencyGraph(1, SHARD, config)
+    graph = graph_cls(1, SHARD, config)
     cmd_0 = make_cmd(dot_0, ["A"])
     cmd_1 = make_cmd(dot_1, ["A"])
     graph.handle_add(dot_0, cmd_0, [dep(dot_1)], TIME)
@@ -68,7 +81,8 @@ def test_simple():
     assert graph.commands_to_execute() == [cmd_0, cmd_1]
 
 
-def test_transitive_conflicts_assumption_regression_1():
+@pytest.mark.parametrize("graph_cls", GRAPHS)
+def test_transitive_conflicts_assumption_regression_1(graph_cls):
     """Commands of one process executed out of submission order can diverge
     across replicas (mod.rs:756-826): the executor is *expected* to produce
     different orders here — the system relies on per-process worker routing
@@ -78,10 +92,13 @@ def test_transitive_conflicts_assumption_regression_1():
     deps = {d1: {d4}, d2: {d4}, d3: {d5}, d4: {d3}, d5: {d4}}
     order_a = [(d, None, deps[d]) for d in [d3, d4, d5, d1, d2]]
     order_b = [(d, None, deps[d]) for d in [d3, d4, d5, d2, d1]]
-    assert check_termination(n, order_a) != check_termination(n, order_b)
+    a = check_termination(n, order_a, graph_cls)
+    b = check_termination(n, order_b, graph_cls)
+    assert a != b
 
 
-def test_transitive_conflicts_assumption_regression_2():
+@pytest.mark.parametrize("graph_cls", GRAPHS)
+def test_transitive_conflicts_assumption_regression_2(graph_cls):
     """Highest-conflict-per-replica dep encoding is order-sensitive
     (mod.rs:828-896)."""
     n = 3
@@ -93,7 +110,9 @@ def test_transitive_conflicts_assumption_regression_2():
     }
     order_a = [(d, args[d][0], args[d][1]) for d in [d11, d12, d21]]
     order_b = [(d, args[d][0], args[d][1]) for d in [d12, d21, d11]]
-    assert check_termination(n, order_a) != check_termination(n, order_b)
+    a = check_termination(n, order_a, graph_cls)
+    b = check_termination(n, order_b, graph_cls)
+    assert a != b
 
 
 def test_cycle():

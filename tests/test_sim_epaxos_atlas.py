@@ -23,9 +23,27 @@ def test_sim_epaxos_5_2():
     assert slow_paths > 0
 
 
+def test_sim_epaxos_3_1_batched_executor():
+    """Full sim with the batched device resolver ordering the graph
+    executor (Config.batched_graph_executor) — same agreement and
+    accounting checks as the host-Tarjan run."""
+    slow_paths = sim_test(EPaxos, Config(3, 1, batched_graph_executor=True))
+    assert slow_paths == 0
+
+
+def test_sim_epaxos_5_2_batched_executor():
+    slow_paths = sim_test(EPaxos, Config(5, 2, batched_graph_executor=True))
+    assert slow_paths > 0
+
+
 def test_sim_atlas_3_1():
     slow_paths = sim_test(Atlas, Config(3, 1))
     assert slow_paths == 0
+
+
+def test_sim_atlas_5_2_batched_executor():
+    slow_paths = sim_test(Atlas, Config(5, 2, batched_graph_executor=True))
+    assert slow_paths > 0
 
 
 def test_sim_atlas_5_1():
