@@ -309,7 +309,14 @@ class Caesar(Protocol):
         # the coordinator can end up rejecting its own command, hence REJECT
         if info.status not in (Status.PROPOSE, Status.REJECT):
             return
-        assert not info.quorum_clocks.all(), "acks after completion are impossible"
+        if info.quorum_clocks.all():
+            # straggler ack: MPropose goes to all n but the quorum (< n for
+            # n>=5) completes first, and the commit/retry that flips the
+            # status travels through the message queue — so a late ack can
+            # legitimately arrive while the status is still PROPOSE/REJECT
+            # (the reference panics here, reachable in our runner's
+            # reader-task queueing; see ADVICE r1)
+            return
 
         info.quorum_clocks.add(from_, clock, deps, ok)
         if not info.quorum_clocks.all():
@@ -379,7 +386,10 @@ class Caesar(Protocol):
         info = self._cmds.get_existing(dot)
         if info is None or info.status != Status.ACCEPT:
             return
-        assert not info.quorum_retries.all()
+        if info.quorum_retries.all():
+            # straggler MRetryAck past write-quorum completion (see the
+            # matching guard in _handle_mproposeack)
+            return
 
         info.quorum_retries.add(from_, deps)
         if not info.quorum_retries.all():

@@ -47,13 +47,21 @@ async def run_clients(
 
     queues: Dict[ClientId, asyncio.Queue] = {cid: asyncio.Queue() for cid in client_ids}
 
+    # sentinel fanned out to every client queue when the demux dies (EOF or
+    # error), so the wait loops below fail loudly instead of hanging
+    eof_sentinel = object()
+
     async def demux() -> None:
-        while True:
-            msg = await rw.recv()
-            if msg is None:
-                return
-            assert isinstance(msg, ToClient)
-            queues[msg.cmd_result.rifl.source].put_nowait(msg.cmd_result)
+        try:
+            while True:
+                msg = await rw.recv()
+                if msg is None:
+                    return
+                assert isinstance(msg, ToClient)
+                queues[msg.cmd_result.rifl.source].put_nowait(msg.cmd_result)
+        finally:
+            for queue in queues.values():
+                queue.put_nowait(eof_sentinel)
 
     demux_task = asyncio.ensure_future(demux())
 
@@ -65,15 +73,24 @@ async def run_clients(
             _shard, cmd = nxt
             await rw.send(Submit(cmd))
             cmd_result = await queues[client.id].get()
+            if cmd_result is eof_sentinel:
+                raise ConnectionError(
+                    f"client {client.id}: server connection closed with an "
+                    "outstanding command"
+                )
             client.handle([cmd_result], time)
 
     async def open_loop(client: Client) -> None:
         pending = 0
+        eof = False
 
         async def collector() -> None:
-            nonlocal pending
+            nonlocal pending, eof
             while True:
                 cmd_result = await queues[client.id].get()
+                if cmd_result is eof_sentinel:
+                    eof = True
+                    return
                 client.handle([cmd_result], time)
                 pending -= 1
 
@@ -86,9 +103,14 @@ async def run_clients(
             await rw.send(Submit(cmd))
             pending += 1
             await asyncio.sleep(open_loop_interval_ms / 1000)
-        while pending > 0:
+        while pending > 0 and not eof:
             await asyncio.sleep(0.01)
         collect.cancel()
+        if eof and pending > 0:
+            raise ConnectionError(
+                f"client {client.id}: server connection closed with "
+                f"{pending} outstanding commands"
+            )
 
     driver = open_loop if open_loop_interval_ms is not None else closed_loop
     await asyncio.gather(*(driver(client) for client in clients.values()))

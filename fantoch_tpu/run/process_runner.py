@@ -31,7 +31,7 @@ parallelism axis on TPU is the batched device step, not threads.
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import AtomicIdGen, ClientId, ProcessId, Rifl, ShardId
@@ -72,12 +72,19 @@ class _ClientSession:
         self.rw = rw
         self.pending = AggregatePending(runtime.process.id, runtime.process.shard_id)
         self.client_ids: List[ClientId] = []
+        self._flush_needed = asyncio.Event()
 
     def deliver(self, result: ExecutorResult) -> None:
         cmd_result = self.pending.add_executor_result(result)
         if cmd_result is not None:
             self.rw.write(ToClient(cmd_result))
-            self.runtime.spawn(self.rw.flush())
+            self._flush_needed.set()  # single per-session flusher picks it up
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await self._flush_needed.wait()
+            self._flush_needed.clear()
+            await self.rw.flush()
 
     async def run(self) -> None:
         hi = await self.rw.recv()
@@ -85,6 +92,7 @@ class _ClientSession:
         self.client_ids = hi.client_ids
         for client_id in self.client_ids:
             self.runtime.client_sessions[client_id] = self
+        flusher = self.runtime.spawn(self._flush_loop())
         while True:
             msg = await self.rw.recv()
             if msg is None:
@@ -105,6 +113,7 @@ class _ClientSession:
                 else (0, 0)  # leader-based: submit handled by any worker
             )
             self.runtime.workers.forward(index, ("submit", dot, cmd))
+        flusher.cancel()
         for client_id in self.client_ids:
             self.runtime.client_sessions.pop(client_id, None)
 
@@ -149,29 +158,43 @@ class ProcessRuntime:
         self.dot_gen = AtomicIdGen(process_id)
         self.client_sessions: Dict[ClientId, _ClientSession] = {}
         self._peer_writers: Dict[ProcessId, asyncio.Queue] = {}
-        self._tasks: List[asyncio.Task] = []
+        self._tasks: Set[asyncio.Task] = set()
         self._servers: List[asyncio.base_events.Server] = []
         self._connected = asyncio.Event()
+        # first task failure; .failed is awaited by harnesses so a crashed
+        # worker tears the cluster down loudly instead of stalling it
+        self.failure: Optional[BaseException] = None
+        self.failed = asyncio.Event()
 
     # --- lifecycle ---
 
     def spawn(self, coro) -> asyncio.Task:
         task = asyncio.ensure_future(coro)
         task.add_done_callback(self._on_task_done)
-        self._tasks.append(task)
+        self._tasks.add(task)
         return task
 
-    @staticmethod
-    def _on_task_done(task: asyncio.Task) -> None:
+    def _on_task_done(self, task: asyncio.Task) -> None:
         # a dead worker/reader/executor silently stalls the whole process
         # (the reference logs and exits the task, process.rs:320-325); make
-        # failures loud instead
+        # failures loud: record the exception and actively tear down.
+        # (Raising here would only reach the loop exception handler.)
+        self._tasks.discard(task)
         if task.cancelled():
             return
         exc = task.exception()
         if exc is not None:
             logger.error("runner task crashed: %r", exc)
-            raise exc
+            if self.failure is None:
+                self.failure = exc
+                self.failed.set()
+            self._teardown()
+
+    def _teardown(self) -> None:
+        for task in list(self._tasks):
+            task.cancel()
+        for server in self._servers:
+            server.close()
 
     async def start(self) -> None:
         """Listen, connect to all peers, then start worker/executor loops."""
@@ -202,11 +225,9 @@ class ProcessRuntime:
         self._connected.set()
 
     async def stop(self) -> None:
-        for task in self._tasks:
-            task.cancel()
-        for server in self._servers:
-            server.close()
-        await asyncio.gather(*self._tasks, return_exceptions=True)
+        tasks = list(self._tasks)
+        self._teardown()
+        await asyncio.gather(*tasks, return_exceptions=True)
 
     @staticmethod
     async def _connect_with_retry(addr: Address, attempts: int = 100) -> Rw:

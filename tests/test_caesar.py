@@ -106,6 +106,47 @@ def caesar_config(n: int, f: int, wait: bool) -> Config:
     return Config(n=n, f=f, caesar_wait_condition=wait)
 
 
+def test_straggler_ack_after_quorum_completion_is_ignored():
+    """MPropose goes to all n but the fast quorum (4 of 5) completes first;
+    a 5th ack queued before the self-delivered MCommit flips the status
+    must be ignored, not crash the worker (ADVICE r1: the reference panics
+    here, reachable under the TCP runner's reader-task queueing)."""
+    from fantoch_tpu.core.timing import SimTime
+    from fantoch_tpu.protocol.caesar import MCommit, MPropose, MProposeAck
+    from fantoch_tpu.sim.runner import ToSend
+
+    time = SimTime()
+    config = caesar_config(5, 2, wait=True)
+    caesar = Caesar(1, SHARD, config)
+    assert caesar.discover([(pid, SHARD) for pid in range(1, 6)])
+
+    dot = Dot(1, 1)
+    caesar.submit(dot, cmd(1, ["K"]), time)
+    actions = list(caesar.to_processes_iter())
+    (propose,) = [a.msg for a in actions if isinstance(a.msg, MPropose)]
+
+    # self-delivery of the MPropose produces the coordinator's own ack
+    caesar.handle(1, SHARD, propose, time)
+    actions = list(caesar.to_processes_iter())
+    (ack,) = [a.msg for a in actions if isinstance(a.msg, MProposeAck)]
+    assert ack.ok
+
+    # three more identical acks complete the fast quorum (fq = 4) and queue
+    # the MCommit broadcast
+    for from_ in (2, 3, 4):
+        caesar.handle(
+            from_, SHARD, MProposeAck(dot, ack.clock, set(ack.deps), True), time
+        )
+    actions = list(caesar.to_processes_iter())
+    assert any(
+        isinstance(a, ToSend) and isinstance(a.msg, MCommit) for a in actions
+    ), "fast quorum completion must broadcast MCommit"
+
+    # the straggler: 5th ack arrives before the self-MCommit is handled
+    caesar.handle(5, SHARD, MProposeAck(dot, ack.clock, set(ack.deps), True), time)
+    assert list(caesar.to_processes_iter()) == [], "straggler ack is a no-op"
+
+
 def test_caesar_wait_3_1():
     sim_test(Caesar, caesar_config(3, 1, wait=True))
 

@@ -84,8 +84,6 @@ def run_cluster(
     # commit + GC accounting (protocol/mod.rs:1015-1080)
     min_commits = COMMANDS_PER_CLIENT * total_clients
     total_fast = total_slow = total_stable = 0
-    for runtime in runtimes.items():
-        pass
     for pid, runtime in runtimes.items():
         m = runtime.process.metrics()
         total_fast += m.get_aggregated(ProtocolMetricsKind.FAST_PATH) or 0
