@@ -103,7 +103,7 @@ def test_pred_executor_higher_clock_dep_not_waited():
 
 
 def caesar_config(n: int, f: int, wait: bool) -> Config:
-    return Config(n=n, f=f, caesar_wait_condition=wait)
+    return Config(n=n, f=f, caesar_wait_condition=wait, gc_interval_ms=100)
 
 
 def test_straggler_ack_after_quorum_completion_is_ignored():
@@ -131,9 +131,9 @@ def test_straggler_ack_after_quorum_completion_is_ignored():
     (ack,) = [a.msg for a in actions if isinstance(a.msg, MProposeAck)]
     assert ack.ok
 
-    # three more identical acks complete the fast quorum (fq = 4) and queue
-    # the MCommit broadcast
-    for from_ in (2, 3, 4):
+    # the coordinator's own ack plus three identical acks complete the fast
+    # quorum (fq = 3n//4+1 = 4) and queue the MCommit broadcast
+    for from_ in (1, 2, 3, 4):
         caesar.handle(
             from_, SHARD, MProposeAck(dot, ack.clock, set(ack.deps), True), time
         )
