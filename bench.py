@@ -50,14 +50,16 @@ _CHILD_ENV = "FANTOCH_BENCH_CHILD"  # "tpu" | "cpu"
 
 
 def build_workload(batch: int, conflict: float, clients: int = 4096):
-    """(dep, dot_src, dot_seq): conflicting commands chain on the hot key;
-    private commands chain per client (latest-per-key sequential deps)."""
+    """(key, dep, dot_src, dot_seq): conflicting commands chain on the hot
+    key; private commands chain per client (latest-per-key sequential
+    deps).  ``key`` is the per-command conflict-key id the protocol knows
+    at commit time (KeyDeps is keyed by it)."""
     import numpy as np
 
     rng = np.random.default_rng(42)
     hot = rng.random(batch) < conflict
     # key id 0 = hot key; else private per-client key
-    key = np.where(hot, 0, 1 + rng.integers(0, clients, size=batch)).astype(np.int64)
+    key = np.where(hot, 0, 1 + rng.integers(0, clients, size=batch)).astype(np.int32)
     # latest-per-key chain (what KeyDeps::add_cmd produces)
     dep = np.full(batch, -1, dtype=np.int32)
     last = {}
@@ -68,7 +70,7 @@ def build_workload(batch: int, conflict: float, clients: int = 4096):
         last[k] = i
     dot_src = (1 + rng.integers(0, 5, size=batch)).astype(np.int32)
     dot_seq = np.arange(batch, dtype=np.int32)
-    return dep, dot_src, dot_seq
+    return key, dep, dot_src, dot_seq
 
 
 def child_main(mode: str) -> None:
@@ -78,31 +80,88 @@ def child_main(mode: str) -> None:
 
         force_cpu_platform()
 
+    import functools
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from fantoch_tpu.ops.graph_resolve import resolve_functional
+    from fantoch_tpu.ops.graph_resolve import (
+        _residual_size_for,
+        resolve_functional_keyed,
+    )
 
     platform = jax.devices()[0].platform
 
-    dep_np, src_np, seq_np = build_workload(BATCH, CONFLICT)
+    key_np, dep_np, src_np, seq_np = build_workload(BATCH, CONFLICT)
+    key = jax.device_put(jnp.asarray(key_np))
     dep = jax.device_put(jnp.asarray(dep_np))
     src = jax.device_put(jnp.asarray(src_np))
     seq = jax.device_put(jnp.asarray(seq_np))
+    residual = _residual_size_for(BATCH)
 
-    # warmup / compile
-    res = resolve_functional(dep, src, seq)
-    jax.block_until_ready(res.order)
-    assert bool(res.resolved.all())
+    # correctness check of the measured kernel on this workload: everything
+    # resolves (latest-per-key chains, no cycles, nothing missing)
+    res = resolve_functional_keyed(
+        key, dep, src, seq, residual_size=residual, return_structure=False
+    )
+    assert int(res.n_resolved) == BATCH, f"resolved {int(res.n_resolved)}/{BATCH}"
+    assert not bool(res.overflow)
 
-    times = []
-    for _ in range(ITERS):
-        t0 = time.perf_counter()
-        res = resolve_functional(dep, src, seq)
-        jax.block_until_ready(res.order)
-        times.append((time.perf_counter() - t0) * 1000.0)
-    p50 = float(np.median(times))
+    # --- slope-timed device latency.  The measurement rig reaches the TPU
+    # through a tunnel with a large fixed per-dispatch round-trip (~80 ms
+    # measured; a bare `jit(lambda x: x[0])` fetch costs the same), so a
+    # single timed call cannot see a <10 ms kernel.  We time K back-to-back
+    # resolves inside ONE dispatch — serialized by a real data dependence
+    # (order[0] of resolve i perturbs the key batch of resolve i+1 by a
+    # runtime zero the compiler cannot fold) — and take the slope:
+    # per-resolve latency = (t(K_HI) - t(K_LO)) / (K_HI - K_LO).
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def resolve_k(key, dep, src, seq, *, k):
+        carry = jnp.int32(0)
+        for _ in range(k):
+            r = resolve_functional_keyed(
+                key + (carry >> jnp.int32(30)),  # runtime zero, data-dependent
+                dep,
+                src,
+                seq,
+                residual_size=residual,
+                return_structure=False,
+            )
+            carry = r.order[0]
+        return carry + r.n_resolved
+
+    K_LO, K_HI = 1, 5
+
+    def timed(k):
+        float(resolve_k(key, dep, src, seq, k=k))  # compile
+        out = []
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            float(resolve_k(key, dep, src, seq, k=k))
+            out.append((time.perf_counter() - t0) * 1000.0)
+        return out
+
+    lo_ms = timed(K_LO)
+    hi_ms = timed(K_HI)
+    lo_p50 = float(np.median(lo_ms))
+    hi_p50 = float(np.median(hi_ms))
+    slope = (hi_p50 - lo_p50) / (K_HI - K_LO)
+    if slope > 0:
+        p50 = slope
+        method = (
+            f"slope over {K_LO}->{K_HI} chained in-dispatch resolves, "
+            f"p50 of {ITERS}; removes the rig's fixed dispatch round-trip"
+        )
+    else:
+        # noise swamped the slope — fall back to the conservative single-call
+        # number rather than fabricating a near-zero latency
+        p50 = lo_p50
+        method = (
+            f"single-call p50 of {ITERS} (slope measurement failed: "
+            f"t(K={K_HI})={hi_p50:.1f}ms <= t(K={K_LO})={lo_p50:.1f}ms); "
+            "includes the rig's fixed dispatch round-trip"
+        )
 
     record = {
         "metric": METRIC,
@@ -110,6 +169,10 @@ def child_main(mode: str) -> None:
         "unit": "ms",
         "vs_baseline": round(TARGET_MS / p50, 3),
         "platform": platform,
+        "method": method,
+        "single_call_ms_p50": round(lo_p50, 3),
+        "dispatch_overhead_ms": round(lo_p50 - p50, 3),
+        "residual_size": residual,
     }
     # secondary measurement must never cost us the primary one
     try:
@@ -135,7 +198,7 @@ def bench_integrated_executor():
     from fantoch_tpu.protocol.common.graph_deps import Dependency
 
     shard = 0
-    dep_np, src_np, seq_np = build_workload(EXECUTOR_BATCH, CONFLICT)
+    _key_np, dep_np, src_np, seq_np = build_workload(EXECUTOR_BATCH, CONFLICT)
     dots = [Dot(int(s), int(q) + 1) for s, q in zip(src_np, seq_np)]
     shards = frozenset({shard})
     adds = []

@@ -90,15 +90,13 @@ def _num_doubling_steps(batch: int) -> int:
     return steps
 
 
-@functools.partial(jax.jit, static_argnames=("return_order",))
-def resolve_functional(
-    dep: jax.Array,  # int32[B] — single dependency (TERMINAL/MISSING sentinels)
-    dot_src: jax.Array,  # int32[B]
-    dot_seq: jax.Array,  # int32[B]
-    *,
-    return_order: bool = True,
-) -> Resolution:
-    """Exact batched resolution of an out-degree-<=1 dependency graph."""
+def _doubling_core(dep: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Pointer-doubling resolution core: (resolved, rank, leader, on_cycle).
+
+    Exact for any out-degree-<=1 graph; O(log B) rounds of B-wide gathers.
+    Shared by ``resolve_functional`` (full batch) and the keyed path's
+    residual finish (small compacted batch, where the gathers are cheap).
+    """
     batch = dep.shape[0]
     idx = jnp.arange(batch, dtype=jnp.int32)
     steps = _num_doubling_steps(batch)
@@ -148,12 +146,236 @@ def resolve_functional(
     resolved = jnp.where(on_cycle, True, is_term[jump2] | on_cycle[jump2])
     rank = jnp.where(resolved, dist2, _UNRESOLVED_RANK).astype(jnp.int32)
     leader = jnp.where(on_cycle, cyc_leader, idx).astype(jnp.int32)
+    return resolved, rank, leader, on_cycle
 
+
+@functools.partial(jax.jit, static_argnames=("return_order",))
+def resolve_functional(
+    dep: jax.Array,  # int32[B] — single dependency (TERMINAL/MISSING sentinels)
+    dot_src: jax.Array,  # int32[B]
+    dot_seq: jax.Array,  # int32[B]
+    *,
+    return_order: bool = True,
+) -> Resolution:
+    """Exact batched resolution of an out-degree-<=1 dependency graph."""
+    resolved, rank, leader, on_cycle = _doubling_core(dep)
     if not return_order:
-        order = idx
+        order = jnp.arange(dep.shape[0], dtype=jnp.int32)
     else:
         order = _order_from_ranks(rank, leader, dot_src, dot_seq)
     return Resolution(order, resolved, rank, leader, on_cycle)
+
+
+class KeyedResolution(NamedTuple):
+    """Result of one keyed batched resolve (``resolve_functional_keyed``).
+
+    ``order``/``resolved``/``rank``/``leader``/``on_cycle`` as in
+    ``Resolution`` when ``return_structure=True``.  With
+    ``return_structure=False`` (the latency-critical entry) ``resolved`` is
+    a *permutation* of the true per-vertex flags — valid for reductions
+    (``all``/``sum``) but not for indexing — and rank/leader/on_cycle are
+    zeros; use ``n_resolved`` for counting.  ``overflow`` means the
+    residual exceeded ``residual_size`` and the result must be discarded
+    (the caller falls back to ``resolve_functional``).
+    """
+
+    order: jax.Array  # int32[B]
+    resolved: jax.Array  # bool[B]
+    rank: jax.Array  # int32[B]
+    leader: jax.Array  # int32[B]
+    on_cycle: jax.Array  # bool[B]
+    n_resolved: jax.Array  # int32 scalar
+    overflow: jax.Array  # bool scalar
+
+
+def _residual_size_for(batch: int) -> int:
+    """Default residual capacity: whole batch when small (tests — never
+    overflow), B/64 when large (cycles + cross-replica chain inversions are
+    a thin slice of real traffic; overflow falls back to full doubling)."""
+    cap = batch if batch <= 4096 else max(4096, batch // 64)
+    return _pow2_at_least(cap)
+
+
+def _pow2_at_least(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@functools.partial(jax.jit, static_argnames=("residual_size", "return_structure"))
+def resolve_functional_keyed(
+    key: jax.Array,  # int32[B] — conflict-key hash per command (perf hint)
+    dep: jax.Array,  # int32[B]
+    dot_src: jax.Array,  # int32[B]
+    dot_seq: jax.Array,  # int32[B]
+    *,
+    residual_size: int,
+    return_structure: bool = True,
+) -> KeyedResolution:
+    """Sort-based exact resolution of an out-degree-<=1 dependency graph.
+
+    The north-star kernel (SURVEY §7 stage 4; VERDICT r2 item 1).  Replaces
+    O(log B) rounds of B-wide random gathers (~6.6 ms each on TPU v5e at
+    B=1M — the 894 ms of round 2) with a handful of B-wide *sorts*
+    (~0.4-2 ms each) plus small-residual doubling:
+
+      1. stable-sort the batch by key hash: each key's commands become one
+         contiguous run in batch-arrival order;
+      2. verify every in-run link: position p is *chain-verified* when its
+         dep is exactly the previous in-run vertex and the run head's dep
+         is TERMINAL.  For graphs produced by sequential KeyDeps in arrival
+         order (the dominant shape — the file docstring's rho argument),
+         every link verifies and the run position IS the rank;
+      3. everything downstream of the first unverified link in a run (cycle
+         heads, cross-replica chain inversions, missing-blocked suffixes)
+         is compacted into a ``residual_size`` buffer and finished exactly
+         by ``_doubling_core`` at residual scale, where gathers are cheap;
+         deps that point back into a verified prefix fold to TERMINAL —
+         sound because the whole prefix of that run is emitted first;
+      4. residual vertices are re-emitted at their run's tail positions
+         ((rank, SCC leader, dot) order within the run), and one final sort
+         by (unresolved, emit position) yields ``order``.
+
+    Exactness does not depend on the key hint: any link the sort order
+    cannot verify lands in the residual and is resolved by doubling, so
+    hash collisions and adversarial inputs only cost performance (worst
+    case ``overflow`` → caller reruns via ``resolve_functional``).  The
+    only structural requirement is the functional one (out-degree <= 1)
+    plus deps linking same-key vertices (guaranteed: deps are conflicts —
+    fantoch_ps/src/protocol/common/graph/deps/keys/sequential.rs:8-11);
+    cross-key deps would break run locality and must go through
+    ``resolve_general``.
+    """
+    batch = dep.shape[0]
+    res_n = min(residual_size, batch)
+    idx = jnp.arange(batch, dtype=jnp.int32)
+    p_iota = idx
+
+    # --- 1. one stable sort groups runs in arrival order
+    k_s, pos_s, dep_s = jax.lax.sort(
+        (key.astype(jnp.int32), idx, dep), num_keys=1, is_stable=True
+    )
+
+    # --- 2. link verification + prefix ranking (elementwise + cummax)
+    head = jnp.concatenate([jnp.ones((1,), bool), k_s[1:] != k_s[:-1]])
+    prev_pos = jnp.roll(pos_s, 1)  # head rows never read it
+    ok = jnp.where(head, dep_s == TERMINAL, dep_s == prev_pos)
+    run_start = jax.lax.cummax(jnp.where(head, p_iota, 0))
+    lastbad = jax.lax.cummax(jnp.where(~ok, p_iota, -1))
+    chain_ok = lastbad < run_start  # no unverified link in [run_start, p]
+    rank_fast = p_iota - run_start
+
+    # --- 3. compact the residual (stable by cflag keeps run order)
+    cflag = chain_ok.astype(jnp.int32)
+    _, p_r_full = jax.lax.sort((cflag, p_iota), num_keys=1, is_stable=True)
+    n_residual = batch - cflag.sum()
+    overflow = n_residual > res_n
+
+    p_r = p_r_full[:res_n]  # sorted-space position of each residual row
+    r_iota = jnp.arange(res_n, dtype=jnp.int32)
+    valid_r = r_iota < n_residual
+    # small gathers (res_n rows) pull the rest of the residual view
+    rpos = pos_s[p_r]  # original batch index
+    rdep = dep_s[p_r]
+    rrs = jnp.where(valid_r, run_start[p_r], jnp.iinfo(jnp.int32).max)
+    rsrc = dot_src[rpos]
+    rseq = dot_seq[rpos]
+
+    # remap deps to residual-local slots; deps leaving the residual (into a
+    # verified prefix or already executed) fold to TERMINAL — the whole
+    # prefix of the run is emitted before any residual member of it
+    remap = jnp.full((batch,), TERMINAL, dtype=jnp.int32)
+    remap = remap.at[jnp.where(valid_r, rpos, batch)].set(r_iota, mode="drop")
+    rdep_local = jnp.where(
+        rdep >= 0, remap[jnp.clip(rdep, 0, batch - 1)], rdep
+    )
+    rdep_local = jnp.where(valid_r, rdep_local, TERMINAL)
+
+    # residual groups (per run) in p order: first residual row of a run
+    # sits exactly at the run's first unverified position
+    g_head = jnp.concatenate([jnp.ones((1,), bool), rrs[1:] != rrs[:-1]])
+    firstbad = jax.lax.cummax(jnp.where(g_head, p_r, 0))
+
+    # --- exact finish at residual scale
+    l_resolved, l_rank, l_leader, l_on_cycle = _doubling_core(rdep_local)
+
+    # emit order within each run's residual tail: resolved first, then
+    # (rank, SCC leader, dot) — SCC members contiguous and dot-sorted
+    l_unres = (~l_resolved).astype(jnp.int32)
+    (_, _, _, _, _, _, e_p_r, e_firstbad, e_res, e_rank2, e_leader2, e_cyc) = jax.lax.sort(
+        (
+            rrs,
+            l_unres,
+            l_rank,
+            l_leader,
+            rsrc,
+            rseq,
+            p_r,
+            firstbad,
+            l_resolved.astype(jnp.int32),
+            jnp.where(valid_r, l_rank, 0),
+            rpos[jnp.clip(l_leader, 0, res_n - 1)],  # leader as original index
+            l_on_cycle.astype(jnp.int32),
+        ),
+        num_keys=6,
+        is_stable=True,
+    )
+    # group boundaries after the emit sort: rrs is its primary key, so the
+    # emit-ordered rrs column is simply sorted(rrs)
+    rrs_emit = jnp.sort(rrs)
+    e_g_head = jnp.concatenate([jnp.ones((1,), bool), rrs_emit[1:] != rrs_emit[:-1]])
+    e_group_start = jax.lax.cummax(jnp.where(e_g_head, r_iota, 0))
+    emit_local = r_iota - e_group_start
+    e_valid = valid_r  # invalid rows sank to the emit-sort tail (rrs=max)
+    target_r = e_firstbad + emit_local
+
+    # --- 4. scatter residual emit data back over the batch, final sort
+    sc_idx = jnp.where(e_valid, e_p_r, batch)
+    tgt_b = p_iota.at[sc_idx].set(target_r, mode="drop")
+    unres_b = (~chain_ok).at[sc_idx].set(e_res == 0, mode="drop")
+    order_sorted = jax.lax.sort(
+        (unres_b.astype(jnp.int32), tgt_b, pos_s), num_keys=2, is_stable=True
+    )
+    order = order_sorted[2]
+    n_resolved = (batch - unres_b.sum()).astype(jnp.int32)
+
+    if not return_structure:
+        zeros = jnp.zeros((batch,), jnp.int32)
+        return KeyedResolution(
+            order, ~unres_b, zeros, zeros, zeros.astype(bool), n_resolved, overflow
+        )
+
+    # realign per-vertex structure to original batch order (one more sort)
+    rank_b = jnp.where(chain_ok, rank_fast, _UNRESOLVED_RANK)
+    rank_b = rank_b.at[sc_idx].set(
+        jnp.where(e_res == 1, e_firstbad - rrs_emit + e_rank2, _UNRESOLVED_RANK),
+        mode="drop",
+    )
+    leader_b = pos_s  # prefix rows lead themselves
+    leader_b = leader_b.at[sc_idx].set(e_leader2, mode="drop")
+    cyc_b = jnp.zeros((batch,), jnp.int32).at[sc_idx].set(e_cyc, mode="drop")
+    aligned = jax.lax.sort(
+        (
+            pos_s,
+            (~unres_b).astype(jnp.int32),
+            rank_b,
+            leader_b,
+            cyc_b,
+        ),
+        num_keys=1,
+        is_stable=True,
+    )
+    _, a_res, a_rank, a_leader, a_cyc = aligned
+    return KeyedResolution(
+        order,
+        a_res == 1,
+        a_rank,
+        a_leader,
+        a_cyc.astype(bool),
+        n_resolved,
+        overflow,
+    )
 
 
 def _order_from_ranks(rank, leader, dot_src, dot_seq) -> jax.Array:
@@ -165,6 +387,41 @@ def _order_from_ranks(rank, leader, dot_src, dot_seq) -> jax.Array:
     vertices carry rank INT32_MAX and sink to the tail.
     """
     return jnp.lexsort((dot_seq, dot_src, leader, rank)).astype(jnp.int32)
+
+
+def resolve_keyed_auto(
+    key: jax.Array,
+    dep: jax.Array,
+    dot_src: jax.Array,
+    dot_seq: jax.Array,
+    *,
+    return_structure: bool = True,
+) -> KeyedResolution:
+    """Host wrapper over ``resolve_functional_keyed``: picks the default
+    residual capacity and falls back to the exact full-batch doubling path
+    if the residual overflows (one host sync either way — the caller
+    fetches results right after)."""
+    batch = dep.shape[0]
+    res = resolve_functional_keyed(
+        key,
+        dep,
+        dot_src,
+        dot_seq,
+        residual_size=_residual_size_for(batch),
+        return_structure=return_structure,
+    )
+    if bool(res.overflow):
+        full = resolve_functional(dep, dot_src, dot_seq)
+        return KeyedResolution(
+            full.order,
+            full.resolved,
+            full.rank,
+            full.leader,
+            full.on_cycle,
+            full.resolved.sum().astype(jnp.int32),
+            jnp.bool_(False),
+        )
+    return res
 
 
 # ---------------------------------------------------------------------------

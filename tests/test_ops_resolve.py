@@ -201,6 +201,222 @@ def test_random_functional_vs_oracle():
         assert_matches_oracle(3, args, functional=True)
 
 
+# --- keyed sort-based path (the round-3 north-star kernel) ---
+
+
+def _key_hash(key: str) -> int:
+    import zlib
+
+    return zlib.crc32(key.encode()) & 0x7FFFFFFF
+
+
+def keyed_per_key_order(args, residual_size=None, collide=False):
+    """Drive resolve_functional_keyed; returns (per_key order, count, res)."""
+    from fantoch_tpu.ops.graph_resolve import (
+        _residual_size_for,
+        resolve_functional_keyed,
+    )
+
+    deps, src, seq, _ = batch_arrays(args)
+    assert deps.shape[1] == 1
+    keys = np.array(
+        [0 if collide else _key_hash(ks[0]) for _, ks, _ in args], dtype=np.int32
+    )
+    res = resolve_functional_keyed(
+        jnp.asarray(keys),
+        jnp.asarray(deps[:, 0]),
+        jnp.asarray(src),
+        jnp.asarray(seq),
+        residual_size=residual_size or _residual_size_for(len(args)),
+    )
+    assert not bool(res.overflow)
+    order = np.asarray(res.order)
+    resolved = np.asarray(res.resolved)
+    assert int(res.n_resolved) == int(resolved.sum())
+    per_key = {}
+    count = 0
+    for i in order:
+        if not resolved[i]:
+            continue
+        count += 1
+        dot, keys_i, _ = args[i]
+        for key in keys_i:
+            per_key.setdefault(key, []).append(dot)
+    return per_key, count, res
+
+
+def assert_keyed_matches_oracle(n, args, **kw):
+    expected, n_exec = oracle_per_key_order(n, args)
+    got, n_res, _ = keyed_per_key_order(args, **kw)
+    assert n_res == n_exec
+    assert got == expected
+
+
+def test_keyed_chain_ranks():
+    # arrival-order chain: the pure sort path, empty residual
+    dots = [Dot(1, s) for s in range(1, 6)]
+    args = [(dots[0], ["A"], set())] + [
+        (dots[i], ["A"], {dots[i - 1]}) for i in range(1, 5)
+    ]
+    _, _, res = keyed_per_key_order(args)
+    assert np.asarray(res.rank).tolist() == [0, 1, 2, 3, 4]
+    assert np.asarray(res.resolved).all()
+    assert_keyed_matches_oracle(1, args)
+
+
+def test_keyed_inverted_chain():
+    # batch order is the reverse of chain order: every link fails
+    # verification, the whole run goes through the residual doubling
+    dots = [Dot(1, s) for s in range(1, 6)]
+    args = [(dots[i], ["A"], {dots[i - 1]}) for i in range(4, 0, -1)] + [
+        (dots[0], ["A"], set())
+    ]
+    assert_keyed_matches_oracle(1, args)
+
+
+def test_keyed_two_cycle():
+    d0, d1 = Dot(1, 1), Dot(2, 1)
+    args = [(d0, ["A"], {d1}), (d1, ["A"], {d0})]
+    per_key, count, res = keyed_per_key_order(args)
+    assert count == 2
+    assert per_key["A"] == [d0, d1]
+    assert np.asarray(res.on_cycle).all()
+    assert_keyed_matches_oracle(2, args)
+
+
+def test_keyed_rho_shape():
+    cyc = [Dot(1, 1), Dot(2, 1), Dot(3, 1)]
+    tail = [Dot(1, s) for s in range(2, 6)]
+    args = [
+        (cyc[0], ["A"], {cyc[2]}),
+        (cyc[1], ["A"], {cyc[0]}),
+        (cyc[2], ["A"], {cyc[1]}),
+        (tail[0], ["A"], {cyc[2]}),
+    ] + [(tail[i], ["A"], {tail[i - 1]}) for i in range(1, 4)]
+    per_key, count, res = keyed_per_key_order(args)
+    assert count == 7
+    assert per_key["A"] == sorted(cyc) + tail
+    assert_keyed_matches_oracle(3, args)
+
+
+def test_keyed_mid_run_cycle_with_verified_prefix():
+    # verified prefix (chain from TERMINAL head) followed by a 2-cycle and
+    # its tail: prefix resolves by run position, the rest via the residual
+    a, b = Dot(1, 1), Dot(1, 2)
+    c, d = Dot(2, 5), Dot(3, 5)  # the racing pair
+    e = Dot(1, 3)
+    args = [
+        (a, ["A"], set()),
+        (b, ["A"], {a}),
+        (c, ["A"], {d}),  # link check fails here (dep is not `b`)
+        (d, ["A"], {c}),
+        (e, ["A"], {d}),
+    ]
+    per_key, count, _ = keyed_per_key_order(args)
+    assert count == 5
+    # prefix a,b first; then the cycle {c,d} dot-sorted; then e
+    assert per_key["A"][:2] == [a, b]
+    assert per_key["A"][2:4] == sorted([c, d])
+    assert per_key["A"][4] == e
+
+
+def test_keyed_missing_blocks_suffix():
+    d1, d2, d3 = Dot(1, 1), Dot(1, 2), Dot(1, 3)
+    args = [(d1, ["A"], {Dot(2, 9)}), (d2, ["A"], {d1}), (d3, ["A"], {d2})]
+    _, count, res = keyed_per_key_order(args)
+    assert count == 0
+    assert not np.asarray(res.resolved).any()
+
+
+def test_keyed_missing_blocks_only_its_run():
+    # missing dep blocks one key's run; another key's chain still resolves
+    d1, d2 = Dot(1, 1), Dot(1, 2)
+    e1, e2 = Dot(2, 1), Dot(2, 2)
+    args = [
+        (d1, ["A"], {Dot(3, 9)}),
+        (d2, ["A"], {d1}),
+        (e1, ["B"], set()),
+        (e2, ["B"], {e1}),
+    ]
+    per_key, count, _ = keyed_per_key_order(args)
+    assert count == 2
+    assert per_key == {"B": [e1, e2]}
+
+
+def test_keyed_hash_collision_is_correct():
+    # all keys collide into one run: pure perf degradation, same answer
+    rng = random.Random(11)
+    args = random_functional_args(
+        n=3, keys=["A", "B", "C", "D"], cmds_per_key=5, rng=rng
+    )
+    expected, n_exec = oracle_per_key_order(3, args)
+    got, n_res, _ = keyed_per_key_order(args, collide=True)
+    assert n_res == n_exec
+    assert got == expected
+
+
+def test_keyed_overflow_falls_back():
+    from fantoch_tpu.ops.graph_resolve import resolve_keyed_auto
+
+    # inverted chain with a tiny residual: keyed kernel overflows, the
+    # auto wrapper must still return the exact doubling answer
+    dots = [Dot(1, s) for s in range(1, 9)]
+    args = [(dots[i], ["A"], {dots[i - 1]}) for i in range(7, 0, -1)] + [
+        (dots[0], ["A"], set())
+    ]
+    deps, src, seq, _ = batch_arrays(args)
+    keys = np.zeros(len(args), dtype=np.int32)
+    from fantoch_tpu.ops.graph_resolve import resolve_functional_keyed
+
+    res_small = resolve_functional_keyed(
+        jnp.asarray(keys),
+        jnp.asarray(deps[:, 0]),
+        jnp.asarray(src),
+        jnp.asarray(seq),
+        residual_size=2,
+    )
+    assert bool(res_small.overflow)
+    res = resolve_keyed_auto(
+        jnp.asarray(keys), jnp.asarray(deps[:, 0]), jnp.asarray(src), jnp.asarray(seq)
+    )
+    assert not bool(res.overflow)
+    order = [i for i in np.asarray(res.order) if np.asarray(res.resolved)[i]]
+    assert [args[i][0] for i in order] == [dots[i] for i in range(8)]
+
+
+def test_keyed_random_vs_oracle():
+    rng = random.Random(7)
+    for trial in range(20):
+        args = random_functional_args(
+            n=3, keys=["A", "B", "C"], cmds_per_key=rng.randint(1, 8), rng=rng
+        )
+        assert_keyed_matches_oracle(3, args)
+
+
+def test_keyed_fast_entry_counts():
+    # return_structure=False: order + n_resolved only; resolved is a
+    # permutation of the true flags (reduction-safe)
+    from fantoch_tpu.ops.graph_resolve import (
+        _residual_size_for,
+        resolve_functional_keyed,
+    )
+
+    rng = random.Random(5)
+    args = random_functional_args(n=3, keys=["A", "B"], cmds_per_key=6, rng=rng)
+    deps, src, seq, _ = batch_arrays(args)
+    keys = np.array([_key_hash(ks[0]) for _, ks, _ in args], dtype=np.int32)
+    res = resolve_functional_keyed(
+        jnp.asarray(keys),
+        jnp.asarray(deps[:, 0]),
+        jnp.asarray(src),
+        jnp.asarray(seq),
+        residual_size=_residual_size_for(len(args)),
+        return_structure=False,
+    )
+    full, n_exec = oracle_per_key_order(3, args)
+    assert int(res.n_resolved) == n_exec == int(np.asarray(res.resolved).sum())
+
+
 # --- general (multi-key, out-degree D) ---
 
 
