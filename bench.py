@@ -190,31 +190,49 @@ def child_main(mode: str) -> None:
 
 
 def bench_integrated_executor():
-    """Time the integrated executor path: (dot, cmd, deps) adds through
-    BatchedDependencyGraph.handle_add_batch, including the execute-queue
-    drain.  Returns (wall ms, commands/s)."""
+    """Time the integrated executor path: commands crossing the
+    Protocol/Executor boundary *as arrays* (the commit-buffer seam,
+    BatchedDependencyGraph.handle_add_arrays) including batch assembly,
+    the device resolve and the execute-queue drain.
+    Returns (wall ms, commands/s)."""
+    import numpy as np
+
     from fantoch_tpu.core import Command, Config, Dot, KVOp, Rifl, RunTime
     from fantoch_tpu.executor.graph.batched import BatchedDependencyGraph
-    from fantoch_tpu.protocol.common.graph_deps import Dependency
+    from fantoch_tpu.ops.frontier import pack_dots
 
     shard = 0
-    _key_np, dep_np, src_np, seq_np = build_workload(EXECUTOR_BATCH, CONFLICT)
-    dots = [Dot(int(s), int(q) + 1) for s, q in zip(src_np, seq_np)]
-    shards = frozenset({shard})
-    adds = []
-    for i in range(EXECUTOR_BATCH):
-        rifl = Rifl(1, i + 1)
-        cmd = Command.from_keys(rifl, shard, {f"k{i}": (KVOp.put(""),)})
-        deps = [Dependency(dots[dep_np[i]], shards)] if dep_np[i] >= 0 else []
-        adds.append((dots[i], cmd, deps))
+    key_np, dep_np, src_np, seq_np = build_workload(EXECUTOR_BATCH, CONFLICT)
+    # dots: (source, arrival+1); dep column -> packed dep dots
+    dot_seq = seq_np.astype(np.int64) + 1
+    dot_src = src_np.astype(np.int64)
+    has_dep = dep_np >= 0
+    dep_idx = np.where(has_dep, dep_np, 0)
+    dep_dots = np.where(
+        has_dep, pack_dots(dot_src[dep_idx], dot_seq[dep_idx]), -1
+    ).reshape(-1, 1)
+    # the command arena the protocol would hold anyway (not timed: these
+    # objects exist at submit time in any design)
+    cmds = [
+        Command.from_keys(Rifl(1, i + 1), shard, {f"k{i}": (KVOp.put(""),)})
+        for i in range(EXECUTOR_BATCH)
+    ]
 
-    graph = BatchedDependencyGraph(1, shard, Config(5, 2))
     clock = RunTime()
-    t0 = time.perf_counter()
-    graph.handle_add_batch(adds, clock)
-    executed = len(graph.commands_to_execute())
-    wall_ms = (time.perf_counter() - t0) * 1000.0
-    assert executed == EXECUTOR_BATCH, f"executed {executed}/{EXECUTOR_BATCH}"
+
+    def run_once():
+        graph = BatchedDependencyGraph(
+            1, shard, Config(5, 2, batched_graph_executor=True)
+        )
+        t0 = time.perf_counter()
+        graph.handle_add_arrays(dot_src, dot_seq, key_np, dep_dots, cmds, clock)
+        executed = len(graph.commands_to_execute())
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        assert executed == EXECUTOR_BATCH, f"executed {executed}/{EXECUTOR_BATCH}"
+        return wall_ms
+
+    run_once()  # warm the XLA compile cache for this batch shape
+    wall_ms = min(run_once() for _ in range(3))
     return wall_ms, EXECUTOR_BATCH / (wall_ms / 1000.0)
 
 

@@ -107,6 +107,19 @@ class Metrics(Generic[K]):
     def collect(self, kind: K, value: int) -> None:
         self._collected.setdefault(kind, Histogram()).increment(value)
 
+    def collect_many(self, kind: K, values) -> None:
+        """Bulk histogram update from an array of values (one Counter merge
+        instead of a Python call per command — the batched executor path)."""
+        import numpy as np
+
+        values = np.asarray(values)
+        if values.size == 0:
+            return
+        uniq, counts = np.unique(values.astype(np.int64), return_counts=True)
+        hist = self._collected.setdefault(kind, Histogram())
+        for v, c in zip(uniq.tolist(), counts.tolist()):
+            hist.increment(v, int(c))
+
     def aggregate(self, kind: K, by: int = 1) -> None:
         self._aggregated[kind] = self._aggregated.get(kind, 0) + by
 

@@ -1,31 +1,35 @@
-"""Batched device-resolved DependencyGraph — the north-star integration.
+"""Batched device-resolved DependencyGraph — the tensorized north-star seam.
 
 Replaces the per-add host Tarjan walk of
 fantoch_ps/src/executor/graph/mod.rs:215-644 + tarjan.rs:99-319 with the
 batched device resolver (fantoch_tpu/ops/graph_resolve.py) at the same
 seam: ``BatchedDependencyGraph`` is a drop-in for ``DependencyGraph``
-(select with ``Config.batched_graph_executor``), reusing its vertex /
-pending indexes, cross-shard request plumbing and GC bookkeeping, and
-overriding only the ordering core.
+(select with ``Config.batched_graph_executor``).
 
-How one ``handle_add`` resolves:
+Round-3 redesign (VERDICT r2 item 2): commands cross the boundary **as
+arrays**.  The backlog lives in append-only numpy columns — dot source /
+sequence, conflict-key hash, commit time, packed dependency dots — grown
+incrementally at add time (``handle_add_arrays`` appends whole array
+chunks straight from the protocol's commit buffer; the (dot, cmd, deps)
+tuple APIs remain as thin converters).  One resolve then:
 
-  1. the whole committed-but-unexecuted backlog (arrival order from the
-     insertion-ordered VertexIndex) becomes one batch; each vertex's deps
-     are pruned against the executed clock (-> TERMINAL), mapped to batch
-     indices, or marked MISSING when not committed here yet (missing deps
-     are recorded in the PendingIndex, which also yields the cross-shard
-     info requests of mod.rs:300-375);
-  2. out-degree <= 1 batches take the exact O(log B) functional path
-     (resolve_functional); wider batches take resolve_general;
-  3. vertices the device resolved are executed in the returned
-     (rank, SCC leader, dot) order — SCCs contiguous and dot-sorted,
-     every SCC after all SCCs it depends on, matching the order contract
-     of the host oracle (tarjan.rs:15, mod.rs:490-525);
-  4. ``stuck`` residues (rare 3+-cycles with strictly one-directional
-     conflict visibility that the device pass cannot collapse) are closed
-     under dependencies, so they are handed to the host TarjanSCCFinder
-     oracle, in arrival order, after all device-resolved vertices.
+  1. maps dependency dots to batch slots with a vectorized
+     sort + searchsorted join (no per-dep dict lookups),
+  2. prunes executed deps against a ``DeviceFrontier``
+     (fantoch_tpu/ops/frontier.py — batch ``contains``, killing the
+     per-dep Python ``executed_clock.contains`` of round 2),
+  3. resolves on device: the keyed sort-based kernel for single-key
+     functional batches (the hot path), ``resolve_general`` for wider
+     ones; ``stuck`` residues (rare 3+-cycles) finish on the host Tarjan
+     oracle over the stuck subgraph,
+  4. emits in device order, advances the frontier in one batch add, and
+     compacts the unresolved residue (missing-blocked rows simply wait for
+     their dependency to arrive as a later add).
+
+Resolution is **lazy**: adds mark the backlog dirty and the resolve runs
+once per output drain (``commands_to_execute`` & friends), fixing the
+round-2 O(B^2) behavior where every single ``handle_add`` re-resolved the
+whole backlog.
 
 Per-key execution order is identical to the host oracle's: conflicting
 commands are always dependency-linked, so their relative order is forced
@@ -34,28 +38,464 @@ which the device order preserves.  Whole-batch order may interleave
 *independent* commands differently, which the correctness argument
 explicitly permits (fantoch/src/executor/monitor.rs agreement is per key).
 
-Batch shapes are padded to powers of two so XLA compiles O(log^2) distinct
-programs, and device results are fetched with one host sync per resolve.
+Partial replication: the array fast path is single-shard; with
+``shard_count > 1`` this class defers to the host ``DependencyGraph``
+machinery (cross-shard Request/RequestReply plumbing untouched), so
+multi-shard stays correct while the tensorized path covers the
+throughput-critical single-shard configuration.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 from fantoch_tpu.core.command import Command
-from fantoch_tpu.core.ids import Dot
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
 from fantoch_tpu.core.timing import SysTime
 from fantoch_tpu.executor.base import ExecutorMetricsKind
 from fantoch_tpu.executor.graph.deps_graph import DependencyGraph
-from fantoch_tpu.executor.graph.tarjan import FinderResult, Vertex
+from fantoch_tpu.ops.frontier import DeviceFrontier, pack_dots
 from fantoch_tpu.ops.graph_resolve import (
     MISSING,
     TERMINAL,
-    resolve_functional,
     resolve_general,
+    resolve_keyed_auto,
 )
+from fantoch_tpu.utils import key_hash as _framework_key_hash
+
+_NO_DEP = np.int64(-1)  # packed-dep sentinel: no dependency in this slot
+# below this backlog size, ask the keyed kernel for full structure so
+# CHAIN_SIZE metrics stay exact (tests/sims); above it, skip the extra
+# device sort and only collect aggregate metrics
+_STRUCTURE_THRESHOLD = 4096
+
+
+def key_hash(key: str) -> int:
+    """Stable 31-bit conflict-key hash: the framework-wide key hash
+    (fantoch_tpu/utils key_hash, the executor-routing hash of
+    fantoch/src/util.rs:107) folded to int32 range for the device kernel.
+    Collisions only cost resolver performance, not correctness."""
+    return _framework_key_hash(key) & 0x7FFFFFFF
+
+
+class _Backlog:
+    """Append-only column store for committed-but-unexecuted commands."""
+
+    __slots__ = ("cmds", "chunks", "scalars", "count")
+
+    def __init__(self) -> None:
+        self.cmds: List[Command] = []
+        # each chunk: (src i64[b], seq i64[b], key i32[b], tms f64[b],
+        #             deps i64[b, w] packed dots, _NO_DEP padded)
+        self.chunks: List[Tuple[np.ndarray, ...]] = []
+        self.scalars: List[Tuple[int, int, int, float, Tuple[int, ...]]] = []
+        self.count = 0
+
+    def append_arrays(self, src, seq, key, tms, deps, cmds) -> None:
+        assert len(src) == len(cmds)
+        self.chunks.append((src, seq, key, tms, deps))
+        self.cmds.extend(cmds)
+        self.count += len(src)
+
+    def append_one(self, src, seq, key, tms, dep_packed, cmd) -> None:
+        self.scalars.append((src, seq, key, tms, dep_packed))
+        self.cmds.append(cmd)
+        self.count += 1
+
+    def columns(self):
+        """Materialize (src, seq, key, tms, deps[B, W]) over everything."""
+        chunks = list(self.chunks)
+        if self.scalars:
+            width = max(len(d) for *_x, d in self.scalars)
+            width = max(width, 1)
+            src = np.fromiter((s for s, *_ in self.scalars), np.int64)
+            seq = np.fromiter((q for _, q, *_ in self.scalars), np.int64)
+            key = np.fromiter((k for _, _, k, *_ in self.scalars), np.int32)
+            tms = np.fromiter((t for _, _, _, t, _ in self.scalars), np.float64)
+            deps = np.full((len(self.scalars), width), _NO_DEP)
+            for i, (*_x, d) in enumerate(self.scalars):
+                deps[i, : len(d)] = d
+            chunks.append((src, seq, key, tms, deps))
+        if not chunks:
+            empty = np.empty(0, np.int64)
+            return empty, empty, empty.astype(np.int32), empty.astype(np.float64), np.empty((0, 1), np.int64)
+        width = max(c[4].shape[1] for c in chunks)
+        dep_mats = []
+        for c in chunks:
+            mat = c[4]
+            if mat.shape[1] < width:
+                pad = np.full((mat.shape[0], width - mat.shape[1]), _NO_DEP)
+                mat = np.concatenate([mat, pad], axis=1)
+            dep_mats.append(mat)
+        return (
+            np.concatenate([c[0] for c in chunks]),
+            np.concatenate([c[1] for c in chunks]),
+            np.concatenate([c[2] for c in chunks]),
+            np.concatenate([c[3] for c in chunks]),
+            np.concatenate(dep_mats, axis=0),
+        )
+
+    def replace(self, src, seq, key, tms, deps, cmds) -> None:
+        self.chunks = [(src, seq, key, tms, deps)] if len(src) else []
+        self.scalars = []
+        self.cmds = cmds
+        self.count = len(cmds)
+
+
+class BatchedDependencyGraph(DependencyGraph):
+    """DependencyGraph whose ordering core is the batched device resolver."""
+
+    def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
+        super().__init__(process_id, shard_id, config)
+        self._array_mode = config.shard_count == 1
+        if self._array_mode:
+            from fantoch_tpu.core.ids import all_process_ids
+
+            ids = [pid for pid, _ in all_process_ids(config.shard_count, config.n)]
+            self._frontier = DeviceFrontier(ids)
+            # keep the inherited name pointing at the frontier so the host
+            # Tarjan oracle (stuck residues) sees the same executed set
+            self._executed_clock = self._frontier  # type: ignore[assignment]
+            self._backlog = _Backlog()
+            self._dirty = False
+            self._last_time: Optional[SysTime] = None
+
+    # --- add paths ---
+
+    def handle_add(self, dot: Dot, cmd: Command, deps, time: SysTime) -> None:
+        assert self.executor_index == 0
+        if not self._array_mode:
+            return super().handle_add(dot, cmd, list(deps), time)
+        self._append_tuple(dot, cmd, deps, time)
+        self._dirty = True
+        self._last_time = time
+
+    def handle_add_batch(self, adds, time: SysTime) -> None:
+        """Bulk tuple add: one resolve for the batch on the next drain."""
+        assert self.executor_index == 0
+        if not self._array_mode:
+            return super().handle_add_batch(adds, time)
+        for dot, cmd, deps in adds:
+            self._append_tuple(dot, cmd, deps, time)
+        self._dirty = True
+        self._last_time = time
+
+    def handle_add_arrays(
+        self,
+        dot_src: np.ndarray,  # int64[b]
+        dot_seq: np.ndarray,  # int64[b]
+        key: np.ndarray,  # int32[b] conflict-key hash (-1 = multi-key)
+        dep_dots: np.ndarray,  # int64[b, w] packed dep dots (pack_dots), -1 pad
+        cmds: List[Command],
+        time: SysTime,
+    ) -> None:
+        """The tensorized seam: the protocol's commit buffer lands here as
+        whole arrays — no per-command Python in the executor."""
+        assert self.executor_index == 0 and self._array_mode
+        tms = np.full(len(cmds), float(time.millis()), np.float64)
+        self._backlog.append_arrays(
+            dot_src.astype(np.int64),
+            dot_seq.astype(np.int64),
+            key.astype(np.int32),
+            tms,
+            dep_dots.astype(np.int64),
+            cmds,
+        )
+        self._dirty = True
+        self._last_time = time
+
+    def _append_tuple(self, dot: Dot, cmd: Command, deps, time: SysTime) -> None:
+        if cmd.key_count(self._shard_id) == 1:
+            khash = key_hash(next(iter(cmd.keys(self._shard_id))))
+        else:
+            khash = -1
+        packed = tuple(
+            (int(d.dot.source) << 32) | int(d.dot.sequence)
+            for d in deps
+            if d.dot != dot  # self-dependency pruned (tarjan.py:129)
+        )
+        self._backlog.append_one(
+            int(dot.source), int(dot.sequence), khash, float(time.millis()), packed, cmd
+        )
+
+    # --- executed notifications / request replies ---
+
+    def handle_executed(self, dots, _time: SysTime) -> None:
+        if not self._array_mode:
+            return super().handle_executed(dots, _time)
+        if self.executor_index > 0 and dots:
+            src = np.fromiter((d.source for d in dots), np.int64, len(dots))
+            seq = np.fromiter((d.sequence for d in dots), np.int64, len(dots))
+            self._frontier.add_batch(src, seq)
+
+    def _check_pending(self, dots, time: SysTime) -> None:
+        """Executed-dot notifications just mark the backlog dirty: the next
+        drain re-resolves with the updated frontier."""
+        assert self.executor_index == 0
+        if not self._array_mode:
+            return super()._check_pending(dots, time)
+        self._dirty = True
+
+    def handle_request_reply(self, infos, time: SysTime) -> None:
+        if not self._array_mode:
+            return super().handle_request_reply(infos, time)
+        from fantoch_tpu.executor.graph.deps_graph import RequestReplyInfo
+
+        for info in infos:
+            if isinstance(info, RequestReplyInfo):
+                self.handle_add(info.dot, info.cmd, info.deps, time)
+            else:
+                self._frontier.add(info.dot.source, info.dot.sequence)
+                self._dirty = True
+
+    # --- lazy resolution at the output drains ---
+
+    def command_to_execute(self) -> Optional[Command]:
+        self._flush()
+        return super().command_to_execute()
+
+    def commands_to_execute(self) -> List[Command]:
+        self._flush()
+        return super().commands_to_execute()
+
+    def monitor_pending(self, time: SysTime) -> None:
+        if not self._array_mode:
+            return super().monitor_pending(time)
+        self._flush(time)
+        # liveness watchdog (index.rs:53-103 analog): after a resolve, every
+        # still-pending row must be (transitively) missing-blocked — the
+        # device kernel resolves everything else.  If rows are old but no
+        # missing dependency exists in the whole backlog, an execution was
+        # lost: panic loudly.
+        if not self._backlog.count:
+            return
+        src, seq, _key, tms, deps = self._backlog.columns()
+        from fantoch_tpu.executor.graph.indexes import MONITOR_PENDING_THRESHOLD_MS
+
+        old = (float(time.millis()) - tms) >= MONITOR_PENDING_THRESHOLD_MS
+        if not old.any():
+            return
+        dep_rows = self._map_deps(src, seq, deps)
+        if not (dep_rows == MISSING).any():
+            raise AssertionError(
+                f"p{self._process_id}: {int(old.sum())} commands pending "
+                "without missing dependencies"
+            )
+
+    def _flush(self, time: Optional[SysTime] = None) -> None:
+        if not self._array_mode or not self._dirty:
+            return
+        self._dirty = False
+        if time is None:
+            time = self._last_time
+        if time is None:
+            from fantoch_tpu.core.timing import RunTime
+
+            time = RunTime()
+        self._resolve_backlog(time)
+
+    # --- the batched ordering core ---
+
+    def _map_deps(self, src, seq, deps) -> np.ndarray:
+        """Vectorized dep-dot -> batch-slot join.  Returns int32[B, W] with
+        TERMINAL (executed / none / self) and MISSING sentinels."""
+        batch, width = deps.shape
+        packed = pack_dots(src, seq)
+        sort_idx = np.argsort(packed, kind="stable").astype(np.int64)
+        sorted_packed = packed[sort_idx]
+        assert len(np.unique(sorted_packed)) == batch, "duplicate dot added"
+
+        flat = deps.reshape(-1)
+        valid = flat >= 0
+        out = np.full(batch * width, TERMINAL, dtype=np.int32)
+        if valid.any():
+            v = flat[valid]
+            j = np.searchsorted(sorted_packed, v)
+            j = np.minimum(j, batch - 1)
+            in_batch = sorted_packed[j] == v
+            slot = np.where(in_batch, sort_idx[j], -1)
+            # not in batch: executed -> TERMINAL, else MISSING
+            dep_src = v >> 32
+            dep_seq = v & 0xFFFFFFFF
+            executed = self._frontier.contains_batch(dep_src, dep_seq)
+            res = np.where(
+                in_batch, slot, np.where(executed, TERMINAL, MISSING)
+            ).astype(np.int32)
+            # self-dependency guard (array chunks may carry them)
+            rows = np.nonzero(valid)[0] // width
+            res = np.where(res == rows, TERMINAL, res)
+            out[valid] = res
+        return out.reshape(batch, width)
+
+    def _resolve_backlog(self, time: SysTime) -> None:
+        if not self._backlog.count:
+            return
+        src, seq, key, tms, deps = self._backlog.columns()
+        batch = len(src)
+        dep_rows = self._map_deps(src, seq, deps)
+
+        # compress to functional form when every row has <= 1 live dep
+        live = dep_rows != TERMINAL
+        live_counts = live.sum(axis=1)
+        functional = bool((live_counts <= 1).all())
+        src32 = src.astype(np.int32)
+        seq32 = (seq - seq.min()).astype(np.int32) if batch else src32
+
+        import jax.numpy as jnp
+
+        if functional and bool((key >= 0).all()):
+            col = np.where(
+                live_counts > 0,
+                dep_rows[np.arange(batch), np.argmax(live, axis=1)],
+                TERMINAL,
+            ).astype(np.int32)
+            # pad to pow2 so XLA compiles O(log) distinct programs, not one
+            # per backlog size (the lazy flush sees arbitrary sizes).  Pad
+            # rows carry a private key so they form their own run, resolve
+            # as singletons, and are filtered out of the emitted prefix.
+            padded_b = _pad_pow2(batch)
+            # distinct pad keys: each pad row is its own single-row run
+            # (one shared key would make every non-head pad row fail the
+            # in-run link check and flood the residual)
+            pk = np.iinfo(np.int32).max - np.arange(padded_b, dtype=np.int32)
+            pc = np.full(padded_b, TERMINAL, dtype=np.int32)
+            ps = np.zeros(padded_b, np.int32)
+            pq = np.zeros(padded_b, np.int32)
+            pk[:batch] = key
+            pc[:batch] = col
+            ps[:batch] = src32
+            pq[:batch] = seq32
+            want_structure = batch <= _STRUCTURE_THRESHOLD
+            res = resolve_keyed_auto(
+                jnp.asarray(pk),
+                jnp.asarray(pc),
+                jnp.asarray(ps),
+                jnp.asarray(pq),
+                return_structure=want_structure,
+            )
+            order = np.asarray(res.order)
+            n_res = int(res.n_resolved)
+            emitted = order[:n_res]
+            emitted = emitted[emitted < batch]  # drop resolved pad rows
+            n_res = len(emitted)
+            stuck_rows = None
+            if want_structure and n_res:
+                leaders = np.asarray(res.leader)[emitted]
+                sizes = np.diff(
+                    np.concatenate(
+                        [[0], np.nonzero(np.diff(leaders))[0] + 1, [n_res]]
+                    )
+                )
+                self._metrics.collect_many(ExecutorMetricsKind.CHAIN_SIZE, sizes)
+        else:
+            padded_b = _pad_pow2(batch)
+            padded_w = _pad_pow2(max(dep_rows.shape[1], 1))
+            mat = np.full((padded_b, padded_w), TERMINAL, dtype=np.int32)
+            mat[:batch, : dep_rows.shape[1]] = dep_rows
+            ps = np.zeros(padded_b, np.int32)
+            pq = np.zeros(padded_b, np.int32)
+            ps[:batch] = src32
+            pq[:batch] = seq32
+            res = resolve_general(jnp.asarray(mat), jnp.asarray(ps), jnp.asarray(pq))
+            order = np.asarray(res.order)
+            resolved = np.asarray(res.resolved)
+            order = order[order < batch]
+            emitted = order[resolved[order]]
+            n_res = len(emitted)
+            stuck = np.asarray(res.stuck)[:batch]
+            stuck_rows = np.nonzero(stuck)[0] if stuck.any() else None
+            if n_res:
+                leaders = np.asarray(res.leader)[emitted]
+                sizes = np.diff(
+                    np.concatenate(
+                        [[0], np.nonzero(np.diff(leaders))[0] + 1, [n_res]]
+                    )
+                )
+                self._metrics.collect_many(ExecutorMetricsKind.CHAIN_SIZE, sizes)
+
+        remaining_mask = np.ones(batch, dtype=bool)
+        if n_res:
+            self._emit_rows(emitted, src, seq, tms, time)
+            remaining_mask[emitted] = False
+
+        if stuck_rows is not None and len(stuck_rows):
+            oracle_emitted = self._resolve_stuck_rows(
+                stuck_rows, src, seq, deps, tms, time
+            )
+            remaining_mask[oracle_emitted] = False
+
+        keep = np.nonzero(remaining_mask)[0]
+        cmds = self._backlog.cmds
+        self._backlog.replace(
+            src[keep],
+            seq[keep],
+            key[keep],
+            tms[keep],
+            deps[keep],
+            [cmds[i] for i in keep],
+        )
+
+    def _emit_rows(self, rows: np.ndarray, src, seq, tms, time: SysTime) -> None:
+        cmds = self._backlog.cmds
+        self._to_execute.extend(cmds[i] for i in rows)
+        self._frontier.add_batch(src[rows], seq[rows])
+        now = float(time.millis())
+        self._metrics.collect_many(
+            ExecutorMetricsKind.EXECUTION_DELAY, np.maximum(now - tms[rows], 0.0)
+        )
+
+    def _resolve_stuck_rows(
+        self, stuck_rows, src, seq, deps, tms, time: SysTime
+    ) -> np.ndarray:
+        """Host Tarjan oracle over the stuck residue (dep-closed by the
+        ``stuck`` contract of resolve_general): rebuild the subgraph with
+        deps restricted to stuck members (everything else the device either
+        emitted before them or left missing-blocked — and missing-blocked
+        rows are never stuck) and run the oracle to completion."""
+        from fantoch_tpu.protocol.common.graph_deps import Dependency
+
+        stuck_set = {
+            (int(src[i]) << 32) | int(seq[i]): int(i) for i in stuck_rows
+        }
+        oracle = DependencyGraph(self._process_id, self._shard_id, self._config)
+        shards = frozenset({self._shard_id})
+        cmds = self._backlog.cmds
+        emitted_rows: List[int] = []
+        row_of = {id(cmds[int(i)]): int(i) for i in stuck_rows}
+        for i in stuck_rows:
+            i = int(i)
+            dot = Dot(int(src[i]), int(seq[i]))
+            dep_list = [
+                Dependency(Dot(int(p >> 32), int(p & 0xFFFFFFFF)), shards)
+                for p in deps[i]
+                if int(p) in stuck_set
+            ]
+            oracle.handle_add(dot, cmds[i], dep_list, time)
+            for done in oracle.commands_to_execute():
+                r = row_of[id(done)]
+                emitted_rows.append(r)
+                self._metrics.collect(
+                    ExecutorMetricsKind.EXECUTION_DELAY,
+                    max(int(time.millis() - tms[r]), 0),
+                )
+                self._to_execute.append(done)
+        chain_hist = oracle.metrics().get_collected(ExecutorMetricsKind.CHAIN_SIZE)
+        if chain_hist is not None:
+            from fantoch_tpu.core.metrics import Histogram
+
+            self._metrics.collected.setdefault(
+                ExecutorMetricsKind.CHAIN_SIZE, Histogram()
+            ).merge(chain_hist)
+        rows = np.array(emitted_rows, dtype=np.int64)
+        if len(rows):
+            self._frontier.add_batch(src[rows], seq[rows])
+        assert len(rows) == len(stuck_rows), (
+            f"stuck residue not fully resolvable: {len(rows)}/{len(stuck_rows)}"
+        )
+        return rows
 
 
 def _pad_pow2(n: int) -> int:
@@ -63,166 +503,3 @@ def _pad_pow2(n: int) -> int:
     while p < n:
         p *= 2
     return p
-
-
-class BatchedDependencyGraph(DependencyGraph):
-    """DependencyGraph whose ordering core is the batched device resolver."""
-
-    def handle_add(self, dot: Dot, cmd: Command, deps, time: SysTime) -> None:
-        assert self.executor_index == 0
-        vertex = Vertex(dot, cmd, list(deps), time)
-        if self._vertex_index.index(vertex) is not None:
-            raise AssertionError(
-                f"p{self._process_id}: tried to index already indexed {dot}"
-            )
-        self._resolve_backlog(time)
-
-    def handle_add_batch(self, adds, time: SysTime) -> None:
-        """Bulk add: index the whole batch, then resolve once — one device
-        round-trip for the entire queue drain instead of one per add."""
-        assert self.executor_index == 0
-        for dot, cmd, deps in adds:
-            vertex = Vertex(dot, cmd, list(deps), time)
-            if self._vertex_index.index(vertex) is not None:
-                raise AssertionError(
-                    f"p{self._process_id}: tried to index already indexed {dot}"
-                )
-        self._resolve_backlog(time)
-
-    def _check_pending(self, dots, time: SysTime) -> None:
-        """Executed-dot notifications (request replies) re-resolve the
-        backlog as a whole; no per-dot cascade is needed.  The dots were
-        executed (possibly remotely — RequestReplyExecuted), so their
-        pending-index entries are dropped like the host cascade does
-        (deps_graph.py _check_pending's remove)."""
-        assert self.executor_index == 0
-        for dot in dots:
-            self._pending_index.remove(dot)
-        self._resolve_backlog(time)
-
-    # --- the batched ordering core ---
-
-    def _resolve_backlog(self, time: SysTime) -> None:
-        dots: List[Dot] = list(self._vertex_index.dots())  # arrival order
-        if not dots:
-            return
-        batch = len(dots)
-        index_of: Dict[Dot, int] = {d: i for i, d in enumerate(dots)}
-        vertices: List[Vertex] = [self._vertex_index.find(d) for d in dots]
-
-        rows: List[List[int]] = []
-        width = 1
-        for vertex in vertices:
-            row: List[int] = []
-            missing = set()
-            for dep in vertex.deps:
-                dep_dot = dep.dot
-                if dep_dot == vertex.dot or self._executed_clock.contains(
-                    dep_dot.source, dep_dot.sequence
-                ):
-                    continue
-                j = index_of.get(dep_dot)
-                if j is None:
-                    row.append(MISSING)
-                    missing.add(dep)
-                else:
-                    row.append(j)
-            if missing:
-                # PendingIndex dedupes re-sightings; first sighting of a
-                # non-replicated dep yields a cross-shard request
-                self._index_pending(vertex.dot, missing)
-            rows.append(row)
-            width = max(width, len(row))
-
-        padded_b = _pad_pow2(batch)
-        padded_w = _pad_pow2(width)
-        dot_src = np.zeros(padded_b, dtype=np.int32)
-        dot_seq = np.zeros(padded_b, dtype=np.int32)
-        for i, d in enumerate(dots):
-            dot_src[i] = d.source
-            dot_seq[i] = d.sequence
-
-        if width <= 1:
-            dep_arr = np.full(padded_b, TERMINAL, dtype=np.int32)
-            for i, row in enumerate(rows):
-                if row:
-                    dep_arr[i] = row[0]
-            res = resolve_functional(dep_arr, dot_src, dot_seq)
-            order = np.asarray(res.order)
-            resolved = np.asarray(res.resolved)
-            leader = np.asarray(res.leader)
-            stuck = np.zeros(padded_b, dtype=bool)  # functional path is exact
-        else:
-            deps_arr = np.full((padded_b, padded_w), TERMINAL, dtype=np.int32)
-            for i, row in enumerate(rows):
-                deps_arr[i, : len(row)] = row
-            res = resolve_general(deps_arr, dot_src, dot_seq)
-            order = np.asarray(res.order)
-            resolved = np.asarray(res.resolved)
-            leader = np.asarray(res.leader)
-            stuck = np.asarray(res.stuck)
-
-        # emit device-resolved vertices in device order; SCC boundaries
-        # (leader changes) drive the ChainSize metric like mod.rs:490-525
-        scc_size = 0
-        prev_leader = -1
-        for i in order:
-            if i >= batch or not resolved[i]:
-                continue
-            if leader[i] != prev_leader and scc_size:
-                self._metrics.collect(ExecutorMetricsKind.CHAIN_SIZE, scc_size)
-                scc_size = 0
-            prev_leader = leader[i]
-            scc_size += 1
-            self._emit(dots[i], time)
-        if scc_size:
-            self._metrics.collect(ExecutorMetricsKind.CHAIN_SIZE, scc_size)
-
-        # host-oracle fallback for stuck residues (closed under deps)
-        if stuck[:batch].any():
-            self._resolve_stuck([dots[i] for i in range(batch) if stuck[i]], time)
-
-    def _emit(self, dot: Dot, time: SysTime) -> None:
-        vertex = self._vertex_index.remove(dot)
-        assert vertex is not None, "resolved dot must be indexed"
-        self._executed_clock.add(dot.source, dot.sequence)
-        if self._config.shard_count > 1:
-            self._added_to_executed_clock.add(dot)
-        self._pending_index.remove(dot)
-        self._metrics.collect(
-            ExecutorMetricsKind.EXECUTION_DELAY, vertex.duration_ms(time)
-        )
-        self._to_execute.append(vertex.cmd)
-
-    def _resolve_stuck(self, stuck_dots: List[Dot], time: SysTime) -> None:
-        """Host Tarjan oracle over the stuck residue, in arrival order
-        (the ``stuck`` contract of ops/graph_resolve.resolve_general)."""
-        for dot in stuck_dots:
-            vertex = self._vertex_index.find(dot)
-            if vertex is None:
-                continue  # executed as part of an earlier stuck SCC
-            result, _missing, _count = self._finder.strong_connect(
-                True,
-                dot,
-                vertex,
-                self._executed_clock,
-                self._added_to_executed_clock,
-                self._vertex_index,
-            )
-            for scc in self._finder.sccs():
-                self._metrics.collect(ExecutorMetricsKind.CHAIN_SIZE, len(scc))
-                for member in scc:
-                    member_vertex = self._vertex_index.remove(member)
-                    assert member_vertex is not None
-                    self._pending_index.remove(member)
-                    self._metrics.collect(
-                        ExecutorMetricsKind.EXECUTION_DELAY,
-                        member_vertex.duration_ms(time),
-                    )
-                    self._to_execute.append(member_vertex.cmd)
-            self._finder.finalize(self._vertex_index)
-            # stuck vertices are not missing-blocked (resolve_general
-            # contract), so the oracle walk cannot hit a missing dep
-            assert result is not FinderResult.MISSING_DEPENDENCIES, (
-                f"stuck residue {dot} reached a missing dependency"
-            )

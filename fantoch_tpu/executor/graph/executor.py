@@ -12,6 +12,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Deque, List, Optional, Set, Tuple
 
+import numpy as np
+
 from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
@@ -31,6 +33,22 @@ class GraphAdd:
     dot: Dot
     cmd: Command
     deps: Set[Dependency]
+
+
+@dataclass
+class GraphAddBatch:
+    """A whole commit buffer crossing the Protocol/Executor boundary as
+    arrays (VERDICT r2 item 2; single-shard only — multi-shard commits keep
+    per-command GraphAdd with full Dependency shard sets).
+
+    ``dep_dots`` is int64[B, W] of packed dependency dots
+    (fantoch_tpu/ops/frontier.py pack_dots), -1 padded."""
+
+    dot_src: "np.ndarray"
+    dot_seq: "np.ndarray"
+    key: "np.ndarray"  # int32 conflict-key hash, -1 = multi-key
+    dep_dots: "np.ndarray"
+    cmds: List[Command]
 
 
 @dataclass
@@ -112,6 +130,29 @@ class GraphExecutor(Executor):
             else:
                 self.graph.handle_add(info.dot, info.cmd, list(info.deps), time)
                 self._fetch_actions(time)
+        elif isinstance(info, GraphAddBatch):
+            if self._config.execute_at_commit:
+                for cmd in info.cmds:
+                    self._execute(cmd)
+            elif getattr(self.graph, "_array_mode", False):
+                self.graph.handle_add_arrays(
+                    info.dot_src, info.dot_seq, info.key, info.dep_dots, info.cmds, time
+                )
+                self._fetch_actions(time)
+            else:
+                # host-oracle graph: unpack to per-command adds (buffered
+                # batches are single-shard, so deps are local)
+                shards = frozenset({self._shard_id})
+                for i, cmd in enumerate(info.cmds):
+                    deps = [
+                        Dependency(Dot(int(p >> 32), int(p & 0xFFFFFFFF)), shards)
+                        for p in info.dep_dots[i]
+                        if p >= 0
+                    ]
+                    self.graph.handle_add(
+                        Dot(int(info.dot_src[i]), int(info.dot_seq[i])), cmd, deps, time
+                    )
+                self._fetch_actions(time)
         elif isinstance(info, GraphRequest):
             self.graph.handle_request(info.from_shard, info.dots, time)
             self._fetch_actions(time)
@@ -169,6 +210,6 @@ class GraphExecutor(Executor):
 
     @staticmethod
     def executor_index_of(info: GraphExecutionInfo):
-        if isinstance(info, (GraphAdd, GraphRequestReply)):
+        if isinstance(info, (GraphAdd, GraphAddBatch, GraphRequestReply)):
             return (0, _MAIN_EXECUTOR_INDEX)
         return (0, _SECONDARY_EXECUTOR_INDEX)

@@ -1,0 +1,19 @@
+"""Offline quorum-latency planner (the fantoch_bote analog).
+
+Reference: fantoch_bote/src/{lib,protocol,search}.rs.  ``Bote`` computes
+client-perceived latencies for leaderless and leader-based protocols over
+a Planet RTT matrix; ``Search`` ranks server-region placements against
+FPaxos/EPaxos baselines.
+"""
+
+from fantoch_tpu.planner.bote import Bote, minority, quorum_size
+from fantoch_tpu.planner.search import ConfigScore, RankingParams, Search
+
+__all__ = [
+    "Bote",
+    "ConfigScore",
+    "RankingParams",
+    "Search",
+    "minority",
+    "quorum_size",
+]

@@ -1,0 +1,126 @@
+"""Bote: client-perceived quorum-latency planner.
+
+Reference: fantoch_bote/src/lib.rs:38-186 and protocol.rs:20-35.  Given a
+Planet (inter-region RTT matrix), server regions and client regions, it
+computes the latency every client would perceive:
+
+  * leaderless protocols — client -> closest server + that server ->
+    its closest quorum of ``quorum_size`` (lib.rs:38-58);
+  * leader-based protocols — client -> leader + leader -> its closest
+    quorum (lib.rs:60-88), with ``best_leader`` ranking all leader
+    choices by a Histogram statistic (lib.rs:90-121).
+
+The quorum latency counts the source region itself as the first quorum
+member at 0 ms (the planet's sorted-by-distance list starts with self —
+lib.rs:152-186 ``nth_closest``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Tuple
+
+from fantoch_tpu.core.metrics import Histogram
+from fantoch_tpu.core.planet import Planet, Region
+
+
+def minority(n: int) -> int:
+    return n // 2
+
+
+def quorum_size(protocol: str, n: int, f: int) -> int:
+    """Per-protocol quorum size (fantoch_bote/src/protocol.rs:20-35).
+
+    EPaxos ignores the given f: it always tolerates a minority."""
+    if protocol == "fpaxos":
+        return f + 1
+    if protocol == "epaxos":
+        fm = minority(n)
+        return fm + (fm + 1) // 2
+    if protocol == "atlas":
+        return minority(n) + f
+    raise ValueError(f"unknown protocol {protocol}")
+
+
+class Bote:
+    def __init__(self, planet: Planet):
+        self._planet = planet
+
+    @staticmethod
+    def new(dataset: str = "gcp") -> "Bote":
+        return Bote(Planet.new(dataset))
+
+    @property
+    def planet(self) -> Planet:
+        return self._planet
+
+    def leaderless(
+        self,
+        servers: Sequence[Region],
+        clients: Iterable[Region],
+        quorum_size: int,
+    ) -> List[Tuple[Region, int]]:
+        """Per-client perceived latency for a leaderless protocol."""
+        out = []
+        for client in clients:
+            to_closest, closest = self.nth_closest(1, client, servers)
+            closest_to_quorum = self.quorum_latency(closest, servers, quorum_size)
+            out.append((client, to_closest + closest_to_quorum))
+        return out
+
+    def leader(
+        self,
+        leader: Region,
+        servers: Sequence[Region],
+        clients: Iterable[Region],
+        quorum_size: int,
+    ) -> List[Tuple[Region, int]]:
+        """Per-client perceived latency with a fixed leader."""
+        leader_to_quorum = self.quorum_latency(leader, servers, quorum_size)
+        out = []
+        for client in clients:
+            to_leader = self._planet.ping_latency(client, leader)
+            assert to_leader is not None
+            out.append((client, to_leader + leader_to_quorum))
+        return out
+
+    def best_leader(
+        self,
+        servers: Sequence[Region],
+        clients: Sequence[Region],
+        quorum_size: int,
+        sort_by: str = "mean",
+    ) -> Tuple[Region, Histogram]:
+        """The leader minimizing the chosen latency statistic
+        ('mean' | 'cov' | 'mdtm')."""
+        best = None
+        for leader in servers:
+            hist = Histogram()
+            for _client, latency in self.leader(leader, servers, clients, quorum_size):
+                hist.increment(latency)
+            stat = getattr(hist, sort_by)()
+            if best is None or stat < best[2]:
+                best = (leader, hist, stat)
+        assert best is not None, "servers must be non-empty"
+        return best[0], best[1]
+
+    def quorum_latency(
+        self, from_: Region, regions: Sequence[Region], quorum_size: int
+    ) -> int:
+        latency, _ = self.nth_closest(quorum_size, from_, regions)
+        return latency
+
+    def nth_closest(
+        self, nth: int, from_: Region, regions: Sequence[Region]
+    ) -> Tuple[int, Region]:
+        """nth (1-based) closest of ``regions`` to ``from_``; ``from_``
+        itself counts at distance 0 when it is in ``regions``."""
+        sorted_all = self._planet.sorted_by_distance(from_)
+        assert sorted_all is not None, f"{from_} not in planet"
+        allowed = set(regions)
+        seen = 0
+        for latency, region in sorted_all:
+            if region in allowed:
+                seen += 1
+                if seen == nth:
+                    return latency, region
+        raise AssertionError(f"fewer than {nth} of {regions} in planet")

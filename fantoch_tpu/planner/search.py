@@ -1,0 +1,162 @@
+"""Config search: rank server-region placements by client-perceived latency.
+
+Reference: fantoch_bote/src/search.rs (Search / SearchInput / RankingParams /
+FTMetric) + protocol stats naming from fantoch_bote/src/protocol.rs.  For
+every n-region configuration drawn from the candidate set it computes, per
+protocol and fault level, the histogram of client-perceived latencies
+(clients either at the input regions or colocated with the servers), scores
+the configuration by how much Atlas improves over the FPaxos and EPaxos
+baselines, and returns configurations sorted by score.
+
+Array-first redesign: instead of the reference's nested per-config loops
+over Planet lookups, the candidate regions become one dense RTT matrix
+(Planet.latency_matrix) and each config's quorum latencies are numpy
+row-sorts over matrix slices.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fantoch_tpu.core.metrics import Histogram
+from fantoch_tpu.core.planet import Planet, Region
+from fantoch_tpu.planner.bote import minority, quorum_size
+
+# protocol short names (protocol.rs:12-18); key format "<short><f>[C]"
+_SHORT = {"atlas": "a", "epaxos": "e", "fpaxos": "f"}
+COLOCATED = "C"
+
+
+@dataclass(frozen=True)
+class RankingParams:
+    """Thresholds for counting a config as an improvement
+    (search.rs:617-650): minimum decrease (ms) of Atlas mean latency vs
+    the FPaxos and EPaxos baselines at the same fault level."""
+
+    min_mean_decrease_vs_fpaxos: int = 15
+    min_mean_decrease_vs_epaxos: int = 0
+    min_mean_ft_improvement: int = 0
+    fault_levels: Tuple[int, ...] = (1, 2)
+
+
+@dataclass(frozen=True)
+class ConfigScore:
+    regions: Tuple[Region, ...]
+    score: float
+    stats: Dict[str, Histogram] = field(compare=False, hash=False, default_factory=dict)
+
+
+class Search:
+    def __init__(
+        self,
+        planet: Planet,
+        candidate_servers: Sequence[Region],
+        clients: Optional[Sequence[Region]] = None,
+    ):
+        self._planet = planet
+        self._servers = list(candidate_servers)
+        self._clients = list(clients) if clients is not None else list(candidate_servers)
+        self._all = self._servers + [
+            c for c in self._clients if c not in self._servers
+        ]
+        self._index = {r: i for i, r in enumerate(self._all)}
+        self._matrix = planet.latency_matrix(self._all)
+
+    # --- per-config stats ---
+
+    def compute_stats(
+        self,
+        config: Sequence[Region],
+        colocated: bool = False,
+        fault_levels: Tuple[int, ...] = (1, 2),
+    ) -> Dict[str, Histogram]:
+        """{'a_f1': Histogram, ...} for atlas/fpaxos at each fault level and
+        epaxos (minority), clients at input regions or colocated
+        (search.rs:262-376 analog)."""
+        n = len(config)
+        clients = list(config) if colocated else self._clients
+        suffix = COLOCATED if colocated else ""
+        sidx = np.array([self._index[r] for r in config])
+        cidx = np.array([self._index[r] for r in clients])
+        # server-to-server distances sorted per row: quorum latencies
+        ss = np.sort(self._matrix[np.ix_(sidx, sidx)], axis=1)  # [n, n]
+        # client -> closest server (0 if colocated)
+        cs = self._matrix[np.ix_(cidx, sidx)]  # [clients, n]
+        closest_srv = np.argmin(cs, axis=1)
+        to_closest = cs[np.arange(len(cidx)), closest_srv]
+
+        out: Dict[str, Histogram] = {}
+
+        def add(name: str, per_client: np.ndarray) -> None:
+            hist = Histogram()
+            for v in per_client.tolist():
+                hist.increment(int(v))
+            out[name + suffix] = hist
+
+        for f in fault_levels:
+            if f > minority(n):
+                continue
+            q_atlas = quorum_size("atlas", n, f)
+            add("a_f%d" % f, to_closest + ss[closest_srv, q_atlas - 1])
+            q_fp = quorum_size("fpaxos", n, f)
+            # fpaxos: best leader placement for these clients
+            best = None
+            for leader_pos in range(n):
+                leader_to_q = ss[leader_pos, q_fp - 1]
+                lat = self._matrix[np.ix_(cidx, sidx[leader_pos : leader_pos + 1])][
+                    :, 0
+                ] + leader_to_q
+                mean = lat.mean()
+                if best is None or mean < best[0]:
+                    best = (mean, lat)
+            assert best is not None
+            add("f_f%d" % f, best[1])
+        q_ep = quorum_size("epaxos", n, minority(n))
+        add("e", to_closest + ss[closest_srv, q_ep - 1])
+        return out
+
+    # --- ranked search ---
+
+    def sorted_configs(
+        self,
+        n: int,
+        params: RankingParams = RankingParams(),
+        colocated: bool = False,
+        top: int = 10,
+    ) -> List[ConfigScore]:
+        """All n-combinations of the candidate servers, scored by the summed
+        mean-latency decrease of Atlas vs the FPaxos and EPaxos baselines
+        across ``params.fault_levels`` (search.rs:97-178 ranking); configs
+        failing a minimum-decrease threshold at any level are dropped."""
+        scored: List[ConfigScore] = []
+        for combo in itertools.combinations(self._servers, n):
+            stats = self.compute_stats(
+                combo, colocated=colocated, fault_levels=params.fault_levels
+            )
+            suffix = COLOCATED if colocated else ""
+            score = 0.0
+            ok = True
+            for f in params.fault_levels:
+                if f > minority(n):
+                    continue
+                a = stats.get(f"a_f{f}{suffix}")
+                fp = stats.get(f"f_f{f}{suffix}")
+                ep = stats.get(f"e{suffix}")
+                assert a is not None and fp is not None and ep is not None
+                dec_fp = fp.mean() - a.mean()
+                dec_ep = ep.mean() - a.mean()
+                if dec_fp < params.min_mean_decrease_vs_fpaxos:
+                    ok = False
+                    break
+                if dec_ep < params.min_mean_decrease_vs_epaxos:
+                    ok = False
+                    break
+                score += dec_fp + dec_ep
+            if ok:
+                scored.append(ConfigScore(tuple(combo), score, stats))
+        scored.sort(key=lambda c: -c.score)
+        return scored[:top]

@@ -21,7 +21,7 @@ from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
 from fantoch_tpu.core.timing import SysTime
-from fantoch_tpu.executor.graph.executor import GraphAdd, GraphExecutor
+from fantoch_tpu.executor.graph.executor import GraphAdd, GraphAddBatch, GraphExecutor
 from fantoch_tpu.protocol.base import (
     Action,
     BaseProcess,
@@ -48,6 +48,57 @@ from fantoch_tpu.run.routing import worker_dot_index_shift
 
 
 # --- messages (epaxos.rs:675-702 / atlas.rs:836-871) ---
+
+
+class _CommitBuffer:
+    """Array columns of committed commands, appended at commit time and
+    flushed as one GraphAddBatch per executor drain."""
+
+    __slots__ = ("shard_id", "src", "seq", "key", "deps", "cmds", "count")
+
+    def __init__(self, shard_id: ShardId):
+        self.shard_id = shard_id
+        self.src: list = []
+        self.seq: list = []
+        self.key: list = []
+        self.deps: list = []  # per-command tuple of packed dep dots
+        self.cmds: list = []
+        self.count = 0
+
+    def append(self, dot: Dot, cmd: Command, deps) -> None:
+        from fantoch_tpu.executor.graph.batched import key_hash
+
+        self.src.append(dot.source)
+        self.seq.append(dot.sequence)
+        if cmd.key_count(self.shard_id) == 1:
+            self.key.append(key_hash(next(iter(cmd.keys(self.shard_id)))))
+        else:
+            self.key.append(-1)
+        self.deps.append(
+            tuple(
+                (d.dot.source << 32) | d.dot.sequence for d in deps if d.dot != dot
+            )
+        )
+        self.cmds.append(cmd)
+        self.count += 1
+
+    def flush(self) -> GraphAddBatch:
+        import numpy as np
+
+        width = max((len(d) for d in self.deps), default=1) or 1
+        dep_dots = np.full((self.count, width), -1, dtype=np.int64)
+        for i, d in enumerate(self.deps):
+            dep_dots[i, : len(d)] = d
+        out = GraphAddBatch(
+            np.array(self.src, dtype=np.int64),
+            np.array(self.seq, dtype=np.int64),
+            np.array(self.key, dtype=np.int32),
+            dep_dots,
+            self.cmds,
+        )
+        self.src, self.seq, self.key, self.deps, self.cmds = [], [], [], [], []
+        self.count = 0
+        return out
 
 
 @dataclass
@@ -171,6 +222,13 @@ class GraphProtocol(CommitGCMixin, Protocol):
         # commit notifications that arrived before the MCollect (possible
         # even without failures, due to connection multiplexing)
         self._buffered_commits: Dict[Dot, Tuple[ProcessId, ConsensusValue]] = {}
+        # single-shard commits cross the executor boundary as arrays built
+        # incrementally here at commit time (GraphAddBatch — VERDICT r2
+        # item 2); multi-shard keeps per-command GraphAdd because remote
+        # Dependency shard sets must survive the crossing
+        self._commit_buffer = (
+            _CommitBuffer(shard_id) if config.shard_count == 1 else None
+        )
 
     def periodic_events(self):
         return self.gc_periodic_events()
@@ -212,6 +270,8 @@ class GraphProtocol(CommitGCMixin, Protocol):
         return self._to_processes.popleft() if self._to_processes else None
 
     def to_executors(self):
+        if self._commit_buffer is not None and self._commit_buffer.count:
+            return self._commit_buffer.flush()
         return self._to_executors.popleft() if self._to_executors else None
 
     @classmethod
@@ -297,7 +357,10 @@ class GraphProtocol(CommitGCMixin, Protocol):
         assert not value.is_noop, "handling noops is not implemented yet"
         cmd = info.cmd
         assert cmd is not None, "there should be a command payload"
-        self._to_executors.append(GraphAdd(dot, cmd, set(value.deps)))
+        if self._commit_buffer is not None:
+            self._commit_buffer.append(dot, cmd, value.deps)
+        else:
+            self._to_executors.append(GraphAdd(dot, cmd, set(value.deps)))
         info.status = Status.COMMIT
         out = info.synod.handle(from_, MChosen(value))
         assert out is None

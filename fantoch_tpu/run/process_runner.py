@@ -57,9 +57,11 @@ Address = Tuple[str, int]
 
 def executor_index(info: Any, size: int) -> Optional[int]:
     """Executor routing: by key hash when the info names a key
-    (fantoch/src/executor/mod.rs:161-166), else executor 0."""
+    (fantoch/src/executor/mod.rs:161-166), else executor 0.  A ``key``
+    attribute that is not a string (GraphAddBatch carries the whole key
+    *array*) is not a routing key — batches go to the main executor."""
     key = getattr(info, "key", None)
-    if key is not None:
+    if isinstance(key, str):
         return key_hash(key) % size
     return 0
 

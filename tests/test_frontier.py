@@ -1,0 +1,73 @@
+"""DeviceFrontier vs AEClock: the vectorized executed-set mirror must agree
+with the host lattice on membership, watermark advancement and counts
+(fantoch_tpu/ops/frontier.py vs core/clocks.py AboveExSet/AEClock)."""
+
+import random
+
+import numpy as np
+
+from fantoch_tpu.core.clocks import AEClock
+from fantoch_tpu.ops.frontier import DeviceFrontier
+
+
+def test_scalar_parity_random():
+    rng = random.Random(3)
+    ids = [1, 2, 3, 4, 5]
+    for _ in range(20):
+        fr = DeviceFrontier(ids)
+        ae: AEClock = AEClock(ids)
+        events = [(rng.choice(ids), rng.randint(1, 40)) for _ in range(200)]
+        for s, q in events:
+            assert fr.add(s, q) == ae.add(s, q)
+        for s in ids:
+            for q in range(1, 45):
+                assert fr.contains(s, q) == ae.contains(s, q), (s, q)
+            assert fr.frontier_of(s) == ae.get(s).frontier
+        assert fr.event_count() == ae.event_count()
+
+
+def test_batch_parity_random():
+    rng = np.random.default_rng(9)
+    ids = [1, 2, 3]
+    fr = DeviceFrontier(ids)
+    ae: AEClock = AEClock(ids)
+    for _ in range(10):
+        src = rng.integers(1, 4, size=64)
+        seq = rng.integers(1, 200, size=64)
+        fr.add_batch(src, seq)
+        for s, q in zip(src, seq):
+            ae.add(int(s), int(q))
+        qs_src = rng.integers(1, 4, size=128)
+        qs_seq = rng.integers(1, 220, size=128)
+        got = fr.contains_batch(qs_src, qs_seq)
+        want = np.array(
+            [ae.contains(int(s), int(q)) for s, q in zip(qs_src, qs_seq)]
+        )
+        assert (got == want).all()
+
+
+def test_watermark_absorbs_contiguous():
+    fr = DeviceFrontier([1])
+    fr.add_batch(np.array([1, 1, 1]), np.array([2, 3, 5]))
+    assert fr.frontier_of(1) == 0  # 1 missing
+    fr.add(1, 1)
+    assert fr.frontier_of(1) == 3  # 1,2,3 contiguous; 5 stays an exception
+    assert fr.contains(1, 5) and not fr.contains(1, 4)
+    assert len(fr.exceptions()) == 1
+    fr.add(1, 4)
+    assert fr.frontier_of(1) == 5
+    assert len(fr.exceptions()) == 0
+
+
+def test_unknown_source_grows():
+    fr = DeviceFrontier([1])
+    assert not fr.contains(9, 1)
+    fr.add(9, 1)
+    assert fr.contains(9, 1) and fr.frontier_of(9) == 1
+
+
+def test_add_range():
+    fr = DeviceFrontier([1, 2])
+    fr.add_range(2, 1, 1000)
+    assert fr.frontier_of(2) == 1000
+    assert fr.contains(2, 1000) and not fr.contains(2, 1001)

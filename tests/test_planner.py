@@ -1,0 +1,110 @@
+"""Planner (bote analog): quorum latency arithmetic and config search
+(fantoch_bote/src/{lib,protocol,search}.rs behavior)."""
+
+from fantoch_tpu.core.planet import Planet, Region
+from fantoch_tpu.planner import Bote, RankingParams, Search, quorum_size
+
+
+def synthetic_planet():
+    """Four regions on a line: A - 10 - B - 10 - C - 10 - D (additive)."""
+    a, b, c, d = Region("A"), Region("B"), Region("C"), Region("D")
+    pos = {a: 0, b: 10, c: 20, d: 30}
+    lat = {
+        x: {y: abs(pos[x] - pos[y]) for y in pos}
+        for x in pos
+    }
+    return Planet.from_latencies(lat), (a, b, c, d)
+
+
+def test_quorum_sizes():
+    # protocol.rs:20-35
+    assert quorum_size("fpaxos", 5, 1) == 2
+    assert quorum_size("fpaxos", 5, 2) == 3
+    assert quorum_size("atlas", 5, 1) == 3
+    assert quorum_size("atlas", 5, 2) == 4
+    assert quorum_size("epaxos", 5, 0) == 3  # f = minority = 2; 2 + 3//2
+    assert quorum_size("epaxos", 7, 0) == 5  # f = 3; 3 + 2
+
+
+def test_nth_closest_counts_self():
+    planet, (a, b, c, d) = synthetic_planet()
+    bote = Bote(planet)
+    servers = [a, b, c]
+    # closest to A among servers is A itself at 0
+    assert bote.nth_closest(1, a, servers) == (0, a)
+    assert bote.nth_closest(2, a, servers) == (10, b)
+    # quorum of 2 from A = distance to B
+    assert bote.quorum_latency(a, servers, 2) == 10
+    assert bote.quorum_latency(b, servers, 3) == 10  # B,A/C at 10
+
+
+def test_leaderless_latency():
+    planet, (a, b, c, d) = synthetic_planet()
+    bote = Bote(planet)
+    servers = [a, b, c]
+    got = dict(bote.leaderless(servers, [a, d], quorum_size=2))
+    # client A: closest server A (0) + quorum2 from A (10) = 10
+    assert got[a] == 10
+    # client D: closest server C (10) + quorum2 from C (10) = 20
+    assert got[d] == 20
+
+
+def test_leader_and_best_leader():
+    planet, (a, b, c, d) = synthetic_planet()
+    bote = Bote(planet)
+    servers = [a, b, c]
+    clients = [a, c, d]
+    got = dict(bote.leader(b, servers, clients, quorum_size=2))
+    # leader B -> quorum2 = 10; clients at 10/10/20 from B
+    assert got == {a: 20, c: 20, d: 30}
+    leader, hist = bote.best_leader(servers, clients, quorum_size=2)
+    # C minimizes mean: clients 20/0/10 + quorum 10 => mean 20 vs B 23.3, A 30
+    assert leader == c
+    assert hist.mean() == (30 + 10 + 20) / 3
+
+
+def test_search_stats_and_ranking():
+    planet, (a, b, c, d) = synthetic_planet()
+    search = Search(planet, [a, b, c, d])
+    stats = search.compute_stats([a, b, c])
+    # atlas n=3 f=1: quorum 2; per client (a,b,c,d): 10, 10, 10, 10+10
+    assert stats["a_f1"].mean() == (10 + 10 + 10 + 20) / 4
+    assert "f_f1" in stats and "e" in stats
+    # with no thresholds every 3-config is scored; best must be returned
+    ranked = search.sorted_configs(
+        3, RankingParams(min_mean_decrease_vs_fpaxos=-1000,
+                         min_mean_decrease_vs_epaxos=-1000,
+                         fault_levels=(1,)),
+    )
+    assert ranked and len(ranked) <= 10
+    assert ranked[0].score >= ranked[-1].score
+    # colocated placement drops the client->closest leg
+    colo = search.compute_stats([a, b, c], colocated=True)
+    assert colo["a_f1C"].mean() == 10.0
+
+
+def test_search_thresholds_filter():
+    planet, (a, b, c, d) = synthetic_planet()
+    search = Search(planet, [a, b, c, d])
+    # impossible threshold: atlas can't beat fpaxos by 10s on this planet
+    ranked = search.sorted_configs(
+        3, RankingParams(min_mean_decrease_vs_fpaxos=10_000, fault_levels=(1,))
+    )
+    assert ranked == []
+
+
+def test_search_on_real_planet():
+    planet = Planet.new("gcp")
+    regions = sorted(planet.regions())[:8]
+    search = Search(planet, regions)
+    ranked = search.sorted_configs(
+        3,
+        RankingParams(min_mean_decrease_vs_fpaxos=-10_000,
+                      min_mean_decrease_vs_epaxos=-10_000,
+                      fault_levels=(1,)),
+        top=5,
+    )
+    assert len(ranked) == 5
+    for cfg in ranked:
+        assert len(cfg.regions) == 3
+        assert set(cfg.stats) >= {"a_f1", "f_f1", "e"}
