@@ -44,6 +44,12 @@ from fantoch_tpu.protocol.common.synod import (
 )
 from fantoch_tpu.protocol.gc import GCTrack
 from fantoch_tpu.protocol.info import CommandsInfo
+from fantoch_tpu.protocol.partial import (
+    MForwardSubmit,
+    MShardAggregatedCommit,
+    MShardCommit,
+    PartialCommitMixin,
+)
 from fantoch_tpu.run.routing import worker_dot_index_shift
 
 
@@ -172,7 +178,7 @@ class GraphCommandInfo:
         self.quorum_deps = QuorumDeps(quorum_deps_size)
 
 
-class GraphProtocol(CommitGCMixin, Protocol):
+class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
     """Common skeleton; see module docstring for the specialization points."""
 
     Executor = GraphExecutor
@@ -229,6 +235,7 @@ class GraphProtocol(CommitGCMixin, Protocol):
         self._commit_buffer = (
             _CommitBuffer(shard_id) if config.shard_count == 1 else None
         )
+        self._init_partial()
 
     def periodic_events(self):
         return self.gc_periodic_events()
@@ -245,8 +252,19 @@ class GraphProtocol(CommitGCMixin, Protocol):
         connect_ok = self.bp.discover(processes)
         return connect_ok, dict(self.bp.closest_shard_process())
 
+    @classmethod
+    def supports_partial_replication(cls) -> bool:
+        """EPaxos does not support partial replication (mirroring the
+        reference: no partial messages in fantoch_ps/src/protocol/epaxos.rs);
+        Atlas does (atlas.rs:157-165)."""
+        return False
+
     def submit(self, dot: Optional[Dot], cmd: Command, time: SysTime) -> None:
-        self._handle_submit(dot, cmd)
+        if cmd.shard_count > 1:
+            assert self.supports_partial_replication(), (
+                f"{type(self).__name__} does not support multi-shard commands"
+            )
+        self._handle_submit(dot, cmd, target_shard=True)
 
     def handle(self, from_, from_shard_id, msg, time):
         if isinstance(msg, MCollect):
@@ -259,6 +277,18 @@ class GraphProtocol(CommitGCMixin, Protocol):
             self._handle_mconsensus(from_, msg.dot, msg.ballot, msg.value)
         elif isinstance(msg, MConsensusAck):
             self._handle_mconsensusack(from_, msg.dot, msg.ballot)
+        elif isinstance(msg, MForwardSubmit):
+            self._handle_submit(msg.dot, msg.cmd, target_shard=False)
+        elif isinstance(msg, MShardCommit):
+            info = self._cmds.get(msg.dot)
+            assert info.cmd is not None, (
+                "the dot owner submits before any shard can commit"
+            )
+            self.partial_handle_mshard_commit(
+                from_, msg.dot, msg.data, info.cmd.shard_count
+            )
+        elif isinstance(msg, MShardAggregatedCommit):
+            self.partial_handle_mshard_aggregated_commit(msg.dot, msg.data)
         elif not self.handle_gc_message(from_, msg):
             raise AssertionError(f"unknown message {msg}")
 
@@ -287,8 +317,13 @@ class GraphProtocol(CommitGCMixin, Protocol):
 
     # --- handlers ---
 
-    def _handle_submit(self, dot: Optional[Dot], cmd: Command) -> None:
+    def _handle_submit(
+        self, dot: Optional[Dot], cmd: Command, target_shard: bool
+    ) -> None:
         dot = dot if dot is not None else self.bp.next_dot()
+        # forward the submit to the other shards the command touches
+        # (no-op for single-shard commands / forwarded submits)
+        self.partial_submit_actions(dot, cmd, target_shard)
         deps = self.key_deps.add_cmd(dot, cmd, None)
         mcollect = MCollect(dot, cmd, deps, self.bp.fast_quorum())
         self._to_processes.append(ToSend(self.bp.all(), mcollect))
@@ -338,7 +373,7 @@ class GraphProtocol(CommitGCMixin, Protocol):
         value = ConsensusValue(final_deps)
         if fast_path:
             self.bp.fast_path()
-            self._to_processes.append(ToSend(self.bp.all(), MCommit(dot, value)))
+            self._mcommit_actions(dot, value)
         else:
             self.bp.slow_path()
             ballot = info.synod.skip_prepare()
@@ -375,12 +410,16 @@ class GraphProtocol(CommitGCMixin, Protocol):
         if out is None:
             return  # ballot too low
         if isinstance(out, SynodMAccepted):
-            msg = MConsensusAck(dot, out.ballot)
+            self._to_processes.append(ToSend({from_}, MConsensusAck(dot, out.ballot)))
         elif isinstance(out, MChosen):
-            msg = MCommit(dot, out.value)
+            # already chosen here (late MConsensus): replying the *local*
+            # value is only sound single-shard — a multi-shard MCommit must
+            # carry the cross-shard aggregate, which travels through
+            # MShardAggregatedCommit (the coordinator's ack path)
+            if info.cmd is None or info.cmd.shard_count == 1:
+                self._to_processes.append(ToSend({from_}, MCommit(dot, out.value)))
         else:
             raise AssertionError(f"unexpected synod output {out}")
-        self._to_processes.append(ToSend({from_}, msg))
 
     def _handle_mconsensusack(self, from_, dot, ballot) -> None:
         info = self._cmds.get(dot)
@@ -388,7 +427,26 @@ class GraphProtocol(CommitGCMixin, Protocol):
         if out is None:
             return
         assert isinstance(out, MChosen), f"unexpected synod output {out}"
-        self._to_processes.append(ToSend(self.bp.all(), MCommit(dot, out.value)))
+        self._mcommit_actions(dot, out.value)
+
+    def _mcommit_actions(self, dot: Dot, value: ConsensusValue) -> None:
+        """Single-shard: broadcast MCommit.  Multi-shard: route the decided
+        deps through the shard-commit aggregation (partial.rs:37-102)."""
+        info = self._cmds.get(dot)
+        cmd = info.cmd
+        if cmd is None or not self.partial_mcommit_actions(dot, cmd, set(value.deps)):
+            self._to_processes.append(ToSend(self.bp.all(), MCommit(dot, value)))
+
+    # --- partial-replication adapters (deps union; atlas.rs:559-650) ---
+
+    def _partial_initial_data(self):
+        return set()
+
+    def _partial_join(self, acc, data):
+        return acc | set(data)
+
+    def _partial_final_mcommit(self, dot: Dot, data):
+        return MCommit(dot, ConsensusValue(set(data)))
 
     def _dot_in_my_shard(self, dot: Dot) -> bool:
         return dot.target_shard(self.bp.config.n) == self.bp.shard_id
@@ -397,7 +455,19 @@ class GraphProtocol(CommitGCMixin, Protocol):
 
     @staticmethod
     def message_index(msg):
-        if isinstance(msg, (MCollect, MCollectAck, MCommit, MConsensus, MConsensusAck)):
+        if isinstance(
+            msg,
+            (
+                MCollect,
+                MCollectAck,
+                MCommit,
+                MConsensus,
+                MConsensusAck,
+                MForwardSubmit,
+                MShardCommit,
+                MShardAggregatedCommit,
+            ),
+        ):
             return worker_dot_index_shift(msg.dot)
         gc_index = CommitGCMixin.gc_message_index(msg)
         if gc_index is not None:
@@ -433,7 +503,13 @@ class EPaxos(GraphProtocol):
 
 class Atlas(GraphProtocol):
     """Atlas: fast quorum n//2 + f; fast path via threshold union — every
-    dependency reported at least f times (atlas.rs:28-1143)."""
+    dependency reported at least f times (atlas.rs:28-1143).  Supports
+    partial replication (MForwardSubmit / MShardCommit /
+    MShardAggregatedCommit, atlas.rs:157-165)."""
+
+    @classmethod
+    def supports_partial_replication(cls) -> bool:
+        return True
 
     @classmethod
     def quorum_sizes(cls, config: Config) -> Tuple[int, int]:

@@ -19,8 +19,11 @@ Extras mirrored here:
   under low load (newt.rs:983-1006);
 - detached-vote batching via the periodic ``SendDetached`` event.
 
-Multi-shard commands (MForwardSubmit/MBump/MShardCommit, partial
-replication) are wired through fantoch_tpu.protocol.partial.
+Partial replication: NOT yet wired for Newt — the reference's Newt partial
+path (MBump key-clock priming + clock-max MShardCommit aggregation,
+newt.rs:1025-1100) differs from the deps-union aggregation that
+fantoch_tpu.protocol.partial provides for Atlas; Newt submits assert
+single-shard commands until that clock-flavored aggregation lands.
 """
 
 from __future__ import annotations
@@ -279,7 +282,10 @@ class Newt(CommitGCMixin, Protocol):
 
     def _handle_submit(self, dot: Optional[Dot], cmd: Command) -> None:
         dot = dot if dot is not None else self.bp.next_dot()
-        assert cmd.shard_count == 1, "multi-shard commands arrive in the partial layer"
+        assert cmd.shard_count == 1, (
+            "Newt does not support multi-shard commands yet (the clock-max "
+            "shard aggregation of newt.rs:1025-1100 is not wired)"
+        )
         # propose: bump key clocks, consuming votes; those votes are either
         # shipped in the MCollect (skip_fast_ack: quorum members can commit
         # without the ack round) or kept for the MCollectAck aggregation

@@ -1,138 +1,159 @@
 """Partial-replication (multi-shard) commit glue.
 
-Reference: fantoch_ps/src/protocol/partial.rs.  A multi-shard command runs
-the protocol *independently in each shard it touches*; commits are then
-aggregated: every shard sends an MShardCommit to the dot owner (the process
-in the client's target shard), which replies MShardAggregatedCommit with
-the joined data once all shards reported, and each shard then broadcasts
-the final MCommit internally.  Used by Atlas (deps union) and Newt (max
-clock + votes); EPaxos does not support partial replication.
+Reference: fantoch_ps/src/protocol/partial.rs:8-246.  A multi-shard command
+runs the protocol *independently in each shard it touches* under the same
+dot; commits are then aggregated:
+
+  1. the shard the client targeted forwards the submit to the closest
+     process of every other shard the command touches (MForwardSubmit);
+  2. when a shard's instance decides (fast or slow path), instead of
+     broadcasting MCommit it sends its decided data to the dot owner (the
+     coordinator process in the target shard) as MShardCommit;
+  3. the owner aggregates one MShardCommit per shard; once all shards
+     reported it answers every participant with MShardAggregatedCommit;
+  4. each participant then broadcasts the final MCommit *within its own
+     shard* (BaseProcess.all() is shard-local).
+
+``PartialCommitMixin`` owns the per-dot aggregation state and exposes the
+four hooks; the protocol supplies three small adapters describing what its
+commit data looks like (join for the aggregate, message constructors).
+Used by Atlas (deps union); EPaxos does not support partial replication
+(mirroring the reference, fantoch_ps/src/protocol/epaxos.rs).
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Generic, Optional, Set, TypeVar
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Set
 
 from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.ids import Dot, ProcessId
-from fantoch_tpu.protocol.base import BaseProcess, ToSend
-
-I = TypeVar("I")
+from fantoch_tpu.protocol.base import ToSend
 
 
-class ShardsCommits(Generic[I]):
+@dataclass
+class MForwardSubmit:
+    """Submit forwarded to the closest process of a non-target shard."""
+
+    dot: Dot
+    cmd: Command
+
+
+@dataclass
+class MShardCommit:
+    """One shard's decided data, sent to the dot owner for aggregation."""
+
+    dot: Dot
+    data: Any
+
+
+@dataclass
+class MShardAggregatedCommit:
+    """The joined decision, sent back to every participant shard."""
+
+    dot: Dot
+    data: Any
+
+
+class ShardsCommits:
     """Aggregation of one commit notification per shard (partial.rs:206-246)."""
 
-    __slots__ = ("process_id", "shard_count", "participants", "info")
+    __slots__ = ("shard_count", "participants", "data")
 
-    def __init__(self, process_id: ProcessId, shard_count: int, info: I):
-        self.process_id = process_id
+    def __init__(self, shard_count: int, initial: Any):
         self.shard_count = shard_count
         self.participants: Set[ProcessId] = set()
-        self.info = info
+        self.data = initial
 
-    def add(self, from_: ProcessId, add: Callable[[I], None]) -> bool:
-        assert from_ not in self.participants
+    def add(self, from_: ProcessId, data: Any, join: Callable[[Any, Any], Any]) -> bool:
+        assert from_ not in self.participants, (
+            f"duplicate MShardCommit from {from_}"
+        )
         self.participants.add(from_)
-        add(self.info)
+        self.data = join(self.data, data)
         return len(self.participants) == self.shard_count
 
-    def update(self, update: Callable[[I], None]) -> None:
-        update(self.info)
 
+class PartialCommitMixin:
+    """Protocol mixin owning the multi-shard commit aggregation.
 
-def submit_actions(
-    bp: BaseProcess,
-    dot: Dot,
-    cmd: Command,
-    target_shard: bool,
-    create_mforward_submit,
-    to_processes,
-) -> None:
-    """Forward the submit to the closest process of every other shard the
-    command touches — only from the shard the client targeted
-    (partial.rs:8-35)."""
-    if not target_shard:
-        return
-    for shard_id in cmd.shards():
-        if shard_id != bp.shard_id:
-            to_processes.append(
-                ToSend({bp.closest_process(shard_id)}, create_mforward_submit(dot, cmd))
+    Requirements on the host protocol class:
+      * ``self.bp`` — a BaseProcess (shard-local all(), closest_process);
+      * ``self._to_processes`` — the action deque;
+      * ``_partial_initial_data()`` — bottom element of the commit-data
+        join (e.g. an empty Dependency set for Atlas);
+      * ``_partial_join(acc, data)`` — commutative join of per-shard data
+        (deps union for Atlas; max clock for a timestamp protocol);
+      * ``_partial_final_mcommit(dot, data)`` — the protocol's MCommit
+        message carrying the aggregated data.
+    """
+
+    _shards_commits: Dict[Dot, ShardsCommits]
+
+    def _init_partial(self) -> None:
+        self._shards_commits = {}
+
+    # --- hook 1: submit-side forwarding (partial.rs:8-35) ---
+
+    def partial_submit_actions(self, dot: Dot, cmd: Command, target_shard: bool) -> None:
+        if not target_shard:
+            return
+        for shard_id in cmd.shards():
+            if shard_id != self.bp.shard_id:
+                self._to_processes.append(
+                    ToSend(
+                        {self.bp.closest_process(shard_id)},
+                        MForwardSubmit(dot, cmd),
+                    )
+                )
+
+    # --- hook 2: at a shard's commit decision (partial.rs:37-102) ---
+
+    def partial_mcommit_actions(self, dot: Dot, cmd: Command, data: Any) -> bool:
+        """Returns True if the commit was routed through shard aggregation
+        (multi-shard); False means the caller should broadcast its own
+        MCommit (single-shard command)."""
+        shard_count = cmd.shard_count
+        if shard_count == 1:
+            return False
+        # our own data flows through the MShardCommit to the owner (which
+        # may be ourselves — self-delivery) and comes back aggregated
+        self._to_processes.append(ToSend({dot.source}, MShardCommit(dot, data)))
+        return True
+
+    # --- hook 3: at the dot owner (partial.rs:104-142) ---
+
+    def partial_handle_mshard_commit(
+        self, from_: ProcessId, dot: Dot, data: Any, shard_count: int
+    ) -> None:
+        agg = self._shards_commits.get(dot)
+        if agg is None:
+            agg = ShardsCommits(shard_count, self._partial_initial_data())
+            self._shards_commits[dot] = agg
+        done = agg.add(from_, data, self._partial_join)
+        if done:
+            self._to_processes.append(
+                ToSend(
+                    set(agg.participants),
+                    MShardAggregatedCommit(dot, agg.data),
+                )
             )
+            del self._shards_commits[dot]
 
+    # --- hook 4: back at each participant (partial.rs:144-177) ---
 
-def mcommit_actions(
-    bp: BaseProcess,
-    get_shards_commits: Callable[[], Optional[ShardsCommits]],
-    set_shards_commits: Callable[[ShardsCommits], None],
-    info_factory: Callable[[], I],
-    shard_count: int,
-    dot: Dot,
-    data1,
-    data2,
-    create_mcommit,
-    create_mshard_commit,
-    update_shards_commits_info: Callable[[I, object], None],
-    to_processes,
-) -> None:
-    """Single shard: broadcast the MCommit.  Multi-shard: record our own
-    data and send an MShardCommit to the dot owner (partial.rs:37-102)."""
-    if shard_count == 1:
-        to_processes.append(ToSend(bp.all(), create_mcommit(dot, data1, data2)))
-        return
-    shards_commits = _init(get_shards_commits, set_shards_commits, bp, shard_count, info_factory)
-    shards_commits.update(lambda info: update_shards_commits_info(info, data2))
-    to_processes.append(ToSend({dot.source}, create_mshard_commit(dot, data1)))
-
-
-def handle_mshard_commit(
-    bp: BaseProcess,
-    get_shards_commits: Callable[[], Optional[ShardsCommits]],
-    set_shards_commits: Callable[[ShardsCommits], None],
-    info_factory: Callable[[], I],
-    shard_count: int,
-    from_: ProcessId,
-    dot: Dot,
-    data,
-    add_shards_commits_info: Callable[[I, object], None],
-    create_mshard_aggregated_commit,
-    to_processes,
-) -> None:
-    """At the dot owner: aggregate per-shard commits; once all shards
-    reported, answer every participant (partial.rs:104-142)."""
-    shards_commits = _init(get_shards_commits, set_shards_commits, bp, shard_count, info_factory)
-    done = shards_commits.add(from_, lambda info: add_shards_commits_info(info, data))
-    if done:
-        to_processes.append(
-            ToSend(
-                set(shards_commits.participants),
-                create_mshard_aggregated_commit(dot, shards_commits.info),
-            )
+    def partial_handle_mshard_aggregated_commit(self, dot: Dot, data: Any) -> None:
+        self._to_processes.append(
+            ToSend(self.bp.all(), self._partial_final_mcommit(dot, data))
         )
 
+    # --- adapters the protocol must provide ---
 
-def handle_mshard_aggregated_commit(
-    bp: BaseProcess,
-    take_shards_commits: Callable[[], Optional[ShardsCommits]],
-    dot: Dot,
-    data1,
-    extract_mcommit_extra_data,
-    create_mcommit,
-    to_processes,
-) -> None:
-    """Back at each participant: broadcast the final MCommit within the
-    shard (partial.rs:144-177)."""
-    shards_commits = take_shards_commits()
-    assert shards_commits is not None, (
-        f"no shards commit info when handling MShardAggregatedCommit for {dot}"
-    )
-    data2 = extract_mcommit_extra_data(shards_commits.info)
-    to_processes.append(ToSend(bp.all(), create_mcommit(dot, data1, data2)))
+    def _partial_initial_data(self) -> Any:
+        raise NotImplementedError
 
+    def _partial_join(self, acc: Any, data: Any) -> Any:
+        raise NotImplementedError
 
-def _init(get, set_, bp: BaseProcess, shard_count: int, info_factory) -> ShardsCommits:
-    shards_commits = get()
-    if shards_commits is None:
-        shards_commits = ShardsCommits(bp.process_id, shard_count, info_factory())
-        set_(shards_commits)
-    return shards_commits
+    def _partial_final_mcommit(self, dot: Dot, data: Any):
+        raise NotImplementedError

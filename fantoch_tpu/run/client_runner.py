@@ -1,10 +1,16 @@
 """Client side of the real runner: closed- and open-loop drivers.
 
 Reference: fantoch/src/run/mod.rs:448-832.  A client task pool shares one
-TCP connection per shard; a demux task routes CommandResults back to the
-issuing client by rifl source.  Closed-loop clients keep one outstanding
-command; open-loop clients submit on a fixed interval regardless of
-completions (mod.rs:526-664).
+TCP connection per shard; a demux task per connection routes CommandResults
+back to the issuing client by rifl source.  Closed-loop clients keep one
+outstanding command; open-loop clients submit on a fixed interval
+regardless of completions (mod.rs:526-664).
+
+Multi-shard commands: the client Submits to the target shard and Registers
+the command with every other shard it touches (mod.rs:757-764); each shard
+executes its part and returns one CommandResult, aggregated client-side —
+the ShardsPending role of mod.rs:859-917 is played by the per-command
+``needed`` counter in the drivers below.
 """
 
 from __future__ import annotations
@@ -14,9 +20,10 @@ from typing import Dict, List, Optional, Tuple
 
 from fantoch_tpu.client.client import Client
 from fantoch_tpu.client.workload import Workload
+from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.ids import ClientId, ShardId
 from fantoch_tpu.core.timing import RunTime
-from fantoch_tpu.run.prelude import ClientHi, Submit, ToClient
+from fantoch_tpu.run.prelude import ClientHi, Register, Submit, ToClient
 from fantoch_tpu.run.rw import Rw
 
 Address = Tuple[str, int]
@@ -31,11 +38,12 @@ async def run_clients(
 ) -> Dict[ClientId, Client]:
     """Drive `client_ids` against the cluster; returns the finished clients
     (latency data inside)."""
-    assert len(shard_addresses) == 1, "multi-shard clients arrive with the partial layer"
-    (shard_id, addr), = shard_addresses.items()
-    reader, writer = await asyncio.open_connection(*addr)
-    rw = Rw(reader, writer)
-    await rw.send(ClientHi(list(client_ids)))
+    rws: Dict[ShardId, Rw] = {}
+    for shard_id, addr in sorted(shard_addresses.items()):
+        reader, writer = await asyncio.open_connection(*addr)
+        rw = Rw(reader, writer)
+        await rw.send(ClientHi(list(client_ids)))
+        rws[shard_id] = rw
 
     time = RunTime()
     clients = {
@@ -43,15 +51,15 @@ async def run_clients(
         for client_id in client_ids
     }
     for client in clients.values():
-        client.connect({shard_id: 0})
+        client.connect({shard_id: 0 for shard_id in rws})
 
     queues: Dict[ClientId, asyncio.Queue] = {cid: asyncio.Queue() for cid in client_ids}
 
-    # sentinel fanned out to every client queue when the demux dies (EOF or
+    # sentinel fanned out to every client queue when a demux dies (EOF or
     # error), so the wait loops below fail loudly instead of hanging
     eof_sentinel = object()
 
-    async def demux() -> None:
+    async def demux(rw: Rw) -> None:
         try:
             while True:
                 msg = await rw.recv()
@@ -63,49 +71,77 @@ async def run_clients(
             for queue in queues.values():
                 queue.put_nowait(eof_sentinel)
 
-    demux_task = asyncio.ensure_future(demux())
+    demux_tasks = [asyncio.ensure_future(demux(rw)) for rw in rws.values()]
 
-    async def closed_loop(client: Client) -> None:
-        while True:
-            nxt = client.next_cmd(time)
-            if nxt is None:
-                break
-            _shard, cmd = nxt
-            await rw.send(Submit(cmd))
+    async def submit(target_shard: ShardId, cmd: Command) -> int:
+        """Submit + per-shard registration; returns the number of
+        CommandResults to expect (one per shard touched).  All frames are
+        written first, then the touched connections flush concurrently —
+        no serialized per-shard round-trips on the submit path."""
+        touched = []
+        for shard_id in cmd.shards():
+            if shard_id != target_shard:
+                rws[shard_id].write(Register(cmd))
+                touched.append(rws[shard_id])
+        rws[target_shard].write(Submit(cmd))
+        touched.append(rws[target_shard])
+        await asyncio.gather(*(rw.flush() for rw in touched))
+        return cmd.shard_count
+
+    async def collect(client: Client, needed: int) -> list:
+        results = []
+        for _ in range(needed):
             cmd_result = await queues[client.id].get()
             if cmd_result is eof_sentinel:
                 raise ConnectionError(
                     f"client {client.id}: server connection closed with an "
                     "outstanding command"
                 )
-            client.handle([cmd_result], time)
+            results.append(cmd_result)
+        return results
+
+    async def closed_loop(client: Client) -> None:
+        while True:
+            nxt = client.next_cmd(time)
+            if nxt is None:
+                break
+            target_shard, cmd = nxt
+            needed = await submit(target_shard, cmd)
+            client.handle(await collect(client, needed), time)
 
     async def open_loop(client: Client) -> None:
         pending = 0
         eof = False
+        expect: Dict[object, int] = {}  # rifl -> results still to arrive
 
         async def collector() -> None:
             nonlocal pending, eof
+            buffered: Dict[object, list] = {}
             while True:
                 cmd_result = await queues[client.id].get()
                 if cmd_result is eof_sentinel:
                     eof = True
                     return
-                client.handle([cmd_result], time)
-                pending -= 1
+                rifl = cmd_result.rifl
+                buffered.setdefault(rifl, []).append(cmd_result)
+                if len(buffered[rifl]) == expect[rifl]:
+                    client.handle(buffered.pop(rifl), time)
+                    del expect[rifl]
+                    pending -= 1
 
-        collect = asyncio.ensure_future(collector())
+        collect_task = asyncio.ensure_future(collector())
         while True:
             nxt = client.next_cmd(time)
             if nxt is None:
                 break
-            _shard, cmd = nxt
-            await rw.send(Submit(cmd))
+            target_shard, cmd = nxt
+            expect[cmd.rifl] = cmd.shard_count
+            await submit(target_shard, cmd)
             pending += 1
             await asyncio.sleep(open_loop_interval_ms / 1000)
         while pending > 0 and not eof:
             await asyncio.sleep(0.01)
-        collect.cancel()
+        collect_task.cancel()
         if eof and pending > 0:
             raise ConnectionError(
                 f"client {client.id}: server connection closed with "
@@ -114,6 +150,8 @@ async def run_clients(
 
     driver = open_loop if open_loop_interval_ms is not None else closed_loop
     await asyncio.gather(*(driver(client) for client in clients.values()))
-    demux_task.cancel()
-    rw.close()
+    for task in demux_tasks:
+        task.cancel()
+    for rw in rws.values():
+        rw.close()
     return clients

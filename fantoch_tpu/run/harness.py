@@ -38,14 +38,27 @@ async def run_localhost_cluster(
     workers: int = 1,
     executors: int = 1,
 ) -> Tuple[Dict[ProcessId, ProcessRuntime], Dict[ClientId, Client]]:
-    """Boot n processes + clients, run the workload to completion, keep the
-    cluster alive `extra_run_time_ms` (for GC rounds), then tear down."""
-    shard_id = 0
-    ids = list(process_ids(shard_id, config.n))
-    peer_ports = {pid: free_port() for pid in ids}
-    client_ports = {pid: free_port() for pid in ids}
+    """Boot n*shard_count processes + clients, run the workload to
+    completion, keep the cluster alive `extra_run_time_ms` (for GC rounds),
+    then tear down.
+
+    Multi-shard topology (mod.rs:786-838 region-index pattern): shard s
+    owns ids s*n+1..=(s+1)*n; the process at offset o of shard s peers with
+    its own shard plus the offset-o process of every other shard (its
+    "closest" of that shard), mirroring the reference's
+    connect-to-closest-per-shard rule (run/task/process.rs:21)."""
+    shard_count = config.shard_count
+    shard_ids = {s: list(process_ids(s, config.n)) for s in range(shard_count)}
+    all_pids = [pid for ids in shard_ids.values() for pid in ids]
+    shard_of = {pid: s for s, ids in shard_ids.items() for pid in ids}
+    offset_of = {pid: pid - shard_ids[shard_of[pid]][0] for pid in all_pids}
+    peer_ports = {pid: free_port() for pid in all_pids}
+    client_ports = {pid: free_port() for pid in all_pids}
     runtimes: Dict[ProcessId, ProcessRuntime] = {}
-    for pid in ids:
+    for pid in all_pids:
+        shard_id = shard_of[pid]
+        ids = shard_ids[shard_id]
+        offset = offset_of[pid]
         # localhost processes are equidistant except to themselves: the
         # distance-sorted list must lead with self (ping 0), like the
         # reference's ping sort (run/task/ping.rs:144), or a process's fast
@@ -54,6 +67,12 @@ async def run_localhost_cluster(
         sorted_processes = [(pid, shard_id)] + [
             (peer, shard_id) for peer in ids if peer != pid
         ]
+        peers = {peer: ("127.0.0.1", peer_ports[peer]) for peer in ids if peer != pid}
+        for other_shard, other_ids in shard_ids.items():
+            if other_shard != shard_id:
+                closest = other_ids[offset]
+                sorted_processes.append((closest, other_shard))
+                peers[closest] = ("127.0.0.1", peer_ports[closest])
         runtimes[pid] = ProcessRuntime(
             protocol_cls,
             pid,
@@ -61,7 +80,7 @@ async def run_localhost_cluster(
             config,
             listen_addr=("127.0.0.1", peer_ports[pid]),
             client_addr=("127.0.0.1", client_ports[pid]),
-            peers={peer: ("127.0.0.1", peer_ports[peer]) for peer in ids if peer != pid},
+            peers=peers,
             sorted_processes=sorted_processes,
             workers=workers,
             executors=executors,
@@ -69,10 +88,11 @@ async def run_localhost_cluster(
 
     await asyncio.gather(*(runtime.start() for runtime in runtimes.values()))
 
-    # one client pool per process, connected to that process (mod.rs:1240-1290)
+    # one client pool per shard-0 process; each pool talks to the offset-o
+    # process of every shard (mod.rs:1240-1290)
     client_groups: List[Tuple[List[ClientId], ProcessId]] = []
     next_client = 1
-    for pid in ids:
+    for pid in shard_ids[0]:
         group = list(range(next_client, next_client + clients_per_process))
         next_client += clients_per_process
         client_groups.append((group, pid))
@@ -81,7 +101,10 @@ async def run_localhost_cluster(
         *(
             run_clients(
                 group,
-                {shard_id: ("127.0.0.1", client_ports[pid])},
+                {
+                    s: ("127.0.0.1", client_ports[shard_ids[s][offset_of[pid]]])
+                    for s in range(shard_count)
+                },
                 workload,
                 open_loop_interval_ms=open_loop_interval_ms,
             )

@@ -38,7 +38,11 @@ class ClientHi:
 
 @dataclass
 class Register:
-    pass
+    """Multi-shard registration: a client sends the command to every
+    non-target shard it touches so that shard's result aggregation knows
+    the rifl (fantoch/src/run/prelude.rs:52, mod.rs:757-764)."""
+
+    cmd: Any
 
 
 @dataclass

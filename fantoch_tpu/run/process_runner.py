@@ -41,6 +41,7 @@ from fantoch_tpu.executor.base import ExecutorResult
 from fantoch_tpu.protocol.base import Protocol, ToForward, ToSend
 from fantoch_tpu.run.prelude import (
     ClientHi,
+    POEExecutor,
     POEProtocol,
     ProcessHi,
     Register,
@@ -100,7 +101,11 @@ class _ClientSession:
             if msg is None:
                 break
             if isinstance(msg, Register):
-                continue  # multi-shard registration: handled in the partial layer
+                # non-target shard of a multi-shard command: start result
+                # aggregation for our part, but do not submit (the target
+                # shard's MForwardSubmit drives our protocol instance)
+                self.pending.wait_for(msg.cmd)
+                continue
             assert isinstance(msg, Submit)
             cmd = msg.cmd
             self.pending.wait_for(cmd)
@@ -150,6 +155,14 @@ class ProcessRuntime:
             workers = 1
         if not protocol_cls.Executor.parallel():
             executors = 1
+        # multi-shard graph executors answer peer-shard dependency requests
+        # on the secondary executor (executor.rs:242-262): fail fast here
+        # rather than hang when a GraphRequest cannot be routed
+        if config.shard_count > 1 and hasattr(protocol_cls.Executor, "executor_index_of"):
+            assert executors >= 2, (
+                "shard_count > 1 needs executors >= 2 (main + secondary "
+                "request-serving executor)"
+            )
         self.workers = ToPool("workers", workers)
         self.executor_pool = ToPool("executors", executors)
         self.executors = [
@@ -212,7 +225,9 @@ class ProcessRuntime:
             self._peer_writers[peer_id] = queue
             self.spawn(self._writer_task(rw, queue))
 
-        connect_ok, _ = self.process.discover(self.sorted_processes)
+        connect_ok, self.closest_shard_process = self.process.discover(
+            self.sorted_processes
+        )
         assert connect_ok, "discover must succeed with a full process list"
 
         for position in range(self.workers.size):
@@ -224,6 +239,9 @@ class ProcessRuntime:
         interval = self.config.executor_executed_notification_interval_ms
         if interval is not None:
             self.spawn(self._executed_notification_task(interval))
+        cleanup = self.config.executor_cleanup_interval_ms
+        if cleanup is not None and self.config.shard_count > 1:
+            self.spawn(self._executor_cleanup_task(cleanup))
         self._connected.set()
 
     async def stop(self) -> None:
@@ -257,15 +275,20 @@ class ProcessRuntime:
     # --- tasks ---
 
     async def _reader_task(self, from_: ProcessId, from_shard: ShardId, rw: Rw) -> None:
-        """Route peer messages to workers by message index
-        (process.rs:292-326)."""
+        """Route peer messages to workers by message index, and peer
+        executor infos (cross-shard dependency traffic) to the executor
+        pool (process.rs:292-326)."""
         while True:
             msg = await rw.recv()
             if msg is None:
                 return
-            assert isinstance(msg, POEProtocol)
-            index = self.protocol_cls.message_index(msg.msg)
-            self.workers.forward(index, ("msg", from_, from_shard, msg.msg))
+            if isinstance(msg, POEExecutor):
+                position = self._executor_position(msg.info)
+                self.executor_pool.forward_to(position, msg.info)
+            else:
+                assert isinstance(msg, POEProtocol)
+                index = self.protocol_cls.message_index(msg.msg)
+                self.workers.forward(index, ("msg", from_, from_shard, msg.msg))
 
     async def _writer_task(self, rw: Rw, queue: asyncio.Queue) -> None:
         """Drains pre-serialized frames (serialization happens at enqueue
@@ -333,6 +356,35 @@ class ProcessRuntime:
             position = executor_index(info, self.executor_pool.size)
             self.executor_pool.forward_to(position, info)
 
+    def _executor_position(self, info: Any) -> int:
+        """Position in the executor pool for an info: the Executor's own
+        routing when it defines one (GraphExecutor's main/secondary split,
+        executor.rs:242-262), else key/0 routing."""
+        index_of = getattr(self.protocol_cls.Executor, "executor_index_of", None)
+        if index_of is not None:
+            _reserved, index = index_of(info)
+            assert index < self.executor_pool.size, (
+                f"info {type(info).__name__} routes to executor {index} but the "
+                f"pool has {self.executor_pool.size}; multi-shard graph "
+                "executors need the main/secondary split (executors >= 2)"
+            )
+            return index
+        pos = executor_index(info, self.executor_pool.size)
+        return 0 if pos is None else pos
+
+    def _ship_executor_outputs(self, executor: Any) -> None:
+        """Deliver an executor's (shard, info) outputs: same-shard infos go
+        to the local pool, cross-shard ones to the closest process of the
+        target shard (executor.rs:220-260 fetch_info_to_executors)."""
+        for to_shard, xinfo in executor.to_executors_iter():
+            if to_shard == self.process.shard_id:
+                self.executor_pool.forward_to(self._executor_position(xinfo), xinfo)
+            else:
+                target = self.closest_shard_process[to_shard]
+                self._peer_writers[target].put_nowait(
+                    serialize(POEExecutor(xinfo))
+                )
+
     async def _executor_task(self, position: int) -> None:
         queue = self.executor_pool.queue(position)
         executor = self.executors[position]
@@ -350,6 +402,16 @@ class ProcessRuntime:
                 session = self.client_sessions.get(result.rifl.source)
                 if session is not None:
                     session.deliver(result)
+            self._ship_executor_outputs(executor)
+
+    async def _executor_cleanup_task(self, interval_ms: int) -> None:
+        """Periodic cleanup tick: retries buffered cross-shard requests on
+        the secondary executor (executor.rs:279-293)."""
+        while True:
+            await asyncio.sleep(interval_ms / 1000)
+            for executor in self.executors:
+                executor.cleanup(self.time)
+                self._ship_executor_outputs(executor)
 
     async def _periodic_task(self, event: Any, interval_ms: int) -> None:
         while True:

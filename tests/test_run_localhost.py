@@ -9,7 +9,7 @@ import pytest
 
 from fantoch_tpu.client import ConflictRateKeyGen, Workload
 from fantoch_tpu.core import Config
-from fantoch_tpu.protocol import Basic, Caesar, EPaxos, FPaxos, Newt, ProtocolMetricsKind
+from fantoch_tpu.protocol import Atlas, Basic, Caesar, EPaxos, FPaxos, Newt, ProtocolMetricsKind
 from fantoch_tpu.run.harness import run_localhost_cluster
 
 COMMANDS_PER_CLIENT = 10
@@ -100,6 +100,96 @@ def run_cluster(
         f"incomplete gc: {total_stable} != {gc_at} * {min_commits}"
     )
     return total_slow
+
+
+def run_multi_shard_cluster(protocol_cls, config, shard_count, executors=2):
+    """Multi-shard variant (protocol/mod.rs:786-838): agreement is checked
+    within each shard (keys live on exactly one shard), and commit/GC
+    accounting is per shard — every shard commits each command that touches
+    it (mod.rs:1042-1075)."""
+    from fantoch_tpu.core.ids import process_ids
+
+    config = config.with_(
+        executor_monitor_execution_order=True,
+        gc_interval_ms=50,
+        executor_executed_notification_interval_ms=50,
+        executor_cleanup_interval_ms=5,
+        shard_count=shard_count,
+    )
+    workload = Workload(
+        shard_count=shard_count,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=2,
+        commands_per_client=COMMANDS_PER_CLIENT,
+        payload_size=1,
+    )
+    runtimes, clients = asyncio.run(
+        run_localhost_cluster(
+            protocol_cls,
+            config,
+            workload,
+            CLIENTS_PER_PROCESS,
+            executors=executors,
+            extra_run_time_ms=1000,
+        )
+    )
+
+    total_clients = config.n * CLIENTS_PER_PROCESS
+    assert len(clients) == total_clients
+    for client in clients.values():
+        assert client.issued_commands == COMMANDS_PER_CLIENT
+        assert len(list(client.data().latency_data())) == COMMANDS_PER_CLIENT
+
+    shard_pids = {s: list(process_ids(s, config.n)) for s in range(shard_count)}
+    # per-shard agreement on per-key execution order
+    for s, pids in shard_pids.items():
+        monitors = {}
+        for pid in pids:
+            monitor = None
+            for executor in runtimes[pid].executors:
+                m = executor.monitor()
+                if m is None:
+                    continue
+                if monitor is None:
+                    monitor = m
+                else:
+                    monitor.merge(m)
+            assert monitor is not None
+            monitors[pid] = monitor
+        items = list(monitors.items())
+        pid_a, monitor_a = items[0]
+        for pid_b, monitor_b in items[1:]:
+            for key in monitor_a.keys():
+                assert monitor_a.get_order(key) == monitor_b.get_order(key), (
+                    f"shard {s}: p{pid_a} and p{pid_b} disagree on {key!r}"
+                )
+
+    # commit + GC accounting (mod.rs:1042-1075): commits are counted once
+    # per shard a command touches, so the total lies in [min, min * shards];
+    # GC only happens at the dot-owner shard, so stable is exactly
+    # n * min_total regardless of shard spread
+    min_total = COMMANDS_PER_CLIENT * total_clients
+    total_fast = total_slow = total_stable = 0
+    for pid, runtime in runtimes.items():
+        m = runtime.process.metrics()
+        total_fast += m.get_aggregated(ProtocolMetricsKind.FAST_PATH) or 0
+        total_slow += m.get_aggregated(ProtocolMetricsKind.SLOW_PATH) or 0
+        total_stable += m.get_aggregated(ProtocolMetricsKind.STABLE) or 0
+    commits = total_fast + total_slow
+    assert min_total <= commits <= min_total * shard_count, (
+        f"commits {commits} outside [{min_total}, {min_total * shard_count}]"
+    )
+    assert total_stable == config.n * min_total, (
+        f"incomplete gc: {total_stable} != {config.n} * {min_total}"
+    )
+
+
+def test_run_atlas_3_1_two_shards():
+    run_multi_shard_cluster(Atlas, Config(n=3, f=1), shard_count=2)
+
+
+def test_run_atlas_3_1_three_shards():
+    run_multi_shard_cluster(Atlas, Config(n=3, f=1), shard_count=3)
 
 
 def test_run_basic_3_1():
