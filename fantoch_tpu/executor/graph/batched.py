@@ -333,6 +333,19 @@ class BatchedDependencyGraph(DependencyGraph):
     def _resolve_backlog(self, time: SysTime) -> None:
         if not self._backlog.count:
             return
+        # host-side latency histogram + device-side xprof annotation
+        # (SURVEY §5: jax.profiler is the TPU-native tracer; the host span
+        # lands in fantoch_tpu.utils.prof's registry)
+        import jax.profiler
+
+        from fantoch_tpu.utils.prof import elapsed
+
+        with elapsed("BatchedDependencyGraph._resolve_backlog"), (
+            jax.profiler.TraceAnnotation("graph_resolve")
+        ):
+            self._resolve_backlog_inner(time)
+
+    def _resolve_backlog_inner(self, time: SysTime) -> None:
         src, seq, key, tms, deps = self._backlog.columns()
         batch = len(src)
         dep_rows = self._map_deps(src, seq, deps)

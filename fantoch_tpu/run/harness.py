@@ -37,6 +37,9 @@ async def run_localhost_cluster(
     extra_run_time_ms: int = 500,
     workers: int = 1,
     executors: int = 1,
+    peer_delays: Optional[Dict[ProcessId, Dict[ProcessId, int]]] = None,
+    ping_sort: bool = False,
+    observe_dir: Optional[str] = None,
 ) -> Tuple[Dict[ProcessId, ProcessRuntime], Dict[ClientId, Client]]:
     """Boot n*shard_count processes + clients, run the workload to
     completion, keep the cluster alive `extra_run_time_ms` (for GC rounds),
@@ -84,6 +87,15 @@ async def run_localhost_cluster(
             sorted_processes=sorted_processes,
             workers=workers,
             executors=executors,
+            peer_delays=(peer_delays or {}).get(pid),
+            ping_sort=ping_sort,
+            metrics_file=(
+                f"{observe_dir}/metrics_p{pid}.gz" if observe_dir else None
+            ),
+            metrics_interval_ms=200,
+            execution_log=(
+                f"{observe_dir}/execution_p{pid}.log" if observe_dir else None
+            ),
         )
 
     await asyncio.gather(*(runtime.start() for runtime in runtimes.values()))

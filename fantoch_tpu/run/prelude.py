@@ -59,6 +59,19 @@ class ToClient:
 
 
 @dataclass
+class PingReq:
+    """Peer RTT probe (the localhost analog of the reference's `ping -c 1`
+    shell-out, fantoch/src/run/task/ping.rs:71-78)."""
+
+    nonce: int
+
+
+@dataclass
+class PingReply:
+    nonce: int
+
+
+@dataclass
 class POEProtocol:
     msg: Any
 

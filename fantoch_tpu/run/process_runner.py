@@ -41,6 +41,8 @@ from fantoch_tpu.executor.base import ExecutorResult
 from fantoch_tpu.protocol.base import Protocol, ToForward, ToSend
 from fantoch_tpu.run.prelude import (
     ClientHi,
+    PingReply,
+    PingReq,
     POEExecutor,
     POEProtocol,
     ProcessHi,
@@ -65,6 +67,18 @@ def executor_index(info: Any, size: int) -> Optional[int]:
     if isinstance(key, str):
         return key_hash(key) % size
     return 0
+
+
+class _StampingQueue(asyncio.Queue):
+    """Queue whose items carry their entry time — the delay line's source
+    (delay.rs timestamps messages on entry, :6-39)."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        super().__init__()
+        self._stamp_loop = loop
+
+    def put_nowait(self, item: Any) -> None:  # type: ignore[override]
+        super().put_nowait((self._stamp_loop.time(), item))
 
 
 class _ClientSession:
@@ -138,6 +152,12 @@ class ProcessRuntime:
         sorted_processes: List[Tuple[ProcessId, ShardId]],
         workers: int = 1,
         executors: int = 1,
+        peer_delays: Optional[Dict[ProcessId, int]] = None,
+        ping_sort: bool = False,
+        metrics_file: Optional[str] = None,
+        metrics_interval_ms: int = 5000,
+        execution_log: Optional[str] = None,
+        tracer_show_interval_ms: Optional[int] = None,
     ):
         self.protocol_cls = protocol_cls
         self.config = config
@@ -173,6 +193,22 @@ class ProcessRuntime:
         self.dot_gen = AtomicIdGen(process_id)
         self.client_sessions: Dict[ClientId, _ClientSession] = {}
         self._peer_writers: Dict[ProcessId, asyncio.Queue] = {}
+        # per-connection artificial delay in ms (delay.rs:6-39): outbound
+        # frames to these peers pass through a FIFO delay line
+        self.peer_delays = peer_delays or {}
+        # latency-sort peers at startup via in-band ping (ping.rs:13-78)
+        self.ping_sort = ping_sort
+        self._ping_waiters: Dict[int, asyncio.Future] = {}
+        self._ping_nonce = 0
+        # observability (metrics_logger.rs / execution_logger.rs / tracer.rs)
+        self.metrics_file = metrics_file
+        self.metrics_interval_ms = metrics_interval_ms
+        self.tracer_show_interval_ms = tracer_show_interval_ms
+        self.execution_logger = None
+        if execution_log is not None:
+            from fantoch_tpu.run.observe import ExecutionLogger
+
+            self.execution_logger = ExecutionLogger(execution_log)
         self._tasks: Set[asyncio.Task] = set()
         self._servers: List[asyncio.base_events.Server] = []
         self._connected = asyncio.Event()
@@ -221,10 +257,23 @@ class ProcessRuntime:
         for peer_id, addr in self.peers.items():
             rw = await self._connect_with_retry(addr)
             await rw.send(ProcessHi(self.process.id, self.process.shard_id))
-            queue: asyncio.Queue = asyncio.Queue()
+            delay_ms = self.peer_delays.get(peer_id)
+            if delay_ms:
+                # FIFO delay line between the enqueue side and the writer
+                # (delay.rs:6-39): frames leave `delay_ms` after entering,
+                # so entry times are stamped at put (a burst still leaves
+                # one delay later, not serialized at one frame per delay)
+                queue = _StampingQueue(asyncio.get_running_loop())
+                delayed: asyncio.Queue = asyncio.Queue()
+                self.spawn(self._delay_task(queue, delayed, delay_ms))
+                self.spawn(self._writer_task(rw, delayed))
+            else:
+                queue = asyncio.Queue()
+                self.spawn(self._writer_task(rw, queue))
             self._peer_writers[peer_id] = queue
-            self.spawn(self._writer_task(rw, queue))
 
+        if self.ping_sort:
+            self.sorted_processes = await self._ping_sorted_processes()
         connect_ok, self.closest_shard_process = self.process.discover(
             self.sorted_processes
         )
@@ -242,12 +291,23 @@ class ProcessRuntime:
         cleanup = self.config.executor_cleanup_interval_ms
         if cleanup is not None and self.config.shard_count > 1:
             self.spawn(self._executor_cleanup_task(cleanup))
+        if self.metrics_file is not None:
+            self.spawn(self._metrics_logger_task())
+        if self.execution_logger is not None:
+            self.spawn(self._execution_log_flush_task())
+        if self.tracer_show_interval_ms is not None:
+            self.spawn(self._tracer_task())
         self._connected.set()
 
     async def stop(self) -> None:
         tasks = list(self._tasks)
         self._teardown()
         await asyncio.gather(*tasks, return_exceptions=True)
+        if self.execution_logger is not None:
+            self.execution_logger.close()
+        if self.metrics_file is not None:
+            # final snapshot so short runs always leave one behind
+            self._write_metrics_snapshot()
 
     @staticmethod
     async def _connect_with_retry(addr: Address, attempts: int = 100) -> Rw:
@@ -282,13 +342,72 @@ class ProcessRuntime:
             msg = await rw.recv()
             if msg is None:
                 return
-            if isinstance(msg, POEExecutor):
+            if isinstance(msg, PingReq):
+                # our outbound writer to this peer may still be connecting
+                # (pings fly during start); wait for it rather than crash
+                while from_ not in self._peer_writers:
+                    await asyncio.sleep(0.01)
+                self._peer_writers[from_].put_nowait(serialize(PingReply(msg.nonce)))
+            elif isinstance(msg, PingReply):
+                waiter = self._ping_waiters.pop(msg.nonce, None)
+                if waiter is not None and not waiter.done():
+                    waiter.set_result(None)
+            elif isinstance(msg, POEExecutor):
                 position = self._executor_position(msg.info)
                 self.executor_pool.forward_to(position, msg.info)
             else:
                 assert isinstance(msg, POEProtocol)
                 index = self.protocol_cls.message_index(msg.msg)
                 self.workers.forward(index, ("msg", from_, from_shard, msg.msg))
+
+    @staticmethod
+    async def _delay_task(
+        source: "_StampingQueue", sink: asyncio.Queue, delay_ms: int
+    ) -> None:
+        """FIFO delay line (delay.rs:6-39): each frame is released
+        ``delay_ms`` after it *entered* the queue (entry time stamped by
+        the _StampingQueue at put), preserving order."""
+        loop = asyncio.get_running_loop()
+        while True:
+            entered, frame = await source.get()
+            remaining = entered + delay_ms / 1000 - loop.time()
+            if remaining > 0:
+                await asyncio.sleep(remaining)
+            sink.put_nowait(frame)
+
+    async def _ping_sorted_processes(self) -> List[Tuple[ProcessId, ShardId]]:
+        """Latency-sort same-shard peers by measured RTT (ping.rs:13-78,
+        sort_by_distance :144); self always leads at 0ms, other-shard
+        entries keep their closest-process role."""
+        shard_peers = [
+            (pid, s) for pid, s in self.sorted_processes
+            if s == self.process.shard_id and pid != self.process.id
+        ]
+        rtts: Dict[ProcessId, float] = {}
+        for pid, _s in shard_peers:
+            rtts[pid] = await self._ping_peer(pid)
+        ordered = sorted(shard_peers, key=lambda e: rtts[e[0]])
+        others = [
+            (pid, s) for pid, s in self.sorted_processes
+            if s != self.process.shard_id
+        ]
+        return [(self.process.id, self.process.shard_id)] + ordered + others
+
+    async def _ping_peer(self, peer_id: ProcessId, samples: int = 3) -> float:
+        """Median RTT to a peer over the live connection, ms."""
+        loop = asyncio.get_running_loop()
+        times = []
+        for _ in range(samples):
+            self._ping_nonce += 1
+            nonce = self._ping_nonce
+            fut: asyncio.Future = loop.create_future()
+            self._ping_waiters[nonce] = fut
+            t0 = loop.time()
+            self._peer_writers[peer_id].put_nowait(serialize(PingReq(nonce)))
+            await asyncio.wait_for(fut, timeout=10.0)
+            times.append((loop.time() - t0) * 1000)
+        times.sort()
+        return times[len(times) // 2]
 
     async def _writer_task(self, rw: Rw, queue: asyncio.Queue) -> None:
         """Drains pre-serialized frames (serialization happens at enqueue
@@ -397,6 +516,8 @@ class ProcessRuntime:
                     infos.append(queue.get_nowait())
                 except asyncio.QueueEmpty:
                     break
+            if self.execution_logger is not None:
+                self.execution_logger.log(infos)
             executor.handle_batch(infos, self.time)
             for result in executor.to_clients_iter():
                 session = self.client_sessions.get(result.rifl.source)
@@ -412,6 +533,49 @@ class ProcessRuntime:
             for executor in self.executors:
                 executor.cleanup(self.time)
                 self._ship_executor_outputs(executor)
+
+    def _write_metrics_snapshot(self) -> None:
+        from fantoch_tpu.run.observe import ProcessMetrics, write_metrics_snapshot
+
+        write_metrics_snapshot(
+            self.metrics_file,
+            ProcessMetrics(
+                [self.process.metrics()],
+                [e.metrics() for e in self.executors],
+            ),
+        )
+
+    async def _metrics_logger_task(self) -> None:
+        """Periodic crash-consistent metrics snapshots
+        (metrics_logger.rs:75-87)."""
+        while True:
+            await asyncio.sleep(self.metrics_interval_ms / 1000)
+            self._write_metrics_snapshot()
+
+    async def _execution_log_flush_task(self) -> None:
+        """1s execution-log flush (execution_logger.rs:8-29)."""
+        while True:
+            await asyncio.sleep(1.0)
+            self.execution_logger.flush()
+
+    async def _tracer_task(self) -> None:
+        """Periodic function-latency histogram dump (tracer.rs:16-44).
+
+        The prof registry is OS-process-global (like the reference's
+        ProfSubscriber); in the localhost harness several runtimes share
+        one Python process, so the dump is labeled accordingly rather than
+        claiming per-runtime ownership of the samples."""
+        from fantoch_tpu.utils import prof
+
+        while True:
+            await asyncio.sleep(self.tracer_show_interval_ms / 1000)
+            formatted = prof.format_snapshot()
+            if formatted:
+                logger.info(
+                    "tracer (process-global registry, printed by p%s):\n%s",
+                    self.process.id,
+                    formatted,
+                )
 
     async def _periodic_task(self, event: Any, interval_ms: int) -> None:
         while True:

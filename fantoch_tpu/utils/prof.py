@@ -1,0 +1,76 @@
+"""Function-latency profiling: the fantoch_prof analog.
+
+Reference: fantoch_prof/src/lib.rs:78-186 — a tracing Subscriber that
+turns span enter/exit into per-function latency histograms, printed
+periodically by the tracer task (fantoch/src/run/task/tracer.rs:16-44).
+
+Here the span surface is explicit: wrap hot functions with ``@profiled``
+or time a region with ``elapsed("name")``; latencies land in a global
+``Metrics`` histogram registry keyed by name (microseconds).  The runner's
+tracer task (``ProcessRuntime`` with ``tracer_show_interval_ms``) prints
+``snapshot()`` on an interval.  For device work, prefer
+``jax.profiler.TraceAnnotation`` (wired in executor/graph/batched.py) —
+this module covers the host side.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+from typing import Callable, Dict, Iterator
+
+from fantoch_tpu.core.metrics import Histogram, Metrics
+
+_metrics: Metrics = Metrics()
+_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def elapsed(name: str) -> Iterator[None]:
+    """Time a region into the global histogram for `name` (microseconds)."""
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        micros = int((time.perf_counter() - start) * 1e6)
+        with _lock:
+            _metrics.collect(name, micros)
+
+
+def profiled(fn: Callable) -> Callable:
+    """Decorator: record every call's latency under the function's name."""
+    name = fn.__qualname__
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with elapsed(name):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def snapshot() -> Dict[str, Histogram]:
+    """Copy of the collected histograms (name -> Histogram)."""
+    with _lock:
+        out: Metrics = Metrics()
+        out.merge(_metrics)
+        return dict(out.collected)
+
+
+def reset() -> None:
+    global _metrics
+    with _lock:
+        _metrics = Metrics()
+
+
+def format_snapshot() -> str:
+    """One line per profiled function (tracer.rs:16-44 output analog)."""
+    lines = []
+    for name, hist in sorted(snapshot().items()):
+        lines.append(
+            f"{name}: n={hist.count} mean={hist.mean():.0f}us "
+            f"p95={hist.percentile(0.95):.0f}us p99={hist.percentile(0.99):.0f}us"
+        )
+    return "\n".join(lines)

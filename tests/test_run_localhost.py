@@ -23,6 +23,11 @@ def run_cluster(
     executors=1,
     open_loop_interval_ms=None,
     check_agreement=True,
+    peer_delays=None,
+    ping_sort=False,
+    conflict_rate=50,
+    keys_per_command=2,
+    return_runtimes=False,
 ):
     config = config.with_(
         executor_monitor_execution_order=True,
@@ -32,8 +37,8 @@ def run_cluster(
     )
     workload = Workload(
         shard_count=1,
-        key_gen=ConflictRateKeyGen(50),
-        keys_per_command=2,
+        key_gen=ConflictRateKeyGen(conflict_rate),
+        keys_per_command=keys_per_command,
         commands_per_client=COMMANDS_PER_CLIENT,
         payload_size=1,
     )
@@ -47,6 +52,8 @@ def run_cluster(
             executors=executors,
             open_loop_interval_ms=open_loop_interval_ms,
             extra_run_time_ms=1000,
+            peer_delays=peer_delays,
+            ping_sort=ping_sort,
         )
     )
 
@@ -99,6 +106,8 @@ def run_cluster(
     assert total_stable == gc_at * min_commits, (
         f"incomplete gc: {total_stable} != {gc_at} * {min_commits}"
     )
+    if return_runtimes:
+        return total_slow, runtimes
     return total_slow
 
 
@@ -229,3 +238,74 @@ def test_run_basic_3_1_open_loop():
     run_cluster(
         Basic, Config(n=3, f=1), open_loop_interval_ms=5, check_agreement=False
     )
+
+
+# --- n=5 f=2 rows of the reference matrix (protocol/mod.rs:112-750):
+# with f=2 the fast quorum is larger, so concurrent conflicting commands
+# disagree on deps/clocks and some commits take the slow path ---
+
+
+def test_run_epaxos_5_2():
+    slow = run_cluster(EPaxos, Config(n=5, f=2), conflict_rate=100, keys_per_command=1)
+    assert slow > 0, "f=2 with full conflicts must exercise the slow path"
+
+
+def test_run_atlas_3_1():
+    slow = run_cluster(Atlas, Config(n=3, f=1))
+    assert slow == 0, "f=1: everything commits on the fast path"
+
+
+def test_run_atlas_5_2():
+    slow = run_cluster(Atlas, Config(n=5, f=2), conflict_rate=100, keys_per_command=1)
+    assert slow > 0
+
+
+def test_run_newt_5_2():
+    slow = run_cluster(
+        Newt,
+        Config(n=5, f=2, newt_detached_send_interval_ms=50),
+        conflict_rate=100,
+        keys_per_command=1,
+    )
+    assert slow > 0
+
+
+def test_run_caesar_5_2():
+    run_cluster(Caesar, Config(n=5, f=2), conflict_rate=100, keys_per_command=1)
+
+
+def test_run_fpaxos_5_2():
+    run_cluster(FPaxos, Config(n=5, f=2, leader=1))
+
+
+def test_run_epaxos_3_1_batched_executor():
+    # the device-batched graph executor as a drop-in on the real runner
+    slow = run_cluster(
+        EPaxos, Config(n=3, f=1, batched_graph_executor=True)
+    )
+    assert slow == 0
+
+
+def test_run_epaxos_3_1_delay_injection():
+    # odd processes write through a FIFO delay line (delay.rs:6-39; the
+    # reference's run tests give odd processes delay entries,
+    # run/mod.rs:1184-1192) — correctness must hold under asymmetric delays
+    delays = {1: {2: 10}, 3: {2: 10}}
+    slow = run_cluster(EPaxos, Config(n=3, f=1), peer_delays=delays)
+    assert slow == 0
+
+
+def test_run_ping_sort_orders_by_latency():
+    # p1's connection to p3 is delayed, so p1's ping-sorted process list
+    # must place p3 after p2 (ping.rs:13-78 distance sorting)
+    delays = {1: {3: 40}}
+    _slow, runtimes = run_cluster(
+        Basic,
+        Config(n=3, f=1),
+        peer_delays=delays,
+        ping_sort=True,
+        check_agreement=False,
+        return_runtimes=True,
+    )
+    order = [pid for pid, _ in runtimes[1].sorted_processes]
+    assert order == [1, 2, 3], f"delayed peer must sort last: {order}"
