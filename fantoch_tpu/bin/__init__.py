@@ -1,0 +1,15 @@
+"""CLI entry points (the fantoch_ps/src/bin analog).
+
+One server binary covering every protocol via ``--protocol`` (the
+reference monomorphizes one binary per protocol x variant,
+fantoch_ps/src/bin/{atlas,epaxos,...}.rs over common/protocol.rs; a flag
+is the Python-idiomatic equivalent), a client binary, and the aux tools:
+simulation sweep, execution-log replay, and shard-distribution analysis.
+
+Usage:
+    python -m fantoch_tpu.bin.server --protocol epaxos --id 1 ...
+    python -m fantoch_tpu.bin.client --ids 1-3 --addresses 0=127.0.0.1:7001 ...
+    python -m fantoch_tpu.bin.simulation --protocol newt --clients 10
+    python -m fantoch_tpu.bin.replay --log execution_p1.log --protocol epaxos
+    python -m fantoch_tpu.bin.shard_distribution --shard-count 4
+"""

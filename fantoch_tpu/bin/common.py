@@ -1,0 +1,130 @@
+"""Shared CLI plumbing: protocol registry, config flags, platform forcing.
+
+Reference: fantoch_ps/src/bin/common/protocol.rs:126-368 (the full server
+flag set) and common/mod.rs.  The TPU platform is forced *in-Python*
+before the first jax import (a JAX_PLATFORMS env var hangs interpreter
+start under this rig's TPU hook — see bench.py's postmortem), via the
+FANTOCH_PLATFORM environment variable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Dict, Optional, Tuple
+
+
+def force_platform_from_env() -> None:
+    """FANTOCH_PLATFORM=cpu forces the CPU backend before jax loads."""
+    if os.environ.get("FANTOCH_PLATFORM") == "cpu":
+        from fantoch_tpu.hostenv import force_cpu_platform
+
+        force_cpu_platform()
+
+
+def protocol_by_name(name: str):
+    from fantoch_tpu.protocol import Atlas, Basic, Caesar, EPaxos, FPaxos, Newt
+
+    registry = {
+        "basic": Basic,
+        "epaxos": EPaxos,
+        "atlas": Atlas,
+        "newt": Newt,
+        "caesar": Caesar,
+        "fpaxos": FPaxos,
+    }
+    if name not in registry:
+        raise SystemExit(f"unknown protocol {name!r}; one of {sorted(registry)}")
+    return registry[name]
+
+
+def add_config_flags(parser: argparse.ArgumentParser) -> None:
+    """The Config-backed flags (common/protocol.rs:126-368)."""
+    parser.add_argument("--processes", "-n", type=int, required=True, help="replicas per shard")
+    parser.add_argument("--faults", "-f", type=int, required=True)
+    parser.add_argument("--shard-count", type=int, default=1)
+    parser.add_argument("--execute-at-commit", action="store_true")
+    parser.add_argument("--executor-executed-notification-interval", type=int, default=50, metavar="MS")
+    parser.add_argument("--executor-cleanup-interval", type=int, default=5, metavar="MS")
+    parser.add_argument("--executor-monitor-execution-order", action="store_true")
+    parser.add_argument("--gc-interval", type=int, default=50, metavar="MS")
+    parser.add_argument("--leader", type=int, default=None, help="leader process (FPaxos)")
+    parser.add_argument("--newt-tiny-quorums", action="store_true")
+    parser.add_argument("--newt-clock-bump-interval", type=int, default=None, metavar="MS")
+    parser.add_argument("--newt-detached-send-interval", type=int, default=None, metavar="MS")
+    parser.add_argument("--caesar-wait-condition", action="store_true", default=True)
+    parser.add_argument("--no-caesar-wait-condition", dest="caesar_wait_condition", action="store_false")
+    parser.add_argument("--skip-fast-ack", action="store_true")
+    parser.add_argument("--batched-graph-executor", action="store_true",
+                        help="order committed commands with the batched device resolver")
+
+
+def config_from_args(args: argparse.Namespace):
+    from fantoch_tpu.core import Config
+
+    return Config(
+        n=args.processes,
+        f=args.faults,
+        shard_count=args.shard_count,
+        execute_at_commit=args.execute_at_commit,
+        executor_executed_notification_interval_ms=args.executor_executed_notification_interval,
+        executor_cleanup_interval_ms=args.executor_cleanup_interval,
+        executor_monitor_execution_order=args.executor_monitor_execution_order,
+        gc_interval_ms=args.gc_interval,
+        leader=args.leader,
+        newt_tiny_quorums=args.newt_tiny_quorums,
+        newt_clock_bump_interval_ms=args.newt_clock_bump_interval,
+        newt_detached_send_interval_ms=args.newt_detached_send_interval,
+        caesar_wait_condition=args.caesar_wait_condition,
+        skip_fast_ack=args.skip_fast_ack,
+        batched_graph_executor=args.batched_graph_executor,
+    )
+
+
+def parse_peer(entry: str) -> Tuple[int, str, int, Optional[int]]:
+    """'pid=host:port' or 'pid=host:port:delay_ms' -> (pid, host, port, delay)."""
+    pid_s, addr = entry.split("=", 1)
+    parts = addr.split(":")
+    if len(parts) == 2:
+        host, port = parts
+        delay = None
+    elif len(parts) == 3:
+        host, port, delay_s = parts
+        delay = int(delay_s)
+    else:
+        raise SystemExit(f"bad peer address {entry!r} (pid=host:port[:delay_ms])")
+    return int(pid_s), host, int(port), delay
+
+
+def parse_shard_addr(entry: str) -> Tuple[int, str, int]:
+    """'shard=host:port' -> (shard, host, port)."""
+    shard_s, addr = entry.split("=", 1)
+    host, port_s = addr.rsplit(":", 1)
+    return int(shard_s), host, int(port_s)
+
+
+def parse_sorted(entry: str) -> list:
+    """'1:0,2:0,3:0' -> [(pid, shard), ...]."""
+    out = []
+    for item in entry.split(","):
+        pid_s, shard_s = item.split(":")
+        out.append((int(pid_s), int(shard_s)))
+    return out
+
+
+def parse_id_range(entry: str) -> list:
+    """'1-3' or '7' -> [ids]."""
+    if "-" in entry:
+        lo, hi = entry.split("-")
+        return list(range(int(lo), int(hi) + 1))
+    return [int(entry)]
+
+
+def maybe_log_file(path: Optional[str]) -> None:
+    if path:
+        import logging
+
+        handler = logging.FileHandler(path)
+        handler.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(message)s"))
+        logging.getLogger("fantoch_tpu").addHandler(handler)
+        logging.getLogger("fantoch_tpu").setLevel(logging.INFO)

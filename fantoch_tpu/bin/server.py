@@ -1,0 +1,132 @@
+"""Server binary: boot one protocol process of a cluster.
+
+Reference: fantoch_ps/src/bin/common/protocol.rs:64-368 (`run::<P>()` and
+the clap flag set) — protocol selection is a flag here instead of one
+binary per protocol.
+
+Example (3-process localhost EPaxos, process 1):
+    python -m fantoch_tpu.bin.server --protocol epaxos --id 1 --shard-id 0 \\
+        --port 7001 --client-port 8001 \\
+        --addresses 2=127.0.0.1:7002,3=127.0.0.1:7003 \\
+        --sorted 1:0,2:0,3:0 -n 3 -f 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from fantoch_tpu.bin.common import (
+    add_config_flags,
+    config_from_args,
+    force_platform_from_env,
+    maybe_log_file,
+    parse_peer,
+    parse_sorted,
+    protocol_by_name,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="fantoch_tpu.bin.server", description=__doc__
+    )
+    parser.add_argument("--protocol", required=True,
+                        help="basic|epaxos|atlas|newt|caesar|fpaxos")
+    parser.add_argument("--id", type=int, required=True, help="process id")
+    parser.add_argument("--shard-id", type=int, default=0)
+    parser.add_argument("--ip", default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True, help="peer port")
+    parser.add_argument("--client-port", type=int, required=True)
+    parser.add_argument(
+        "--addresses",
+        required=True,
+        help="comma list of pid=host:port[:delay_ms] for every peer this "
+        "process connects to (own-shard peers + closest process of each "
+        "other shard); delay_ms adds an artificial FIFO delay line "
+        "(delay.rs:6-39)",
+    )
+    parser.add_argument(
+        "--sorted",
+        default=None,
+        help="distance-sorted 'pid:shard,...' process list (self first); "
+        "omit with --ping-sort to measure instead (ping.rs:13-78)",
+    )
+    parser.add_argument("--ping-sort", action="store_true")
+    add_config_flags(parser)
+    parser.add_argument("--workers", type=int, default=1)
+    parser.add_argument("--executors", type=int, default=1)
+    parser.add_argument("--metrics-file", default=None)
+    parser.add_argument("--metrics-interval", type=int, default=5000, metavar="MS")
+    parser.add_argument("--execution-log", default=None)
+    parser.add_argument("--tracer-show-interval", type=int, default=None, metavar="MS")
+    parser.add_argument("--log-file", default=None)
+    return parser
+
+
+async def serve(args: argparse.Namespace) -> None:
+    from fantoch_tpu.run.process_runner import ProcessRuntime
+
+    protocol_cls = protocol_by_name(args.protocol)
+    config = config_from_args(args)
+
+    peers = {}
+    delays = {}
+    for entry in args.addresses.split(","):
+        pid, host, port, delay = parse_peer(entry)
+        peers[pid] = (host, port)
+        if delay is not None:
+            delays[pid] = delay
+
+    if args.sorted:
+        sorted_processes = parse_sorted(args.sorted)
+    else:
+        assert args.ping_sort, "--sorted or --ping-sort is required"
+        # the address list carries no shard labels, so the provisional
+        # all-own-shard list is only correct single-shard; multi-shard
+        # topologies must say which peer serves which shard via --sorted
+        assert args.shard_count == 1, (
+            "--ping-sort without --sorted requires --shard-count 1; "
+            "pass --sorted for multi-shard topologies"
+        )
+        # provisional order (self first); ping_sort re-sorts at startup
+        sorted_processes = [(args.id, args.shard_id)] + [
+            (pid, args.shard_id) for pid in sorted(peers)
+        ]
+
+    runtime = ProcessRuntime(
+        protocol_cls,
+        args.id,
+        args.shard_id,
+        config,
+        listen_addr=(args.ip, args.port),
+        client_addr=(args.ip, args.client_port),
+        peers=peers,
+        sorted_processes=sorted_processes,
+        workers=args.workers,
+        executors=args.executors,
+        peer_delays=delays or None,
+        ping_sort=args.ping_sort,
+        metrics_file=args.metrics_file,
+        metrics_interval_ms=args.metrics_interval,
+        execution_log=args.execution_log,
+        tracer_show_interval_ms=args.tracer_show_interval,
+    )
+    await runtime.start()
+    print(f"p{args.id} ({args.protocol}) up on {args.ip}:{args.port}", flush=True)
+    await runtime.failed.wait()
+    raise SystemExit(f"p{args.id} failed: {runtime.failure!r}")
+
+
+def main(argv=None) -> None:
+    force_platform_from_env()
+    args = build_parser().parse_args(argv)
+    maybe_log_file(args.log_file)
+    try:
+        asyncio.run(serve(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Analytic: fraction of multi-shard / multi-key commands under zipf.
+
+Reference: fantoch_ps/src/bin/shard_distribution.rs:1-111 — for a given
+shard count and zipf coefficient, sample commands and report how many
+touch more than one shard (and more than one key), the planner-side input
+for deciding whether partial replication pays off.
+
+    python -m fantoch_tpu.bin.shard_distribution --shard-count 4 \\
+        --keys-per-command 2 --coefficient 0.7
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="fantoch_tpu.bin.shard_distribution", description=__doc__
+    )
+    parser.add_argument("--shard-count", type=int, required=True)
+    parser.add_argument("--keys-per-command", type=int, default=2)
+    parser.add_argument("--coefficient", type=float, default=1.0)
+    parser.add_argument("--keys-per-shard", type=int, default=1_000_000)
+    parser.add_argument("--commands", type=int, default=10_000)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    from fantoch_tpu.client.key_gen import KeyGenState, ZipfKeyGen
+    from fantoch_tpu.client.workload import Workload
+    from fantoch_tpu.core.ids import IdGen
+    import random
+
+    workload = Workload(
+        shard_count=args.shard_count,
+        key_gen=ZipfKeyGen(args.coefficient, args.keys_per_shard),
+        keys_per_command=args.keys_per_command,
+        commands_per_client=args.commands,
+        payload_size=0,
+    )
+    state = KeyGenState(
+        workload.key_gen, args.shard_count, 1, rng=random.Random(args.seed)
+    )
+    rifl_gen = IdGen(1)
+
+    multi_shard = 0
+    multi_key = 0
+    for _ in range(args.commands):
+        nxt = workload.next_cmd(rifl_gen, state)
+        assert nxt is not None
+        _target, cmd = nxt
+        if cmd.multi_shard():
+            multi_shard += 1
+        if cmd.total_key_count > 1:
+            multi_key += 1
+
+    print(
+        json.dumps(
+            {
+                "shard_count": args.shard_count,
+                "commands": args.commands,
+                "multi_shard_pct": round(100 * multi_shard / args.commands, 2),
+                "multi_key_pct": round(100 * multi_key / args.commands, 2),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

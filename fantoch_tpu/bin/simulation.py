@@ -1,0 +1,105 @@
+"""Simulation sweep: deterministic protocol runs over a real planet.
+
+Reference: fantoch_ps/src/bin/simulation.rs:47-584 — sweep protocols and
+client counts over the AWS planet, reporting per-region latency stats.
+(The reference parallelizes with rayon; sweeps here run sequentially —
+each sim is already a tight single-threaded event loop.)
+
+    python -m fantoch_tpu.bin.simulation --protocol newt -n 5 -f 1 \\
+        --clients 1,10 --conflict-rate 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main(argv=None) -> None:
+    from fantoch_tpu.bin.common import force_platform_from_env
+
+    force_platform_from_env()
+    parser = argparse.ArgumentParser(
+        prog="fantoch_tpu.bin.simulation", description=__doc__
+    )
+    parser.add_argument("--protocol", required=True)
+    parser.add_argument("--processes", "-n", type=int, required=True)
+    parser.add_argument("--faults", "-f", type=int, required=True)
+    parser.add_argument("--clients", default="1",
+                        help="comma list of clients-per-region to sweep")
+    parser.add_argument("--conflict-rate", type=int, default=50)
+    parser.add_argument("--keys-per-command", type=int, default=1)
+    parser.add_argument("--commands-per-client", type=int, default=50)
+    parser.add_argument("--dataset", choices=["aws", "gcp"], default="aws")
+    parser.add_argument("--regions", default=None,
+                        help="comma list of region names (default: first n)")
+    parser.add_argument("--newt-tiny-quorums", action="store_true")
+    parser.add_argument("--seed", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    from fantoch_tpu.bin.common import protocol_by_name
+    from fantoch_tpu.client import ConflictRateKeyGen, Workload
+    from fantoch_tpu.core import Config
+    from fantoch_tpu.core.planet import Planet, Region
+    from fantoch_tpu.sim.runner import Runner
+
+    protocol_cls = protocol_by_name(args.protocol)
+    planet = Planet.new(args.dataset)
+    if args.regions:
+        regions = [Region(name) for name in args.regions.split(",")]
+    else:
+        regions = sorted(planet.regions())[: args.processes]
+    assert len(regions) == args.processes, "one region per process"
+
+    config = Config(
+        n=args.processes,
+        f=args.faults,
+        gc_interval_ms=100,
+        newt_tiny_quorums=args.newt_tiny_quorums,
+    )
+
+    for clients in [int(c) for c in args.clients.split(",")]:
+        workload = Workload(
+            shard_count=1,
+            key_gen=ConflictRateKeyGen(args.conflict_rate),
+            keys_per_command=args.keys_per_command,
+            commands_per_client=args.commands_per_client,
+            payload_size=1,
+        )
+        runner = Runner(
+            protocol_cls,
+            planet,
+            config,
+            workload,
+            clients,
+            process_regions=list(regions),
+            client_regions=list(regions),
+            seed=args.seed,
+        )
+        _metrics, _monitors, latencies = runner.run(extra_sim_time_ms=10_000)
+        stats = {
+            str(region): {
+                "issued": issued,
+                "mean_ms": round(hist.mean(), 1),
+                "p99_ms": hist.percentile(0.99),
+            }
+            for region, (issued, hist) in sorted(
+                latencies.items(), key=lambda kv: str(kv[0])
+            )
+        }
+        print(
+            json.dumps(
+                {
+                    "protocol": args.protocol,
+                    "n": args.processes,
+                    "f": args.faults,
+                    "clients_per_region": clients,
+                    "latency": stats,
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -24,7 +24,7 @@ from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.ids import ClientId, ShardId
 from fantoch_tpu.core.timing import RunTime
 from fantoch_tpu.run.prelude import ClientHi, Register, Submit, ToClient
-from fantoch_tpu.run.rw import Rw
+from fantoch_tpu.run.rw import Rw, connect_with_retry
 
 Address = Tuple[str, int]
 
@@ -40,8 +40,7 @@ async def run_clients(
     (latency data inside)."""
     rws: Dict[ShardId, Rw] = {}
     for shard_id, addr in sorted(shard_addresses.items()):
-        reader, writer = await asyncio.open_connection(*addr)
-        rw = Rw(reader, writer)
+        rw = await connect_with_retry(addr)
         await rw.send(ClientHi(list(client_ids)))
         rws[shard_id] = rw
 

@@ -50,6 +50,10 @@ async def run_localhost_cluster(
     its own shard plus the offset-o process of every other shard (its
     "closest" of that shard), mirroring the reference's
     connect-to-closest-per-shard rule (run/task/process.rs:21)."""
+    if observe_dir is not None:
+        import os
+
+        os.makedirs(observe_dir, exist_ok=True)
     shard_count = config.shard_count
     shard_ids = {s: list(process_ids(s, config.n)) for s in range(shard_count)}
     all_pids = [pid for ids in shard_ids.values() for pid in ids]

@@ -52,7 +52,7 @@ from fantoch_tpu.run.prelude import (
     ToPool,
 )
 from fantoch_tpu.run.routing import worker_dot_index_shift
-from fantoch_tpu.run.rw import Rw, serialize
+from fantoch_tpu.run.rw import Rw, connect_with_retry, serialize
 from fantoch_tpu.utils import key_hash, logger
 
 Address = Tuple[str, int]
@@ -255,7 +255,7 @@ class ProcessRuntime:
 
         # connect to every peer, retrying while they boot (process.rs:71-111)
         for peer_id, addr in self.peers.items():
-            rw = await self._connect_with_retry(addr)
+            rw = await connect_with_retry(addr)
             await rw.send(ProcessHi(self.process.id, self.process.shard_id))
             delay_ms = self.peer_delays.get(peer_id)
             if delay_ms:
@@ -308,16 +308,6 @@ class ProcessRuntime:
         if self.metrics_file is not None:
             # final snapshot so short runs always leave one behind
             self._write_metrics_snapshot()
-
-    @staticmethod
-    async def _connect_with_retry(addr: Address, attempts: int = 100) -> Rw:
-        for _ in range(attempts):
-            try:
-                reader, writer = await asyncio.open_connection(*addr)
-                return Rw(reader, writer)
-            except OSError:
-                await asyncio.sleep(0.05)
-        raise ConnectionError(f"could not connect to {addr}")
 
     # --- connection handlers ---
 
@@ -383,9 +373,14 @@ class ProcessRuntime:
             (pid, s) for pid, s in self.sorted_processes
             if s == self.process.shard_id and pid != self.process.id
         ]
-        rtts: Dict[ProcessId, float] = {}
-        for pid, _s in shard_peers:
-            rtts[pid] = await self._ping_peer(pid)
+        # peers are probed concurrently: total ping time ~= samples RTTs of
+        # the slowest peer, not the sum over peers
+        measured = await asyncio.gather(
+            *(self._ping_peer(pid) for pid, _s in shard_peers)
+        )
+        rtts: Dict[ProcessId, float] = {
+            pid: rtt for (pid, _s), rtt in zip(shard_peers, measured)
+        }
         ordered = sorted(shard_peers, key=lambda e: rtts[e[0]])
         others = [
             (pid, s) for pid, s in self.sorted_processes

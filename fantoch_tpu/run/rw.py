@@ -23,6 +23,22 @@ def serialize(value: Any) -> bytes:
     return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
 
 
+async def connect_with_retry(
+    addr: tuple, attempts: int = 100, backoff_s: float = 0.05
+) -> "Rw":
+    """Open a connection, retrying while the peer boots
+    (process.rs:71-111; the client setup retries too, mod.rs:668-740)."""
+    last: Optional[OSError] = None
+    for _ in range(attempts):
+        try:
+            reader, writer = await asyncio.open_connection(*addr)
+            return Rw(reader, writer)
+        except OSError as exc:
+            last = exc
+            await asyncio.sleep(backoff_s)
+    raise ConnectionError(f"could not connect to {addr}: {last!r}")
+
+
 class Rw:
     """Framed reader/writer over one TCP connection."""
 
