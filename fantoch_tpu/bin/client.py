@@ -92,19 +92,23 @@ async def drive(args: argparse.Namespace) -> None:
         status_frequency=args.status_frequency,
     )
 
-    latencies = []
+    latencies = []  # ClientData latencies are microseconds (data.py)
     for client in clients.values():
         latencies.extend(client.data().latency_data())
     latencies.sort()
     total = len(latencies)
+
+    def ms(micros):
+        return round(micros / 1000.0, 3)
+
     summary = {
         "clients": len(clients),
         "commands": total,
         "latency_ms": {
-            "min": latencies[0] if total else None,
-            "p50": latencies[total // 2] if total else None,
-            "p99": latencies[int(total * 0.99)] if total else None,
-            "max": latencies[-1] if total else None,
+            "min": ms(latencies[0]) if total else None,
+            "p50": ms(latencies[total // 2]) if total else None,
+            "p99": ms(latencies[int(total * 0.99)]) if total else None,
+            "max": ms(latencies[-1]) if total else None,
         },
     }
     print(json.dumps(summary), flush=True)

@@ -81,14 +81,16 @@ async def serve(args: argparse.Namespace) -> None:
     if args.sorted:
         sorted_processes = parse_sorted(args.sorted)
     else:
-        assert args.ping_sort, "--sorted or --ping-sort is required"
+        if not args.ping_sort:
+            raise SystemExit("--sorted or --ping-sort is required")
         # the address list carries no shard labels, so the provisional
         # all-own-shard list is only correct single-shard; multi-shard
         # topologies must say which peer serves which shard via --sorted
-        assert args.shard_count == 1, (
-            "--ping-sort without --sorted requires --shard-count 1; "
-            "pass --sorted for multi-shard topologies"
-        )
+        if args.shard_count != 1:
+            raise SystemExit(
+                "--ping-sort without --sorted requires --shard-count 1; "
+                "pass --sorted for multi-shard topologies"
+            )
         # provisional order (self first); ping_sort re-sorts at startup
         sorted_processes = [(args.id, args.shard_id)] + [
             (pid, args.shard_id) for pid in sorted(peers)

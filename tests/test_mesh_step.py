@@ -38,19 +38,25 @@ def test_protocol_step_executes_batch(mesh):
     seq = jnp.arange(batch, dtype=jnp.int32)
 
     state, out = step(state, key, src, seq)
-    assert bool(out.resolved.all())
-    # order is a permutation
-    assert sorted(out.order.tolist()) == list(range(batch))
+    gids = np.asarray(out.gids)
+    valid = gids >= 0
+    resolved = np.asarray(out.resolved)
+    assert resolved[valid].all()
+    work = len(gids)
+    # order is a permutation of the working rows
+    assert sorted(out.order.tolist()) == list(range(work))
     # deps respect execution order: a command's dependency executes first
-    pos = np.empty(batch, dtype=np.int64)
-    pos[np.asarray(out.order)] = np.arange(batch)
+    pos = np.empty(work, dtype=np.int64)
+    pos[np.asarray(out.order)] = np.arange(work)
+    pos_by_gid = {int(g): pos[i] for i, g in enumerate(gids) if g >= 0}
     deps = np.asarray(out.deps_gid)
-    for i in range(batch):
-        if deps[i] >= 0:
-            assert pos[deps[i]] < pos[i], f"dep of {i} executed after it"
+    for i in range(work):
+        if valid[i] and deps[i] >= 0:
+            assert pos_by_gid[int(deps[i])] < pos[i], f"dep of {i} executed after it"
     # state advanced
     assert int(state.next_gid) == batch
     assert state.frontier.tolist() == [batch] * num_replicas
+    assert int(out.pending) == 0 and int(out.pend_dropped) == 0
 
 
 def test_protocol_step_fast_path_divergence(mesh):
@@ -77,13 +83,15 @@ def test_protocol_step_fast_path_divergence(mesh):
 
     fast = np.asarray(out.fast_path)
     deps = np.asarray(out.deps_gid)
-    assert not fast[0], "diverging replica views must take the slow path"
-    assert deps[0] == 7, "union of reported deps = max gid"
+    valid = np.asarray(out.gids) >= 0
+    new0 = state.pend_gid.shape[0]  # first new-batch working row
+    assert not fast[new0], "diverging replica views must take the slow path"
+    assert deps[new0] == 7, "union of reported deps = max gid"
     # the rest of the batch chains on key 5: deterministic, fast path
-    assert fast[1:].all()
+    assert fast[new0 + 1 :].all()
     # the Synod accept round committed the fast-path miss
     assert int(out.slow_paths) == 1
-    assert bool(out.resolved.all()), "slow-path command still commits"
+    assert np.asarray(out.resolved)[valid].all(), "slow-path command still commits"
     # GC watermark: all replicas executed the whole round
     assert int(out.stable) == batch
 
@@ -109,11 +117,14 @@ def test_slow_path_fails_without_write_quorum(mesh):
     state, out = step(state, key, src, seq)
 
     resolved = np.asarray(out.resolved)
-    assert not np.asarray(out.fast_path)[0], "cmd 0 sees diverging views"
-    assert not resolved[0], "no write quorum -> slow-path cmd uncommitted"
+    new0 = state.pend_gid.shape[0]
+    assert not np.asarray(out.fast_path)[new0], "cmd 0 sees diverging views"
+    assert not resolved[new0], "no write quorum -> slow-path cmd uncommitted"
     # every later command chains (directly or transitively) on cmd 0
     assert not resolved.any(), "dependents of an uncommitted cmd cannot run"
     assert int(out.stable) == 0
+    # the liveness fix: the whole round is carried, not dropped
+    assert int(out.pending) == batch and int(out.pend_dropped) == 0
 
 
 def test_state_carries_across_steps(mesh):
@@ -130,6 +141,49 @@ def test_state_carries_across_steps(mesh):
 
     state, out = step(state, key, src, seq)
     deps = np.asarray(out.deps_gid)
+    valid = np.asarray(out.gids) >= 0
+    new0 = state.pend_gid.shape[0]
     # first command of round 2 depends on the last command of round 1
-    assert deps[0] == batch - 1
-    assert bool(out.resolved.all())
+    assert deps[new0] == batch - 1
+    assert np.asarray(out.resolved)[valid].all()
+
+
+def test_pending_commands_commit_after_quorum_recovers(mesh):
+    """The VERDICT r2 weak-#4 liveness scenario: a quorum-failed round's
+    commands carry in the device-resident pending buffer and commit in a
+    later round once enough replicas are live again."""
+    num_replicas = mesh.shape["replica"] * 2  # n=4: write quorum 3
+    batch = mesh.shape["batch"] * 4
+    state = mesh_step.init_state(
+        mesh, num_replicas, key_buckets=16, pending_capacity=2 * batch
+    )
+    kc = np.array(state.key_clock)
+    kc[0, 3] = 7  # replica 0 alone saw a prior commit on key 3: slow path
+    state = state._replace(
+        key_clock=jax.device_put(jnp.asarray(kc), state.key_clock.sharding),
+        next_gid=jnp.int32(100),
+    )
+
+    degraded = mesh_step.jit_protocol_step(mesh, live_replicas=2)
+    key = jnp.full((batch,), 3, dtype=jnp.int32)
+    src = jnp.ones((batch,), jnp.int32)
+    seq = jnp.arange(batch, dtype=jnp.int32)
+    state, out1 = degraded(state, key, src, seq)
+    assert not np.asarray(out1.resolved).any()
+    assert int(out1.pending) == batch
+
+    # quorum recovers; a fresh (disjoint-key) batch arrives
+    healthy = mesh_step.jit_protocol_step(mesh)
+    key2 = jnp.full((batch,), 9, dtype=jnp.int32)
+    seq2 = jnp.arange(batch, 2 * batch, dtype=jnp.int32)
+    state, out2 = healthy(state, key2, src, seq2)
+
+    gids = np.asarray(out2.gids)
+    resolved = np.asarray(out2.resolved)
+    carried = (gids >= 100) & (gids < 100 + batch)
+    assert carried.sum() == batch, "round-1 commands must be in the working set"
+    assert resolved[carried].all(), "carried commands commit after recovery"
+    assert resolved[gids >= 0].all()
+    assert int(out2.pending) == 0
+    # every replica executed both rounds
+    assert state.frontier.tolist() == [2 * batch] * num_replicas
