@@ -49,6 +49,28 @@ CHILD_TIMEOUT_S = 450
 _CHILD_ENV = "FANTOCH_BENCH_CHILD"  # "tpu" | "cpu"
 
 
+def slope_timed(run_k, k_lo: int, k_hi: int, iters: int):
+    """Shared slope-timing harness: ``run_k(k)`` executes k chained
+    resolves in one dispatch and returns a scalar to materialize.  Returns
+    (per_op_ms or None if the slope was noise-negative, lo_p50, hi_p50) —
+    the slope removes the rig's fixed per-dispatch round-trip (~80 ms
+    measured), which would otherwise mask a <10 ms kernel."""
+    import numpy as np
+
+    def timed(k):
+        float(run_k(k))  # compile / warm
+        out = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            float(run_k(k))
+            out.append((time.perf_counter() - t0) * 1000.0)
+        return float(np.median(out))
+
+    lo, hi = timed(k_lo), timed(k_hi)
+    slope = (hi - lo) / (k_hi - k_lo)
+    return (slope if slope > 0 else None), lo, hi
+
+
 def build_workload(batch: int, conflict: float, clients: int = 4096):
     """(key, dep, dot_src, dot_seq): conflicting commands chain on the hot
     key; private commands chain per client (latest-per-key sequential
@@ -108,14 +130,10 @@ def child_main(mode: str) -> None:
     assert int(res.n_resolved) == BATCH, f"resolved {int(res.n_resolved)}/{BATCH}"
     assert not bool(res.overflow)
 
-    # --- slope-timed device latency.  The measurement rig reaches the TPU
-    # through a tunnel with a large fixed per-dispatch round-trip (~80 ms
-    # measured; a bare `jit(lambda x: x[0])` fetch costs the same), so a
-    # single timed call cannot see a <10 ms kernel.  We time K back-to-back
-    # resolves inside ONE dispatch — serialized by a real data dependence
-    # (order[0] of resolve i perturbs the key batch of resolve i+1 by a
-    # runtime zero the compiler cannot fold) — and take the slope:
-    # per-resolve latency = (t(K_HI) - t(K_LO)) / (K_HI - K_LO).
+    # slope-timed device latency (see slope_timed): K back-to-back resolves
+    # inside ONE dispatch, serialized by a real data dependence (order[0]
+    # of resolve i perturbs the key batch of resolve i+1 by a runtime zero
+    # the compiler cannot fold).
     @functools.partial(jax.jit, static_argnames=("k",))
     def resolve_k(key, dep, src, seq, *, k):
         carry = jnp.int32(0)
@@ -132,22 +150,10 @@ def child_main(mode: str) -> None:
         return carry + r.n_resolved
 
     K_LO, K_HI = 1, 5
-
-    def timed(k):
-        float(resolve_k(key, dep, src, seq, k=k))  # compile
-        out = []
-        for _ in range(ITERS):
-            t0 = time.perf_counter()
-            float(resolve_k(key, dep, src, seq, k=k))
-            out.append((time.perf_counter() - t0) * 1000.0)
-        return out
-
-    lo_ms = timed(K_LO)
-    hi_ms = timed(K_HI)
-    lo_p50 = float(np.median(lo_ms))
-    hi_p50 = float(np.median(hi_ms))
-    slope = (hi_p50 - lo_p50) / (K_HI - K_LO)
-    if slope > 0:
+    slope, lo_p50, hi_p50 = slope_timed(
+        lambda k: resolve_k(key, dep, src, seq, k=k), K_LO, K_HI, ITERS
+    )
+    if slope is not None:
         p50 = slope
         method = (
             f"slope over {K_LO}->{K_HI} chained in-dispatch resolves, "
@@ -174,7 +180,7 @@ def child_main(mode: str) -> None:
         "dispatch_overhead_ms": round(lo_p50 - p50, 3),
         "residual_size": residual,
     }
-    # secondary measurement must never cost us the primary one
+    # secondary measurements must never cost us the primary one
     try:
         exec_ms, exec_cmds_per_s = bench_integrated_executor()
         record.update(
@@ -185,6 +191,11 @@ def child_main(mode: str) -> None:
     except Exception as exc:  # noqa: BLE001 — report, don't die
         print(f"# integrated-executor bench failed: {exc!r}", file=sys.stderr)
         record["executor_error"] = repr(exc)[:200]
+    try:
+        record.update(bench_general_path())
+    except Exception as exc:  # noqa: BLE001
+        print(f"# general-path bench failed: {exc!r}", file=sys.stderr)
+        record["general_error"] = repr(exc)[:200]
 
     print(json.dumps(record))
 
@@ -234,6 +245,53 @@ def bench_integrated_executor():
     run_once()  # warm the XLA compile cache for this batch shape
     wall_ms = min(run_once() for _ in range(3))
     return wall_ms, EXECUTOR_BATCH / (wall_ms / 1000.0)
+
+
+def bench_general_path(batch: int = 1 << 18, width: int = 4):
+    """Slope-timed ``resolve_general`` on a multi-key workload (VERDICT r2
+    weak #7: the general path had never been measured).  Commands carry up
+    to ``width`` deps: the latest command on each of their keys."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fantoch_tpu.ops.graph_resolve import TERMINAL, resolve_general
+
+    rng = np.random.default_rng(7)
+    keys = rng.integers(0, 4096, size=(batch, width))  # one dep slot per key
+    deps = np.full((batch, width), TERMINAL, dtype=np.int32)
+    last = {}
+    for i in range(batch):
+        slot = 0
+        for k in keys[i]:
+            prev = last.get(k)
+            if prev is not None and slot < width:
+                deps[i, slot] = prev
+                slot += 1
+            last[k] = i
+    dmat = jax.device_put(jnp.asarray(deps))
+    src = jax.device_put(jnp.asarray((1 + rng.integers(0, 5, size=batch)).astype(np.int32)))
+    seq = jax.device_put(jnp.asarray(np.arange(batch, dtype=np.int32)))
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def resolve_k(dmat, src, seq, *, k):
+        carry = jnp.int32(0)
+        for _ in range(k):
+            r = resolve_general(dmat + (carry >> jnp.int32(30)), src, seq)
+            carry = r.order[0]
+        return carry + r.resolved.sum()
+
+    slope, lo, _hi = slope_timed(
+        lambda k: resolve_k(dmat, src, seq, k=k), 1, 3, 5
+    )
+    return {
+        "general_batch": batch,
+        "general_width": width,
+        "general_ms": round(slope if slope is not None else lo, 3),
+        "general_method": "slope 1->3" if slope is not None else "single-call",
+    }
 
 
 def _run_child(mode: str, timeout_s: int):

@@ -40,6 +40,76 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+_WINDOW_MAX = (1 << 31) - 1
+
+
+class ClockWindow:
+    """31-bit device-clock window over unbounded host clocks.
+
+    Owns the rebasing the module docstring demands of callers: host-side
+    clocks are int64 (Newt's real-time mode uses wall-clock micros, which
+    overflow int32 after ~35 minutes); device kernels see
+    ``clock - floor`` as int32.  The floor advances monotonically with the
+    protocol's GC'd stable clock — every *live* comparison happens above
+    it, so subtracting it is order-preserving.
+
+    ``advance`` returns the shift to apply to device-resident clock tables
+    (see :func:`shift_table`); entries at or below the new floor clamp to
+    0, which keeps proposal semantics (``max(prior + 1, min)``) because a
+    floor-or-older prior constrains nothing above the floor.
+    """
+
+    __slots__ = ("_floor",)
+
+    def __init__(self, floor: int = 0):
+        assert floor >= 0
+        self._floor = int(floor)
+
+    @property
+    def floor(self) -> int:
+        return self._floor
+
+    def rebase(self, values) -> np.ndarray:
+        """Host int64 clocks -> int32 device clocks (values - floor).
+
+        Zero stays zero (the \"no clock yet\" bottom), everything else must
+        lie in (floor, floor + 2^31)."""
+        values = np.asarray(values, dtype=np.int64)
+        out = np.where(values == 0, 0, values - self._floor)
+        # strict: a clock exactly at the floor would alias the bottom (0)
+        assert (out[values != 0] > 0).all(), (
+            f"clock at or below the window floor {self._floor}: "
+            f"min {values.min()}"
+        )
+        assert (out <= _WINDOW_MAX).all(), (
+            f"clock overflows the 31-bit window above floor {self._floor}: "
+            f"max {values.max()} (advance the window)"
+        )
+        return out.astype(np.int32)
+
+    def restore(self, device_values) -> np.ndarray:
+        """Device int32 clocks -> host int64 clocks (values + floor)."""
+        vals = np.asarray(device_values, dtype=np.int64)
+        return np.where(vals == 0, 0, vals + self._floor)
+
+    def advance(self, new_floor: int) -> int:
+        """Move the floor forward (monotone); returns the int32 shift to
+        subtract from device-resident clock tables."""
+        new_floor = int(new_floor)
+        assert new_floor >= self._floor, "window floor is monotone"
+        shift = new_floor - self._floor
+        assert shift <= _WINDOW_MAX
+        self._floor = new_floor
+        return shift
+
+
+@jax.jit
+def shift_table(table: jax.Array, shift) -> jax.Array:
+    """Rebase a device-resident clock table after ``ClockWindow.advance``:
+    entries at or below the new floor clamp to 0 (no constraint)."""
+    return jnp.maximum(table - jnp.int32(shift), 0)
 
 
 @jax.jit

@@ -89,3 +89,86 @@ def test_stable_clocks_matches_votes_table():
             if frontier > 0:
                 table.add_votes([VoteRange(pid, 1, int(frontier))])
         assert int(got[key]) == table.stable_clock(), f"key {key}"
+
+
+def test_clock_window_rebase_roundtrip():
+    from fantoch_tpu.ops.table_ops import ClockWindow
+
+    # ~40 minutes of wall-clock micros: beyond int32, the real-time mode
+    # that motivates the window (newt.rs clock-bump to time.micros())
+    floor = 40 * 60 * 1_000_000
+    win = ClockWindow(floor)
+    host = np.array([0, floor + 1, floor + 12345], dtype=np.int64)
+    dev = win.rebase(host)
+    assert dev.dtype == np.int32
+    assert dev.tolist() == [0, 1, 12345]
+    assert win.restore(dev).tolist() == host.tolist()
+
+
+def test_clock_window_rejects_out_of_window():
+    import pytest
+
+    from fantoch_tpu.ops.table_ops import ClockWindow
+
+    win = ClockWindow(1000)
+    with pytest.raises(AssertionError, match="below the window floor"):
+        win.rebase(np.array([999], dtype=np.int64))
+    with pytest.raises(AssertionError, match="overflows"):
+        win.rebase(np.array([1000 + (1 << 31)], dtype=np.int64))
+
+
+def test_newt_device_clocks_cross_window_boundary():
+    """Real-time-scale Newt clock proposals through the 31-bit window:
+    batch 1 under floor A, then the window advances (GC stable moved) and
+    batch 2's proposals continue the same chains — results must equal the
+    unbounded int64 host oracle throughout."""
+    from fantoch_tpu.ops.table_ops import ClockWindow, shift_table
+
+    n_keys = 4
+    # host truth: unbounded int64 key clocks (the host oracle twin)
+    t0 = 50 * 60 * 1_000_000  # 50 min of micros — far beyond int32
+    host_prior = [t0 + k * 7 for k in range(n_keys)]
+
+    win = ClockWindow(t0 - 1)
+    dev_prior = jnp.asarray(win.rebase(host_prior))
+
+    def run_batch(keys, host_mins):
+        mins_dev = jnp.asarray(win.rebase(host_mins))
+        clock, start, new_prior = batched_clock_proposal(
+            dev_prior, jnp.asarray(keys, jnp.int32), mins_dev
+        )
+        return win.restore(clock), win.restore(start), new_prior
+
+    keys1 = [0, 1, 0, 2, 0, 3, 1]
+    mins1 = [0, 0, t0 + 100, 0, 0, 0, 0]
+    # oracle over int64 (rebased to small ints for SequentialKeyClocks)
+    base = t0 - 1
+    want_clock, want_start, want_prior = oracle_proposals(
+        [p - base for p in host_prior], keys1, [max(m - base, 0) for m in mins1]
+    )
+    got_clock, got_start, dev_prior = run_batch(keys1, mins1)
+    assert got_clock.tolist() == [c + base for c in want_clock]
+    assert got_start.tolist() == [s + base for s in want_start]
+
+    # the protocol GC'd up to a new stable clock: advance the window and
+    # rebase the device table in place
+    new_floor = t0 + 50
+    shift = win.advance(new_floor)
+    dev_prior = shift_table(dev_prior, shift)
+
+    keys2 = [0, 0, 1, 2, 3]
+    mins2 = [new_floor + 500, 0, 0, 0, 0]
+    host_prior2 = [int(v) for v in win.restore(np.asarray(dev_prior))]
+    want_clock2, want_start2, _ = oracle_proposals(
+        [max(p - new_floor, 0) for p in host_prior2],
+        keys2,
+        [max(m - new_floor, 0) for m in mins2],
+    )
+    got_clock2, got_start2, _ = run_batch(keys2, mins2)
+    assert got_clock2.tolist() == [c + new_floor for c in want_clock2]
+    assert got_start2.tolist() == [s + new_floor for s in want_start2]
+    # chains really continued across the boundary: key 0's first batch-2
+    # clock exceeds its batch-1 maximum
+    assert got_clock2[0] > max(
+        c for k, c in zip(keys1, got_clock.tolist()) if k == 0
+    )
