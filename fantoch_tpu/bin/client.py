@@ -84,6 +84,9 @@ async def drive(args: argparse.Namespace) -> None:
     client_ids = parse_id_range(args.ids)
     workload = workload_from_args(args, shard_count)
 
+    import time
+
+    t0 = time.perf_counter()
     clients = await run_clients(
         client_ids,
         shard_addresses,
@@ -91,6 +94,7 @@ async def drive(args: argparse.Namespace) -> None:
         open_loop_interval_ms=args.interval,
         status_frequency=args.status_frequency,
     )
+    elapsed_s = time.perf_counter() - t0
 
     latencies = []  # ClientData latencies are microseconds (data.py)
     for client in clients.values():
@@ -104,6 +108,10 @@ async def drive(args: argparse.Namespace) -> None:
     summary = {
         "clients": len(clients),
         "commands": total,
+        # workload wall time measured inside the client (excludes the
+        # subprocess's interpreter/JAX startup — the honest throughput base)
+        "elapsed_s": round(elapsed_s, 3),
+        "throughput_cmds_per_s": round(total / elapsed_s, 1) if elapsed_s else None,
         "latency_ms": {
             "min": ms(latencies[0]) if total else None,
             "p50": ms(latencies[total // 2]) if total else None,

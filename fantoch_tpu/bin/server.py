@@ -56,6 +56,9 @@ def build_parser() -> argparse.ArgumentParser:
     add_config_flags(parser)
     parser.add_argument("--workers", type=int, default=1)
     parser.add_argument("--executors", type=int, default=1)
+    parser.add_argument("--multiplexing", type=int, default=1,
+                        help="TCP connections per peer (random writer pick, "
+                        "process.rs:71-97)")
     parser.add_argument("--metrics-file", default=None)
     parser.add_argument("--metrics-interval", type=int, default=5000, metavar="MS")
     parser.add_argument("--execution-log", default=None)
@@ -107,6 +110,7 @@ async def serve(args: argparse.Namespace) -> None:
         sorted_processes=sorted_processes,
         workers=args.workers,
         executors=args.executors,
+        multiplexing=args.multiplexing,
         peer_delays=delays or None,
         ping_sort=args.ping_sort,
         metrics_file=args.metrics_file,

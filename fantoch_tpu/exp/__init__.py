@@ -1,0 +1,17 @@
+"""Experiment orchestration (the fantoch_exp analog).
+
+Reference: fantoch_exp/src/{lib,bench,machine,config}.rs + testbed/{aws,
+baremetal,local}.rs — launches a testbed, generates the full server/client
+flag sets from an ``ExperimentConfig``, runs the binaries, and collects
+logs + metrics into a results directory that fantoch_tpu.plot consumes.
+
+The localhost testbed is fully functional (subprocess-driven CLI
+binaries — the analog of testbed/local.rs); AWS/baremetal orchestration
+(tsunami/rusoto in the reference) is out of scope for this environment
+and raises with a clear message.
+"""
+
+from fantoch_tpu.exp.config import ExperimentConfig
+from fantoch_tpu.exp.bench import run_experiment
+
+__all__ = ["ExperimentConfig", "run_experiment"]

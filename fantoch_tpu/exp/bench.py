@@ -1,0 +1,155 @@
+"""Experiment driver: boot a testbed, run one experiment, collect results.
+
+Reference: fantoch_exp/src/bench.rs:43-260 (run the protocol + client
+binaries with generated flags, wait for completion, pull metrics files)
+and testbed/local.rs (the localhost testbed).  Each experiment leaves a
+results directory::
+
+    <output_dir>/<config.name()>/
+        manifest.json        — the ExperimentConfig + outcome summary
+        client_data.pkl      — per-client latency data (client binary)
+        client_summary.json  — the client binary's stdout summary
+        metrics_p*.gz        — per-process metrics snapshots
+        execution_p*.log     — per-process execution logs
+        server_p*.log        — server stdout/stderr
+
+which fantoch_tpu.plot's ResultsDB indexes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+from typing import Dict, Optional
+
+from fantoch_tpu.exp.config import ExperimentConfig
+
+
+def _cli_env() -> Dict[str, str]:
+    env = dict(os.environ)
+    env["FANTOCH_PLATFORM"] = env.get("FANTOCH_PLATFORM", "cpu")
+    env.pop("JAX_PLATFORMS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def run_experiment(
+    config: ExperimentConfig,
+    output_dir: str,
+    testbed: str = "localhost",
+    client_timeout_s: int = 600,
+) -> Dict:
+    """Run one experiment end to end; returns the manifest dict."""
+    if testbed != "localhost":
+        raise NotImplementedError(
+            f"testbed {testbed!r}: the reference's AWS/baremetal orchestration "
+            "(fantoch_exp/src/testbed/{aws,baremetal}.rs over tsunami/rusoto) "
+            "has no cloud access in this environment; use 'localhost'"
+        )
+    from fantoch_tpu.core.ids import process_ids
+    from fantoch_tpu.run.harness import free_port
+
+    exp_dir = os.path.join(output_dir, config.name())
+    os.makedirs(exp_dir, exist_ok=True)
+
+    shard_ids = {s: list(process_ids(s, config.n)) for s in range(config.shard_count)}
+    all_pids = [(pid, s) for s, ids in shard_ids.items() for pid in ids]
+    offset_of = {pid: pid - shard_ids[s][0] for pid, s in all_pids}
+    peer_ports = {pid: free_port() for pid, _ in all_pids}
+    client_ports = {pid: free_port() for pid, _ in all_pids}
+
+    env = _cli_env()
+    servers = []
+    logs = []
+    try:
+        for pid, shard in all_pids:
+            ids = shard_ids[shard]
+            offset = offset_of[pid]
+            peers = [p for p in ids if p != pid]
+            sorted_entries = [f"{pid}:{shard}"] + [f"{p}:{shard}" for p in peers]
+            for other, other_ids in shard_ids.items():
+                if other != shard:
+                    closest = other_ids[offset]
+                    peers.append(closest)
+                    sorted_entries.append(f"{closest}:{other}")
+            addresses = ",".join(f"{p}=127.0.0.1:{peer_ports[p]}" for p in peers)
+            args = config.server_args(
+                pid,
+                shard,
+                peer_ports[pid],
+                client_ports[pid],
+                addresses,
+                ",".join(sorted_entries),
+                observe_dir=exp_dir,
+            )
+            log = open(os.path.join(exp_dir, f"server_p{pid}.log"), "w")
+            logs.append(log)
+            servers.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "fantoch_tpu.bin.server", *args],
+                    stdout=log,
+                    stderr=subprocess.STDOUT,
+                    env=env,
+                )
+            )
+
+        # clients attach to the offset-0 process of every shard
+        client_addresses = ",".join(
+            f"{s}=127.0.0.1:{client_ports[ids[0]]}" for s, ids in shard_ids.items()
+        )
+        n_clients = config.clients_per_process * config.n
+        client = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "fantoch_tpu.bin.client",
+                *config.client_args(
+                    f"1-{n_clients}",
+                    client_addresses,
+                    metrics_file=os.path.join(exp_dir, "client_data.pkl"),
+                ),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=client_timeout_s,
+            env=env,
+        )
+        if client.returncode != 0:
+            raise RuntimeError(
+                f"client failed:\n{client.stdout}\n{client.stderr}"
+            )
+        summary = json.loads(client.stdout.strip().splitlines()[-1])
+        with open(os.path.join(exp_dir, "client_summary.json"), "w") as fh:
+            json.dump(summary, fh)
+        # let the metrics loggers take a final-interval snapshot
+        time.sleep(0.7)
+    finally:
+        for proc in servers:
+            proc.send_signal(signal.SIGINT)
+        for proc in servers:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for log in logs:
+            log.close()
+
+    manifest = {
+        "config": config.to_dict(),
+        "name": config.name(),
+        "outcome": {
+            "commands": summary["commands"],
+            "latency_ms": summary["latency_ms"],
+            # measured inside the client binary, excluding its startup
+            "wall_s": summary["elapsed_s"],
+            "throughput_cmds_per_s": summary["throughput_cmds_per_s"],
+        },
+    }
+    with open(os.path.join(exp_dir, "manifest.json"), "w") as fh:
+        json.dump(manifest, fh, indent=2)
+    return manifest

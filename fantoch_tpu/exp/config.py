@@ -1,0 +1,118 @@
+"""ExperimentConfig: one experiment's full parameterization + flag gen.
+
+Reference: fantoch_exp/src/config.rs — ``ProtocolConfig::to_args`` /
+``ClientConfig::to_args`` (:134-230, :320-378) serialize the experiment
+into the binaries' flag sets; ``ExperimentConfig`` (:380-472) is the
+record the results DB indexes by.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    protocol: str
+    n: int
+    f: int
+    shard_count: int = 1
+    clients_per_process: int = 1
+    commands_per_client: int = 100
+    key_gen: str = "conflict_rate"  # or "zipf"
+    conflict_rate: int = 50
+    zipf_coefficient: float = 1.0
+    keys_per_shard: int = 1_000_000
+    keys_per_command: int = 1
+    payload_size: int = 0
+    read_only_percentage: int = 0
+    open_loop_interval_ms: Optional[int] = None
+    # parallelism (prod defaults in the reference: 16/16/32,
+    # fantoch_exp/src/config.rs:20-41 — localhost defaults are small)
+    workers: int = 1
+    executors: int = 1
+    multiplexing: int = 1
+    batched_graph_executor: bool = False
+    gc_interval_ms: int = 50
+    extra_flags: Tuple[str, ...] = field(default_factory=tuple)
+
+    def name(self) -> str:
+        """Directory-friendly experiment name (config.rs:464-472)."""
+        kg = (
+            f"cr{self.conflict_rate}"
+            if self.key_gen == "conflict_rate"
+            else f"zipf{self.zipf_coefficient}"
+        )
+        return (
+            f"{self.protocol}_n{self.n}_f{self.f}_s{self.shard_count}_"
+            f"{kg}_k{self.keys_per_command}_c{self.clients_per_process}"
+        )
+
+    def to_dict(self) -> Dict:
+        return asdict(self)
+
+    # --- flag generation (the to_args analogs) ---
+
+    def server_args(
+        self,
+        process_id: int,
+        shard_id: int,
+        port: int,
+        client_port: int,
+        addresses: str,
+        sorted_processes: str,
+        observe_dir: Optional[str] = None,
+    ) -> List[str]:
+        args = [
+            "--protocol", self.protocol,
+            "--id", str(process_id),
+            "--shard-id", str(shard_id),
+            "--port", str(port),
+            "--client-port", str(client_port),
+            "--addresses", addresses,
+            "--sorted", sorted_processes,
+            "-n", str(self.n),
+            "-f", str(self.f),
+            "--shard-count", str(self.shard_count),
+            "--workers", str(self.workers),
+            "--executors", str(self.executors),
+            "--multiplexing", str(self.multiplexing),
+            "--gc-interval", str(self.gc_interval_ms),
+        ]
+        if self.batched_graph_executor:
+            args.append("--batched-graph-executor")
+        if self.protocol == "fpaxos":
+            args += ["--leader", "1"]
+        if self.protocol == "newt":
+            args += ["--newt-detached-send-interval", "50"]
+        if observe_dir:
+            args += [
+                "--metrics-file", f"{observe_dir}/metrics_p{process_id}.gz",
+                "--metrics-interval", "500",
+                "--execution-log", f"{observe_dir}/execution_p{process_id}.log",
+            ]
+        args += list(self.extra_flags)
+        return args
+
+    def client_args(
+        self, ids: str, addresses: str, metrics_file: Optional[str] = None
+    ) -> List[str]:
+        args = [
+            "--ids", ids,
+            "--addresses", addresses,
+            "--key-gen", self.key_gen,
+            "--conflict-rate", str(self.conflict_rate),
+            "--zipf-coefficient", str(self.zipf_coefficient),
+            "--keys-per-shard", str(self.keys_per_shard),
+            "--keys-per-command", str(self.keys_per_command),
+            "--commands-per-client", str(self.commands_per_client),
+            "--read-only-percentage", str(self.read_only_percentage),
+            "--payload-size", str(self.payload_size),
+            "--shard-count", str(self.shard_count),
+        ]
+        if self.open_loop_interval_ms is not None:
+            args += ["--interval", str(self.open_loop_interval_ms)]
+        if metrics_file:
+            args += ["--metrics-file", metrics_file]
+        return args

@@ -1,0 +1,11 @@
+"""Results database + plots (the fantoch_plot analog).
+
+Reference: fantoch_plot/src/{lib,db/*,plot/*}.rs — a results DB over
+serialized experiment configs + metrics, and latency/CDF/throughput
+plots rendered through matplotlib (the reference reaches matplotlib via
+pyo3; here it is native).
+"""
+
+from fantoch_tpu.plot.db import ExperimentResult, ResultsDB
+
+__all__ = ["ExperimentResult", "ResultsDB", "plots"]

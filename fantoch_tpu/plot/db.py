@@ -1,0 +1,94 @@
+"""Results database: index experiment output directories.
+
+Reference: fantoch_plot/src/db/*.rs (``ResultsDB``/``Search`` over
+serialized ExperimentConfig + metrics + client data).  Each experiment
+directory is one ``run_experiment`` output (fantoch_tpu/exp/bench.py);
+``search`` filters by any ExperimentConfig field.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ExperimentResult:
+    path: str
+    config: Dict[str, Any]
+    outcome: Dict[str, Any]
+    _client_data: Optional[Dict] = field(default=None, repr=False)
+    _metrics: Optional[Dict[int, Any]] = field(default=None, repr=False)
+
+    @property
+    def name(self) -> str:
+        return os.path.basename(self.path)
+
+    def latencies_us(self) -> List[int]:
+        """All client-observed latencies (microseconds), pooled."""
+        if self._client_data is None:
+            with open(os.path.join(self.path, "client_data.pkl"), "rb") as fh:
+                self._client_data = pickle.load(fh)
+        out: List[int] = []
+        for data in self._client_data.values():
+            out.extend(data.latency_data())
+        return out
+
+    def process_metrics(self) -> Dict[int, Any]:
+        """pid -> ProcessMetrics snapshot (fantoch_tpu/run/observe.py)."""
+        if self._metrics is None:
+            from fantoch_tpu.run.observe import read_metrics_snapshot
+
+            self._metrics = {}
+            for path in glob.glob(os.path.join(self.path, "metrics_p*.gz")):
+                pid = int(os.path.basename(path)[len("metrics_p"):-len(".gz")])
+                self._metrics[pid] = read_metrics_snapshot(path)
+        return self._metrics
+
+    def protocol_totals(self) -> Dict[str, int]:
+        """Summed fast/slow/stable counters across processes."""
+        from fantoch_tpu.protocol import ProtocolMetricsKind
+
+        totals = {"fast_path": 0, "slow_path": 0, "stable": 0}
+        for snap in self.process_metrics().values():
+            for worker in snap.workers:
+                totals["fast_path"] += (
+                    worker.get_aggregated(ProtocolMetricsKind.FAST_PATH) or 0
+                )
+                totals["slow_path"] += (
+                    worker.get_aggregated(ProtocolMetricsKind.SLOW_PATH) or 0
+                )
+                totals["stable"] += (
+                    worker.get_aggregated(ProtocolMetricsKind.STABLE) or 0
+                )
+        return totals
+
+
+class ResultsDB:
+    def __init__(self, root: str):
+        self.root = root
+        self.results: List[ExperimentResult] = []
+        for manifest in sorted(glob.glob(os.path.join(root, "*", "manifest.json"))):
+            with open(manifest) as fh:
+                data = json.load(fh)
+            self.results.append(
+                ExperimentResult(
+                    os.path.dirname(manifest), data["config"], data["outcome"]
+                )
+            )
+
+    def __len__(self) -> int:
+        return len(self.results)
+
+    def search(self, **filters: Any) -> List[ExperimentResult]:
+        """Results whose config matches every given field, e.g.
+        ``db.search(protocol="epaxos", f=1)``."""
+        out = []
+        for result in self.results:
+            if all(result.config.get(k) == v for k, v in filters.items()):
+                out.append(result)
+        return out

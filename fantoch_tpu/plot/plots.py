@@ -1,0 +1,119 @@
+"""Plots over the results DB.
+
+Reference: fantoch_plot/src/lib.rs:179-1664 — latency bars, CDFs,
+throughput-latency curves and metrics tables, rendered with matplotlib
+(via pyo3 there, natively here; Agg backend, file output only).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from fantoch_tpu.plot.db import ExperimentResult
+
+# headless: the reference renders to files too (fantoch_plot output dir)
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+
+def _label(result: ExperimentResult) -> str:
+    cfg = result.config
+    return f"{cfg['protocol']} n={cfg['n']} f={cfg['f']}"
+
+
+def latency_cdf(results: List[ExperimentResult], path: str) -> str:
+    """Per-experiment latency CDFs (lib.rs cdf_plot analog)."""
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for result in results:
+        lat_ms = np.sort(np.asarray(result.latencies_us())) / 1000.0
+        ys = np.arange(1, len(lat_ms) + 1) / len(lat_ms)
+        ax.plot(lat_ms, ys, label=_label(result), drawstyle="steps-post")
+    ax.set_xlabel("latency (ms)")
+    ax.set_ylabel("CDF")
+    ax.set_ylim(0, 1)
+    ax.legend(fontsize=8)
+    ax.grid(alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+def latency_percentiles(
+    results: List[ExperimentResult], path: str, percentiles=(50, 95, 99)
+) -> str:
+    """Grouped percentile bars per experiment (latency_plot analog)."""
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    width = 0.8 / len(percentiles)
+    xs = np.arange(len(results))
+    for j, p in enumerate(percentiles):
+        vals = [
+            float(np.percentile(np.asarray(r.latencies_us()), p)) / 1000.0
+            for r in results
+        ]
+        ax.bar(xs + j * width, vals, width, label=f"p{p}")
+    ax.set_xticks(xs + width * (len(percentiles) - 1) / 2)
+    ax.set_xticklabels([_label(r) for r in results], fontsize=8, rotation=15)
+    ax.set_ylabel("latency (ms)")
+    ax.legend()
+    ax.grid(axis="y", alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+def throughput_latency(
+    results: List[ExperimentResult], path: str, percentile: float = 50
+) -> str:
+    """Throughput vs latency scatter/curve across experiments
+    (throughput_latency_plot analog): one point per experiment, meant for
+    a client-count sweep of the same protocol config."""
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    by_proto = {}
+    for r in results:
+        by_proto.setdefault(r.config["protocol"], []).append(r)
+    for proto, rs in sorted(by_proto.items()):
+        rs = sorted(rs, key=lambda r: r.outcome["throughput_cmds_per_s"])
+        xs = [r.outcome["throughput_cmds_per_s"] for r in rs]
+        ys = [
+            float(np.percentile(np.asarray(r.latencies_us()), percentile)) / 1000.0
+            for r in rs
+        ]
+        ax.plot(xs, ys, marker="o", label=proto)
+    ax.set_xlabel("throughput (cmds/s)")
+    ax.set_ylabel(f"p{percentile:.0f} latency (ms)")
+    ax.legend()
+    ax.grid(alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+def fast_path_split(results: List[ExperimentResult], path: str) -> str:
+    """Stacked fast/slow commit counts per experiment (the metrics-table
+    analog of lib.rs:1491-1664, as a bar chart)."""
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    xs = np.arange(len(results))
+    fast = []
+    slow = []
+    for r in results:
+        totals = r.protocol_totals()
+        fast.append(totals["fast_path"])
+        slow.append(totals["slow_path"])
+    ax.bar(xs, fast, 0.6, label="fast path")
+    ax.bar(xs, slow, 0.6, bottom=fast, label="slow path")
+    ax.set_xticks(xs)
+    ax.set_xticklabels([_label(r) for r in results], fontsize=8, rotation=15)
+    ax.set_ylabel("commits")
+    ax.legend()
+    ax.grid(axis="y", alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path

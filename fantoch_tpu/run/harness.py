@@ -37,6 +37,7 @@ async def run_localhost_cluster(
     extra_run_time_ms: int = 500,
     workers: int = 1,
     executors: int = 1,
+    multiplexing: int = 1,
     peer_delays: Optional[Dict[ProcessId, Dict[ProcessId, int]]] = None,
     ping_sort: bool = False,
     observe_dir: Optional[str] = None,
@@ -91,6 +92,7 @@ async def run_localhost_cluster(
             sorted_processes=sorted_processes,
             workers=workers,
             executors=executors,
+            multiplexing=multiplexing,
             peer_delays=(peer_delays or {}).get(pid),
             ping_sort=ping_sort,
             metrics_file=(

@@ -31,6 +31,7 @@ parallelism axis on TPU is the batched device step, not threads.
 from __future__ import annotations
 
 import asyncio
+import random
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from fantoch_tpu.core.config import Config
@@ -67,6 +68,25 @@ def executor_index(info: Any, size: int) -> Optional[int]:
     if isinstance(key, str):
         return key_hash(key) % size
     return 0
+
+
+class _PeerLinks:
+    """The ``multiplexing`` TCP connections to one peer: each send picks a
+    random link (process.rs:71-97 connect loop + :680-696
+    send_to_one_writer), so messages to the same peer may ride different
+    connections and arrive reordered — the adversity the reference's
+    buffered-commit paths are built for."""
+
+    __slots__ = ("queues",)
+
+    def __init__(self) -> None:
+        self.queues: List[asyncio.Queue] = []
+
+    def put_nowait(self, frame: Any) -> None:
+        if len(self.queues) == 1:
+            self.queues[0].put_nowait(frame)
+        else:
+            random.choice(self.queues).put_nowait(frame)
 
 
 class _StampingQueue(asyncio.Queue):
@@ -152,6 +172,7 @@ class ProcessRuntime:
         sorted_processes: List[Tuple[ProcessId, ShardId]],
         workers: int = 1,
         executors: int = 1,
+        multiplexing: int = 1,
         peer_delays: Optional[Dict[ProcessId, int]] = None,
         ping_sort: bool = False,
         metrics_file: Optional[str] = None,
@@ -192,7 +213,9 @@ class ProcessRuntime:
             executor.set_executor_index(index)
         self.dot_gen = AtomicIdGen(process_id)
         self.client_sessions: Dict[ClientId, _ClientSession] = {}
-        self._peer_writers: Dict[ProcessId, asyncio.Queue] = {}
+        assert multiplexing >= 1
+        self.multiplexing = multiplexing
+        self._peer_writers: Dict[ProcessId, _PeerLinks] = {}
         # per-connection artificial delay in ms (delay.rs:6-39): outbound
         # frames to these peers pass through a FIFO delay line
         self.peer_delays = peer_delays or {}
@@ -253,24 +276,32 @@ class ProcessRuntime:
         client_server = await asyncio.start_server(self._on_client, *self.client_addr)
         self._servers = [peer_server, client_server]
 
-        # connect to every peer, retrying while they boot (process.rs:71-111)
+        # connect to every peer — `multiplexing` connections each, retrying
+        # while they boot (process.rs:71-111).  The links object is only
+        # registered once its first connection is up: the reader task's
+        # wait-guard keys on _peer_writers membership, and an empty links
+        # would crash its random pick
         for peer_id, addr in self.peers.items():
-            rw = await connect_with_retry(addr)
-            await rw.send(ProcessHi(self.process.id, self.process.shard_id))
-            delay_ms = self.peer_delays.get(peer_id)
-            if delay_ms:
-                # FIFO delay line between the enqueue side and the writer
-                # (delay.rs:6-39): frames leave `delay_ms` after entering,
-                # so entry times are stamped at put (a burst still leaves
-                # one delay later, not serialized at one frame per delay)
-                queue = _StampingQueue(asyncio.get_running_loop())
-                delayed: asyncio.Queue = asyncio.Queue()
-                self.spawn(self._delay_task(queue, delayed, delay_ms))
-                self.spawn(self._writer_task(rw, delayed))
-            else:
-                queue = asyncio.Queue()
-                self.spawn(self._writer_task(rw, queue))
-            self._peer_writers[peer_id] = queue
+            links = _PeerLinks()
+            for _ in range(self.multiplexing):
+                rw = await connect_with_retry(addr)
+                await rw.send(ProcessHi(self.process.id, self.process.shard_id))
+                delay_ms = self.peer_delays.get(peer_id)
+                if delay_ms:
+                    # FIFO delay line between the enqueue side and the
+                    # writer (delay.rs:6-39): frames leave `delay_ms` after
+                    # entering, so entry times are stamped at put (a burst
+                    # still leaves one delay later, not serialized at one
+                    # frame per delay)
+                    queue = _StampingQueue(asyncio.get_running_loop())
+                    delayed: asyncio.Queue = asyncio.Queue()
+                    self.spawn(self._delay_task(queue, delayed, delay_ms))
+                    self.spawn(self._writer_task(rw, delayed))
+                else:
+                    queue = asyncio.Queue()
+                    self.spawn(self._writer_task(rw, queue))
+                links.queues.append(queue)
+                self._peer_writers[peer_id] = links
 
         if self.ping_sort:
             self.sorted_processes = await self._ping_sorted_processes()

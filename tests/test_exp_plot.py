@@ -1,0 +1,62 @@
+"""Experiment orchestration + results DB + plots (fantoch_exp /
+fantoch_plot analogs): run real localhost experiments through the CLI
+binaries, index the results, render plots."""
+
+import os
+
+import pytest
+
+from fantoch_tpu.exp import ExperimentConfig, run_experiment
+from fantoch_tpu.plot import ResultsDB
+from fantoch_tpu.plot import plots
+
+
+def test_experiment_config_name_and_args():
+    cfg = ExperimentConfig("epaxos", 3, 1, conflict_rate=30, clients_per_process=2)
+    assert cfg.name() == "epaxos_n3_f1_s1_cr30_k1_c2"
+    args = cfg.server_args(1, 0, 7001, 8001, "2=h:2,3=h:3", "1:0,2:0,3:0")
+    assert "--protocol" in args and "epaxos" in args
+    cargs = cfg.client_args("1-6", "0=h:8001")
+    assert "--commands-per-client" in cargs
+
+
+def test_non_localhost_testbed_rejected(tmp_path):
+    cfg = ExperimentConfig("epaxos", 3, 1)
+    with pytest.raises(NotImplementedError, match="aws"):
+        run_experiment(cfg, str(tmp_path), testbed="aws")
+
+
+def test_run_experiments_db_and_plots(tmp_path):
+    out = str(tmp_path / "results")
+    configs = [
+        ExperimentConfig(
+            "epaxos", 3, 1, commands_per_client=8, conflict_rate=50, payload_size=2
+        ),
+        ExperimentConfig(
+            "newt", 3, 1, commands_per_client=8, conflict_rate=50, payload_size=2
+        ),
+    ]
+    for cfg in configs:
+        manifest = run_experiment(cfg, out)
+        assert manifest["outcome"]["commands"] == 8 * 3  # 1 client/process x n
+        assert manifest["outcome"]["latency_ms"]["p50"] is not None
+
+    db = ResultsDB(out)
+    assert len(db) == 2
+    (ep,) = db.search(protocol="epaxos")
+    assert ep.config["n"] == 3
+    lats = ep.latencies_us()
+    assert len(lats) == 24 and all(l > 0 for l in lats)
+    totals = ep.protocol_totals()
+    assert totals["fast_path"] + totals["slow_path"] == 24
+    assert totals["stable"] == 3 * 24
+
+    # plots render to files
+    for fn, name in [
+        (plots.latency_cdf, "cdf.png"),
+        (plots.latency_percentiles, "pct.png"),
+        (plots.throughput_latency, "tl.png"),
+        (plots.fast_path_split, "split.png"),
+    ]:
+        path = fn(db.results, str(tmp_path / name))
+        assert os.path.getsize(path) > 1000

@@ -21,6 +21,7 @@ def run_cluster(
     config,
     workers=1,
     executors=1,
+    multiplexing=1,
     open_loop_interval_ms=None,
     check_agreement=True,
     peer_delays=None,
@@ -50,6 +51,7 @@ def run_cluster(
             CLIENTS_PER_PROCESS,
             workers=workers,
             executors=executors,
+            multiplexing=multiplexing,
             open_loop_interval_ms=open_loop_interval_ms,
             extra_run_time_ms=1000,
             peer_delays=peer_delays,
@@ -292,6 +294,14 @@ def test_run_epaxos_3_1_delay_injection():
     # run/mod.rs:1184-1192) — correctness must hold under asymmetric delays
     delays = {1: {2: 10}, 3: {2: 10}}
     slow = run_cluster(EPaxos, Config(n=3, f=1), peer_delays=delays)
+    assert slow == 0
+
+
+def test_run_epaxos_3_1_multiplexing():
+    # 3 TCP connections per peer with random writer pick: same-peer
+    # messages may reorder across links (process.rs:71-97,680-696),
+    # exercising the buffered-commit reordering paths
+    slow = run_cluster(EPaxos, Config(n=3, f=1), multiplexing=3)
     assert slow == 0
 
 
