@@ -23,7 +23,7 @@ import os
 import signal
 import subprocess
 import sys
-
+import time
 from typing import Dict, Optional
 
 from fantoch_tpu.exp.config import ExperimentConfig
