@@ -445,7 +445,7 @@ class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
     def _partial_join(self, acc, data):
         return acc | set(data)
 
-    def _partial_final_mcommit(self, dot: Dot, data):
+    def _partial_final_mcommit(self, dot: Dot, data, _local):
         return MCommit(dot, ConsensusValue(set(data)))
 
     def _dot_in_my_shard(self, dot: Dot) -> bool:

@@ -19,11 +19,14 @@ Extras mirrored here:
   under low load (newt.rs:983-1006);
 - detached-vote batching via the periodic ``SendDetached`` event.
 
-Partial replication: NOT yet wired for Newt — the reference's Newt partial
-path (MBump key-clock priming + clock-max MShardCommit aggregation,
-newt.rs:1025-1100) differs from the deps-union aggregation that
-fantoch_tpu.protocol.partial provides for Atlas; Newt submits assert
-single-shard commands until that clock-flavored aggregation lands.
+Partial replication (newt.rs:1025-1100 + 680-730): the target shard
+forwards submits (MForwardSubmit); every acking fast-quorum member also
+MBumps the closest process of each other shard so their key clocks chase
+the command's likely timestamp with detached votes; each shard's decided
+clock travels to the dot owner via MShardCommit, the owner aggregates the
+*max* over shards, and the final MCommit at each participant carries the
+aggregated clock with the shard's locally-held Votes (Votes never cross
+shards — the data2 channel of partial.rs).
 """
 
 from __future__ import annotations
@@ -64,6 +67,12 @@ from fantoch_tpu.protocol.common.table_clocks import (
     Votes,
 )
 from fantoch_tpu.protocol.gc import GCTrack
+from fantoch_tpu.protocol.partial import (
+    MForwardSubmit,
+    MShardAggregatedCommit,
+    MShardCommit,
+    PartialCommitMixin,
+)
 from fantoch_tpu.protocol.info import CommandsInfo
 from fantoch_tpu.run.routing import (
     worker_dot_index_shift,
@@ -101,6 +110,17 @@ class MCommit:
 class MCommitClock:
     """Notify the clock-bump worker of a commit clock (newt.rs:660-676)."""
 
+    clock: int
+
+
+@dataclass
+class MBump:
+    """Cross-shard key-clock priming: a fast-quorum member of the target
+    shard tells the closest process of every other shard the clock it
+    acked, so that shard's keys chase the likely final timestamp with
+    detached votes (newt.rs:1045-1060, handler :680-708)."""
+
+    dot: Dot
     clock: int
 
 
@@ -166,7 +186,7 @@ class NewtInfo:
 CLOCK_BUMP_WORKER_INDEX = 1
 
 
-class Newt(CommitGCMixin, Protocol):
+class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
     Executor = TableExecutor
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
@@ -188,6 +208,9 @@ class Newt(CommitGCMixin, Protocol):
         self._to_executors: Deque[Any] = deque()
         # accumulated detached votes, flushed by SendDetachedEvent
         self._detached = Votes()
+        # MBump clocks that arrived before the MCollect (newt.rs:45,699-708)
+        self._buffered_mbumps: Dict[Dot, int] = {}
+        self._init_partial()
         # MCommit before MCollect (multiplexing reorders): buffer
         self._buffered_mcommits: Dict[Dot, Tuple[ProcessId, int, Votes]] = {}
         # highest committed clock: the floor for real-time clock bumps
@@ -228,7 +251,7 @@ class Newt(CommitGCMixin, Protocol):
         return connect_ok, dict(self.bp.closest_shard_process())
 
     def submit(self, dot: Optional[Dot], cmd: Command, time: SysTime) -> None:
-        self._handle_submit(dot, cmd)
+        self._handle_submit(dot, cmd, target_shard=True)
 
     def handle(self, from_, from_shard_id, msg, time):
         if isinstance(msg, MCollect):
@@ -248,6 +271,20 @@ class Newt(CommitGCMixin, Protocol):
             self._handle_mconsensus(from_, msg.dot, msg.ballot, msg.clock)
         elif isinstance(msg, MConsensusAck):
             self._handle_mconsensusack(from_, msg.dot, msg.ballot)
+        elif isinstance(msg, MForwardSubmit):
+            self._handle_submit(msg.dot, msg.cmd, target_shard=False)
+        elif isinstance(msg, MBump):
+            self._handle_mbump(msg.dot, msg.clock)
+        elif isinstance(msg, MShardCommit):
+            info = self._cmds.get(msg.dot)
+            assert info.cmd is not None, (
+                "the dot owner submits before any shard can commit"
+            )
+            self.partial_handle_mshard_commit(
+                from_, msg.dot, msg.data, info.cmd.shard_count
+            )
+        elif isinstance(msg, MShardAggregatedCommit):
+            self.partial_handle_mshard_aggregated_commit(msg.dot, msg.data)
         elif not self.handle_gc_message(from_, msg):
             raise AssertionError(f"unknown message {msg}")
 
@@ -280,12 +317,11 @@ class Newt(CommitGCMixin, Protocol):
 
     # --- handlers ---
 
-    def _handle_submit(self, dot: Optional[Dot], cmd: Command) -> None:
+    def _handle_submit(
+        self, dot: Optional[Dot], cmd: Command, target_shard: bool
+    ) -> None:
         dot = dot if dot is not None else self.bp.next_dot()
-        assert cmd.shard_count == 1, (
-            "Newt does not support multi-shard commands yet (the clock-max "
-            "shard aggregation of newt.rs:1025-1100 is not wired)"
-        )
+        self.partial_submit_actions(dot, cmd, target_shard)
         # propose: bump key clocks, consuming votes; those votes are either
         # shipped in the MCollect (skip_fast_ack: quorum members can commit
         # without the ack round) or kept for the MCollectAck aggregation
@@ -311,6 +347,9 @@ class Newt(CommitGCMixin, Protocol):
                 self.key_clocks.init_clocks(cmd)
             info.status = Status.PAYLOAD
             info.cmd = cmd
+            buffered_bump = self._buffered_mbumps.pop(dot, None)
+            if buffered_bump is not None:
+                self.key_clocks.detached(cmd, buffered_bump, self._detached)
             buffered = self._buffered_mcommits.pop(dot, None)
             if buffered is not None:
                 buf_from, buf_clock, buf_votes = buffered
@@ -350,6 +389,19 @@ class Newt(CommitGCMixin, Protocol):
             self._to_processes.append(
                 ToSend({from_}, MCollectAck(dot, clock, process_votes))
             )
+            # prime the other shards' key clocks with the acked clock
+            # (newt.rs:1045-1060): each acking member bumps the closest
+            # process of every other shard the command touches
+            for shard_id in cmd.shards():
+                if shard_id != self.bp.shard_id:
+                    self._to_processes.append(
+                        ToSend({self.bp.closest_process(shard_id)}, MBump(dot, clock))
+                    )
+        # a buffered MBump from another shard can now generate detached
+        # votes (newt.rs:434-440)
+        buffered_bump = self._buffered_mbumps.pop(dot, None)
+        if buffered_bump is not None:
+            self.key_clocks.detached(cmd, buffered_bump, self._detached)
 
     def _handle_mcollectack(self, from_, dot, clock, remote_votes) -> None:
         info = self._cmds.get(dot)
@@ -380,8 +432,43 @@ class Newt(CommitGCMixin, Protocol):
                 ToSend(self.bp.write_quorum(), MConsensus(dot, ballot, max_clock))
             )
 
+    def _handle_mbump(self, dot: Dot, clock: int) -> None:
+        """Another shard's acked clock: chase it with detached votes, or
+        buffer (keeping the max) until the MCollect delivers the payload
+        (newt.rs:680-708).
+
+        get_existing, not get: a bump racing behind the commit (the bump is
+        one hop, the commit path is four) must not resurrect a GC'd info —
+        the reference's `cmds.get` here re-creates it and leaks.  A bump
+        for a dot with no info either precedes the MCollect (buffer; the
+        MCollect handler drains it) or trails the commit (the commit
+        handler drops the buffered entry, see _handle_mcommit)."""
+        info = self._cmds.get_existing(dot)
+        if info is not None and info.cmd is not None:
+            if info.status != Status.COMMIT:
+                self.key_clocks.detached(info.cmd, clock, self._detached)
+            return
+        prev = self._buffered_mbumps.get(dot, 0)
+        self._buffered_mbumps[dot] = max(prev, clock)
+
     def _mcommit_actions(self, info: NewtInfo, dot: Dot, clock: int, votes: Votes) -> None:
-        self._to_processes.append(ToSend(self.bp.all(), MCommit(dot, clock, votes)))
+        """Single-shard: broadcast MCommit.  Multi-shard: clock-max shard
+        aggregation; the Votes stay here and rejoin the final MCommit
+        (newt.rs:1063-1093)."""
+        cmd = info.cmd
+        if cmd is None or not self.partial_mcommit_actions(dot, cmd, clock, local=votes):
+            self._to_processes.append(ToSend(self.bp.all(), MCommit(dot, clock, votes)))
+
+    # --- partial-replication adapters (clock max; newt.rs:825-895) ---
+
+    def _partial_initial_data(self):
+        return 0
+
+    def _partial_join(self, acc, data):
+        return max(acc, data)
+
+    def _partial_final_mcommit(self, dot: Dot, data, local):
+        return MCommit(dot, data, local if local is not None else Votes())
 
     def _handle_mcommit(self, from_, dot, clock, votes: Votes) -> None:
         info = self._cmds.get(dot)
@@ -400,6 +487,9 @@ class Newt(CommitGCMixin, Protocol):
             )
 
         info.status = Status.COMMIT
+        # a bump buffered between our commit and its own delivery is moot
+        # (detached votes already cover the commit clock)
+        self._buffered_mbumps.pop(dot, None)
         out = info.synod.handle(from_, MChosen(clock))
         assert out is None
 
@@ -425,13 +515,17 @@ class Newt(CommitGCMixin, Protocol):
         if out is None:
             return
         if isinstance(out, SynodMAccepted):
-            msg: Any = MConsensusAck(dot, out.ballot)
+            self._to_processes.append(ToSend({from_}, MConsensusAck(dot, out.ballot)))
         elif isinstance(out, MChosen):
-            # already chosen: answer with a commit carrying our local votes
-            msg = MCommit(dot, out.value, info.votes)
+            # already chosen: answer with a commit carrying our local votes.
+            # Multi-shard commands must not: the local clock lacks the
+            # cross-shard max, which only travels via MShardAggregatedCommit
+            if info.cmd is None or info.cmd.shard_count == 1:
+                self._to_processes.append(
+                    ToSend({from_}, MCommit(dot, out.value, info.votes))
+                )
         else:
             raise AssertionError(f"unexpected synod output {out}")
-        self._to_processes.append(ToSend({from_}, msg))
 
     def _handle_mconsensusack(self, from_, dot, ballot) -> None:
         info = self._cmds.get(dot)
@@ -463,7 +557,20 @@ class Newt(CommitGCMixin, Protocol):
 
     @staticmethod
     def message_index(msg):
-        if isinstance(msg, (MCollect, MCollectAck, MCommit, MConsensus, MConsensusAck)):
+        if isinstance(
+            msg,
+            (
+                MCollect,
+                MCollectAck,
+                MCommit,
+                MConsensus,
+                MConsensusAck,
+                MForwardSubmit,
+                MBump,
+                MShardCommit,
+                MShardAggregatedCommit,
+            ),
+        ):
             return worker_dot_index_shift(msg.dot)
         if isinstance(msg, MCommitClock):
             return worker_index_no_shift(CLOCK_BUMP_WORKER_INDEX)

@@ -17,8 +17,9 @@ dot; commits are then aggregated:
 ``PartialCommitMixin`` owns the per-dot aggregation state and exposes the
 four hooks; the protocol supplies three small adapters describing what its
 commit data looks like (join for the aggregate, message constructors).
-Used by Atlas (deps union); EPaxos does not support partial replication
-(mirroring the reference, fantoch_ps/src/protocol/epaxos.rs).
+Used by Atlas (deps union) and Newt (clock max, with the Votes riding the
+``local`` channel); EPaxos does not support partial replication (mirroring
+the reference, fantoch_ps/src/protocol/epaxos.rs).
 """
 
 from __future__ import annotations
@@ -84,14 +85,21 @@ class PartialCommitMixin:
         join (e.g. an empty Dependency set for Atlas);
       * ``_partial_join(acc, data)`` — commutative join of per-shard data
         (deps union for Atlas; max clock for a timestamp protocol);
-      * ``_partial_final_mcommit(dot, data)`` — the protocol's MCommit
-        message carrying the aggregated data.
+      * ``_partial_final_mcommit(dot, data, local)`` — the protocol's
+        MCommit message carrying the aggregated data plus whatever the
+        participant stashed as ``local`` at ``partial_mcommit_actions``
+        time (the reference's data2 channel — e.g. Newt's Votes, which
+        never cross shards; None when nothing was stashed).
     """
 
     _shards_commits: Dict[Dot, ShardsCommits]
 
     def _init_partial(self) -> None:
         self._shards_commits = {}
+        # per-dot data that stays at the participant and rejoins the final
+        # MCommit after aggregation (the reference's D2 / set_votes channel:
+        # Newt's Votes never cross shards, partial.rs:37-102 data2)
+        self._partial_local: Dict[Dot, Any] = {}
 
     # --- hook 1: submit-side forwarding (partial.rs:8-35) ---
 
@@ -109,13 +117,18 @@ class PartialCommitMixin:
 
     # --- hook 2: at a shard's commit decision (partial.rs:37-102) ---
 
-    def partial_mcommit_actions(self, dot: Dot, cmd: Command, data: Any) -> bool:
+    def partial_mcommit_actions(
+        self, dot: Dot, cmd: Command, data: Any, local: Any = None
+    ) -> bool:
         """Returns True if the commit was routed through shard aggregation
         (multi-shard); False means the caller should broadcast its own
-        MCommit (single-shard command)."""
+        MCommit (single-shard command).  ``local`` stays here and is handed
+        back to ``_partial_final_mcommit`` when the aggregate returns."""
         shard_count = cmd.shard_count
         if shard_count == 1:
             return False
+        if local is not None:
+            self._partial_local[dot] = local
         # our own data flows through the MShardCommit to the owner (which
         # may be ourselves — self-delivery) and comes back aggregated
         self._to_processes.append(ToSend({dot.source}, MShardCommit(dot, data)))
@@ -143,8 +156,9 @@ class PartialCommitMixin:
     # --- hook 4: back at each participant (partial.rs:144-177) ---
 
     def partial_handle_mshard_aggregated_commit(self, dot: Dot, data: Any) -> None:
+        local = self._partial_local.pop(dot, None)
         self._to_processes.append(
-            ToSend(self.bp.all(), self._partial_final_mcommit(dot, data))
+            ToSend(self.bp.all(), self._partial_final_mcommit(dot, data, local))
         )
 
     # --- adapters the protocol must provide ---
@@ -155,5 +169,5 @@ class PartialCommitMixin:
     def _partial_join(self, acc: Any, data: Any) -> Any:
         raise NotImplementedError
 
-    def _partial_final_mcommit(self, dot: Dot, data: Any):
+    def _partial_final_mcommit(self, dot: Dot, data: Any, local: Any):
         raise NotImplementedError

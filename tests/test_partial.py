@@ -32,8 +32,9 @@ class Cluster:
     message router (the protocol-level analog of the reference's
     message-walk tests, atlas.rs:922+)."""
 
-    def __init__(self, n: int, f: int, shard_count: int, protocol_cls=Atlas):
-        self.config = Config(
+    def __init__(self, n: int, f: int, shard_count: int, protocol_cls=Atlas,
+                 config: Config = None):
+        self.config = config or Config(
             n=n, f=f, shard_count=shard_count, gc_interval_ms=100
         )
         self.n = n
@@ -64,7 +65,7 @@ class Cluster:
                 ok, _ = proto.discover(discover)
                 assert ok
                 self.protocols[pid] = proto
-                executor = GraphExecutor(pid, shard, self.config)
+                executor = protocol_cls.Executor(pid, shard, self.config)
                 executor.set_executor_index(0)
                 self.executors[pid] = executor
                 self.shard_of[pid] = shard
@@ -76,11 +77,20 @@ class Cluster:
         self.drain(pid)
 
     def drain(self, pid: int) -> None:
+        import copy
+
         proto = self.protocols[pid]
         for action in proto.to_processes_iter():
             if isinstance(action, ToSend):
-                for target in sorted(action.target):
-                    self.queue.append((pid, self.shard_of[pid], target, action.msg))
+                # one deep copy per target, like the sim/runner's
+                # serialize-per-connection: receivers may mutate payloads
+                # in place (Newt strips MCommit Votes per key)
+                targets = sorted(action.target)
+                copies = [action.msg] + [
+                    copy.deepcopy(action.msg) for _ in targets[1:]
+                ]
+                for target, msg in zip(targets, copies):
+                    self.queue.append((pid, self.shard_of[pid], target, msg))
             elif isinstance(action, ToForward):
                 self.queue.append((pid, self.shard_of[pid], pid, action.msg))
         for info in proto.to_executors_iter():
@@ -226,3 +236,48 @@ def test_atlas_cross_shard_dependency_fetch():
             assert rifls == [2], f"p{pid}: {rifls}"
         else:
             assert rifls == [1, 2], f"p{pid}: {rifls}"
+
+
+def test_newt_two_shard_commit_and_execute():
+    """Newt partial replication: MForwardSubmit + MBump priming + clock-max
+    MShardCommit aggregation (newt.rs:1025-1100); both shards execute their
+    part of the command once timestamps stabilize."""
+    from fantoch_tpu.protocol.newt import Newt, SendDetachedEvent
+
+    class NewtCluster(Cluster):
+        def __init__(self, n, f, shard_count):
+            super().__init__(
+                n,
+                f,
+                shard_count,
+                protocol_cls=Newt,
+                config=Config(
+                    n=n,
+                    f=f,
+                    shard_count=shard_count,
+                    gc_interval_ms=100,
+                    newt_detached_send_interval_ms=50,
+                ),
+            )
+
+        def pump_detached(self):
+            """Manually fire the detached-vote flush (the periodic event the
+            message-walk loop has no timer for)."""
+            for pid, proto in self.protocols.items():
+                proto.handle_event(SendDetachedEvent(), TIME)
+                self.drain(pid)
+            self.run()
+
+    cluster = NewtCluster(3, 1, 2)
+    cmd = multi_shard_cmd(1, {0: ["a"], 1: ["b"]})
+    cluster.submit(1, cmd)
+    cluster.run()
+    for _ in range(4):
+        cluster.pump_detached()
+
+    seen = set(cluster.messages_seen)
+    assert {"MForwardSubmit", "MBump", "MShardCommit",
+            "MShardAggregatedCommit", "MCommit"} <= seen
+    for pid, shard in cluster.shard_of.items():
+        rifls = cluster.executed(pid)
+        assert rifls == [Rifl(1, 1)], f"p{pid} (shard {shard}) executed {rifls}"

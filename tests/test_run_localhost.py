@@ -203,6 +203,14 @@ def test_run_atlas_3_1_three_shards():
     run_multi_shard_cluster(Atlas, Config(n=3, f=1), shard_count=3)
 
 
+def test_run_newt_3_1_two_shards():
+    run_multi_shard_cluster(
+        Newt,
+        Config(n=3, f=1, newt_detached_send_interval_ms=50),
+        shard_count=2,
+    )
+
+
 def test_run_basic_3_1():
     # Basic is the reference's *inconsistent* protocol (fantoch/src/protocol/
     # basic.rs): commands execute at commit without cross-process ordering,
