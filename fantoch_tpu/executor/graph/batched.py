@@ -306,7 +306,7 @@ class BatchedDependencyGraph(DependencyGraph):
         packed = pack_dots(src, seq)
         sort_idx = np.argsort(packed, kind="stable").astype(np.int64)
         sorted_packed = packed[sort_idx]
-        assert len(np.unique(sorted_packed)) == batch, "duplicate dot added"
+        assert batch == 0 or (np.diff(sorted_packed) > 0).all(), "duplicate dot added"
 
         flat = deps.reshape(-1)
         valid = flat >= 0
@@ -453,7 +453,9 @@ class BatchedDependencyGraph(DependencyGraph):
 
     def _emit_rows(self, rows: np.ndarray, src, seq, tms, time: SysTime) -> None:
         cmds = self._backlog.cmds
-        self._to_execute.extend(cmds[i] for i in rows)
+        # map + tolist: ~3x faster than a genexpr with ndarray indices at
+        # 250k rows (list.__getitem__ on Python ints, one C-level loop)
+        self._to_execute.extend(map(cmds.__getitem__, rows.tolist()))
         self._frontier.add_batch(src[rows], seq[rows])
         now = float(time.millis())
         self._metrics.collect_many(

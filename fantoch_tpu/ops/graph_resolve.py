@@ -266,45 +266,52 @@ def resolve_functional_keyed(
     chain_ok = lastbad < run_start  # no unverified link in [run_start, p]
     rank_fast = p_iota - run_start
 
-    # --- 3. compact the residual (stable by cflag keeps run order)
     cflag = chain_ok.astype(jnp.int32)
-    _, p_r_full = jax.lax.sort((cflag, p_iota), num_keys=1, is_stable=True)
     n_residual = batch - cflag.sum()
     overflow = n_residual > res_n
 
-    p_r = p_r_full[:res_n]  # sorted-space position of each residual row
-    r_iota = jnp.arange(res_n, dtype=jnp.int32)
-    valid_r = r_iota < n_residual
-    # small gathers (res_n rows) pull the rest of the residual view
-    rpos = pos_s[p_r]  # original batch index
-    rdep = dep_s[p_r]
-    rrs = jnp.where(valid_r, run_start[p_r], jnp.iinfo(jnp.int32).max)
-    rsrc = dot_src[rpos]
-    rseq = dot_seq[rpos]
+    def _residual_path(structure: bool):
+        """Compact + doubling + emit (stages 3-4).  Returns
+        (order, unres_b) and, when ``structure``, per-vertex
+        (rank_b, leader_b, cyc_b) in sorted space."""
+        # --- 3. compact the residual (stable by cflag keeps run order)
+        _, p_r_full = jax.lax.sort((cflag, p_iota), num_keys=1, is_stable=True)
+        p_r = p_r_full[:res_n]  # sorted-space position of each residual row
+        r_iota = jnp.arange(res_n, dtype=jnp.int32)
+        valid_r = r_iota < n_residual
+        # small gathers (res_n rows) pull the rest of the residual view
+        rpos = pos_s[p_r]  # original batch index
+        rdep = dep_s[p_r]
+        rrs = jnp.where(valid_r, run_start[p_r], jnp.iinfo(jnp.int32).max)
+        rsrc = dot_src[rpos]
+        rseq = dot_seq[rpos]
 
-    # remap deps to residual-local slots; deps leaving the residual (into a
-    # verified prefix or already executed) fold to TERMINAL — the whole
-    # prefix of the run is emitted before any residual member of it
-    remap = jnp.full((batch,), TERMINAL, dtype=jnp.int32)
-    remap = remap.at[jnp.where(valid_r, rpos, batch)].set(r_iota, mode="drop")
-    rdep_local = jnp.where(
-        rdep >= 0, remap[jnp.clip(rdep, 0, batch - 1)], rdep
-    )
-    rdep_local = jnp.where(valid_r, rdep_local, TERMINAL)
+        # remap deps to residual-local slots; deps leaving the residual (into
+        # a verified prefix or already executed) fold to TERMINAL — the whole
+        # prefix of the run is emitted before any residual member of it
+        remap = jnp.full((batch,), TERMINAL, dtype=jnp.int32)
+        remap = remap.at[jnp.where(valid_r, rpos, batch)].set(r_iota, mode="drop")
+        rdep_local = jnp.where(
+            rdep >= 0, remap[jnp.clip(rdep, 0, batch - 1)], rdep
+        )
+        rdep_local = jnp.where(valid_r, rdep_local, TERMINAL)
 
-    # residual groups (per run) in p order: first residual row of a run
-    # sits exactly at the run's first unverified position
-    g_head = jnp.concatenate([jnp.ones((1,), bool), rrs[1:] != rrs[:-1]])
-    firstbad = jax.lax.cummax(jnp.where(g_head, p_r, 0))
+        # residual groups (per run) in p order: first residual row of a run
+        # sits exactly at the run's first unverified position.  In p_r order
+        # rrs is already sorted (run_start is monotone in p and compaction
+        # is stable), so the emit sort below keeps every group's block at
+        # the same offsets — per-group constants like firstbad carry over
+        # elementwise without riding the sort.
+        g_head = jnp.concatenate([jnp.ones((1,), bool), rrs[1:] != rrs[:-1]])
+        firstbad = jax.lax.cummax(jnp.where(g_head, p_r, 0))
 
-    # --- exact finish at residual scale
-    l_resolved, l_rank, l_leader, l_on_cycle = _doubling_core(rdep_local)
+        # --- exact finish at residual scale
+        l_resolved, l_rank, l_leader, l_on_cycle = _doubling_core(rdep_local)
 
-    # emit order within each run's residual tail: resolved first, then
-    # (rank, SCC leader, dot) — SCC members contiguous and dot-sorted
-    l_unres = (~l_resolved).astype(jnp.int32)
-    (_, _, _, _, _, _, e_p_r, e_firstbad, e_res, e_rank2, e_leader2, e_cyc) = jax.lax.sort(
-        (
+        # emit order within each run's residual tail: resolved first, then
+        # (rank, SCC leader, dot) — SCC members contiguous and dot-sorted
+        l_unres = (~l_resolved).astype(jnp.int32)
+        operands = [
             rrs,
             l_unres,
             l_rank,
@@ -312,49 +319,62 @@ def resolve_functional_keyed(
             rsrc,
             rseq,
             p_r,
-            firstbad,
             l_resolved.astype(jnp.int32),
-            jnp.where(valid_r, l_rank, 0),
-            rpos[jnp.clip(l_leader, 0, res_n - 1)],  # leader as original index
-            l_on_cycle.astype(jnp.int32),
-        ),
-        num_keys=6,
-        is_stable=True,
-    )
-    # group boundaries after the emit sort: rrs is its primary key, so the
-    # emit-ordered rrs column is simply sorted(rrs)
-    rrs_emit = jnp.sort(rrs)
-    e_g_head = jnp.concatenate([jnp.ones((1,), bool), rrs_emit[1:] != rrs_emit[:-1]])
-    e_group_start = jax.lax.cummax(jnp.where(e_g_head, r_iota, 0))
-    emit_local = r_iota - e_group_start
-    e_valid = valid_r  # invalid rows sank to the emit-sort tail (rrs=max)
-    target_r = e_firstbad + emit_local
+        ]
+        if structure:
+            operands += [
+                jnp.where(valid_r, l_rank, 0),
+                rpos[jnp.clip(l_leader, 0, res_n - 1)],  # leader as orig index
+                l_on_cycle.astype(jnp.int32),
+            ]
+        sorted_ops = jax.lax.sort(tuple(operands), num_keys=6, is_stable=True)
+        e_p_r, e_res = sorted_ops[6], sorted_ops[7]
+        emit_local = r_iota - jax.lax.cummax(jnp.where(g_head, r_iota, 0))
+        target_r = firstbad + emit_local
+        # invalid rows sank to the emit-sort tail (rrs=max) = exactly ~valid_r
 
-    # --- 4. scatter residual emit data back over the batch, final sort
-    sc_idx = jnp.where(e_valid, e_p_r, batch)
-    tgt_b = p_iota.at[sc_idx].set(target_r, mode="drop")
-    unres_b = (~chain_ok).at[sc_idx].set(e_res == 0, mode="drop")
-    order_sorted = jax.lax.sort(
-        (unres_b.astype(jnp.int32), tgt_b, pos_s), num_keys=2, is_stable=True
-    )
-    order = order_sorted[2]
-    n_resolved = (batch - unres_b.sum()).astype(jnp.int32)
+        # --- 4. scatter residual emit data back over the batch, final sort
+        # by one packed key: (unresolved << 30) | target position
+        sc_idx = jnp.where(valid_r, e_p_r, batch)
+        tgt_b = p_iota.at[sc_idx].set(target_r, mode="drop")
+        unres_b = (~chain_ok).at[sc_idx].set(e_res == 0, mode="drop")
+        packed = jnp.where(unres_b, jnp.int32(1) << 30, 0) | tgt_b
+        _, order = jax.lax.sort((packed, pos_s), num_keys=1, is_stable=True)
+        if not structure:
+            return order, unres_b
+
+        e_rank2, e_leader2, e_cyc = sorted_ops[8], sorted_ops[9], sorted_ops[10]
+        rank_b = jnp.where(chain_ok, rank_fast, _UNRESOLVED_RANK)
+        rank_b = rank_b.at[sc_idx].set(
+            jnp.where(e_res == 1, firstbad - rrs + e_rank2, _UNRESOLVED_RANK),
+            mode="drop",
+        )
+        leader_b = pos_s.at[sc_idx].set(e_leader2, mode="drop")
+        cyc_b = jnp.zeros((batch,), jnp.int32).at[sc_idx].set(e_cyc, mode="drop")
+        return order, unres_b, rank_b, leader_b, cyc_b
 
     if not return_structure:
+        # latency-critical entry: when every link chain-verified (the
+        # dominant shape — deps produced by latest-per-key KeyDeps in
+        # arrival order) the run position IS the rank and the grouped order
+        # is already the execution order; skip compaction + doubling + emit,
+        # which at residual scale are pure op-launch overhead (~10 ms of the
+        # round-2 kernel's 17 ms — scripts/profile_resolve.py).
+        order, unres_b = jax.lax.cond(
+            n_residual == 0,
+            lambda: (pos_s, jnp.zeros((batch,), bool)),
+            lambda: _residual_path(False),
+        )
+        n_resolved = (batch - unres_b.sum()).astype(jnp.int32)
         zeros = jnp.zeros((batch,), jnp.int32)
         return KeyedResolution(
             order, ~unres_b, zeros, zeros, zeros.astype(bool), n_resolved, overflow
         )
 
+    order, unres_b, rank_b, leader_b, cyc_b = _residual_path(True)
+    n_resolved = (batch - unres_b.sum()).astype(jnp.int32)
+
     # realign per-vertex structure to original batch order (one more sort)
-    rank_b = jnp.where(chain_ok, rank_fast, _UNRESOLVED_RANK)
-    rank_b = rank_b.at[sc_idx].set(
-        jnp.where(e_res == 1, e_firstbad - rrs_emit + e_rank2, _UNRESOLVED_RANK),
-        mode="drop",
-    )
-    leader_b = pos_s  # prefix rows lead themselves
-    leader_b = leader_b.at[sc_idx].set(e_leader2, mode="drop")
-    cyc_b = jnp.zeros((batch,), jnp.int32).at[sc_idx].set(e_cyc, mode="drop")
     aligned = jax.lax.sort(
         (
             pos_s,

@@ -277,18 +277,8 @@ class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
             self._handle_mconsensus(from_, msg.dot, msg.ballot, msg.value)
         elif isinstance(msg, MConsensusAck):
             self._handle_mconsensusack(from_, msg.dot, msg.ballot)
-        elif isinstance(msg, MForwardSubmit):
-            self._handle_submit(msg.dot, msg.cmd, target_shard=False)
-        elif isinstance(msg, MShardCommit):
-            info = self._cmds.get(msg.dot)
-            assert info.cmd is not None, (
-                "the dot owner submits before any shard can commit"
-            )
-            self.partial_handle_mshard_commit(
-                from_, msg.dot, msg.data, info.cmd.shard_count
-            )
-        elif isinstance(msg, MShardAggregatedCommit):
-            self.partial_handle_mshard_aggregated_commit(msg.dot, msg.data)
+        elif self.handle_partial_message(from_, msg):
+            pass
         elif not self.handle_gc_message(from_, msg):
             raise AssertionError(f"unknown message {msg}")
 

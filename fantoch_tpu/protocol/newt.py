@@ -210,6 +210,16 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
         self._detached = Votes()
         # MBump clocks that arrived before the MCollect (newt.rs:45,699-708)
         self._buffered_mbumps: Dict[Dot, int] = {}
+        # committed-dot guard for the buffer: a bump trailing the commit by
+        # more than one message (the info is already GC'd for cross-shard
+        # dots) must be dropped, not buffered forever — get_existing cannot
+        # distinguish "never seen" from "GC'd"
+        from fantoch_tpu.core.clocks import AEClock
+        from fantoch_tpu.core.ids import all_process_ids
+
+        self._mbump_committed: AEClock[ProcessId] = AEClock(
+            [pid for pid, _ in all_process_ids(config.shard_count, config.n)]
+        )
         self._init_partial()
         # MCommit before MCollect (multiplexing reorders): buffer
         self._buffered_mcommits: Dict[Dot, Tuple[ProcessId, int, Votes]] = {}
@@ -271,20 +281,10 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
             self._handle_mconsensus(from_, msg.dot, msg.ballot, msg.clock)
         elif isinstance(msg, MConsensusAck):
             self._handle_mconsensusack(from_, msg.dot, msg.ballot)
-        elif isinstance(msg, MForwardSubmit):
-            self._handle_submit(msg.dot, msg.cmd, target_shard=False)
         elif isinstance(msg, MBump):
             self._handle_mbump(msg.dot, msg.clock)
-        elif isinstance(msg, MShardCommit):
-            info = self._cmds.get(msg.dot)
-            assert info.cmd is not None, (
-                "the dot owner submits before any shard can commit"
-            )
-            self.partial_handle_mshard_commit(
-                from_, msg.dot, msg.data, info.cmd.shard_count
-            )
-        elif isinstance(msg, MShardAggregatedCommit):
-            self.partial_handle_mshard_aggregated_commit(msg.dot, msg.data)
+        elif self.handle_partial_message(from_, msg):
+            pass
         elif not self.handle_gc_message(from_, msg):
             raise AssertionError(f"unknown message {msg}")
 
@@ -448,6 +448,8 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
             if info.status != Status.COMMIT:
                 self.key_clocks.detached(info.cmd, clock, self._detached)
             return
+        if self._mbump_committed.contains(dot.source, dot.sequence):
+            return  # trails a GC'd commit: buffering would leak forever
         prev = self._buffered_mbumps.get(dot, 0)
         self._buffered_mbumps[dot] = max(prev, clock)
 
@@ -488,8 +490,13 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
 
         info.status = Status.COMMIT
         # a bump buffered between our commit and its own delivery is moot
-        # (detached votes already cover the commit clock)
+        # (detached votes already cover the commit clock); the guard clock
+        # drops bumps that trail the commit after the info is GC'd — only
+        # multi-shard dots ever receive MBumps, so single-shard commits
+        # (the hot path) skip the guard entirely
         self._buffered_mbumps.pop(dot, None)
+        if cmd.shard_count > 1:
+            self._mbump_committed.add(dot.source, dot.sequence)
         out = info.synod.handle(from_, MChosen(clock))
         assert out is None
 
@@ -519,7 +526,12 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
         elif isinstance(out, MChosen):
             # already chosen: answer with a commit carrying our local votes.
             # Multi-shard commands must not: the local clock lacks the
-            # cross-shard max, which only travels via MShardAggregatedCommit
+            # cross-shard max, which only travels via MShardAggregatedCommit.
+            # Staying silent here is a liveness gap only under coordinator
+            # recovery (a new coordinator re-running consensus against
+            # already-chosen acceptors) — recovery is out of scope, as in
+            # the reference (newt.rs:1110-1112 panics todo!); in the
+            # no-recovery regime the sole MConsensus round precedes MChosen
             if info.cmd is None or info.cmd.shard_count == 1:
                 self._to_processes.append(
                     ToSend({from_}, MCommit(dot, out.value, info.votes))

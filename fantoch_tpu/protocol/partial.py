@@ -161,6 +161,27 @@ class PartialCommitMixin:
             ToSend(self.bp.all(), self._partial_final_mcommit(dot, data, local))
         )
 
+    # --- shared message dispatch (the handle() tail both protocols share) ---
+
+    def handle_partial_message(self, from_: ProcessId, msg) -> bool:
+        """Dispatch the partial-replication message set; False when ``msg``
+        is none of them (caller continues its chain)."""
+        if isinstance(msg, MForwardSubmit):
+            self._handle_submit(msg.dot, msg.cmd, target_shard=False)
+        elif isinstance(msg, MShardCommit):
+            info = self._cmds.get(msg.dot)
+            assert info.cmd is not None, (
+                "the dot owner submits before any shard can commit"
+            )
+            self.partial_handle_mshard_commit(
+                from_, msg.dot, msg.data, info.cmd.shard_count
+            )
+        elif isinstance(msg, MShardAggregatedCommit):
+            self.partial_handle_mshard_aggregated_commit(msg.dot, msg.data)
+        else:
+            return False
+        return True
+
     # --- adapters the protocol must provide ---
 
     def _partial_initial_data(self) -> Any:

@@ -417,6 +417,49 @@ def test_keyed_fast_entry_counts():
     assert int(res.n_resolved) == n_exec == int(np.asarray(res.resolved).sum())
 
 
+def test_keyed_fast_entry_order_matches_structure_entry():
+    # the latency entry (return_structure=False) takes a lax.cond fast path
+    # when the residual is empty; its emitted order must equal the structure
+    # entry's on both branches
+    from fantoch_tpu.ops.graph_resolve import (
+        _residual_size_for,
+        resolve_functional_keyed,
+    )
+
+    def both_orders(keys, dep, src, seq):
+        outs = []
+        for structure in (True, False):
+            res = resolve_functional_keyed(
+                jnp.asarray(keys),
+                jnp.asarray(dep),
+                jnp.asarray(src),
+                jnp.asarray(seq),
+                residual_size=_residual_size_for(len(keys)),
+                return_structure=structure,
+            )
+            assert not bool(res.overflow)
+            outs.append((np.asarray(res.order), int(res.n_resolved)))
+        return outs
+
+    # (a) arrival-order chains on two keys: residual empty -> cond fast path
+    keys = np.array([7, 9, 7, 9, 7], dtype=np.int32)
+    dep = np.array([-1, -1, 0, 1, 2], dtype=np.int32)
+    src = np.ones(5, dtype=np.int32)
+    seq = np.arange(1, 6, dtype=np.int32)
+    (o_s, n_s), (o_f, n_f) = both_orders(keys, dep, src, seq)
+    assert n_s == n_f == 5
+    assert o_s.tolist() == o_f.tolist()
+
+    # (b) an inverted chain + a 2-cycle: residual path on both entries
+    keys = np.array([7, 7, 7, 9, 9], dtype=np.int32)
+    dep = np.array([1, 2, -1, 4, 3], dtype=np.int32)  # 0<-1<-2; 3<->4
+    src = np.array([1, 1, 1, 1, 2], dtype=np.int32)
+    seq = np.array([3, 2, 1, 1, 1], dtype=np.int32)
+    (o_s, n_s), (o_f, n_f) = both_orders(keys, dep, src, seq)
+    assert n_s == n_f == 5
+    assert o_s.tolist() == o_f.tolist()
+
+
 # --- general (multi-key, out-degree D) ---
 
 

@@ -281,3 +281,15 @@ def test_newt_two_shard_commit_and_execute():
     for pid, shard in cluster.shard_of.items():
         rifls = cluster.executed(pid)
         assert rifls == [Rifl(1, 1)], f"p{pid} (shard {shard}) executed {rifls}"
+
+    # a bump trailing the commit (info already GC'd on cross-shard
+    # processes) must be dropped, not buffered forever; a bump for a dot
+    # never seen here must still buffer (it precedes the MCollect)
+    committed_dot = Dot(1, 1)
+    for pid, proto in cluster.protocols.items():
+        proto._handle_mbump(committed_dot, 10_000)
+        assert proto._buffered_mbumps == {}, f"p{pid} leaked a stale bump"
+    some_shard1 = next(p for p, s in cluster.shard_of.items() if s == 1)
+    proto = cluster.protocols[some_shard1]
+    proto._handle_mbump(Dot(1, 99), 7)
+    assert proto._buffered_mbumps == {Dot(1, 99): 7}
