@@ -491,6 +491,40 @@ def resolve_general(
     if max_iters == 0:
         max_iters = 4 * _num_doubling_steps(batch) + 8
 
+    # self-dependencies are semantic no-ops (a command never waits on
+    # itself); prune them up front like the host oracle (tarjan.py:129) —
+    # left in, they'd read as unfinishable frozen slots in the iterative
+    # pass and falsely disqualify the backward fast path
+    deps = jnp.where(deps == idx[:, None], TERMINAL, deps)
+
+    # --- fast path: every dependency points backward in batch order and
+    # nothing is missing.  This is the dominant executor shape (deps are
+    # latest-per-key at commit time, appended in commit order), and it
+    # makes batch order itself a topological order: backward-only edges
+    # cannot form cycles, so every SCC is a singleton and emitting in
+    # arrival order satisfies the per-key dependency contract.  The
+    # iterative machinery below costs O(critical-path alternations) rounds
+    # of B-wide gathers — measured 6.7 s at B=262k, D=4 on deep chains —
+    # while this check is one elementwise pass.
+    backward_only = jnp.where(deps >= 0, deps < idx[:, None], True).all()
+    fast = backward_only & ~(deps == MISSING).any()
+
+    def _fast_arrival():
+        ones = jnp.ones((batch,), bool)
+        return idx, ones, idx, idx, jnp.zeros((batch,), bool)
+
+    def _iterative():
+        return _resolve_general_iterative(deps, dot_src, dot_seq, max_iters)
+
+    return GeneralResolution(*jax.lax.cond(fast, _fast_arrival, _iterative))
+
+
+def _resolve_general_iterative(deps, dot_src, dot_seq, max_iters):
+    """The exact fallback: mutual-edge SCC collapse + affine-max doubling
+    (see resolve_general).  Returns the GeneralResolution fields."""
+    batch, width = deps.shape
+    idx = jnp.arange(batch, dtype=jnp.int32)
+
     # --- mutual-edge SCC collapse: v and u mutually dependent -> same SCC,
     # and so is the whole connected component of the (undirected) mutual-
     # edge graph.  leader = min id of the component, found by min-label
@@ -612,4 +646,4 @@ def resolve_general(
     stuck = ~resolved & ~(missing_blocked | scc_missing[leader])
 
     order = _order_from_ranks(rank, leader, dot_src, dot_seq)
-    return GeneralResolution(order, resolved, rank, leader, stuck)
+    return order, resolved, rank, leader, stuck

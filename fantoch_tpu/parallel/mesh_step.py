@@ -58,10 +58,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 shard_map = jax.shard_map
 
-from fantoch_tpu.ops.graph_resolve import MISSING, TERMINAL, resolve_functional
+from fantoch_tpu.ops.graph_resolve import (
+    MISSING,
+    TERMINAL,
+    resolve_functional,
+    resolve_general,
+)
 
 REPLICA_AXIS = "replica"
 BATCH_AXIS = "batch"
+KEY_PAD = -1  # empty key slot in a [.., KW] key matrix
 
 
 class ReplicaState(NamedTuple):
@@ -81,12 +87,15 @@ class ReplicaState(NamedTuple):
     (VERDICT r2 weak #4 liveness fix).  Slot empty iff ``pend_gid == -1``;
     replicated across the mesh (pending commands are global protocol
     state, like the reference's per-dot info store awaiting commit).
+
+    ``pend_key`` is ``int32[Pcap, KW]``: commands carry up to KW key
+    buckets (multi-key commands, command.rs:12-19), padded with KEY_PAD.
     """
 
     key_clock: jax.Array  # int32[R, K]
     frontier: jax.Array  # int32[R]
     next_gid: jax.Array  # int32[] — global id of the next batch's first cmd
-    pend_key: jax.Array  # int32[Pcap]
+    pend_key: jax.Array  # int32[Pcap, KW]
     pend_src: jax.Array  # int32[Pcap]
     pend_seq: jax.Array  # int32[Pcap]
     pend_gid: jax.Array  # int32[Pcap] (-1 = empty slot)
@@ -100,7 +109,7 @@ class StepOutput(NamedTuple):
     order: jax.Array  # int32[W] execution order (working-row indices)
     resolved: jax.Array  # bool[W] — executed this round
     fast_path: jax.Array  # bool[W] — committed on the fast path
-    deps_gid: jax.Array  # int32[W] — final dependency (global id, -1 none)
+    deps_gid: jax.Array  # int32[W, KW] — final deps (global ids, -1 none)
     gids: jax.Array  # int32[W] — global id per working row (-1 = empty)
     slow_paths: jax.Array  # int32[] — commands that took the Synod round
     stable: jax.Array  # int32[] — GC watermark: min executed frontier
@@ -144,8 +153,12 @@ def init_state(
     num_replicas: int,
     key_buckets: int = 4096,
     pending_capacity: int = 256,
+    key_width: int = 1,
 ) -> ReplicaState:
-    """Device-resident initial state, sharded over the replica axis."""
+    """Device-resident initial state, sharded over the replica axis.
+
+    ``key_width``: max key buckets per command (multi-key commands route
+    through the general resolver on-mesh)."""
     sharding = NamedSharding(mesh, P(REPLICA_AXIS, None))
     key_clock = jax.device_put(
         jnp.full((num_replicas, key_buckets), -1, dtype=jnp.int32), sharding
@@ -157,38 +170,43 @@ def init_state(
     rep = NamedSharding(mesh, P())
     next_gid = jax.device_put(jnp.int32(0), rep)
 
-    def empty():  # distinct buffers: donated state must not alias
-        return jax.device_put(
-            jnp.full((pending_capacity,), -1, dtype=jnp.int32), rep
-        )
+    def empty(shape):  # distinct buffers: donated state must not alias
+        return jax.device_put(jnp.full(shape, -1, dtype=jnp.int32), rep)
 
+    cap = pending_capacity
     return ReplicaState(
-        key_clock, frontier, next_gid, empty(), empty(), empty(), empty()
+        key_clock, frontier, next_gid,
+        empty((cap, key_width)), empty((cap,)), empty((cap,)), empty((cap,)),
     )
 
 
-def _intra_batch_chain(key: jax.Array) -> jax.Array:
-    """dep_in_batch[i] = latest j < i with key[j] == key[i], else -1.
+def _intra_batch_chain(keys: jax.Array) -> jax.Array:
+    """chain[i, w] = latest row j < i sharing key keys[i, w], else -1.
 
-    Stable-sort by key, then each element's predecessor within its key run
-    is its intra-batch dependency — the tensorized ``KeyDeps::add_cmd``
-    latest-per-key chain for commands of the same round.
+    Stable-sort the flattened (row-major) key slots, then each slot's
+    predecessor within its key run is the latest earlier slot of the same
+    key — the tensorized ``KeyDeps::add_cmd`` latest-per-key chain for
+    commands of the same round, one dependency slot per key.  Rows must
+    not repeat a key (commands hold distinct keys), so an in-run
+    predecessor is always an earlier row.
     """
-    batch = key.shape[0]
-    idx = jnp.arange(batch, dtype=jnp.int32)
-    perm = jnp.argsort(key, stable=True).astype(jnp.int32)
-    sorted_key = key[perm]
+    batch, kw = keys.shape
+    flat = keys.reshape(-1)
+    n = flat.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    perm = jnp.argsort(flat, stable=True).astype(jnp.int32)
+    sorted_key = flat[perm]
     prev_same = jnp.where(
         (idx > 0) & (sorted_key == jnp.roll(sorted_key, 1)),
-        jnp.roll(perm, 1),
+        jnp.roll(perm, 1) // kw,  # predecessor's row
         jnp.int32(TERMINAL),
     )
-    return jnp.zeros((batch,), jnp.int32).at[perm].set(prev_same)
+    return jnp.zeros((n,), jnp.int32).at[perm].set(prev_same).reshape(batch, kw)
 
 
 def protocol_step(
     state: ReplicaState,
-    key: jax.Array,  # int32[B] key buckets, replicated
+    key: jax.Array,  # int32[B] or int32[B, KW] key buckets, replicated
     dot_src: jax.Array,  # int32[B]
     dot_seq: jax.Array,  # int32[B]
     *,
@@ -197,6 +215,12 @@ def protocol_step(
 ) -> Tuple[ReplicaState, StepOutput]:
     """One batched commit+execute round over the (replica, batch) mesh.
 
+    ``key`` may carry up to KW distinct key buckets per command (KEY_PAD
+    pads unused slots); multi-key rounds resolve through the general
+    out-degree-KW resolver (ops/graph_resolve.resolve_general), whose
+    arrival-order fast path covers the clean-commit case and whose
+    iterative pass handles quorum-failure MISSING blocking.
+
     ``live_replicas``: replicas (global rows) < this count respond to the
     Synod accept round; the rest are crashed/partitioned for the round.
     With fewer than write_quorum live replicas, slow-path commands do NOT
@@ -204,7 +228,12 @@ def protocol_step(
     Default: all replicas live.
     """
     num_replicas, key_buckets = state.key_clock.shape
-    batch = key.shape[0]
+    if key.ndim == 1:
+        key = key[:, None]
+    batch, key_width = key.shape
+    assert key_width == state.pend_key.shape[1], (
+        "key width must match init_state(key_width=...)"
+    )
     pend_cap = state.pend_gid.shape[0]
     work = pend_cap + batch  # working rows: pending buffer first, then new
     fast_quorum, write_quorum = quorum_sizes(num_replicas)
@@ -218,11 +247,11 @@ def protocol_step(
         key_clock, frontier, next_gid, pend_key, pend_src, pend_seq, pend_gid,
         key_l, dot_src_l, dot_seq_l,
     ):
-        # local blocks: key_clock [r_blk, K], key_l [b_blk] (sharded batch)
-        # 1. full batch view of the keys (commands are tiny; one gather),
-        # prefixed with the carried pending buffer (older commands first so
-        # intra-batch chains point the right way)
-        key_new = jax.lax.all_gather(key_l, BATCH_AXIS, tiled=True)  # [B]
+        # local blocks: key_clock [r_blk, K], key_l [b_blk, KW] (sharded
+        # batch).  1. full batch view of the keys (commands are tiny; one
+        # gather), prefixed with the carried pending buffer (older commands
+        # first so intra-batch chains point the right way)
+        key_new = jax.lax.all_gather(key_l, BATCH_AXIS, tiled=True)  # [B, KW]
         src_new = jax.lax.all_gather(dot_src_l, BATCH_AXIS, tiled=True)
         seq_new = jax.lax.all_gather(dot_seq_l, BATCH_AXIS, tiled=True)
 
@@ -231,45 +260,48 @@ def protocol_step(
             [pend_gid, next_gid + jnp.arange(batch, dtype=jnp.int32)]
         )  # [W]
         valid = gid >= 0  # empty pending slots are invalid rows
-        # invalid rows get unique out-of-range keys: singleton chains
-        key_full = jnp.where(
-            valid,
-            jnp.concatenate([pend_key, key_new]),
-            key_buckets + widx,
+        key_cat = jnp.concatenate([pend_key, key_new], axis=0)  # [W, KW]
+        real_slot = valid[:, None] & (key_cat != KEY_PAD)  # [W, KW]
+        # pad slots and invalid rows get unique out-of-range keys:
+        # singleton runs, no chain links, no key-clock read
+        slot_iota = jnp.arange(work * key_width, dtype=jnp.int32).reshape(
+            work, key_width
         )
+        key_full = jnp.where(real_slot, key_cat, key_buckets + slot_iota)
         dot_src_f = jnp.where(valid, jnp.concatenate([pend_src, src_new]), 0)
         dot_seq_f = jnp.where(valid, jnp.concatenate([pend_seq, seq_new]), 0)
 
-        # 2. per-replica deps: intra-working-batch chain, else the
-        # replica's key-clock entry (KeyDeps::add_cmd per replica)
-        chain = _intra_batch_chain(key_full)  # [W] working index or -1
+        # 2. per-replica deps, one slot per key: intra-working-batch chain,
+        # else the replica's key-clock entry (KeyDeps::add_cmd per replica)
+        chain = _intra_batch_chain(key_full)  # [W, KW] working row or -1
         safe_key = jnp.minimum(key_full, key_buckets - 1)
-        prior = jnp.where(valid[None, :], key_clock[:, safe_key], -1)
+        prior = jnp.where(real_slot[None], key_clock[:, safe_key], -1)
         dep_gid = jnp.where(
             chain >= 0, gid[jnp.maximum(chain, 0)], prior
-        )  # [r_blk, W]
+        )  # [r_blk, W, KW]
 
         # 3. MCollectAck fan-in over the *fast quorum* = the first
         # fast_quorum global replica rows (distance-sorted quorum,
         # base.rs:59-131).  Fast path iff all fast-quorum replicas
-        # reported the same dep (check_union, epaxos.rs:339-345).
+        # reported the same deps on every key slot (check_union,
+        # epaxos.rs:339-345).
         row = (
             jax.lax.axis_index(REPLICA_AXIS) * replica_blocks
             + jnp.arange(replica_blocks, dtype=jnp.int32)
         )  # global replica row ids of this block
-        in_fq = (row < fast_quorum)[:, None]  # [r_blk, 1]
+        in_fq = (row < fast_quorum)[:, None, None]  # [r_blk, 1, 1]
         fq_max = jax.lax.pmax(
             jnp.where(in_fq, dep_gid, int_min).max(axis=0), REPLICA_AXIS
-        )  # [W]
+        )  # [W, KW]
         fq_min = jax.lax.pmin(
             jnp.where(in_fq, dep_gid, int_max).min(axis=0), REPLICA_AXIS
-        )  # [W]
-        fast = (fq_max == fq_min) & valid
-        # slow-path proposal: union of fast-quorum deps (= max over
-        # latest-per-key singletons), Synod ballot 0 / skip-prepare
+        )  # [W, KW]
+        fast = (fq_max == fq_min).all(axis=-1) & valid
+        # slow-path proposal: union of fast-quorum deps (= per-slot max
+        # over latest-per-key singletons), Synod ballot 0 / skip-prepare
         # (synod single.rs:86) — same value either way, so the committed
-        # dep is fq_max; what the slow path adds is the accept round.
-        final_gid = fq_max
+        # deps are fq_max; what the slow path adds is the accept round.
+        final_gid = fq_max  # [W, KW]
 
         # Synod accept round for fast-path misses: every *live* replica
         # accepts the ballot-0 proposal (no competing coordinator within a
@@ -294,22 +326,36 @@ def protocol_step(
         sort_gid = masked_gid[sort_row]
         j = jnp.clip(
             jnp.searchsorted(sort_gid, jnp.maximum(final_gid, 0)), 0, work - 1
-        )
+        )  # [W, KW]
         in_work = (final_gid >= 0) & (sort_gid[j] == final_gid)
         dep_idx = jnp.where(in_work, sort_row[j], jnp.int32(TERMINAL))
-        dep_idx = jnp.where(committed, dep_idx, jnp.int32(MISSING))
-        dep_idx = jnp.where(valid, dep_idx, jnp.int32(TERMINAL))
-        res = resolve_functional(dep_idx, dot_src_f, dot_seq_f)
+        dep_idx = jnp.where(committed[:, None], dep_idx, jnp.int32(MISSING))
+        dep_idx = jnp.where(valid[:, None], dep_idx, jnp.int32(TERMINAL))
+        if key_width == 1:
+            # exact O(log W) doubling: resolves every non-missing-blocked
+            # row regardless of chain depth
+            res = resolve_functional(dep_idx[:, 0], dot_src_f, dot_seq_f)
+        else:
+            # general resolver; max_iters = 2*W+8 guarantees convergence
+            # for committed acyclic rows (>= one vertex finalizes per
+            # iteration) and the while_loop's changed-flag exits early on
+            # the typical round, so degraded rounds cannot strand
+            # committed commands past the pending buffer
+            res = resolve_general(
+                dep_idx, dot_src_f, dot_seq_f, max_iters=2 * work + 8
+            )
         executed = res.resolved & committed
 
         # 5. state update: every *live* replica learns the *executed* dots
-        # (scatter-max by key; later commands in the batch win).  Only
+        # (scatter-max by key slot; later commands in the batch win).  Only
         # executed gids enter the key clock: the next round prunes
         # out-of-working-set deps as already-executed (step 4), which is
         # only sound if the clock never holds an unexecuted gid.
         clock_upd = jnp.where(
-            live & executed[None, :], gid[None, :], jnp.int32(-1)
-        )  # [r_blk, W]
+            live[..., None] & (executed[None, :, None] & real_slot[None]),
+            gid[None, :, None],
+            jnp.int32(-1),
+        )  # [r_blk, W, KW]
         new_clock = key_clock.at[:, safe_key].max(clock_upd)
         new_frontier = frontier + jnp.where(
             live[:, 0], executed.sum().astype(jnp.int32), 0
@@ -327,7 +373,7 @@ def protocol_step(
         take = carry_order[:pend_cap]
         is_carry = carry[take]
         new_pend_gid = jnp.where(is_carry, gid[take], -1)
-        new_pend_key = jnp.where(is_carry, key_full[take], -1)
+        new_pend_key = jnp.where(is_carry[:, None], key_cat[take], KEY_PAD)
         new_pend_src = jnp.where(is_carry, dot_src_f[take], -1)
         new_pend_seq = jnp.where(is_carry, dot_seq_f[take], -1)
         pending = carry.sum().astype(jnp.int32)
@@ -344,7 +390,7 @@ def protocol_step(
             res.order,
             executed,
             fast,
-            jnp.where(valid, final_gid, -1),
+            jnp.where(real_slot, final_gid, -1),
             jnp.where(valid, gid, -1),
             slow_paths,
             stable,

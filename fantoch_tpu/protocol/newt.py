@@ -185,6 +185,11 @@ class NewtInfo:
 # (newt.rs:1236 CLOCK_BUMP_WORKER_INDEX)
 CLOCK_BUMP_WORKER_INDEX = 1
 
+# cap on MBump clocks buffered before their MCollect arrives; comfortably
+# above any realistic in-flight multi-shard window (bumps are hints, so
+# eviction never affects correctness)
+_MBUMP_BUFFER_CAP = 4096
+
 
 class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
     Executor = TableExecutor
@@ -208,18 +213,12 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
         self._to_executors: Deque[Any] = deque()
         # accumulated detached votes, flushed by SendDetachedEvent
         self._detached = Votes()
-        # MBump clocks that arrived before the MCollect (newt.rs:45,699-708)
+        # MBump clocks that arrived before the MCollect (newt.rs:45,699-708).
+        # Bounded: a bump is a clock-priming *hint*, so evicting the oldest
+        # entry is always safe — this caps the stale residue of bumps that
+        # trail a GC'd commit (get_existing cannot distinguish "never seen"
+        # from "GC'd", and no later message would ever pop such an entry)
         self._buffered_mbumps: Dict[Dot, int] = {}
-        # committed-dot guard for the buffer: a bump trailing the commit by
-        # more than one message (the info is already GC'd for cross-shard
-        # dots) must be dropped, not buffered forever — get_existing cannot
-        # distinguish "never seen" from "GC'd"
-        from fantoch_tpu.core.clocks import AEClock
-        from fantoch_tpu.core.ids import all_process_ids
-
-        self._mbump_committed: AEClock[ProcessId] = AEClock(
-            [pid for pid, _ in all_process_ids(config.shard_count, config.n)]
-        )
         self._init_partial()
         # MCommit before MCollect (multiplexing reorders): buffer
         self._buffered_mcommits: Dict[Dot, Tuple[ProcessId, int, Votes]] = {}
@@ -448,9 +447,11 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
             if info.status != Status.COMMIT:
                 self.key_clocks.detached(info.cmd, clock, self._detached)
             return
-        if self._mbump_committed.contains(dot.source, dot.sequence):
-            return  # trails a GC'd commit: buffering would leak forever
         prev = self._buffered_mbumps.get(dot, 0)
+        if prev == 0 and len(self._buffered_mbumps) >= _MBUMP_BUFFER_CAP:
+            # evict the oldest entry (dict = insertion order): either a
+            # stale post-GC straggler or, at worst, a lost priming hint
+            self._buffered_mbumps.pop(next(iter(self._buffered_mbumps)))
         self._buffered_mbumps[dot] = max(prev, clock)
 
     def _mcommit_actions(self, info: NewtInfo, dot: Dot, clock: int, votes: Votes) -> None:
@@ -490,13 +491,9 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
 
         info.status = Status.COMMIT
         # a bump buffered between our commit and its own delivery is moot
-        # (detached votes already cover the commit clock); the guard clock
-        # drops bumps that trail the commit after the info is GC'd — only
-        # multi-shard dots ever receive MBumps, so single-shard commits
-        # (the hot path) skip the guard entirely
+        # (detached votes already cover the commit clock); one trailing the
+        # GC'd commit ages out of the bounded buffer instead
         self._buffered_mbumps.pop(dot, None)
-        if cmd.shard_count > 1:
-            self._mbump_committed.add(dot.source, dot.sequence)
         out = info.synod.handle(from_, MChosen(clock))
         assert out is None
 

@@ -22,8 +22,24 @@ def test_mesh_axes(mesh):
 
 def test_intra_batch_chain():
     key = jnp.asarray([3, 5, 3, 3, 5, 9], dtype=jnp.int32)
-    chain = mesh_step._intra_batch_chain(key)
-    assert chain.tolist() == [TERMINAL, TERMINAL, 0, 2, 1, TERMINAL]
+    chain = mesh_step._intra_batch_chain(key[:, None])
+    assert chain[:, 0].tolist() == [TERMINAL, TERMINAL, 0, 2, 1, TERMINAL]
+
+
+def test_intra_batch_chain_multikey():
+    # rows tagged with up to two keys; per-slot chains follow each key
+    keys = jnp.asarray(
+        [[3, 5], [5, 9], [3, 9], [9, 3]], dtype=jnp.int32
+    )
+    chain = mesh_step._intra_batch_chain(keys)
+    # row0: first on 3 and 5; row1: 5<-row0, first on 9;
+    # row2: 3<-row0, 9<-row1; row3: 9<-row2, 3<-row2
+    assert chain.tolist() == [
+        [TERMINAL, TERMINAL],
+        [0, TERMINAL],
+        [0, 1],
+        [2, 2],
+    ]
 
 
 def test_protocol_step_executes_batch(mesh):
@@ -51,8 +67,11 @@ def test_protocol_step_executes_batch(mesh):
     pos_by_gid = {int(g): pos[i] for i, g in enumerate(gids) if g >= 0}
     deps = np.asarray(out.deps_gid)
     for i in range(work):
-        if valid[i] and deps[i] >= 0:
-            assert pos_by_gid[int(deps[i])] < pos[i], f"dep of {i} executed after it"
+        if not valid[i]:
+            continue
+        for d in deps[i]:
+            if d >= 0:
+                assert pos_by_gid[int(d)] < pos[i], f"dep of {i} executed after it"
     # state advanced
     assert int(state.next_gid) == batch
     assert state.frontier.tolist() == [batch] * num_replicas
@@ -82,7 +101,7 @@ def test_protocol_step_fast_path_divergence(mesh):
     state, out = step(state, key, src, seq)
 
     fast = np.asarray(out.fast_path)
-    deps = np.asarray(out.deps_gid)
+    deps = np.asarray(out.deps_gid)[:, 0]
     valid = np.asarray(out.gids) >= 0
     new0 = state.pend_gid.shape[0]  # first new-batch working row
     assert not fast[new0], "diverging replica views must take the slow path"
@@ -140,12 +159,57 @@ def test_state_carries_across_steps(mesh):
     state, _ = step(state, key, src, seq)
 
     state, out = step(state, key, src, seq)
-    deps = np.asarray(out.deps_gid)
+    deps = np.asarray(out.deps_gid)[:, 0]
     valid = np.asarray(out.gids) >= 0
     new0 = state.pend_gid.shape[0]
     # first command of round 2 depends on the last command of round 1
     assert deps[new0] == batch - 1
     assert np.asarray(out.resolved)[valid].all()
+
+
+def test_protocol_step_multikey(mesh):
+    """Multi-key commands (two key buckets each) route through the general
+    resolver on-mesh: per-slot deps all execute before their dependents,
+    and round-2 chains continue from both key-clock slots."""
+    num_replicas = mesh.shape["replica"]
+    batch = mesh.shape["batch"] * 4
+    state = mesh_step.init_state(
+        mesh, num_replicas, key_buckets=16, key_width=2
+    )
+    step = mesh_step.jit_protocol_step(mesh)
+
+    rng = np.random.default_rng(3)
+    keys = np.stack(
+        [rng.choice(6, size=2, replace=False) for _ in range(batch)]
+    ).astype(np.int32)
+    src = jnp.ones((batch,), jnp.int32)
+    seq = jnp.arange(batch, dtype=jnp.int32)
+    state, out = step(state, jnp.asarray(keys), src, seq)
+
+    gids = np.asarray(out.gids)
+    valid = gids >= 0
+    assert np.asarray(out.resolved)[valid].all()
+    work = len(gids)
+    pos = np.empty(work, np.int64)
+    pos[np.asarray(out.order)] = np.arange(work)
+    pos_by_gid = {int(g): pos[i] for i, g in enumerate(gids) if g >= 0}
+    deps = np.asarray(out.deps_gid)
+    for i in range(work):
+        if not valid[i]:
+            continue
+        for d in deps[i]:
+            if d >= 0:
+                assert pos_by_gid[int(d)] < pos[i], f"dep of {i} after it"
+
+    # round 2 on the same key sets: both dep slots of the first round-2
+    # command come from round 1 via the replicated key clock
+    seq2 = jnp.arange(batch, 2 * batch, dtype=jnp.int32)
+    state, out2 = step(state, jnp.asarray(keys), src, seq2)
+    new0 = state.pend_gid.shape[0]
+    deps2 = np.asarray(out2.deps_gid)
+    assert (deps2[new0] >= 0).all() and (deps2[new0] < batch).all()
+    assert np.asarray(out2.resolved)[np.asarray(out2.gids) >= 0].all()
+    assert state.frontier.tolist() == [2 * batch] * num_replicas
 
 
 def test_pending_commands_commit_after_quorum_recovers(mesh):
