@@ -1,0 +1,14 @@
+"""Explicit-state model checker over (Protocol, Executor) pairs.
+
+The working analog of the reference's ``fantoch_mc`` crate
+(fantoch_mc/src/lib.rs:75-120), which wraps a protocol as a stateright
+Actor but is bit-rotted and excluded from the reference workspace
+(Cargo.toml:10).  This checker explores every interleaving of command
+submissions and message deliveries for a small cluster and workload,
+checking safety at every state and execution completeness at terminal
+states.  See :mod:`fantoch_tpu.mc.checker`.
+"""
+
+from fantoch_tpu.mc.checker import CheckResult, ModelChecker, Violation
+
+__all__ = ["CheckResult", "ModelChecker", "Violation"]

@@ -1,0 +1,333 @@
+"""Breadth-first explicit-state model checking of protocol + executor.
+
+Reference: ``fantoch_mc`` (fantoch_mc/src/lib.rs:75-120) adapts a
+``(Protocol, Executor)`` pair to a stateright ``Actor``; stateright then
+enumerates message-delivery interleavings and checks user properties.
+That crate is bit-rotted (pre-shard API) and disabled upstream — this is
+a working equivalent, self-contained because our protocols are plain
+deterministic Python objects that deepcopy/pickle cleanly.
+
+Model:
+
+* a **state** is (protocol instances, executor instances, network
+  multiset of in-flight messages, not-yet-submitted commands, per-process
+  executed results);
+* **actions**: submit any unsubmitted command at its coordinator, or
+  deliver any in-flight message (in any order — the network reorders
+  arbitrarily but neither drops nor duplicates, matching the simulator's
+  delivery model, fantoch/src/sim/runner.rs:514-518);
+* successors are explored breadth-first with a visited set keyed on a
+  canonical pickle fingerprint, so converging interleavings merge.
+
+Checked properties (the reference harness's assertions,
+fantoch_ps/src/protocol/mod.rs:924-1010, turned into MC invariants):
+
+* **safety, every state**: per-key execution orders across processes are
+  pairwise prefix-compatible (linearizable agreement — a divergence shows
+  up as soon as it happens, with a minimal-length trace);
+* **terminal states** (no messages in flight, everything submitted):
+  every process executed every command on every key it owns, and the
+  per-key orders are identical.
+
+Periodic events (GC, detached votes, executed notifications) are outside
+the model — they expand the state space multiplicatively and affect only
+liveness of *cleanup*; protocols whose commit path depends on a periodic
+event cannot be checked here (Newt's detached-vote stability, Caesar's
+executor-driven GC).  Basic / EPaxos / Atlas / FPaxos commit and execute
+without them.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import types
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import ProcessId
+from fantoch_tpu.core.timing import SimTime
+from fantoch_tpu.protocol.base import ToForward, ToSend
+
+
+class _FingerprintPickler(pickle.Pickler):
+    """Pickler that serializes function objects (e.g. the per-dot info
+    factory lambdas inside CommandsInfo) as their qualified name: the
+    fingerprint only needs stability, not round-tripping."""
+
+    def reducer_override(self, obj):
+        if isinstance(obj, types.FunctionType):
+            return str, (f"<fn {obj.__module__}.{obj.__qualname__}>",)
+        return NotImplemented
+
+
+def _dumps(obj) -> bytes:
+    buf = io.BytesIO()
+    _FingerprintPickler(buf, protocol=4).dump(obj)
+    return buf.getvalue()
+
+
+@dataclass
+class Violation:
+    kind: str  # "agreement" | "incomplete" | "divergent_terminal"
+    detail: str
+    trace: List[str]  # action descriptions from the initial state
+
+
+@dataclass
+class CheckResult:
+    states: int
+    transitions: int
+    terminals: int
+    violations: List[Violation]
+    complete: bool  # exhausted the space (False = hit max_states)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+class _State:
+    __slots__ = ("protocols", "executors", "network", "unsubmitted", "executed")
+
+    def __init__(self, protocols, executors, network, unsubmitted, executed):
+        self.protocols: Dict[ProcessId, Any] = protocols
+        self.executors: Dict[ProcessId, Any] = executors
+        # in-flight messages: list of (from_pid, to_pid, msg)
+        self.network: List[Tuple[ProcessId, ProcessId, Any]] = network
+        self.unsubmitted: List[Tuple[ProcessId, Command]] = unsubmitted
+        # per-process executed (rifl) order, per key — the agreement object
+        self.executed: Dict[ProcessId, Dict[str, List[Any]]] = executed
+
+
+class ModelChecker:
+    """Exhaustive small-scope checker for one protocol class.
+
+    ``submits``: list of (coordinator process id, Command); every
+    interleaving of submissions and deliveries is explored.
+    """
+
+    def __init__(
+        self,
+        protocol_cls,
+        config: Config,
+        submits: List[Tuple[ProcessId, Command]],
+        max_states: int = 200_000,
+        check_agreement: bool = True,
+    ):
+        self._protocol_cls = protocol_cls
+        self._config = config
+        self._submits = submits
+        self._max_states = max_states
+        # Basic is the reference's intentionally *inconsistent* protocol
+        # (fantoch/src/protocol/basic.rs): per-key agreement is not among
+        # its properties, so callers disable that invariant for it
+        self._check_agreement_flag = check_agreement
+        self._time = SimTime()  # fixed logical time: delivery order is the model
+
+    # --- state construction ---
+
+    def _initial_state(self) -> _State:
+        n = self._config.n
+        from fantoch_tpu.core.ids import process_ids
+
+        ids = list(process_ids(0, n))
+        protocols, executors = {}, {}
+        for pid in ids:
+            proto = self._protocol_cls(pid, 0, self._config)
+            # self-first discover list, deterministic topology
+            sorted_procs = [(pid, 0)] + [(p, 0) for p in ids if p != pid]
+            ok, _ = proto.discover(sorted_procs)
+            assert ok
+            protocols[pid] = proto
+            executor = self._protocol_cls.Executor(pid, 0, self._config)
+            executor.set_executor_index(0)
+            executors[pid] = executor
+        return _State(
+            protocols,
+            executors,
+            [],
+            list(self._submits),
+            {pid: {} for pid in ids},
+        )
+
+    # --- actions ---
+
+    def _enabled(self, st: _State) -> List[Tuple[str, Any]]:
+        actions: List[Tuple[str, Any]] = []
+        for i, (pid, cmd) in enumerate(st.unsubmitted):
+            actions.append(("submit", i))
+        seen = set()
+        for i, (src, dst, msg) in enumerate(st.network):
+            # identical in-flight messages are interchangeable: exploring
+            # one of them covers all (multiset symmetry reduction)
+            key = (src, dst, _dumps(msg))
+            if key not in seen:
+                seen.add(key)
+                actions.append(("deliver", i))
+        return actions
+
+    def _apply(self, st: _State, action: Tuple[str, Any]) -> Tuple[_State, str]:
+        import copy
+
+        succ = _State(
+            copy.deepcopy(st.protocols),
+            copy.deepcopy(st.executors),
+            copy.deepcopy(st.network),
+            list(st.unsubmitted),
+            copy.deepcopy(st.executed),
+        )
+        kind, i = action
+        if kind == "submit":
+            pid, cmd = succ.unsubmitted.pop(i)
+            succ.protocols[pid].submit(None, cmd, self._time)
+            self._drain(succ, pid)
+            desc = f"submit {cmd.rifl} at p{pid}"
+        else:
+            src, dst, msg = succ.network.pop(i)
+            succ.protocols[dst].handle(src, 0, msg, self._time)
+            self._drain(succ, dst)
+            desc = f"deliver {type(msg).__name__} {src}->{dst}"
+        return succ, desc
+
+    def _drain(self, st: _State, pid: ProcessId) -> None:
+        """Collect a process's outputs: peer messages enter the reorderable
+        network; self-addressed messages (self∈ToSend target, ToForward)
+        are handled synchronously, exactly like the reference runner's
+        local fast path (fantoch/src/run/task/process.rs:591-678) and the
+        simulator's zero-latency self hop — protocols rely on it (e.g. a
+        coordinator's own MCollectAck can never trail a peer's)."""
+        import copy
+
+        local = deque()
+        proto = st.protocols[pid]
+        executor = st.executors[pid]
+
+        def pump() -> None:
+            for act in proto.to_processes_iter():
+                if isinstance(act, ToSend):
+                    targets = sorted(act.target)
+                    msgs = [act.msg] + [
+                        copy.deepcopy(act.msg) for _ in targets[1:]
+                    ]  # per-connection copy: receivers may mutate in place
+                    for target, msg in zip(targets, msgs):
+                        if target == pid:
+                            local.append(msg)
+                        else:
+                            st.network.append((pid, target, msg))
+                elif isinstance(act, ToForward):
+                    local.append(act.msg)
+                else:  # pragma: no cover
+                    raise AssertionError(f"unknown action {act}")
+            for info in proto.to_executors_iter():
+                executor.handle(info, self._time)
+            for result in executor.to_clients_iter():
+                st.executed[pid].setdefault(result.key, []).append(result.rifl)
+
+        pump()
+        while local:
+            proto.handle(pid, 0, local.popleft(), self._time)
+            pump()
+
+    # --- invariants ---
+
+    @staticmethod
+    def _check_agreement(st: _State) -> Optional[str]:
+        """Per-key orders must be pairwise prefix-compatible at all times."""
+        pids = sorted(st.executed)
+        for a_i, a in enumerate(pids):
+            for b in pids[a_i + 1 :]:
+                for key, order_a in st.executed[a].items():
+                    order_b = st.executed[b].get(key, [])
+                    short = min(len(order_a), len(order_b))
+                    if order_a[:short] != order_b[:short]:
+                        return (
+                            f"key {key!r}: p{a} executed {order_a[:short]} "
+                            f"but p{b} executed {order_b[:short]}"
+                        )
+        return None
+
+    def _check_terminal(self, st: _State) -> Optional[str]:
+        """Nothing in flight: every process executed every command."""
+        expected: Dict[str, int] = {}
+        for _pid, cmd in self._submits:
+            for key in cmd.keys(0):
+                expected[key] = expected.get(key, 0) + 1
+        for pid, by_key in st.executed.items():
+            for key, count in expected.items():
+                got = len(by_key.get(key, []))
+                if got != count:
+                    return (
+                        f"p{pid} executed {got}/{count} commands on key "
+                        f"{key!r} in a terminal state"
+                    )
+        if self._check_agreement_flag:
+            pids = sorted(st.executed)
+            first = st.executed[pids[0]]
+            for pid in pids[1:]:
+                if st.executed[pid] != first:
+                    return (
+                        f"terminal orders diverge: p{pids[0]}={first} "
+                        f"p{pid}={st.executed[pid]}"
+                    )
+        return None
+
+    # --- exploration ---
+
+    @staticmethod
+    def _fingerprint(st: _State) -> bytes:
+        return _dumps(
+            (
+                sorted(st.protocols.items(), key=lambda kv: kv[0]),
+                sorted(st.executors.items(), key=lambda kv: kv[0]),
+                sorted((s, d, _dumps(m)) for s, d, m in st.network),
+                st.unsubmitted,
+                sorted(st.executed.items()),
+            )
+        )
+
+    def run(self) -> CheckResult:
+        initial = self._initial_state()
+        visited = {self._fingerprint(initial)}
+        # frontier holds (state, trace); traces stay short (depth <= total
+        # actions = submits + messages ever sent)
+        frontier = deque([(initial, [])])
+        states = transitions = terminals = 0
+        violations: List[Violation] = []
+        complete = True
+
+        while frontier:
+            if states >= self._max_states:
+                complete = False
+                break
+            st, trace = frontier.popleft()
+            states += 1
+
+            bad = self._check_agreement(st) if self._check_agreement_flag else None
+            if bad is not None:
+                violations.append(Violation("agreement", bad, trace))
+                continue  # don't explore past a violated state
+
+            actions = self._enabled(st)
+            if not actions:
+                terminals += 1
+                bad = self._check_terminal(st)
+                if bad is not None:
+                    kind = (
+                        "divergent_terminal" if "diverge" in bad else "incomplete"
+                    )
+                    violations.append(Violation(kind, bad, trace))
+                continue
+
+            for action in actions:
+                succ, desc = self._apply(st, action)
+                transitions += 1
+                fp = self._fingerprint(succ)
+                if fp not in visited:
+                    visited.add(fp)
+                    frontier.append((succ, trace + [desc]))
+
+        return CheckResult(states, transitions, terminals, violations, complete)
