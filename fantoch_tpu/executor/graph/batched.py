@@ -465,11 +465,66 @@ class BatchedDependencyGraph(DependencyGraph):
     def _resolve_stuck_rows(
         self, stuck_rows, src, seq, deps, tms, time: SysTime
     ) -> np.ndarray:
-        """Host Tarjan oracle over the stuck residue (dep-closed by the
-        ``stuck`` contract of resolve_general): rebuild the subgraph with
-        deps restricted to stuck members (everything else the device either
+        """Host oracle over the stuck residue (dep-closed by the ``stuck``
+        contract of resolve_general): rebuild the subgraph with deps
+        restricted to stuck members (everything else the device either
         emitted before them or left missing-blocked — and missing-blocked
-        rows are never stuck) and run the oracle to completion."""
+        rows are never stuck) and run it to completion.  Prefers the
+        native C++ resolver (fantoch_tpu/native, the Rust-Tarjan twin);
+        falls back to the Python oracle when the toolchain is missing."""
+        emitted = self._resolve_stuck_rows_native(
+            stuck_rows, src, seq, deps, tms, time
+        )
+        if emitted is not None:
+            return emitted
+        return self._resolve_stuck_rows_python(
+            stuck_rows, src, seq, deps, tms, time
+        )
+
+    def _resolve_stuck_rows_native(
+        self, stuck_rows, src, seq, deps, tms, time: SysTime
+    ) -> Optional[np.ndarray]:
+        from fantoch_tpu import native
+
+        if not native.available():
+            return None
+        stuck_rows = np.asarray(stuck_rows, dtype=np.int64)
+        n = len(stuck_rows)
+        packed = pack_dots(src[stuck_rows], seq[stuck_rows])
+        slot_of = {int(p): i for i, p in enumerate(packed)}
+        # CSR restricted to stuck members (TERMINAL outside — emitted or
+        # missing-blocked rows never appear in a stuck residue)
+        row_targets: List[List[int]] = []
+        for i in stuck_rows:
+            row_targets.append(
+                [slot_of[int(p)] for p in deps[int(i)] if int(p) in slot_of]
+            )
+        offsets = np.zeros(n + 1, dtype=np.int32)
+        offsets[1:] = np.cumsum([len(t) for t in row_targets])
+        targets = np.fromiter(
+            (t for row in row_targets for t in row), np.int32, offsets[-1]
+        )
+        out = native.resolve_sccs(offsets, targets, packed)
+        if out is None:
+            return None
+        order, sizes = out
+        assert len(order) == n, (
+            f"stuck residue not fully resolvable: {len(order)}/{n}"
+        )
+        rows = stuck_rows[order]
+        self._emit_rows(rows, src, seq, tms, time)
+        # one CHAIN_SIZE sample per SCC: block boundaries every `size` rows
+        pos = 0
+        scc_sizes = []
+        while pos < n:
+            scc_sizes.append(int(sizes[pos]))
+            pos += int(sizes[pos])
+        self._metrics.collect_many(ExecutorMetricsKind.CHAIN_SIZE, scc_sizes)
+        return rows
+
+    def _resolve_stuck_rows_python(
+        self, stuck_rows, src, seq, deps, tms, time: SysTime
+    ) -> np.ndarray:
         from fantoch_tpu.protocol.common.graph_deps import Dependency
 
         stuck_set = {

@@ -1,0 +1,108 @@
+"""Native (C++) host-runtime components, loaded via ctypes.
+
+The reference's runtime is entirely native (Rust); here the TPU device
+kernels carry the hot path and this package supplies native host pieces
+where Python costs real time: the batch SCC resolver used by offline
+replay, stuck-residue finishing and the pending watchdog
+(fantoch_tpu/native/tarjan.cpp — the C++ twin of
+fantoch_ps/src/executor/graph/tarjan.rs).
+
+Build-on-first-use with ``g++`` (see :func:`load`); everything degrades
+to the pure-Python oracle when the toolchain or binary is unavailable, so
+the framework stays importable anywhere.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "tarjan.cpp")
+_LIB = os.path.join(_DIR, "_fantoch_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> None:
+    # compile to a temp path and atomically rename: a concurrent process
+    # must never dlopen a partially written .so
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+        check=True,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    os.replace(tmp, _LIB)
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+            fn = lib.fantoch_resolve_sccs
+            fn.restype = ctypes.c_int32
+            fn.argtypes = [
+                ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ]
+            _lib = lib
+        except Exception:  # noqa: BLE001 — toolchain/binary unavailable
+            _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def resolve_sccs(
+    offsets: np.ndarray,  # int32[n + 1] CSR row offsets
+    targets: np.ndarray,  # int32[nnz] dep slots; -1 executed/none, -2 missing
+    dot_key: np.ndarray,  # int64[n] packed dots (intra-SCC order)
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(order, scc_size_per_position) for the emittable prefix, or None when
+    the native library is unavailable (callers fall back to the Python
+    oracle).  Same contract as the host Tarjan oracle: SCCs contiguous and
+    dot-sorted, dependencies before dependents, missing-blocked components
+    omitted."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(offsets) - 1
+    if n == 0:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int32)
+    targets = np.ascontiguousarray(targets, dtype=np.int32)
+    dot_key = np.ascontiguousarray(dot_key, dtype=np.int64)
+    out_order = np.empty(n, dtype=np.int32)
+    out_size = np.empty(n, dtype=np.int32)
+    if len(targets) == 0:
+        targets = np.zeros(1, dtype=np.int32)  # ndpointer rejects size-0 reuse
+    emitted = lib.fantoch_resolve_sccs(
+        n, offsets, targets, dot_key, out_order, out_size
+    )
+    if emitted < 0:
+        raise ValueError("native resolver rejected the input CSR")
+    return out_order[:emitted], out_size[:emitted]
