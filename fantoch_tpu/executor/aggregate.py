@@ -8,7 +8,7 @@ from different key-parallel executors); the pending tracker releases one
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from fantoch_tpu.core.command import Command, CommandResult
 from fantoch_tpu.core.ids import ProcessId, Rifl, ShardId
@@ -16,10 +16,26 @@ from fantoch_tpu.executor.base import ExecutorResult
 
 
 class AggregatePending:
-    def __init__(self, process_id: ProcessId, shard_id: ShardId):
+    """``buffer_early``: stash partials whose ``wait_for`` has not arrived
+    yet instead of dropping them.  The runner's per-client session needs
+    this (results are routed to the session by owning client id, so every
+    partial seen here belongs to one of its clients): on a NON-target
+    shard of a multi-shard command, the server-side MForwardSubmit can
+    commit and execute before the client's own Register message arrives
+    over its connection, and dropping that early partial deadlocks the
+    client.  The simulator/test drivers keep the default drop behavior —
+    there, every process executes every command including those of clients
+    attached elsewhere, and foreign partials must be ignored, not held.
+    """
+
+    def __init__(
+        self, process_id: ProcessId, shard_id: ShardId, buffer_early: bool = False
+    ):
         self._process_id = process_id
         self._shard_id = shard_id
         self._pending: Dict[Rifl, CommandResult] = {}
+        self._buffer_early = buffer_early
+        self._early: Dict[Rifl, List[ExecutorResult]] = {}
 
     def wait_for(self, cmd: Command) -> bool:
         """Track a command submitted by a connected client."""
@@ -38,11 +54,25 @@ class AggregatePending:
             self._pending[rifl] = result
         result.increment_key_count()
 
+    def drain_early(self, rifl: Rifl) -> Optional[CommandResult]:
+        """Apply partials that raced ahead of ``wait_for(rifl)``; returns
+        the CommandResult if they already complete it."""
+        for partial in self._early.pop(rifl, []):
+            done = self.add_executor_result(partial)
+            if done is not None:
+                return done
+        return None
+
     def add_executor_result(self, executor_result: ExecutorResult) -> Optional[CommandResult]:
-        """Add one partial; returns the CommandResult once complete.  Partials
-        for unknown rifls are ignored (clients of other processes)."""
+        """Add one partial; returns the CommandResult once complete.
+        Partials for unknown rifls are buffered (``buffer_early``) or
+        ignored (clients of other processes)."""
         cmd_result = self._pending.get(executor_result.rifl)
         if cmd_result is None:
+            if self._buffer_early:
+                self._early.setdefault(executor_result.rifl, []).append(
+                    executor_result
+                )
             return None
         if cmd_result.add_partial(executor_result.key, executor_result.op_results):
             return self._pending.pop(executor_result.rifl)

@@ -76,6 +76,13 @@ class DependencyGraph:
         self._buffered_in_requests: Dict[ShardId, Set[Dot]] = {}
         self._out_request_replies: Dict[ShardId, List[RequestReply]] = {}
 
+    def share_vertex_index(self, primary: "DependencyGraph") -> None:
+        """Point this (secondary) graph at the primary's vertex index — the
+        reference's SharedMap sharing across executor clones
+        (index.rs:19-22).  Request serving must see pending vertices:
+        executed-only answers deadlock cross-shard dependency cycles."""
+        self._vertex_index = primary._vertex_index
+
     # --- outputs ---
 
     def command_to_execute(self) -> Optional[Command]:

@@ -96,6 +96,15 @@ class GraphExecutor(Executor):
     def set_executor_index(self, index: int) -> None:
         self.graph.executor_index = index
 
+    def share_state_from(self, primary: "GraphExecutor") -> None:
+        """Share the primary executor's vertex index (the reference's
+        SharedMap, index.rs:19-22): the secondary request-serving executor
+        must see the main executor's *pending* vertices — answering peer
+        shards only for executed dots deadlocks cross-shard dependency
+        cycles (each shard waits for the others to execute first).  Safe
+        without locks: one asyncio loop, no preemption inside a handler."""
+        self.graph.share_vertex_index(primary.graph)
+
     def cleanup(self, time: SysTime) -> None:
         if self._config.shard_count > 1:
             self.graph.cleanup(time)

@@ -23,7 +23,7 @@ from fantoch_tpu.client.workload import Workload
 from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.ids import ClientId, ShardId
 from fantoch_tpu.core.timing import RunTime
-from fantoch_tpu.run.prelude import ClientHi, Register, Submit, ToClient
+from fantoch_tpu.run.prelude import ClientHi, ClientHiAck, Register, Submit, ToClient
 from fantoch_tpu.run.rw import Rw, connect_with_retry
 
 Address = Tuple[str, int]
@@ -43,6 +43,13 @@ async def run_clients(
         rw = await connect_with_retry(addr)
         await rw.send(ClientHi(list(client_ids)))
         rws[shard_id] = rw
+    # wait for every shard's registration ack before the first submission:
+    # a partial executed on a non-target shard before its session
+    # registered would be unrouteable (ClientHi-vs-execution race)
+    for shard_id, rw in rws.items():
+        ack = await rw.recv()
+        assert isinstance(ack, ClientHiAck), f"expected ClientHiAck, got {ack}"
+
 
     time = RunTime()
     clients = {

@@ -22,10 +22,26 @@ from fantoch_tpu.run.client_runner import run_clients
 from fantoch_tpu.run.process_runner import ProcessRuntime
 
 
+_claimed_ports: set = set()
+
+
 def free_port() -> int:
-    with socket.socket() as sock:
-        sock.bind(("127.0.0.1", 0))
-        return sock.getsockname()[1]
+    """An OS-assigned free port, never handed out twice by this process.
+
+    The probe socket is closed before the caller binds, so the kernel may
+    recycle the port for a concurrent probe — within one process (the
+    common harness pattern: allocate 2 ports x n processes up front) the
+    claimed-set closes that race; across processes the startup retry in
+    the runners covers the rest."""
+    for _ in range(64):
+        with socket.socket() as sock:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind(("127.0.0.1", 0))
+            port = sock.getsockname()[1]
+        if port not in _claimed_ports:
+            _claimed_ports.add(port)
+            return port
+    raise RuntimeError("could not allocate a fresh localhost port")
 
 
 async def run_localhost_cluster(

@@ -33,6 +33,14 @@ class ClientHi:
     client_ids: List[ClientId]
 
 
+@dataclass
+class ClientHiAck:
+    """Server -> client: the session is registered for result delivery.
+    Clients must not submit before every shard acks — a partial executed
+    on a non-target shard before its session registration would be
+    unrouteable and silently dropped (the ClientHi-vs-execution race)."""
+
+
 # --- client wire protocol (prelude.rs:52-69) ---
 
 

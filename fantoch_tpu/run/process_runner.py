@@ -42,6 +42,7 @@ from fantoch_tpu.executor.base import ExecutorResult
 from fantoch_tpu.protocol.base import Protocol, ToForward, ToSend
 from fantoch_tpu.run.prelude import (
     ClientHi,
+    ClientHiAck,
     PingReply,
     PingReq,
     POEExecutor,
@@ -107,12 +108,18 @@ class _ClientSession:
     def __init__(self, runtime: "ProcessRuntime", rw: Rw):
         self.runtime = runtime
         self.rw = rw
-        self.pending = AggregatePending(runtime.process.id, runtime.process.shard_id)
+        # buffer_early: on a non-target shard the server-side forward can
+        # execute the command before this connection's Register arrives
+        self.pending = AggregatePending(
+            runtime.process.id, runtime.process.shard_id, buffer_early=True
+        )
         self.client_ids: List[ClientId] = []
         self._flush_needed = asyncio.Event()
 
     def deliver(self, result: ExecutorResult) -> None:
-        cmd_result = self.pending.add_executor_result(result)
+        self._emit(self.pending.add_executor_result(result))
+
+    def _emit(self, cmd_result) -> None:
         if cmd_result is not None:
             self.rw.write(ToClient(cmd_result))
             self._flush_needed.set()  # single per-session flusher picks it up
@@ -129,6 +136,10 @@ class _ClientSession:
         self.client_ids = hi.client_ids
         for client_id in self.client_ids:
             self.runtime.client_sessions[client_id] = self
+        # ack AFTER registration: the client holds submissions until every
+        # shard acks, so a partial can never arrive before its session is
+        # routable (the ClientHi-vs-execution race)
+        await self.rw.send(ClientHiAck())
         flusher = self.runtime.spawn(self._flush_loop())
         while True:
             msg = await self.rw.recv()
@@ -139,10 +150,12 @@ class _ClientSession:
                 # aggregation for our part, but do not submit (the target
                 # shard's MForwardSubmit drives our protocol instance)
                 self.pending.wait_for(msg.cmd)
+                self._emit(self.pending.drain_early(msg.cmd.rifl))
                 continue
             assert isinstance(msg, Submit)
             cmd = msg.cmd
             self.pending.wait_for(cmd)
+            self._emit(self.pending.drain_early(cmd.rifl))
             dot = (
                 self.runtime.dot_gen.next_id()
                 if self.runtime.protocol_cls.leaderless()
@@ -211,6 +224,14 @@ class ProcessRuntime:
         ]
         for index, executor in enumerate(self.executors):
             executor.set_executor_index(index)
+        # secondary request-serving executors share the primary's vertex
+        # index (the reference's SharedMap across clones, index.rs:19-22):
+        # peer-shard requests must be answerable from *pending* vertices or
+        # cross-shard dependency cycles deadlock
+        share = getattr(type(self.executors[0]), "share_state_from", None)
+        if share is not None:
+            for executor in self.executors[1:]:
+                executor.share_state_from(self.executors[0])
         self.dot_gen = AtomicIdGen(process_id)
         self.client_sessions: Dict[ClientId, _ClientSession] = {}
         assert multiplexing >= 1

@@ -10,13 +10,14 @@ and exercise the batched executor's stuck-residue path through both the
 native and the Python-fallback resolvers.
 """
 
+import os
 import random
 import sys
 
 import numpy as np
 import pytest
 
-sys.path.insert(0, "tests")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__))))
 from test_ops_resolve import (  # noqa: E402
     batch_arrays,
     oracle_per_key_order,

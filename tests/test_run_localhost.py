@@ -203,11 +203,25 @@ def test_run_atlas_3_1_three_shards():
     run_multi_shard_cluster(Atlas, Config(n=3, f=1), shard_count=3)
 
 
+def test_run_atlas_3_1_four_shards():
+    # the reference matrix tops out at 4 shards (fantoch_ps/src/protocol/
+    # mod.rs:112-750)
+    run_multi_shard_cluster(Atlas, Config(n=3, f=1), shard_count=4)
+
+
 def test_run_newt_3_1_two_shards():
     run_multi_shard_cluster(
         Newt,
         Config(n=3, f=1, newt_detached_send_interval_ms=50),
         shard_count=2,
+    )
+
+
+def test_run_newt_3_1_three_shards():
+    run_multi_shard_cluster(
+        Newt,
+        Config(n=3, f=1, newt_detached_send_interval_ms=50),
+        shard_count=3,
     )
 
 
