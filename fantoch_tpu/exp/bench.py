@@ -66,6 +66,11 @@ def run_experiment(
     env = _cli_env()
     servers = []
     logs = []
+    # dstat analog: machine resource CSV for the plot layer's tables
+    from fantoch_tpu.exp.monitor import ResourceMonitor
+
+    monitor = ResourceMonitor(os.path.join(exp_dir, "resources.csv"))
+    monitor.start()
     try:
         for pid, shard in all_pids:
             ids = shard_ids[shard]
@@ -129,6 +134,7 @@ def run_experiment(
         # let the metrics loggers take a final-interval snapshot
         time.sleep(0.7)
     finally:
+        monitor.stop()
         for proc in servers:
             proc.send_signal(signal.SIGINT)
         for proc in servers:

@@ -1,0 +1,121 @@
+"""Machine resource monitoring during experiments — the dstat analog.
+
+Reference: fantoch_exp runs ``dstat`` on every machine and ships the CSVs
+into the experiment directory (fantoch_exp/src/bench.rs:22,203-258); the
+plot layer renders them as resource tables (fantoch_plot/src/lib.rs
+dstat tables).  No dstat binary here: sample ``/proc`` directly — cpu
+jiffies from /proc/stat, memory from /proc/meminfo, network byte counts
+from /proc/net/dev — into the same kind of per-interval CSV.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_CSV_HEADER = "epoch_s,cpu_pct,mem_used_mb,mem_total_mb,net_rx_kbps,net_tx_kbps"
+
+
+def _read_cpu() -> tuple:
+    """(busy, total) jiffies across all cpus."""
+    with open("/proc/stat") as fh:
+        fields = fh.readline().split()[1:]
+    vals = [int(v) for v in fields]
+    idle = vals[3] + (vals[4] if len(vals) > 4 else 0)  # idle + iowait
+    total = sum(vals)
+    return total - idle, total
+
+
+def _read_mem() -> tuple:
+    """(used_mb, total_mb) like dstat's mem usage (total - available)."""
+    info: Dict[str, int] = {}
+    with open("/proc/meminfo") as fh:
+        for line in fh:
+            name, value, *_ = line.split()
+            info[name.rstrip(":")] = int(value)  # kB
+    total = info.get("MemTotal", 0)
+    avail = info.get("MemAvailable", info.get("MemFree", 0))
+    return (total - avail) / 1024.0, total / 1024.0
+
+
+def _read_net() -> tuple:
+    """(rx_bytes, tx_bytes) summed over non-loopback interfaces."""
+    rx = tx = 0
+    with open("/proc/net/dev") as fh:
+        for line in fh.readlines()[2:]:
+            name, data = line.split(":", 1)
+            if name.strip() == "lo":
+                continue
+            vals = data.split()
+            rx += int(vals[0])
+            tx += int(vals[8])
+    return rx, tx
+
+
+class ResourceMonitor:
+    """Samples cpu/mem/net into ``path`` every ``interval_s`` until stopped.
+
+    Thread-based (the experiment driver is synchronous subprocess
+    orchestration); sampling reads three procfs files per tick.
+    """
+
+    def __init__(self, path: str, interval_s: float = 1.0):
+        self._path = path
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def __enter__(self) -> "ResourceMonitor":
+        self.start()
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        self.stop()
+
+    def start(self) -> None:
+        self._stop.clear()  # support restart after stop()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval_s + 2)
+            self._thread = None
+
+    def _run(self) -> None:
+        busy0, total0 = _read_cpu()
+        rx0, tx0 = _read_net()
+        t0 = time.time()
+        with open(self._path, "w") as fh:
+            fh.write(_CSV_HEADER + "\n")
+            while not self._stop.wait(self._interval_s):
+                busy1, total1 = _read_cpu()
+                rx1, tx1 = _read_net()
+                t1 = time.time()
+                dt = max(t1 - t0, 1e-6)
+                cpu = 100.0 * (busy1 - busy0) / max(total1 - total0, 1)
+                used_mb, total_mb = _read_mem()
+                fh.write(
+                    f"{t1:.3f},{cpu:.1f},{used_mb:.1f},{total_mb:.1f},"
+                    f"{(rx1 - rx0) / dt / 1024.0:.1f},"
+                    f"{(tx1 - tx0) / dt / 1024.0:.1f}\n"
+                )
+                fh.flush()
+                busy0, total0, rx0, tx0, t0 = busy1, total1, rx1, tx1, t1
+
+
+def load_samples(path: str) -> List[Dict[str, float]]:
+    """Parse a monitor CSV back into row dicts."""
+    out: List[Dict[str, float]] = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        header = fh.readline().strip().split(",")
+        for line in fh:
+            vals = line.strip().split(",")
+            if len(vals) == len(header):
+                out.append({k: float(v) for k, v in zip(header, vals)})
+    return out

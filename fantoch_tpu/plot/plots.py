@@ -95,6 +95,69 @@ def throughput_latency(
     return path
 
 
+def metrics_table(results: List[ExperimentResult]) -> str:
+    """Process/executor metrics table (fantoch_plot lib.rs:1491-1664
+    analog): per experiment, fast/slow/stable totals plus executor
+    chain-size and execution-delay statistics from the snapshot files."""
+    from fantoch_tpu.executor.base import ExecutorMetricsKind
+
+    lines = [
+        f"{'experiment':<34} {'fast':>8} {'slow':>8} {'stable':>8} "
+        f"{'chain p99':>10} {'exec delay p99 (ms)':>20}"
+    ]
+    for result in results:
+        totals = result.protocol_totals()
+        chain = delay = None
+        for snap in result.process_metrics().values():
+            for ex in snap.executors:
+                if ex is None:  # executor type without metrics
+                    continue
+                h = ex.get_collected(ExecutorMetricsKind.CHAIN_SIZE)
+                if h is not None and h.count:
+                    chain = max(chain or 0, h.percentile(0.99))
+                h = ex.get_collected(ExecutorMetricsKind.EXECUTION_DELAY)
+                if h is not None and h.count:
+                    delay = max(delay or 0, h.percentile(0.99))
+        lines.append(
+            f"{result.name:<34} {totals['fast_path']:>8} "
+            f"{totals['slow_path']:>8} {totals['stable']:>8} "
+            f"{chain if chain is not None else '-':>10} "
+            f"{delay if delay is not None else '-':>20}"
+        )
+    return "\n".join(lines)
+
+
+def resource_table(results: List[ExperimentResult]) -> str:
+    """Machine resource table from the experiment's dstat-analog CSV
+    (fantoch_plot dstat tables; fantoch_exp/src/bench.rs:203-258):
+    mean/max cpu and mean mem/net over the run."""
+    import os
+
+    from fantoch_tpu.exp.monitor import load_samples
+
+    lines = [
+        f"{'experiment':<34} {'cpu% avg':>9} {'cpu% max':>9} "
+        f"{'mem MB avg':>11} {'net rx KB/s':>12} {'net tx KB/s':>12}"
+    ]
+    for result in results:
+        rows = load_samples(os.path.join(result.path, "resources.csv"))
+        if not rows:
+            lines.append(
+                f"{result.name:<34} {'-':>9} {'-':>9} {'-':>11} {'-':>12} "
+                f"{'-':>12}"
+            )
+            continue
+        cpu = [r["cpu_pct"] for r in rows]
+        mem = [r["mem_used_mb"] for r in rows]
+        rx = [r["net_rx_kbps"] for r in rows]
+        tx = [r["net_tx_kbps"] for r in rows]
+        lines.append(
+            f"{result.name:<34} {np.mean(cpu):>9.1f} {np.max(cpu):>9.1f} "
+            f"{np.mean(mem):>11.0f} {np.mean(rx):>12.1f} {np.mean(tx):>12.1f}"
+        )
+    return "\n".join(lines)
+
+
 def fast_path_split(results: List[ExperimentResult], path: str) -> str:
     """Stacked fast/slow commit counts per experiment (the metrics-table
     analog of lib.rs:1491-1664, as a bar chart)."""

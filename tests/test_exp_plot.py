@@ -60,3 +60,16 @@ def test_run_experiments_db_and_plots(tmp_path):
     ]:
         path = fn(db.results, str(tmp_path / name))
         assert os.path.getsize(path) > 1000
+
+    # metrics table renders the snapshot counters
+    table = plots.metrics_table(db.results)
+    assert "fast" in table and "epaxos" in table
+    assert len(table.splitlines()) == 1 + len(db.results)
+
+    # dstat-analog resource table from the monitor CSV
+    resources = plots.resource_table(db.results)
+    assert "cpu% avg" in resources
+    assert len(resources.splitlines()) == 1 + len(db.results)
+    # the monitor wrote at least the header during the run
+    for result in db.results:
+        assert os.path.exists(os.path.join(result.path, "resources.csv"))

@@ -1,0 +1,176 @@
+"""Property-based tests (hypothesis) — the analog of the reference's
+quickcheck CI runs (.github/workflows: QUICKCHECK_TESTS=10000; quickcheck
+dev-dependency across fantoch crates).
+
+Targets the algebraic core where randomized inputs bite hardest:
+
+* AboveExSet/AEClock against a plain set model (threshold crate semantics);
+* VoteRange compression preserves the voted-integer set
+  (fantoch_ps/src/protocol/common/table/votes.rs:133 try_compress);
+* the keyed device resolver against the host Tarjan oracle on generated
+  latest-per-key graphs with cycles (ops/graph_resolve.py vs
+  executor/graph/deps_graph.py);
+* dot packing round-trips (ops/frontier.pack_dots);
+* the native C++ SCC resolver against the same oracle.
+"""
+
+import os
+import sys
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fantoch_tpu.core.clocks import AboveExSet
+
+# --- AboveExSet vs set model -------------------------------------------------
+
+
+@settings(max_examples=300, deadline=None)
+@given(st.lists(st.integers(min_value=1, max_value=64), max_size=64))
+def test_above_ex_set_matches_set_model(events):
+    eset = AboveExSet()
+    model = set()
+    for e in events:
+        added = eset.add(e)
+        assert added == (e not in model)
+        model.add(e)
+    for probe in range(1, 70):
+        assert eset.contains(probe) == (probe in model), probe
+    # frontier: largest f with 1..f all present
+    f = 0
+    while (f + 1) in model:
+        f += 1
+    assert eset.frontier == f
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.integers(min_value=1, max_value=40),
+            st.integers(min_value=0, max_value=8),
+        ),
+        max_size=30,
+    )
+)
+def test_above_ex_set_add_range_matches_model(ranges):
+    eset = AboveExSet()
+    model = set()
+    for start, width in ranges:
+        eset.add_range(start, start + width)
+        model.update(range(start, start + width + 1))
+    for probe in range(1, 55):
+        assert eset.contains(probe) == (probe in model), probe
+
+
+# --- VoteRange compression ---------------------------------------------------
+
+
+@settings(max_examples=300, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.integers(min_value=1, max_value=30),
+            st.integers(min_value=0, max_value=5),
+        ),
+        min_size=1,
+        max_size=20,
+    )
+)
+def test_vote_range_compression_preserves_votes(ranges):
+    from fantoch_tpu.protocol.common.table_clocks import VoteRange
+
+    compressed = []
+    model = set()
+    for start, width in ranges:
+        vr = VoteRange(by=1, start=start, end=start + width)
+        model.update(range(start, start + width + 1))
+        if compressed and compressed[-1].try_compress(vr):
+            pass
+        else:
+            compressed.append(vr)
+    got = set()
+    for vr in compressed:
+        got.update(range(vr.start, vr.end + 1))
+    # compression joins adjacent/overlapping ranges in order; the union of
+    # represented votes must never change
+    assert got == model
+
+
+# --- dot packing -------------------------------------------------------------
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    st.lists(
+        st.tuples(
+            st.integers(min_value=1, max_value=255),
+            st.integers(min_value=1, max_value=2**31 - 1),
+        ),
+        min_size=1,
+        max_size=32,
+    )
+)
+def test_pack_dots_roundtrip_and_order(pairs):
+    from fantoch_tpu.ops.frontier import pack_dots
+
+    src = np.array([p for p, _ in pairs], dtype=np.int64)
+    seq = np.array([q for _, q in pairs], dtype=np.int64)
+    packed = pack_dots(src, seq)
+    assert ((packed >> 32) == src).all()
+    assert ((packed & 0xFFFFFFFF) == seq).all()
+    # packing is order-preserving on (src, seq) lexicographic order
+    order = np.lexsort((seq, src))
+    assert (packed[order] == np.sort(packed)).all()
+
+
+# --- keyed resolver vs host oracle ------------------------------------------
+
+
+@st.composite
+def functional_graphs(draw):
+    """Latest-per-key chains over a few keys, with optional cycles at the
+    oldest end — the KeyDeps shape (sequential.rs:8-11)."""
+    import random as _random
+
+    from test_ops_resolve import random_functional_args
+
+    seed = draw(st.integers(min_value=0, max_value=2**32 - 1))
+    cmds_per_key = draw(st.integers(min_value=1, max_value=7))
+    rng = _random.Random(seed)
+    return random_functional_args(
+        n=3, keys=["A", "B", "C"], cmds_per_key=cmds_per_key, rng=rng
+    )
+
+
+@settings(max_examples=60, deadline=None)
+@given(functional_graphs())
+def test_keyed_resolver_matches_oracle_property(args):
+    from test_ops_resolve import assert_keyed_matches_oracle
+
+    assert_keyed_matches_oracle(3, args)
+
+
+@settings(max_examples=60, deadline=None)
+@given(functional_graphs())
+def test_native_resolver_matches_oracle_property(args):
+    from test_native import csr_from_args
+    from test_ops_resolve import oracle_per_key_order
+
+    from fantoch_tpu import native
+
+    if not native.available():
+        return
+    offsets, targets, packed = csr_from_args(args)
+    order, _sizes = native.resolve_sccs(offsets, targets, packed)
+    per_key = {}
+    for i in order.tolist():
+        dot, keys, _ = args[i]
+        for key in keys:
+            per_key.setdefault(key, []).append(dot)
+    expected, n_exec = oracle_per_key_order(3, args)
+    assert len(order) == n_exec
+    assert per_key == expected
