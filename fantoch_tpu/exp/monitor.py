@@ -86,6 +86,18 @@ class ResourceMonitor:
             self._thread = None
 
     def _run(self) -> None:
+        try:
+            self._run_inner()
+        except OSError:
+            # no procfs (non-Linux host): leave at most a header CSV
+            # rather than killing the thread with a traceback
+            try:
+                with open(self._path, "w") as fh:
+                    fh.write(_CSV_HEADER + "\n")
+            except OSError:
+                pass
+
+    def _run_inner(self) -> None:
         busy0, total0 = _read_cpu()
         rx0, tx0 = _read_net()
         t0 = time.time()

@@ -29,12 +29,17 @@ fantoch_ps/src/protocol/mod.rs:924-1010, turned into MC invariants):
   every process executed every command on every key it owns, and the
   per-key orders are identical.
 
-Periodic events (GC, detached votes, executed notifications) are outside
-the model — they expand the state space multiplicatively and affect only
-liveness of *cleanup*; protocols whose commit path depends on a periodic
-event cannot be checked here (Newt's detached-vote stability, Caesar's
-executor-driven GC).  Basic / EPaxos / Atlas / FPaxos commit and execute
-without them.
+Periodic events (GC, detached votes, executed notifications) run only at
+**quiescence**, as a DETERMINISTIC stabilization closure: once no submit
+or delivery is enabled, every process's timers fire in sorted order and
+the resulting messages drain FIFO, repeated to a fixpoint
+(:meth:`ModelChecker._stabilize`).  Timer-order interleavings are NOT
+branched over — a deliberate reduction that keeps the space small while
+still running the timer-driven paths (Newt's detached-vote stability,
+Caesar's executor-driven GC, the GC message flow) to their steady state
+on top of every explored workload interleaving.  This mirrors how the
+reference's sim tests drive timers: extra_sim_time after the workload
+(sim/runner.rs:203).
 """
 
 from __future__ import annotations
@@ -180,18 +185,34 @@ class ModelChecker:
             list(st.unsubmitted),
             copy.deepcopy(st.executed),
         )
+        return succ, self._apply_to(succ, action)
+
+    def _apply_to(self, succ: _State, action: Tuple[str, Any]) -> str:
+        """Apply ``action`` to ``succ`` in place; returns the description.
+        Branching exploration copies first (_apply); the linear
+        stabilization closure mutates one working copy."""
         kind, i = action
         if kind == "submit":
             pid, cmd = succ.unsubmitted.pop(i)
             succ.protocols[pid].submit(None, cmd, self._time)
             self._drain(succ, pid)
             desc = f"submit {cmd.rifl} at p{pid}"
+        elif kind == "events":
+            pid = i
+            proto = succ.protocols[pid]
+            for event, _interval in proto.periodic_events():
+                proto.handle_event(event, self._time)
+            executed = succ.executors[pid].executed(self._time)
+            if executed is not None:
+                proto.handle_executed(executed, self._time)
+            self._drain(succ, pid)
+            desc = f"periodic events at p{pid}"
         else:
             src, dst, msg = succ.network.pop(i)
             succ.protocols[dst].handle(src, 0, msg, self._time)
             self._drain(succ, dst)
             desc = f"deliver {type(msg).__name__} {src}->{dst}"
-        return succ, desc
+        return desc
 
     def _drain(self, st: _State, pid: ProcessId) -> None:
         """Collect a process's outputs: peer messages enter the reorderable
@@ -235,8 +256,9 @@ class ModelChecker:
     # --- invariants ---
 
     @staticmethod
-    def _check_agreement(st: _State) -> Optional[str]:
-        """Per-key orders must be pairwise prefix-compatible at all times."""
+    def _check_agreement(st: _State) -> Optional[Tuple[str, str]]:
+        """Per-key orders must be pairwise prefix-compatible at all times.
+        Returns (kind, detail) or None."""
         pids = sorted(st.executed)
         for a_i, a in enumerate(pids):
             for b in pids[a_i + 1 :]:
@@ -245,13 +267,15 @@ class ModelChecker:
                     short = min(len(order_a), len(order_b))
                     if order_a[:short] != order_b[:short]:
                         return (
+                            "agreement",
                             f"key {key!r}: p{a} executed {order_a[:short]} "
-                            f"but p{b} executed {order_b[:short]}"
+                            f"but p{b} executed {order_b[:short]}",
                         )
         return None
 
-    def _check_terminal(self, st: _State) -> Optional[str]:
-        """Nothing in flight: every process executed every command."""
+    def _check_terminal(self, st: _State) -> Optional[Tuple[str, str]]:
+        """Nothing in flight: every process executed every command.
+        Returns (kind, detail) or None."""
         expected: Dict[str, int] = {}
         for _pid, cmd in self._submits:
             for key in cmd.keys(0):
@@ -261,8 +285,9 @@ class ModelChecker:
                 got = len(by_key.get(key, []))
                 if got != count:
                     return (
+                        "incomplete",
                         f"p{pid} executed {got}/{count} commands on key "
-                        f"{key!r} in a terminal state"
+                        f"{key!r} in a terminal state",
                     )
         if self._check_agreement_flag:
             pids = sorted(st.executed)
@@ -270,10 +295,57 @@ class ModelChecker:
             for pid in pids[1:]:
                 if st.executed[pid] != first:
                     return (
+                        "divergent_terminal",
                         f"terminal orders diverge: p{pids[0]}={first} "
-                        f"p{pid}={st.executed[pid]}"
+                        f"p{pid}={st.executed[pid]}",
+                    )
+        # GC completeness (the reference's gc_at x commits == stable check,
+        # fantoch_ps/src/protocol/mod.rs:1060-1075, as a structural
+        # invariant): with GC configured, a stabilized terminal must have
+        # drained every per-dot info
+        if self._config.gc_interval_ms is not None:
+            for pid, proto in st.protocols.items():
+                infos = getattr(getattr(proto, "_cmds", None), "_infos", None)
+                if infos:
+                    return (
+                        "incomplete",
+                        f"p{pid} holds {len(infos)} un-GC'd infos in a "
+                        f"stabilized terminal: {sorted(infos)[:4]}",
                     )
         return None
+
+    # --- quiescence stabilization ---
+
+    def _stabilize(self, st: _State, max_rounds: int = 32) -> _State:
+        """Deterministic timer closure from a quiescent state: fire every
+        process's periodic events + executed notification (sorted order),
+        drain the resulting messages FIFO, repeat until nothing changes.
+        Models "after the network drains, timers keep firing" — the same
+        regime as the reference sim's extra_sim_time tail
+        (sim/runner.rs:203), where periodic GC/detached/executed events
+        run the system to its steady state.  Timer-order interleavings are
+        NOT branched over (a deliberate reduction; delivery interleavings
+        of the actual workload are fully explored before quiescence)."""
+        import copy
+
+        succ = _State(
+            copy.deepcopy(st.protocols),
+            copy.deepcopy(st.executors),
+            copy.deepcopy(st.network),
+            list(st.unsubmitted),
+            copy.deepcopy(st.executed),
+        )
+        prev_fp = self._fingerprint(succ)
+        for _ in range(max_rounds):
+            for pid in sorted(succ.protocols):
+                self._apply_to(succ, ("events", pid))
+            while succ.network:
+                self._apply_to(succ, ("deliver", 0))
+            fp = self._fingerprint(succ)
+            if fp == prev_fp:
+                break
+            prev_fp = fp
+        return succ
 
     # --- exploration ---
 
@@ -308,18 +380,22 @@ class ModelChecker:
 
             bad = self._check_agreement(st) if self._check_agreement_flag else None
             if bad is not None:
-                violations.append(Violation("agreement", bad, trace))
+                violations.append(Violation(bad[0], bad[1], trace))
                 continue  # don't explore past a violated state
 
             actions = self._enabled(st)
             if not actions:
+                # quiescence: stabilize deterministically (timers + FIFO
+                # drains to a fixpoint), then check the terminal invariants
                 terminals += 1
-                bad = self._check_terminal(st)
+                stable = self._stabilize(st)
+                bad = self._check_agreement(stable) if self._check_agreement_flag else None
+                if bad is None:
+                    bad = self._check_terminal(stable)
                 if bad is not None:
-                    kind = (
-                        "divergent_terminal" if "diverge" in bad else "incomplete"
+                    violations.append(
+                        Violation(bad[0], bad[1], trace + ["<stabilize>"])
                     )
-                    violations.append(Violation(kind, bad, trace))
                 continue
 
             for action in actions:
