@@ -17,7 +17,8 @@ Model:
   arbitrarily but neither drops nor duplicates, matching the simulator's
   delivery model, fantoch/src/sim/runner.rs:514-518);
 * successors are explored breadth-first with a visited set keyed on a
-  canonical pickle fingerprint, so converging interleavings merge.
+  canonical *value* fingerprint (identity- and history-blind), so
+  converging interleavings merge regardless of how they were reached.
 
 Checked properties (the reference harness's assertions,
 fantoch_ps/src/protocol/mod.rs:924-1010, turned into MC invariants):
@@ -44,7 +45,6 @@ reference's sim tests drive timers: extra_sim_time after the workload
 
 from __future__ import annotations
 
-import io
 import pickle
 import types
 from collections import deque
@@ -58,21 +58,62 @@ from fantoch_tpu.core.timing import SimTime
 from fantoch_tpu.protocol.base import ToForward, ToSend
 
 
-class _FingerprintPickler(pickle.Pickler):
-    """Pickler that serializes function objects (e.g. the per-dot info
-    factory lambdas inside CommandsInfo) as their qualified name: the
-    fingerprint only needs stability, not round-tripping."""
+def _canonical(obj, depth: int = 0):
+    """Recursively transform ``obj`` into a pure value structure (nested
+    tuples of primitives) whose ``repr`` is identical for logically-equal
+    inputs regardless of object identity or container insertion history.
 
-    def reducer_override(self, obj):
-        if isinstance(obj, types.FunctionType):
-            return str, (f"<fn {obj.__module__}.{obj.__qualname__}>",)
-        return NotImplemented
+    Plain pickling is NOT canonical: the pickler memoizes shared objects
+    (an aliased Dot serializes as a memo reference, an equal-but-distinct
+    one as a full body) and sets/dicts serialize in history-dependent
+    iteration order — logically-equal states would fingerprint differently
+    and be explored redundantly (sound — never merges distinct states —
+    but wasteful and copy-regime-dependent)."""
+    if depth > 60:  # pathological nesting: degrade to repr
+        return repr(obj)
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    if isinstance(obj, types.FunctionType):
+        return f"<fn {obj.__module__}.{obj.__qualname__}>"
+    if isinstance(obj, (list, tuple, deque)):
+        return (
+            type(obj).__name__,
+            tuple(_canonical(e, depth + 1) for e in obj),
+        )
+    if isinstance(obj, (set, frozenset)):
+        elems = [_canonical(e, depth + 1) for e in obj]
+        return ("set", tuple(sorted(elems, key=repr)))
+    if isinstance(obj, dict):
+        items = [
+            (_canonical(k, depth + 1), _canonical(v, depth + 1))
+            for k, v in obj.items()
+        ]
+        return ("dict", tuple(sorted(items, key=lambda kv: repr(kv[0]))))
+    # arbitrary object: class identity + canonical attribute state
+    state = getattr(obj, "__dict__", None)
+    if state is None:
+        slots = []
+        for klass in type(obj).__mro__:
+            slots.extend(getattr(klass, "__slots__", ()))
+        state = {s: getattr(obj, s) for s in slots if hasattr(obj, s)}
+    if not state:
+        # C-implemented objects (functools.partial, bound methods, ...)
+        # keep their payload outside __dict__/__slots__: an empty-state
+        # fingerprint would unsoundly merge distinct values, so
+        # canonicalize their reduce form (or degrade to repr)
+        try:
+            state = obj.__reduce_ex__(2)
+        except Exception:  # noqa: BLE001
+            return repr(obj)
+    return (
+        f"{type(obj).__module__}.{type(obj).__qualname__}",
+        _canonical(state, depth + 1),
+    )
 
 
 def _dumps(obj) -> bytes:
-    buf = io.BytesIO()
-    _FingerprintPickler(buf, protocol=4).dump(obj)
-    return buf.getvalue()
+    """Canonical fingerprint bytes: value-determined, identity-blind."""
+    return repr(_canonical(obj)).encode()
 
 
 @dataclass
@@ -131,6 +172,12 @@ class ModelChecker:
         # (fantoch/src/protocol/basic.rs): per-key agreement is not among
         # its properties, so callers disable that invariant for it
         self._check_agreement_flag = check_agreement
+        # copy regime: pickle round-trip while it works, with a lazy
+        # one-way downgrade to deepcopy on the first pickle failure
+        # (per-instance, warned once).  With alias-free messages (_drain)
+        # and value-canonical fingerprints the two regimes explore the
+        # exact same state space, so the downgrade is purely a speed loss
+        self._use_pickle_copy = True
         self._time = SimTime()  # fixed logical time: delivery order is the model
 
     # --- state construction ---
@@ -176,16 +223,47 @@ class ModelChecker:
         return actions
 
     def _apply(self, st: _State, action: Tuple[str, Any]) -> Tuple[_State, str]:
+        succ = self._copy_state(st)
+        return succ, self._apply_to(succ, action)
+
+    def _copy_state(self, st: _State) -> _State:
+        """Pickle round-trip (~3x faster than deepcopy for these object
+        graphs — the protocol info factories are module-level precisely so
+        state pickles).  Equivalent to deepcopy because messages are copied
+        at send time (_drain), so states carry no cross-object aliases; a
+        pickle failure downgrades THIS checker instance for the rest of
+        its run (per-instance, so one exotic protocol cannot change the
+        copy regime of later checkers in the process)."""
+        if self._use_pickle_copy:
+            try:
+                protocols, executors, network, executed = pickle.loads(
+                    pickle.dumps(
+                        (st.protocols, st.executors, st.network, st.executed),
+                        protocol=pickle.HIGHEST_PROTOCOL,
+                    )
+                )
+                return _State(
+                    protocols, executors, network, list(st.unsubmitted), executed
+                )
+            except Exception as exc:  # noqa: BLE001 — unpicklable: degrade
+                import warnings
+
+                warnings.warn(
+                    f"model checker falling back to deepcopy state copies "
+                    f"(~3x slower): state refused to pickle: {exc!r}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                self._use_pickle_copy = False
         import copy
 
-        succ = _State(
+        return _State(
             copy.deepcopy(st.protocols),
             copy.deepcopy(st.executors),
             copy.deepcopy(st.network),
             list(st.unsubmitted),
             copy.deepcopy(st.executed),
         )
-        return succ, self._apply_to(succ, action)
 
     def _apply_to(self, succ: _State, action: Tuple[str, Any]) -> str:
         """Apply ``action`` to ``succ`` in place; returns the description.
@@ -230,17 +308,22 @@ class ModelChecker:
         def pump() -> None:
             for act in proto.to_processes_iter():
                 if isinstance(act, ToSend):
-                    targets = sorted(act.target)
-                    msgs = [act.msg] + [
-                        copy.deepcopy(act.msg) for _ in targets[1:]
-                    ]  # per-connection copy: receivers may mutate in place
-                    for target, msg in zip(targets, msgs):
+                    # copy EVERY outgoing message, first target included: a
+                    # message object may alias sender state (e.g. Newt's
+                    # MCommit carries info.votes), and the real network
+                    # serializes per send — an aliased in-flight message
+                    # would let a receiver mutate the sender's state across
+                    # the process boundary, and would also make the
+                    # pickle-round-trip state copy (alias-preserving) differ
+                    # from per-field deepcopy (alias-severing)
+                    for target in sorted(act.target):
+                        msg = copy.deepcopy(act.msg)
                         if target == pid:
                             local.append(msg)
                         else:
                             st.network.append((pid, target, msg))
                 elif isinstance(act, ToForward):
-                    local.append(act.msg)
+                    local.append(copy.deepcopy(act.msg))
                 else:  # pragma: no cover
                     raise AssertionError(f"unknown action {act}")
             for info in proto.to_executors_iter():
@@ -326,15 +409,7 @@ class ModelChecker:
         run the system to its steady state.  Timer-order interleavings are
         NOT branched over (a deliberate reduction; delivery interleavings
         of the actual workload are fully explored before quiescence)."""
-        import copy
-
-        succ = _State(
-            copy.deepcopy(st.protocols),
-            copy.deepcopy(st.executors),
-            copy.deepcopy(st.network),
-            list(st.unsubmitted),
-            copy.deepcopy(st.executed),
-        )
+        succ = self._copy_state(st)
         prev_fp = self._fingerprint(succ)
         for _ in range(max_rounds):
             for pid in sorted(succ.protocols):

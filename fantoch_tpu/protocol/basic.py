@@ -56,6 +56,12 @@ class MCommit:
     cmd: Command
 
 
+def _basic_info_factory(*_args) -> "BasicInfo":
+    """Module-level (picklable) per-dot info factory: the model checker
+    copies protocol state by pickling, which lambdas would break."""
+    return BasicInfo()
+
+
 @dataclass
 class BasicInfo:
     """Per-dot lifecycle info (basic.rs:318-341)."""
@@ -77,7 +83,7 @@ class Basic(CommitGCMixin, Protocol):
             config,
             fast_quorum_size,
             write_quorum_size,
-            lambda *_: BasicInfo(),
+            _basic_info_factory,
         )
         self._gc_track = GCTrack(process_id, shard_id, config.n)
         self._to_processes: deque = deque()

@@ -107,6 +107,11 @@ class Status:
     COMMIT = "commit"
 
 
+def _caesar_info_factory(pid, _sid, _cfg, fq, wq) -> "CaesarInfo":
+    """Picklable per-dot info factory (the model checker pickles state)."""
+    return CaesarInfo(pid, fq, wq)
+
+
 class CaesarInfo:
     """Per-dot lifecycle info (caesar.rs:1039-1086)."""
 
@@ -150,7 +155,7 @@ class Caesar(Protocol):
             config,
             fast_quorum_size,
             write_quorum_size,
-            lambda pid, _sid, _cfg, fq, wq: CaesarInfo(pid, fq, wq),
+            _caesar_info_factory,
         )
         self._gc_track = GCTrack(process_id, shard_id, config.n)
         self._to_processes: Deque[Action] = deque()

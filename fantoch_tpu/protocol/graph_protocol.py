@@ -13,6 +13,8 @@ the protocols specialize three points:
 
 from __future__ import annotations
 
+import functools
+
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Deque, Dict, Optional, Set, Tuple
@@ -163,6 +165,12 @@ def _proposal_gen(_values):
     raise NotImplementedError("recovery not implemented yet")
 
 
+def _graph_info_factory(pid, _sid, _cfg, _fq, _wq, *, n, f, quorum_deps_size):
+    """Picklable per-dot info factory (the model checker pickles state);
+    a partial over primitives pickles by reference + args."""
+    return GraphCommandInfo(pid, n, f, quorum_deps_size)
+
+
 class GraphCommandInfo:
     """Per-dot lifecycle info (epaxos.rs:628-668)."""
 
@@ -218,8 +226,9 @@ class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
             config,
             fast_quorum_size,
             write_quorum_size,
-            lambda pid, _sid, _cfg, _fq, _wq: GraphCommandInfo(
-                pid, config.n, f, quorum_deps_size
+            functools.partial(
+                _graph_info_factory, n=config.n, f=f,
+                quorum_deps_size=quorum_deps_size,
             ),
         )
         self._gc_track = GCTrack(process_id, shard_id, config.n)

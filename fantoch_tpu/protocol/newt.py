@@ -166,6 +166,11 @@ def _proposal_gen(_values):
     raise NotImplementedError("recovery not implemented yet")
 
 
+def _newt_info_factory(pid, _sid, cfg, fq, _wq) -> "NewtInfo":
+    """Picklable per-dot info factory (the model checker pickles state)."""
+    return NewtInfo(pid, cfg.n, cfg.f, fq)
+
+
 class NewtInfo:
     """Per-dot lifecycle info (newt.rs:1117-1170)."""
 
@@ -206,7 +211,7 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
             config,
             fast_quorum_size,
             write_quorum_size,
-            lambda pid, _sid, cfg, fq, _wq: NewtInfo(pid, cfg.n, cfg.f, fq),
+            _newt_info_factory,
         )
         self._gc_track = GCTrack(process_id, shard_id, config.n)
         self._to_processes: Deque[Action] = deque()

@@ -8,6 +8,8 @@ reordering — the checker must find a counterexample trace, proving it
 actually distinguishes sound from unsound compositions.
 """
 
+import os
+
 import pytest
 
 from fantoch_tpu.core import Command, Config, KVOp, Rifl
@@ -152,3 +154,21 @@ def test_mc_newt_with_quiescent_timers():
     result = mc.run()
     assert result.complete and result.ok, result.violations[:1]
     assert result.terminals > 0
+
+
+@pytest.mark.skipif(
+    not os.environ.get("FANTOCH_MC_SLOW"),
+    reason="~8 min exhaustive run; set FANTOCH_MC_SLOW=1",
+)
+def test_mc_epaxos_three_conflicting_commands_slow():
+    # measured: 23,269 states, complete, ok (~7 min)
+    from fantoch_tpu.protocol.graph_protocol import EPaxos
+
+    mc = ModelChecker(
+        EPaxos,
+        Config(3, 1),
+        [(1, put(1, 1, "A")), (2, put(2, 1, "A")), (3, put(3, 1, "A"))],
+        max_states=400_000,
+    )
+    result = mc.run()
+    assert result.complete and result.ok, result.violations[:1]
