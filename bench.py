@@ -216,6 +216,11 @@ def child_main(mode: str) -> None:
     except Exception as exc:  # noqa: BLE001
         print(f"# general-path bench failed: {exc!r}", file=sys.stderr)
         record["general_error"] = repr(exc)[:200]
+    try:
+        record.update(bench_native_resolver(key_np, dep_np, src_np, seq_np))
+    except Exception as exc:  # noqa: BLE001
+        print(f"# native-resolver bench failed: {exc!r}", file=sys.stderr)
+        record["native_error"] = repr(exc)[:200]
 
     print(json.dumps(record), flush=True)
 
@@ -350,6 +355,34 @@ def bench_general_path(batch: int = 1 << 18, width: int = 4):
         general_fallback_resolved_frac=round(frac, 4),
     )
     return out
+
+
+def bench_native_resolver(key_np, dep_np, src_np, seq_np):
+    """The native C++ host resolver (fantoch_tpu/native — the Rust-Tarjan
+    twin) on the same 1M-command workload: the framework's host-side
+    ordering path, reported for comparison on every platform."""
+    import numpy as np
+
+    from fantoch_tpu import native
+    from fantoch_tpu.ops.frontier import pack_dots
+
+    if not native.available():
+        return {"native_ms": None}
+    n = len(dep_np)
+    has_dep = dep_np >= 0
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    offsets[1:] = np.cumsum(has_dep.astype(np.int32))
+    targets = dep_np[has_dep].astype(np.int32)
+    packed = pack_dots(src_np.astype(np.int64), seq_np.astype(np.int64))
+
+    order, _sizes = native.resolve_sccs(offsets, targets, packed)  # warm/load
+    assert len(order) == n
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        order, _sizes = native.resolve_sccs(offsets, targets, packed)
+        best = min(best, (time.perf_counter() - t0) * 1000.0)
+    return {"native_ms": round(best, 3)}
 
 
 def _run_child(mode: str, timeout_s: int):

@@ -142,8 +142,10 @@ class _State:
     def __init__(self, protocols, executors, network, unsubmitted, executed):
         self.protocols: Dict[ProcessId, Any] = protocols
         self.executors: Dict[ProcessId, Any] = executors
-        # in-flight messages: list of (from_pid, to_pid, msg)
-        self.network: List[Tuple[ProcessId, ProcessId, Any]] = network
+        # in-flight messages: (from_pid, to_pid, msg, fingerprint) — the
+        # fingerprint is computed once at send time (messages are copied
+        # at send and never mutated in flight)
+        self.network: List[Tuple[ProcessId, ProcessId, Any, bytes]] = network
         self.unsubmitted: List[Tuple[ProcessId, Command]] = unsubmitted
         # per-process executed (rifl) order, per key — the agreement object
         self.executed: Dict[ProcessId, Dict[str, List[Any]]] = executed
@@ -213,10 +215,10 @@ class ModelChecker:
         for i, (pid, cmd) in enumerate(st.unsubmitted):
             actions.append(("submit", i))
         seen = set()
-        for i, (src, dst, msg) in enumerate(st.network):
+        for i, (src, dst, _msg, fp) in enumerate(st.network):
             # identical in-flight messages are interchangeable: exploring
             # one of them covers all (multiset symmetry reduction)
-            key = (src, dst, _dumps(msg))
+            key = (src, dst, fp)
             if key not in seen:
                 seen.add(key)
                 actions.append(("deliver", i))
@@ -286,7 +288,7 @@ class ModelChecker:
             self._drain(succ, pid)
             desc = f"periodic events at p{pid}"
         else:
-            src, dst, msg = succ.network.pop(i)
+            src, dst, msg, _fp = succ.network.pop(i)
             succ.protocols[dst].handle(src, 0, msg, self._time)
             self._drain(succ, dst)
             desc = f"deliver {type(msg).__name__} {src}->{dst}"
@@ -321,7 +323,7 @@ class ModelChecker:
                         if target == pid:
                             local.append(msg)
                         else:
-                            st.network.append((pid, target, msg))
+                            st.network.append((pid, target, msg, _dumps(msg)))
                 elif isinstance(act, ToForward):
                     local.append(copy.deepcopy(act.msg))
                 else:  # pragma: no cover
@@ -430,7 +432,7 @@ class ModelChecker:
             (
                 sorted(st.protocols.items(), key=lambda kv: kv[0]),
                 sorted(st.executors.items(), key=lambda kv: kv[0]),
-                sorted((s, d, _dumps(m)) for s, d, m in st.network),
+                sorted((s, d, fp) for s, d, _m, fp in st.network),
                 st.unsubmitted,
                 sorted(st.executed.items()),
             )
