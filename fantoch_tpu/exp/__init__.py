@@ -12,6 +12,6 @@ and raises with a clear message.
 """
 
 from fantoch_tpu.exp.config import ExperimentConfig
-from fantoch_tpu.exp.bench import run_experiment
+from fantoch_tpu.exp.bench import run_experiment, run_sweep
 
-__all__ = ["ExperimentConfig", "run_experiment"]
+__all__ = ["ExperimentConfig", "run_experiment", "run_sweep"]

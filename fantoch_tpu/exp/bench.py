@@ -18,6 +18,7 @@ which fantoch_tpu.plot's ResultsDB indexes.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import signal
@@ -159,3 +160,28 @@ def run_experiment(
     with open(os.path.join(exp_dir, "manifest.json"), "w") as fh:
         json.dump(manifest, fh, indent=2)
     return manifest
+
+
+def run_sweep(
+    base: ExperimentConfig,
+    output_dir: str,
+    clients_sweep,
+    testbed: str = "localhost",
+    client_timeout_s: int = 600,
+) -> list:
+    """The reference's main experiment shape: the same protocol config at
+    increasing client counts (fantoch_exp/src/bin/main.rs clients_per
+    sweep), producing one experiment dir per point — exactly what
+    plot.throughput_latency needs for a real curve."""
+    manifests = []
+    for clients in clients_sweep:
+        cfg = dataclasses.replace(base, clients_per_process=clients)
+        manifests.append(
+            run_experiment(
+                cfg,
+                output_dir,
+                testbed=testbed,
+                client_timeout_s=client_timeout_s,
+            )
+        )
+    return manifests

@@ -26,6 +26,23 @@ def test_non_localhost_testbed_rejected(tmp_path):
         run_experiment(cfg, str(tmp_path), testbed="aws")
 
 
+def test_run_sweep_throughput_latency_curve(tmp_path):
+    # the reference's main experiment shape: one protocol at increasing
+    # client counts -> a multi-point throughput-latency curve
+    from fantoch_tpu.exp import run_sweep
+
+    out = str(tmp_path / "sweep")
+    base = ExperimentConfig(
+        "epaxos", 3, 1, commands_per_client=6, conflict_rate=50
+    )
+    manifests = run_sweep(base, out, clients_sweep=[1, 2])
+    assert [m["config"]["clients_per_process"] for m in manifests] == [1, 2]
+    db = ResultsDB(out)
+    assert len(db) == 2
+    path = plots.throughput_latency(db.results, str(tmp_path / "curve.png"))
+    assert os.path.getsize(path) > 1000
+
+
 def test_run_experiments_db_and_plots(tmp_path):
     out = str(tmp_path / "results")
     configs = [
