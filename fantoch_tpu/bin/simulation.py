@@ -2,17 +2,88 @@
 
 Reference: fantoch_ps/src/bin/simulation.rs:47-584 — sweep protocols and
 client counts over the AWS planet, reporting per-region latency stats.
-(The reference parallelizes with rayon; sweeps here run sequentially —
-each sim is already a tight single-threaded event loop.)
+The reference parallelizes with rayon; ``--parallel N`` here fans sweep
+points out over worker processes (each sim is a tight single-threaded
+event loop, so process-level parallelism is the right grain).
 
     python -m fantoch_tpu.bin.simulation --protocol newt -n 5 -f 1 \\
-        --clients 1,10 --conflict-rate 50
+        --clients 1,10 --conflict-rate 50 --parallel 4
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+
+
+def _run_point(params: dict) -> str:
+    """One sweep point -> its JSON result line.  Module-level and fed by a
+    plain dict so ProcessPoolExecutor workers can pickle the call.
+
+    Always CPU: a simulation is a host-side deterministic event loop, and
+    concurrent workers must never race to initialize the one TPU backend
+    (hostenv.py: backend init can block indefinitely)."""
+    from fantoch_tpu.hostenv import force_cpu_platform
+
+    force_cpu_platform()
+
+    from fantoch_tpu.bin.common import protocol_by_name
+    from fantoch_tpu.client import ConflictRateKeyGen, Workload
+    from fantoch_tpu.core import Config
+    from fantoch_tpu.core.planet import Planet, Region
+    from fantoch_tpu.sim.runner import Runner
+
+    protocol_cls = protocol_by_name(params["protocol"])
+    planet = Planet.new(params["dataset"])
+    if params["regions"]:
+        regions = [Region(name) for name in params["regions"]]
+    else:
+        regions = sorted(planet.regions())[: params["n"]]
+    assert len(regions) == params["n"], "one region per process"
+
+    config = Config(
+        n=params["n"],
+        f=params["f"],
+        gc_interval_ms=100,
+        newt_tiny_quorums=params["tiny_quorums"],
+    )
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(params["conflict_rate"]),
+        keys_per_command=params["keys_per_command"],
+        commands_per_client=params["commands_per_client"],
+        payload_size=1,
+    )
+    runner = Runner(
+        protocol_cls,
+        planet,
+        config,
+        workload,
+        params["clients"],
+        process_regions=list(regions),
+        client_regions=list(regions),
+        seed=params["seed"],
+    )
+    _metrics, _monitors, latencies = runner.run(extra_sim_time_ms=10_000)
+    stats = {
+        str(region): {
+            "issued": issued,
+            "mean_ms": round(hist.mean(), 1),
+            "p99_ms": hist.percentile(0.99),
+        }
+        for region, (issued, hist) in sorted(
+            latencies.items(), key=lambda kv: str(kv[0])
+        )
+    }
+    return json.dumps(
+        {
+            "protocol": params["protocol"],
+            "n": params["n"],
+            "f": params["f"],
+            "clients_per_region": params["clients"],
+            "latency": stats,
+        }
+    )
 
 
 def main(argv=None) -> None:
@@ -35,70 +106,47 @@ def main(argv=None) -> None:
                         help="comma list of region names (default: first n)")
     parser.add_argument("--newt-tiny-quorums", action="store_true")
     parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument("--parallel", type=int, default=1,
+                        help="worker processes for the sweep (rayon analog)")
     args = parser.parse_args(argv)
 
-    from fantoch_tpu.bin.common import protocol_by_name
-    from fantoch_tpu.client import ConflictRateKeyGen, Workload
-    from fantoch_tpu.core import Config
-    from fantoch_tpu.core.planet import Planet, Region
-    from fantoch_tpu.sim.runner import Runner
-
-    protocol_cls = protocol_by_name(args.protocol)
-    planet = Planet.new(args.dataset)
-    if args.regions:
-        regions = [Region(name) for name in args.regions.split(",")]
-    else:
-        regions = sorted(planet.regions())[: args.processes]
-    assert len(regions) == args.processes, "one region per process"
-
-    config = Config(
-        n=args.processes,
-        f=args.faults,
-        gc_interval_ms=100,
-        newt_tiny_quorums=args.newt_tiny_quorums,
-    )
-
-    for clients in [int(c) for c in args.clients.split(",")]:
-        workload = Workload(
-            shard_count=1,
-            key_gen=ConflictRateKeyGen(args.conflict_rate),
-            keys_per_command=args.keys_per_command,
-            commands_per_client=args.commands_per_client,
-            payload_size=1,
-        )
-        runner = Runner(
-            protocol_cls,
-            planet,
-            config,
-            workload,
-            clients,
-            process_regions=list(regions),
-            client_regions=list(regions),
-            seed=args.seed,
-        )
-        _metrics, _monitors, latencies = runner.run(extra_sim_time_ms=10_000)
-        stats = {
-            str(region): {
-                "issued": issued,
-                "mean_ms": round(hist.mean(), 1),
-                "p99_ms": hist.percentile(0.99),
-            }
-            for region, (issued, hist) in sorted(
-                latencies.items(), key=lambda kv: str(kv[0])
-            )
+    points = [
+        {
+            "protocol": args.protocol,
+            "n": args.processes,
+            "f": args.faults,
+            "clients": clients,
+            "conflict_rate": args.conflict_rate,
+            "keys_per_command": args.keys_per_command,
+            "commands_per_client": args.commands_per_client,
+            "dataset": args.dataset,
+            "regions": args.regions.split(",") if args.regions else None,
+            "tiny_quorums": args.newt_tiny_quorums,
+            "seed": args.seed,
         }
-        print(
-            json.dumps(
-                {
-                    "protocol": args.protocol,
-                    "n": args.processes,
-                    "f": args.faults,
-                    "clients_per_region": clients,
-                    "latency": stats,
-                }
-            ),
-            flush=True,
-        )
+        for clients in [int(c) for c in args.clients.split(",")]
+    ]
+
+    if args.parallel > 1 and len(points) > 1:
+        import concurrent.futures
+        import multiprocessing
+        import os
+
+        # a JAX_PLATFORMS env var hangs worker interpreter start under the
+        # sitecustomize TPU hook (hostenv.py postmortem) — and main() may
+        # have just set it in-process via force_platform_from_env; workers
+        # force CPU in-Python instead (_run_point)
+        os.environ.pop("JAX_PLATFORMS", None)
+        # spawn: workers must not inherit an initialized jax backend
+        ctx = multiprocessing.get_context("spawn")
+        with concurrent.futures.ProcessPoolExecutor(
+            max_workers=min(args.parallel, len(points)), mp_context=ctx
+        ) as pool:
+            for line in pool.map(_run_point, points):
+                print(line, flush=True)
+    else:
+        for point in points:
+            print(_run_point(point), flush=True)
 
 
 if __name__ == "__main__":

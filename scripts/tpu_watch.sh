@@ -1,0 +1,15 @@
+#!/bin/bash
+# Probe the axon TPU tunnel periodically; on recovery, immediately run the
+# full benchmark child and record the output. Dev tool for the tunnel
+# outage of 2026-07-30 — safe to re-run; exits after one successful bench.
+cd "$(dirname "$0")/.."
+for i in $(seq 1 100); do
+  if env -u JAX_PLATFORMS timeout 90 python -u -c "import jax; print(jax.devices()[0].platform)" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel up — running bench" >> tpu_watch.log
+    env -u JAX_PLATFORMS FANTOCH_BENCH_CHILD=tpu timeout 2400 python -u bench.py >> tpu_watch.log 2>&1
+    echo "$(date -u +%H:%M:%S) bench rc=$?" >> tpu_watch.log
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) tunnel still down (probe $i)" >> tpu_watch.log
+  sleep 600
+done

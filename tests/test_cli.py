@@ -137,6 +137,21 @@ def test_cli_simulation_sweep():
             assert stats["mean_ms"] >= 0
 
 
+def test_cli_simulation_sweep_parallel_matches_sequential():
+    # --parallel fans points over spawn workers (the rayon analog);
+    # deterministic sims must yield identical output either way
+    args = [
+        "--protocol", "epaxos", "-n", "3", "-f", "1",
+        "--clients", "1,2", "--commands-per-client", "5", "--seed", "3",
+    ]
+    seq = run_tool("fantoch_tpu.bin.simulation", args, timeout=240)
+    par = run_tool(
+        "fantoch_tpu.bin.simulation", args + ["--parallel", "2"], timeout=240
+    )
+    keep = lambda s: [l for l in s.strip().splitlines() if l.startswith("{")]
+    assert keep(seq) == keep(par)
+
+
 def test_cli_shard_distribution():
     out = run_tool(
         "fantoch_tpu.bin.shard_distribution",
