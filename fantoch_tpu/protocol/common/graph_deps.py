@@ -40,9 +40,10 @@ class KeyDeps:
 
     The reference has Sequential (plain map) and Locked (per-key RwLock)
     variants for worker parallelism; here one implementation serves both
-    (see fantoch_tpu/protocol/info.py for the rationale).  The batched device
-    counterpart — segment-max over pre-hashed keys — lives in
-    fantoch_tpu/ops/clocks.py.
+    (see fantoch_tpu/protocol/info.py for the rationale).  The batched
+    device counterpart — the intra-batch latest-per-key chain — lives in
+    fantoch_tpu/parallel/mesh_step.py (_intra_batch_chain) and
+    fantoch_tpu/ops/table_ops.py (scatter-max key clocks).
     """
 
     def __init__(self, shard_id: ShardId):
