@@ -28,6 +28,13 @@ class AggregatePending:
     attached elsewhere, and foreign partials must be ignored, not held.
     """
 
+    # bound on buffered early *partials* (total across rifls): a rifl
+    # whose Register/Submit never arrives (client died after ClientHi, or
+    # a stream of misrouted results for one dead rifl) must not leak for
+    # the life of the session.  Oldest-rifl eviction; the cap is
+    # per-session so a small bound suffices.
+    EARLY_CAP = 1024
+
     def __init__(
         self, process_id: ProcessId, shard_id: ShardId, buffer_early: bool = False
     ):
@@ -36,6 +43,7 @@ class AggregatePending:
         self._pending: Dict[Rifl, CommandResult] = {}
         self._buffer_early = buffer_early
         self._early: Dict[Rifl, List[ExecutorResult]] = {}
+        self._early_count = 0
 
     def wait_for(self, cmd: Command) -> bool:
         """Track a command submitted by a connected client."""
@@ -57,7 +65,9 @@ class AggregatePending:
     def drain_early(self, rifl: Rifl) -> Optional[CommandResult]:
         """Apply partials that raced ahead of ``wait_for(rifl)``; returns
         the CommandResult if they already complete it."""
-        for partial in self._early.pop(rifl, []):
+        partials = self._early.pop(rifl, [])
+        self._early_count -= len(partials)
+        for partial in partials:
             done = self.add_executor_result(partial)
             if done is not None:
                 return done
@@ -73,6 +83,12 @@ class AggregatePending:
                 self._early.setdefault(executor_result.rifl, []).append(
                     executor_result
                 )
+                self._early_count += 1
+                while self._early_count > self.EARLY_CAP:
+                    # dicts iterate in insertion order: drop the oldest rifl
+                    self._early_count -= len(
+                        self._early.pop(next(iter(self._early)))
+                    )
             return None
         if cmd_result.add_partial(executor_result.key, executor_result.op_results):
             return self._pending.pop(executor_result.rifl)

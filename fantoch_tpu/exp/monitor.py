@@ -88,12 +88,18 @@ class ResourceMonitor:
     def _run(self) -> None:
         try:
             self._run_inner()
-        except OSError:
-            # no procfs (non-Linux host): leave at most a header CSV
-            # rather than killing the thread with a traceback
+        except Exception:  # noqa: BLE001 — sampling is best-effort by design
+            # no procfs (non-Linux host) or an unexpected /proc line format:
+            # stop sampling quietly rather than killing the daemon thread
+            # with a traceback mid-run.  Samples flushed so far stay on
+            # disk; only write the header when nothing was ever written
+            # (so resource_table always finds a parsable CSV).
+            import os
+
             try:
-                with open(self._path, "w") as fh:
-                    fh.write(_CSV_HEADER + "\n")
+                if not os.path.exists(self._path) or os.path.getsize(self._path) == 0:
+                    with open(self._path, "w") as fh:
+                        fh.write(_CSV_HEADER + "\n")
             except OSError:
                 pass
 

@@ -401,7 +401,7 @@ class ModelChecker:
 
     # --- quiescence stabilization ---
 
-    def _stabilize(self, st: _State, max_rounds: int = 32) -> _State:
+    def _stabilize(self, st: _State, max_rounds: int = 32) -> Tuple[_State, bool]:
         """Deterministic timer closure from a quiescent state: fire every
         process's periodic events + executed notification (sorted order),
         drain the resulting messages FIFO, repeat until nothing changes.
@@ -410,9 +410,15 @@ class ModelChecker:
         (sim/runner.rs:203), where periodic GC/detached/executed events
         run the system to its steady state.  Timer-order interleavings are
         NOT branched over (a deliberate reduction; delivery interleavings
-        of the actual workload are fully explored before quiescence)."""
+        of the actual workload are fully explored before quiescence).
+
+        Returns ``(state, converged)``: ``converged`` is False when
+        ``max_rounds`` elapsed without reaching a fingerprint fixpoint —
+        terminal invariants checked on such a state may be spurious, so
+        callers must mark any violation found there as truncated."""
         succ = self._copy_state(st)
         prev_fp = self._fingerprint(succ)
+        converged = False
         for _ in range(max_rounds):
             for pid in sorted(succ.protocols):
                 self._apply_to(succ, ("events", pid))
@@ -420,9 +426,10 @@ class ModelChecker:
                 self._apply_to(succ, ("deliver", 0))
             fp = self._fingerprint(succ)
             if fp == prev_fp:
+                converged = True
                 break
             prev_fp = fp
-        return succ
+        return succ, converged
 
     # --- exploration ---
 
@@ -465,13 +472,25 @@ class ModelChecker:
                 # quiescence: stabilize deterministically (timers + FIFO
                 # drains to a fixpoint), then check the terminal invariants
                 terminals += 1
-                stable = self._stabilize(st)
+                stable, converged = self._stabilize(st)
+                if not converged:
+                    # invariants checked on a truncated stabilization are
+                    # unreliable in both directions: a violation may be
+                    # spurious AND a real one may not have materialized yet
+                    # — so the exploration cannot claim completeness
+                    complete = False
                 bad = self._check_agreement(stable) if self._check_agreement_flag else None
                 if bad is None:
                     bad = self._check_terminal(stable)
                 if bad is not None:
+                    detail = bad[1]
+                    if not converged:
+                        detail += (
+                            " [stabilization truncated at max_rounds without"
+                            " a fixpoint; this violation may be spurious]"
+                        )
                     violations.append(
-                        Violation(bad[0], bad[1], trace + ["<stabilize>"])
+                        Violation(bad[0], detail, trace + ["<stabilize>"])
                     )
                 continue
 

@@ -68,7 +68,21 @@ def load() -> Optional[ctypes.CDLL]:
                 np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
             ]
             _lib = lib
-        except Exception:  # noqa: BLE001 — toolchain/binary unavailable
+        except Exception as exc:  # noqa: BLE001 — toolchain/binary unavailable
+            # one-time diagnostic before latching the permanent fallback to
+            # the slow Python oracle: a broken toolchain should be loud
+            import warnings
+
+            detail = repr(exc)
+            stderr = getattr(exc, "stderr", None)
+            if stderr:
+                detail += f"; stderr: {str(stderr).strip()[-400:]}"
+            warnings.warn(
+                f"native resolver unavailable, falling back to the Python "
+                f"oracle: {detail}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
             _load_failed = True
     return _lib
 

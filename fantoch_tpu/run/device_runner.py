@@ -1,0 +1,401 @@
+"""The TPU serving path: a TCP client plane feeding the device-resident
+multi-replica protocol step.
+
+The reference's runner *is* its serving story —
+fantoch/src/run/mod.rs:105-445 boots protocol + executor tasks behind TCP
+and the clients' commands flow through the state machine one message at a
+time.  The TPU-native serving story inverts the altitude: the whole
+protocol round (dependency collection, fast-path check, Synod accept,
+SCC resolution, GC watermark) is ONE device program over a
+(replica x batch) mesh (fantoch_tpu/parallel/mesh_step.py), state stays
+device-resident across rounds (donated), and the host only
+
+  * feeds command batches in (array columns assembled from client
+    submissions), and
+  * drains execution orders out (applying them to the host KVStore and
+    routing results back to client sessions through AggregatePending —
+    the same client plane as the object runner).
+
+``DeviceDriver`` is the host-side control loop (usable without any
+networking: the driver dry-run and the simulator-style tests call it
+directly); ``DeviceRuntime`` wraps it in the TCP client plane speaking the
+exact wire protocol of fantoch_tpu/run/prelude.py, so ``bin/client.py``
+and ``run_clients`` work unchanged against a device-step server.
+
+Scope: single-shard (full replication).  The mesh models all n replicas —
+on real TPU pods the replica axis spans mesh slices wired by ICI, which is
+exactly the deployment the reference reaches with one TCP mesh per
+geo-replica pair.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.ids import ClientId, Dot, ProcessId, Rifl, ShardId
+from fantoch_tpu.core.kvs import KVStore
+from fantoch_tpu.executor.aggregate import AggregatePending
+from fantoch_tpu.executor.base import ExecutorResult
+from fantoch_tpu.run.prelude import (
+    ClientHi,
+    ClientHiAck,
+    Register,
+    Submit,
+    ToClient,
+)
+from fantoch_tpu.run.rw import Rw
+from fantoch_tpu.utils import key_hash, logger
+
+Address = Tuple[str, int]
+
+
+class DeviceDriver:
+    """Host control loop around the donated-state device protocol step.
+
+    One ``step()`` call = one full commit+execute round for every replica
+    at once.  The driver owns:
+
+      * the device-resident ``ReplicaState`` (donated each step — the
+        arrays never round-trip to the host),
+      * the gid -> Command registry for commands in flight (committed rows
+        execute in device order; quorum-degraded rows carry in the device
+        pending buffer and stay registered),
+      * the host KVStore + execution of ordered commands (the state
+        machine is control-plane: string keys, tiny values — it stays on
+        the host by design, fantoch/src/kvs.rs).
+
+    Key hashing: string keys map to ``key_buckets`` conflict buckets.
+    Bucket collisions create *false* dependencies — extra ordering, never
+    missed ordering — so correctness is preserved and only parallelism is
+    lost (same argument as the reference's worker-partitioned KeyDeps,
+    which also orders by hash partition).
+    """
+
+    def __init__(
+        self,
+        num_replicas: int,
+        *,
+        batch_size: int = 256,
+        key_buckets: int = 4096,
+        key_width: int = 1,
+        pending_capacity: int = 256,
+        live_replicas: Optional[int] = None,
+        shard_id: ShardId = 0,
+        monitor_execution_order: bool = False,
+        mesh=None,
+    ):
+        from fantoch_tpu.parallel import mesh_step
+
+        self.shard_id = shard_id
+        self.batch_size = batch_size
+        self.key_buckets = key_buckets
+        self.key_width = key_width
+        self._mesh = mesh if mesh is not None else mesh_step.make_mesh()
+        self._state = mesh_step.init_state(
+            self._mesh,
+            num_replicas,
+            key_buckets=key_buckets,
+            pending_capacity=pending_capacity,
+            key_width=key_width,
+        )
+        self._step = mesh_step.jit_protocol_step(
+            self._mesh, live_replicas=live_replicas
+        )
+        self._next_gid = 0  # host mirror of state.next_gid
+        # commands in flight: registered at step entry, dropped at execution
+        self._cmds: Dict[int, Tuple[Dot, Command]] = {}
+        self.store = KVStore(monitor_execution_order)
+        # rounds / fast-path / slow-path tallies (BaseProcess metrics twin)
+        self.rounds = 0
+        self.fast_paths = 0
+        self.slow_paths = 0
+        self.executed = 0
+        self.stable_watermark = 0
+
+    # --- introspection ---
+
+    @property
+    def in_flight(self) -> int:
+        """Commands registered but not yet executed (device pending)."""
+        return len(self._cmds)
+
+    # --- the serving round ---
+
+    def _bucket_row(self, cmd: Command) -> List[int]:
+        """Distinct key buckets for one command (device key-row contract:
+        a row must not repeat a bucket — colliding keys dedup, which only
+        coarsens conflicts)."""
+        buckets = sorted({
+            key_hash(k) % self.key_buckets for k in cmd.keys(self.shard_id)
+        })
+        assert len(buckets) >= 1, "command with no keys on this shard"
+        assert len(buckets) <= self.key_width, (
+            f"command touches {len(buckets)} key buckets but the device "
+            f"state was initialized with key_width={self.key_width}"
+        )
+        return buckets
+
+    def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
+        """One device round over up to ``batch_size`` new commands (the
+        rest of the fixed batch is padding; excess raises).  Returns the
+        per-key results of every command *executed* this round — which
+        includes commands carried from previous degraded rounds."""
+        import jax.numpy as jnp
+
+        assert len(batch) <= self.batch_size, (
+            f"batch {len(batch)} exceeds the compiled batch size "
+            f"{self.batch_size}; chunk at the caller"
+        )
+        from fantoch_tpu.parallel.mesh_step import KEY_PAD
+
+        b = self.batch_size
+        key = np.full((b, self.key_width), KEY_PAD, dtype=np.int32)
+        src = np.zeros(b, dtype=np.int32)
+        seq = np.zeros(b, dtype=np.int32)
+        # gid space is int32 and the key clock holds raw gids; exhausting
+        # it needs an epoch reset (rebase clock + frontier), not wraparound
+        assert self._next_gid + b < 2**31 - 1, "gid space exhausted"
+        for i, (dot, cmd) in enumerate(batch):
+            row = self._bucket_row(cmd)
+            key[i, : len(row)] = row
+            src[i] = dot.source
+            seq[i] = dot.sequence & 0x7FFFFFFF
+            self._cmds[self._next_gid + i] = (dot, cmd)
+
+        self._state, out = self._step(
+            self._state, jnp.asarray(key), jnp.asarray(src), jnp.asarray(seq)
+        )
+        self._next_gid += b
+        self.rounds += 1
+
+        order = np.asarray(out.order)
+        resolved = np.asarray(out.resolved)
+        gids = np.asarray(out.gids)
+        fast = np.asarray(out.fast_path)
+        self.stable_watermark = int(out.stable)
+
+        results: List[ExecutorResult] = []
+        for w in order.tolist():
+            gid = int(gids[w])
+            if gid < 0 or not resolved[w]:
+                continue
+            entry = self._cmds.pop(gid, None)
+            if entry is None:
+                continue  # padding row (registered by no one)
+            _dot, cmd = entry
+            results.extend(cmd.execute(self.shard_id, self.store))
+            self.executed += 1
+            if fast[w]:
+                self.fast_paths += 1
+        # valid new rows that missed the fast path took the Synod round
+        self.slow_paths += int(out.slow_paths)
+
+        # device pending overflow: rows beyond the pending capacity were
+        # dropped by the device (loudly — out.pend_dropped).  Re-register
+        # them for the next round under fresh gids: they never executed
+        # and never entered any key clock, so resubmission is safe.
+        if int(out.pend_dropped) > 0:
+            carried = [
+                int(gids[w])
+                for w in range(len(gids))
+                if gids[w] >= 0 and not resolved[w]
+            ]  # working order == device carry order
+            pend_cap = self._state.pend_gid.shape[0]
+            dropped = carried[pend_cap:]
+            logger.warning(
+                "device pending buffer overflowed: re-queueing %d commands",
+                len(dropped),
+            )
+            self._requeue = getattr(self, "_requeue", [])
+            for gid in dropped:
+                entry = self._cmds.pop(gid, None)
+                if entry is not None:
+                    self._requeue.append(entry)
+        return results
+
+    def take_requeue(self) -> List[Tuple[Dot, Command]]:
+        """Commands dropped by a device pending-buffer overflow, to be fed
+        into the next batch by the caller."""
+        out = getattr(self, "_requeue", [])
+        self._requeue = []
+        return out
+
+
+class _DeviceClientSession:
+    """Server side of one client connection against the device driver
+    (the client.rs:79-260 role, minus dot routing — the driver orders)."""
+
+    def __init__(self, runtime: "DeviceRuntime", rw: Rw):
+        self.runtime = runtime
+        self.rw = rw
+        self.pending = AggregatePending(
+            runtime.process_id, runtime.driver.shard_id
+        )
+        self.client_ids: List[ClientId] = []
+        self._flush_needed = asyncio.Event()
+
+    def deliver(self, result: ExecutorResult) -> None:
+        done = self.pending.add_executor_result(result)
+        if done is not None:
+            self.rw.write(ToClient(done))
+            self._flush_needed.set()
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await self._flush_needed.wait()
+            self._flush_needed.clear()
+            await self.rw.flush()
+
+    async def run(self) -> None:
+        hi = await self.rw.recv()
+        assert isinstance(hi, ClientHi)
+        self.client_ids = hi.client_ids
+        for client_id in self.client_ids:
+            self.runtime.client_sessions[client_id] = self
+        await self.rw.send(ClientHiAck())
+        flusher = self.runtime.spawn(self._flush_loop())
+        while True:
+            msg = await self.rw.recv()
+            if msg is None:
+                break
+            assert not isinstance(msg, Register), (
+                "device-step serving is single-shard; Register (multi-shard "
+                "partial registration) has no meaning here"
+            )
+            assert isinstance(msg, Submit)
+            cmd = msg.cmd
+            self.pending.wait_for(cmd)
+            dot = self.runtime.dot_gen.next_id()
+            self.runtime.submit(dot, cmd)
+        flusher.cancel()
+        for client_id in self.client_ids:
+            self.runtime.client_sessions.pop(client_id, None)
+
+
+class DeviceRuntime:
+    """TCP serving front of the device protocol step.
+
+    Same wire protocol as ``ProcessRuntime``'s client plane (ClientHi /
+    ClientHiAck / Submit / ToClient), so ``run_clients`` and
+    ``bin/client.py`` drive it unchanged.  One driver task loops:
+    drain submissions -> one device step -> route results to sessions.
+    The device dispatch runs in a thread-pool executor so the event loop
+    keeps serving connections during the (blocking) device round-trip.
+    """
+
+    def __init__(
+        self,
+        config: Config,
+        client_addr: Address,
+        *,
+        process_id: ProcessId = 1,
+        batch_size: int = 256,
+        key_buckets: int = 4096,
+        key_width: int = 1,
+        pending_capacity: int = 256,
+        live_replicas: Optional[int] = None,
+        monitor_execution_order: bool = False,
+        mesh=None,
+    ):
+        assert config.shard_count == 1, "device-step serving is single-shard"
+        from fantoch_tpu.core.ids import AtomicIdGen
+
+        self.config = config
+        self.process_id = process_id
+        self.client_addr = client_addr
+        self.driver = DeviceDriver(
+            config.n,
+            batch_size=batch_size,
+            key_buckets=key_buckets,
+            key_width=key_width,
+            pending_capacity=pending_capacity,
+            live_replicas=live_replicas,
+            monitor_execution_order=monitor_execution_order,
+            mesh=mesh,
+        )
+        self.dot_gen = AtomicIdGen(process_id)
+        self.client_sessions: Dict[ClientId, _DeviceClientSession] = {}
+        self._submit_queue: Deque[Tuple[Dot, Command]] = __import__(
+            "collections"
+        ).deque()
+        self._work = asyncio.Event()
+        self._tasks: set = set()
+        self._servers: List[Any] = []
+        self.failure: Optional[BaseException] = None
+        self.failed = asyncio.Event()
+
+    # --- lifecycle (mirrors ProcessRuntime's loud-failure contract) ---
+
+    def spawn(self, coro) -> asyncio.Task:
+        task = asyncio.ensure_future(coro)
+        task.add_done_callback(self._on_task_done)
+        self._tasks.add(task)
+        return task
+
+    def _on_task_done(self, task: asyncio.Task) -> None:
+        self._tasks.discard(task)
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            logger.error("device runner task crashed: %r", exc)
+            if self.failure is None:
+                self.failure = exc
+                self.failed.set()
+            self._teardown()
+
+    def _teardown(self) -> None:
+        for task in list(self._tasks):
+            task.cancel()
+        for server in self._servers:
+            server.close()
+
+    async def start(self) -> None:
+        server = await asyncio.start_server(self._on_client, *self.client_addr)
+        self._servers = [server]
+        self.spawn(self._driver_task())
+
+    async def stop(self) -> None:
+        tasks = list(self._tasks)
+        self._teardown()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    # --- client plane ---
+
+    async def _on_client(self, reader, writer) -> None:
+        session = _DeviceClientSession(self, Rw(reader, writer))
+        self.spawn(session.run())
+
+    def submit(self, dot: Dot, cmd: Command) -> None:
+        self._submit_queue.append((dot, cmd))
+        self._work.set()
+
+    def _deliver(self, results: List[ExecutorResult]) -> None:
+        for result in results:
+            session = self.client_sessions.get(result.rifl.source)
+            if session is not None:
+                session.deliver(result)
+
+    # --- the serving loop ---
+
+    async def _driver_task(self) -> None:
+        loop = asyncio.get_running_loop()
+        driver = self.driver
+        while True:
+            if not self._submit_queue and driver.in_flight == 0:
+                self._work.clear()
+                await self._work.wait()
+            batch = []
+            for dot_cmd in driver.take_requeue():
+                batch.append(dot_cmd)
+            while self._submit_queue and len(batch) < driver.batch_size:
+                batch.append(self._submit_queue.popleft())
+            # blocking device dispatch off the event loop: connections and
+            # result flushes stay live during the round
+            results = await loop.run_in_executor(None, driver.step, batch)
+            self._deliver(results)
