@@ -32,15 +32,33 @@ def build_parser() -> argparse.ArgumentParser:
         prog="fantoch_tpu.bin.server", description=__doc__
     )
     parser.add_argument("--protocol", required=True,
-                        help="basic|epaxos|atlas|newt|caesar|fpaxos")
-    parser.add_argument("--id", type=int, required=True, help="process id")
+                        help="basic|epaxos|atlas|newt|caesar|fpaxos; with "
+                        "--device-step the protocol round runs as one device "
+                        "program (EPaxos-style dep-commit) and this flag only "
+                        "labels the deployment")
+    parser.add_argument("--id", type=int, default=None,
+                        help="process id (required without --device-step)")
     parser.add_argument("--shard-id", type=int, default=0)
     parser.add_argument("--ip", default="127.0.0.1")
-    parser.add_argument("--port", type=int, required=True, help="peer port")
+    parser.add_argument("--port", type=int, default=None, help="peer port")
     parser.add_argument("--client-port", type=int, required=True)
     parser.add_argument(
+        "--device-step",
+        action="store_true",
+        help="serve through the device-resident protocol step "
+        "(run/device_runner.py): the whole commit+execute round is one "
+        "jit program over a (replica x batch) mesh; no TCP peer mesh",
+    )
+    parser.add_argument("--device-batch", type=int, default=256,
+                        help="compiled device batch size (--device-step)")
+    parser.add_argument("--device-key-buckets", type=int, default=4096)
+    parser.add_argument("--device-key-width", type=int, default=1,
+                        help="max conflict-key buckets per command")
+    parser.add_argument("--device-pending", type=int, default=256,
+                        help="device pending-buffer capacity")
+    parser.add_argument(
         "--addresses",
-        required=True,
+        default=None,
         help="comma list of pid=host:port[:delay_ms] for every peer this "
         "process connects to (own-shard peers + closest process of each "
         "other shard); delay_ms adds an artificial FIFO delay line "
@@ -67,9 +85,42 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+async def serve_device_step(args: argparse.Namespace) -> None:
+    """The TPU serving path: one server, the protocol round on-device."""
+    from fantoch_tpu.run.device_runner import DeviceRuntime
+
+    protocol_by_name(args.protocol)  # validate the label even when unused
+    config = config_from_args(args)
+    runtime = DeviceRuntime(
+        config,
+        (args.ip, args.client_port),
+        process_id=args.id if args.id is not None else 1,
+        batch_size=args.device_batch,
+        key_buckets=args.device_key_buckets,
+        key_width=args.device_key_width,
+        pending_capacity=args.device_pending,
+        monitor_execution_order=config.executor_monitor_execution_order,
+    )
+    await runtime.start()
+    print(
+        f"p{args.id} (device-step, n={config.n}) serving clients on "
+        f"{args.ip}:{args.client_port}",
+        flush=True,
+    )
+    await runtime.failed.wait()
+    raise SystemExit(f"p{args.id} failed: {runtime.failure!r}")
+
+
 async def serve(args: argparse.Namespace) -> None:
     from fantoch_tpu.run.process_runner import ProcessRuntime
 
+    if args.device_step:
+        await serve_device_step(args)
+        return
+    if args.id is None or args.port is None or args.addresses is None:
+        raise SystemExit(
+            "--id, --port and --addresses are required without --device-step"
+        )
     protocol_cls = protocol_by_name(args.protocol)
     config = config_from_args(args)
 

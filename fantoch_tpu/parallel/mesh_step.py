@@ -127,11 +127,15 @@ def quorum_sizes(num_replicas: int) -> Tuple[int, int]:
     return Config(num_replicas, 0).epaxos_quorum_sizes()
 
 
-def make_mesh(n_devices: int | None = None) -> Mesh:
+def make_mesh(
+    n_devices: int | None = None, num_replicas: int | None = None
+) -> Mesh:
     """Factor the device list into a (replica, batch) mesh.
 
     Replica axis gets the smaller factor (real deployments have 3..11
-    replicas; batches are wide).
+    replicas; batches are wide).  When ``num_replicas`` is given, the
+    replica axis must divide it (each device slice holds a whole number of
+    replica blocks — init_state's sharding contract).
     """
     devices = jax.devices()
     if n_devices is not None:
@@ -139,7 +143,11 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     n = len(devices)
     replica = 1
     for cand in range(min(n, 8), 0, -1):
-        if n % cand == 0 and cand <= n // cand:
+        if (
+            n % cand == 0
+            and cand <= n // cand
+            and (num_replicas is None or num_replicas % cand == 0)
+        ):
             replica = cand
             break
     import numpy as np

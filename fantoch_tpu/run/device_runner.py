@@ -31,6 +31,7 @@ geo-replica pair.
 from __future__ import annotations
 
 import asyncio
+from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -95,7 +96,11 @@ class DeviceDriver:
         self.batch_size = batch_size
         self.key_buckets = key_buckets
         self.key_width = key_width
-        self._mesh = mesh if mesh is not None else mesh_step.make_mesh()
+        self._mesh = (
+            mesh
+            if mesh is not None
+            else mesh_step.make_mesh(num_replicas=num_replicas)
+        )
         self._state = mesh_step.init_state(
             self._mesh,
             num_replicas,
@@ -320,9 +325,7 @@ class DeviceRuntime:
         )
         self.dot_gen = AtomicIdGen(process_id)
         self.client_sessions: Dict[ClientId, _DeviceClientSession] = {}
-        self._submit_queue: Deque[Tuple[Dot, Command]] = __import__(
-            "collections"
-        ).deque()
+        self._submit_queue: Deque[Tuple[Dot, Command]] = deque()
         self._work = asyncio.Event()
         self._tasks: set = set()
         self._servers: List[Any] = []

@@ -154,3 +154,55 @@ async def run_localhost_cluster(
     for group in results:
         clients.update(group)
     return runtimes, clients
+
+
+async def run_device_server(
+    config: Config,
+    workload: Workload,
+    client_count: int,
+    *,
+    batch_size: int = 64,
+    key_buckets: int = 1024,
+    key_width: int = 1,
+    pending_capacity: int = 64,
+    open_loop_interval_ms: Optional[int] = None,
+    monitor_execution_order: bool = True,
+):
+    """Boot the TPU serving path (run/device_runner.py) on a localhost
+    port and drive real TCP clients against it; returns
+    ``(DeviceRuntime, clients)``.  A runtime failure tears the run down
+    loudly instead of stalling the clients."""
+    from fantoch_tpu.run.device_runner import DeviceRuntime
+
+    port = free_port()
+    runtime = DeviceRuntime(
+        config,
+        ("127.0.0.1", port),
+        batch_size=batch_size,
+        key_buckets=key_buckets,
+        key_width=key_width,
+        pending_capacity=pending_capacity,
+        monitor_execution_order=monitor_execution_order,
+    )
+    await runtime.start()
+    client_task = asyncio.ensure_future(
+        run_clients(
+            list(range(1, client_count + 1)),
+            {0: ("127.0.0.1", port)},
+            workload,
+            open_loop_interval_ms=open_loop_interval_ms,
+        )
+    )
+    failure_task = asyncio.ensure_future(runtime.failed.wait())
+    try:
+        done, _pending = await asyncio.wait(
+            {client_task, failure_task}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if failure_task in done:
+            client_task.cancel()
+            raise AssertionError(f"device runtime failed: {runtime.failure!r}")
+        clients = client_task.result()
+    finally:
+        failure_task.cancel()
+        await runtime.stop()
+    return runtime, clients

@@ -119,6 +119,49 @@ def test_cli_cluster_end_to_end(tmp_path):
     assert replayed["results"] == 20  # 20 commands x 1 key
 
 
+def test_cli_device_step_server(tmp_path):
+    """The TPU serving path from the shell: one --device-step server, the
+    stock client binary against it (same wire protocol)."""
+    port = free_port()
+    server = subprocess.Popen(
+        [
+            sys.executable, "-m", "fantoch_tpu.bin.server",
+            "--protocol", "epaxos",
+            "--device-step",
+            "--client-port", str(port),
+            "--device-batch", "32",
+            "-n", "3", "-f", "1",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=cli_env(),
+        cwd=REPO,
+    )
+    try:
+        out = run_tool(
+            "fantoch_tpu.bin.client",
+            [
+                "--ids", "1-2",
+                "--addresses", f"0=127.0.0.1:{port}",
+                "--commands-per-client", "10",
+                "--conflict-rate", "50",
+                "--payload-size", "8",
+            ],
+            timeout=180,
+        )
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["clients"] == 2
+        assert summary["commands"] == 20
+        assert summary["latency_ms"]["p50"] is not None
+    finally:
+        server.send_signal(signal.SIGINT)
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
 def test_cli_simulation_sweep():
     out = run_tool(
         "fantoch_tpu.bin.simulation",
