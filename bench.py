@@ -201,11 +201,13 @@ def child_main(mode: str) -> None:
     print(json.dumps(record), flush=True)
     # secondary measurements must never cost us the primary one
     try:
-        exec_ms, exec_cmds_per_s = bench_integrated_executor()
+        exec_ms, exec_cmds_per_s, order_ms = bench_integrated_executor()
         record.update(
             executor_batch=EXECUTOR_BATCH,
             executor_ms=round(exec_ms, 1),
             executor_cmds_per_s=int(exec_cmds_per_s),
+            executor_order_ms=round(order_ms, 1),
+            executor_order_cmds_per_s=int(EXECUTOR_BATCH / (order_ms / 1000.0)),
         )
     except Exception as exc:  # noqa: BLE001 — report, don't die
         print(f"# integrated-executor bench failed: {exc!r}", file=sys.stderr)
@@ -221,6 +223,11 @@ def child_main(mode: str) -> None:
     except Exception as exc:  # noqa: BLE001
         print(f"# native-resolver bench failed: {exc!r}", file=sys.stderr)
         record["native_error"] = repr(exc)[:200]
+    try:
+        record.update(bench_table_path())
+    except Exception as exc:  # noqa: BLE001
+        print(f"# table-path bench failed: {exc!r}", file=sys.stderr)
+        record["table_error"] = repr(exc)[:200]
 
     print(json.dumps(record), flush=True)
 
@@ -230,7 +237,9 @@ def bench_integrated_executor():
     Protocol/Executor boundary *as arrays* (the commit-buffer seam,
     BatchedDependencyGraph.handle_add_arrays) including batch assembly,
     the device resolve and the execute-queue drain.
-    Returns (wall ms, commands/s)."""
+    Returns (wall ms with the Command-object drain, commands/s, wall ms
+    with the array drain — order as (src, seq) columns, no 250k-object
+    materialization)."""
     import numpy as np
 
     from fantoch_tpu.core import Command, Config, Dot, KVOp, Rifl, RunTime
@@ -256,20 +265,27 @@ def bench_integrated_executor():
 
     clock = RunTime()
 
-    def run_once():
+    def run_once(array_drain=False):
         graph = BatchedDependencyGraph(
             1, shard, Config(5, 2, batched_graph_executor=True)
         )
+        graph.record_order_arrays = array_drain
         t0 = time.perf_counter()
         graph.handle_add_arrays(dot_src, dot_seq, key_np, dep_dots, cmds, clock)
-        executed = len(graph.commands_to_execute())
+        if array_drain:
+            graph.resolve_now(clock)
+            order_src, _order_seq = graph.take_order_arrays()
+            executed = len(order_src)
+        else:
+            executed = len(graph.commands_to_execute())
         wall_ms = (time.perf_counter() - t0) * 1000.0
         assert executed == EXECUTOR_BATCH, f"executed {executed}/{EXECUTOR_BATCH}"
         return wall_ms
 
     run_once()  # warm the XLA compile cache for this batch shape
     wall_ms = min(run_once() for _ in range(3))
-    return wall_ms, EXECUTOR_BATCH / (wall_ms / 1000.0)
+    order_ms = min(run_once(array_drain=True) for _ in range(3))
+    return wall_ms, EXECUTOR_BATCH / (wall_ms / 1000.0), order_ms
 
 
 def bench_general_path(batch: int = 1 << 18, width: int = 4):
@@ -383,6 +399,90 @@ def bench_native_resolver(key_np, dep_np, src_np, seq_np):
         order, _sizes = native.resolve_sccs(offsets, targets, packed)
         best = min(best, (time.perf_counter() - t0) * 1000.0)
     return {"native_ms": round(best, 3)}
+
+
+def bench_table_path(batch: int = 100_000, keys: int = 4096, n: int = 3):
+    """The Newt/Tempo table path (VERDICT r3 item 2): ``batch`` single-key
+    commands through the kernel-batched clock proposal
+    (BatchedKeyClocks.proposal_batch -> ops/table_ops.batched_clock_proposal)
+    and one vectorized executor stability pass
+    (TableExecutor.handle_batch -> ops/table_ops.stable_clocks), against
+    the sequential host twins (SequentialKeyClocks.proposal +
+    per-info VotesTable stability — the reference's per-command path,
+    sequential.rs:36-47 / mod.rs:247-270)."""
+    import numpy as np
+
+    from fantoch_tpu.core import Command, Config, Dot, KVOp, Rifl, RunTime
+    from fantoch_tpu.core.ids import process_ids
+    from fantoch_tpu.executor.table import TableExecutor, TableVotes
+    from fantoch_tpu.protocol.common.table_batched import BatchedKeyClocks
+    from fantoch_tpu.protocol.common.table_clocks import (
+        SequentialKeyClocks,
+        VoteRange,
+    )
+
+    shard = 0
+    rng = np.random.default_rng(11)
+    key_ids = rng.integers(0, keys, size=batch)
+    cmds = [
+        Command.from_single(Rifl(1, i + 1), shard, f"t{key_ids[i]}", KVOp.put(""))
+        for i in range(batch)
+    ]
+    mins = [0] * batch
+
+    def time_proposals(clocks):
+        fn = getattr(clocks, "proposal_batch", None)
+        t0 = time.perf_counter()
+        if fn is not None:
+            results = fn(cmds, mins)
+        else:
+            results = [clocks.proposal(c, 0) for c in cmds]
+        ms = (time.perf_counter() - t0) * 1000.0
+        return ms, results
+
+    time_proposals(BatchedKeyClocks(1, shard))  # warm the kernel compile
+    batched_ms, proposals = time_proposals(BatchedKeyClocks(1, shard))
+    seq_ms, seq_props = time_proposals(SequentialKeyClocks(1, shard))
+    assert [c for c, _ in proposals] == [c for c, _ in seq_props]
+
+    # executor side: every process votes the coordinator's range, so the
+    # whole batch is stable — one vectorized pass drains it
+    pids = list(process_ids(shard, n))
+    infos = []
+    for i, (clock, votes) in enumerate(proposals):
+        key = f"t{key_ids[i]}"
+        (rng0,) = votes.get(key)
+        all_votes = [VoteRange(p, rng0.start, rng0.end) for p in pids]
+        infos.append(
+            TableVotes(Dot(1, i + 1), clock, cmds[i].rifl, key,
+                       (KVOp.put(""),), all_votes)
+        )
+    clock_t = RunTime()
+
+    def time_executor(batched):
+        config = Config(n, 1, newt_detached_send_interval_ms=5,
+                        batched_table_executor=batched)
+        ex = TableExecutor(1, shard, config)
+        t0 = time.perf_counter()
+        ex.handle_batch(infos, clock_t)
+        ms = (time.perf_counter() - t0) * 1000.0
+        executed = sum(1 for _ in ex.to_clients_iter())
+        assert executed == batch, f"stable-drained {executed}/{batch}"
+        return ms
+
+    time_executor(True)  # warm
+    exec_batched_ms = min(time_executor(True) for _ in range(3))
+    exec_seq_ms = min(time_executor(False) for _ in range(3))
+    return {
+        "table_batch": batch,
+        "table_proposal_ms": round(batched_ms, 1),
+        "table_proposal_seq_ms": round(seq_ms, 1),
+        "table_executor_ms": round(exec_batched_ms, 1),
+        "table_executor_seq_ms": round(exec_seq_ms, 1),
+        "table_cmds_per_s": int(
+            batch / ((batched_ms + exec_batched_ms) / 1000.0)
+        ),
+    }
 
 
 def _run_child(mode: str, timeout_s: int):

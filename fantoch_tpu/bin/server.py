@@ -91,10 +91,11 @@ async def serve_device_step(args: argparse.Namespace) -> None:
 
     protocol_by_name(args.protocol)  # validate the label even when unused
     config = config_from_args(args)
+    process_id = args.id if args.id is not None else 1
     runtime = DeviceRuntime(
         config,
         (args.ip, args.client_port),
-        process_id=args.id if args.id is not None else 1,
+        process_id=process_id,
         batch_size=args.device_batch,
         key_buckets=args.device_key_buckets,
         key_width=args.device_key_width,
@@ -103,12 +104,12 @@ async def serve_device_step(args: argparse.Namespace) -> None:
     )
     await runtime.start()
     print(
-        f"p{args.id} (device-step, n={config.n}) serving clients on "
+        f"p{process_id} (device-step, n={config.n}) serving clients on "
         f"{args.ip}:{args.client_port}",
         flush=True,
     )
     await runtime.failed.wait()
-    raise SystemExit(f"p{args.id} failed: {runtime.failure!r}")
+    raise SystemExit(f"p{process_id} failed: {runtime.failure!r}")
 
 
 async def serve(args: argparse.Namespace) -> None:

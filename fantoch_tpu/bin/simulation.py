@@ -46,6 +46,10 @@ def _run_point(params: dict) -> str:
         f=params["f"],
         gc_interval_ms=100,
         newt_tiny_quorums=params["tiny_quorums"],
+        # Newt liveness requires flushing detached votes (the reference's
+        # newt_config! macro always sets it, fantoch_ps/src/protocol/
+        # mod.rs:65); harmless for the other protocols
+        newt_detached_send_interval_ms=100,
     )
     workload = Workload(
         shard_count=1,

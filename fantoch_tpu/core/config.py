@@ -45,6 +45,17 @@ class Config:
     # walk — the TPU-native replacement for tarjan.rs:99-319 (new knob; no
     # reference counterpart)
     batched_graph_executor: bool = False
+    # batch the Newt/Tempo table path: array-backed key clocks with
+    # kernel-batched proposals (protocol/common/table_batched.py) and one
+    # vectorized stability pass per executor batch
+    # (fantoch_tpu/ops/table_ops.py at the executor/table.py seam)
+    batched_table_executor: bool = False
+    # resolver choice for the batched graph executor on *CPU* backends:
+    # None = auto (the native C++ SCC resolver, fantoch_tpu/native, when
+    # its toolchain is available — a single-threaded host loop beats CPU
+    # XLA sorts; accelerator backends always use the device kernels),
+    # True/False force it on/off (tests pin the XLA path with False)
+    host_native_resolver: Optional[bool] = None
     # garbage-collection interval; None disables GC
     gc_interval_ms: Optional[int] = None
     # leader process (leader-based protocols, i.e. FPaxos)

@@ -17,7 +17,7 @@ runner control plane.
 
 from __future__ import annotations
 
-from bisect import insort
+from bisect import bisect_left
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
@@ -90,12 +90,23 @@ class VotesTable:
         ops: Tuple[KVOp, ...],
         votes: List[VoteRange],
     ) -> None:
+        self.add_op(dot, clock, rifl, ops)
+        self.add_votes(votes)
+
+    def add_op(
+        self, dot: Dot, clock: int, rifl: Rifl, ops: Tuple[KVOp, ...]
+    ) -> None:
         sort_id = (clock, dot)
-        assert all(entry[0] != sort_id for entry in self._ops), (
+        entry = (sort_id, rifl, ops)
+        pos = bisect_left(self._ops, entry)
+        # duplicate (clock, dot) check in O(log): only a sort_id-equal
+        # neighbor could collide
+        assert not (
+            pos < len(self._ops) and self._ops[pos][0] == sort_id
+        ) and not (pos > 0 and self._ops[pos - 1][0] == sort_id), (
             "two commands cannot occupy the same (clock, dot) slot"
         )
-        insort(self._ops, (sort_id, rifl, ops))
-        self.add_votes(votes)
+        self._ops.insert(pos, entry)
 
     def add_votes(self, votes: List[VoteRange]) -> None:
         for vote in votes:
@@ -106,9 +117,13 @@ class VotesTable:
         ``(stable_clock + 1, first dot)`` — i.e. with clock <= stable_clock
         (mod.rs:200-244; the reference's split_off keeps ops at the bound
         buffered)."""
-        from bisect import bisect_left
+        return self.stable_ops_at(self.stable_clock())
 
-        stable_clock = self.stable_clock()
+    def stable_ops_at(
+        self, stable_clock: int
+    ) -> List[Tuple[Rifl, Tuple[KVOp, ...]]]:
+        """stable_ops with a precomputed stable clock (the batched path
+        computes all keys' clocks in one kernel and pops per key)."""
         next_stable: SortId = (stable_clock + 1, Dot(1, 1))
         cut = bisect_left(self._ops, (next_stable,))
         stable = [(rifl, ops) for _, rifl, ops in self._ops[:cut]]
@@ -120,6 +135,11 @@ class VotesTable:
         (mod.rs:247-270)."""
         frontiers = sorted(es.frontier for es in self._votes.values())
         return frontiers[self.n - self.stability_threshold]
+
+    def frontier_row(self) -> List[int]:
+        """Per-process vote frontiers in fixed process order (one row of
+        the batched ``int32[K, n]`` frontier matrix)."""
+        return [es.frontier for es in self._votes.values()]
 
 
 class MultiVotesTable:
@@ -167,7 +187,18 @@ class MultiVotesTable:
 
 
 class TableExecutor(Executor):
-    """Executes ops as their timestamps become stable (executor.rs:14-120)."""
+    """Executes ops as their timestamps become stable (executor.rs:14-120).
+
+    With ``Config.batched_table_executor`` the per-info stability check is
+    replaced by one vectorized pass per batch: votes and ops buffer first,
+    then every touched key's stable clock comes out of a single
+    ``(n - threshold)``-th order statistic over the frontier matrix — the
+    :func:`fantoch_tpu.ops.table_ops.stable_clocks` kernel at device
+    scale, a numpy partition below it (identical semantics; kernel
+    dispatch only pays off across many keys)."""
+
+    # touched-key count at which the device kernel beats host numpy
+    _KERNEL_THRESHOLD = 64
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
         _, _, stability_threshold = config.newt_quorum_sizes()
@@ -175,6 +206,83 @@ class TableExecutor(Executor):
         self._table = MultiVotesTable(process_id, shard_id, config.n, stability_threshold)
         self._store = KVStore(config.executor_monitor_execution_order)
         self._to_clients: Deque[ExecutorResult] = deque()
+        self._batched = config.batched_table_executor
+        self._n = config.n
+        self._stability_threshold = stability_threshold
+
+    def handle_batch(self, infos, time) -> None:
+        if not self._batched or self._execute_at_commit:
+            for info in infos:
+                self.handle(info, time)
+            return
+        # pass 1 (host): buffer ops and *accumulate* votes — per-(key,
+        # process) ranges coalesce before touching the RangeEventSets, so
+        # a batch of contiguous proposals costs one add_range, not one per
+        # command per voter
+        touched: Dict[Key, VotesTable] = {}
+        acc: Dict[Tuple[Key, ProcessId], List[Tuple[int, int]]] = {}
+        for info in infos:
+            if isinstance(info, TableVotes):
+                table = self._table._table(info.key)
+                table.add_op(info.dot, info.clock, info.rifl, info.ops)
+                touched[info.key] = table
+                for vote in info.votes:
+                    acc.setdefault((info.key, vote.by), []).append(
+                        (vote.start, vote.end)
+                    )
+            elif isinstance(info, TableDetachedVotes):
+                touched[info.key] = self._table._table(info.key)
+                for vote in info.votes:
+                    acc.setdefault((info.key, vote.by), []).append(
+                        (vote.start, vote.end)
+                    )
+            else:
+                raise AssertionError(f"unknown table execution info {info}")
+        for (key, by), ranges in acc.items():
+            events = touched[key]._votes[by]
+            ranges.sort()
+            start, end = ranges[0]
+            for nxt_start, nxt_end in ranges[1:]:
+                if nxt_start <= end + 1:
+                    end = max(end, nxt_end)
+                else:
+                    events.add_range(start, end)
+                    start, end = nxt_start, nxt_end
+            events.add_range(start, end)
+        if not touched:
+            return
+        # pass 2 (vectorized): one stability computation over all touched
+        # keys (mod.rs:247-270 across the whole batch)
+        import numpy as np
+
+        frontiers = np.array(
+            [t.frontier_row() for t in touched.values()], dtype=np.int64
+        )
+        stable = self._stable_clocks(frontiers)
+        for (key, table), clock in zip(touched.items(), stable.tolist()):
+            ready = table.stable_ops_at(int(clock))
+            if ready:
+                self._execute(key, ready)
+
+    def _stable_clocks(self, frontiers) -> "np.ndarray":
+        import numpy as np
+
+        k, n = frontiers.shape
+        col = n - self._stability_threshold
+        if k >= self._KERNEL_THRESHOLD:
+            base = int(frontiers.min())
+            rebased = frontiers - base  # order statistic is shift-invariant
+            if int(rebased.max()) < (1 << 31):
+                import jax.numpy as jnp
+
+                from fantoch_tpu.ops.table_ops import stable_clocks
+
+                out = stable_clocks(
+                    jnp.asarray(rebased.astype(np.int32)),
+                    threshold=self._stability_threshold,
+                )
+                return np.asarray(out).astype(np.int64) + base
+        return np.partition(frontiers, col, axis=1)[:, col]
 
     def handle(self, info, time) -> None:
         if isinstance(info, TableVotes):

@@ -35,7 +35,9 @@ def pack_dots(src: np.ndarray, seq: np.ndarray) -> np.ndarray:
 class DeviceFrontier:
     """Vectorized executed-dot set over a fixed universe of process ids."""
 
-    __slots__ = ("_max_id", "_watermark", "_exceptions", "_dirty", "_clean")
+    __slots__ = (
+        "_max_id", "_watermark", "_exceptions", "_dirty", "_chunks", "_clean"
+    )
 
     def __init__(self, process_ids: Iterable[int]):
         ids = list(process_ids)
@@ -44,7 +46,8 @@ class DeviceFrontier:
         # dense by process id (ids are small: shard*n+1..): O(max_id) memory
         self._watermark = np.zeros(self._max_id + 1, dtype=np.int64)
         self._exceptions = np.empty(0, dtype=np.int64)  # sorted packed dots
-        self._dirty: List[int] = []  # unsorted packed adds since last compact
+        self._dirty: List[int] = []  # unsorted packed scalar adds
+        self._chunks: List[np.ndarray] = []  # whole-batch adds, uncompacted
         self._clean = True  # one compact pass is a fixpoint until new adds
 
     def _ensure(self, source: int) -> None:
@@ -70,6 +73,8 @@ class DeviceFrontier:
 
     def contains(self, source: int, sequence: int) -> bool:
         self._ensure(source)
+        if self._chunks:
+            self._compact()
         if sequence <= self._watermark[source]:
             return True
         packed = (int(source) << _SEQ_BITS) | int(sequence)
@@ -98,12 +103,13 @@ class DeviceFrontier:
         return below | (self._exceptions[i] == packed)
 
     def add_batch(self, src: np.ndarray, seq: np.ndarray) -> None:
+        """Whole-batch add: stored as an uncompacted chunk; compaction is
+        lazy (first read), so back-to-back batch adds pay one merge."""
         if len(src) == 0:
             return
         self._ensure(int(np.max(src)))
-        self._dirty.extend(pack_dots(src, seq).tolist())
+        self._chunks.append(pack_dots(src, seq))
         self._clean = False
-        self._compact()
 
     def _compact(self) -> None:
         """Merge dirty adds into the sorted exception array, then advance
@@ -111,10 +117,13 @@ class DeviceFrontier:
         if self._clean:
             return
         self._clean = True
-        if self._dirty:
-            fresh = np.array(self._dirty, dtype=np.int64)
+        if self._dirty or self._chunks:
+            fresh = self._chunks
+            if self._dirty:
+                fresh = fresh + [np.array(self._dirty, dtype=np.int64)]
             self._dirty = []
-            merged = np.concatenate([self._exceptions, fresh])
+            self._chunks = []
+            merged = np.concatenate([self._exceptions, *fresh])
             self._exceptions = np.unique(merged)  # sort + dedupe
         if len(self._exceptions) == 0:
             return

@@ -1,0 +1,185 @@
+"""Array-backed key clocks for the Newt/Tempo proposal path.
+
+The host twin (``SequentialKeyClocks``, table_clocks.py) bumps one dict
+entry per key per command — the per-command Python the reference pays per
+``SequentialKeyClocks::proposal`` call
+(fantoch_ps/src/protocol/common/table/clocks/keys/sequential.rs:36-47).
+``BatchedKeyClocks`` holds the clock table as a dense int64 array over a
+key registry and adds ``proposal_batch``: one
+:func:`fantoch_tpu.ops.table_ops.batched_clock_proposal` kernel call
+assigns clocks + consumed vote ranges to a whole batch of single-key
+commands (commands on the same key receive consecutive clocks in batch
+order, exactly the sequential semantics).  Scalar ``proposal`` /
+``detached`` / ``detached_all`` keep the full SequentialKeyClocks
+interface, so this is a drop-in replacement selected by
+``Config.batched_table_executor``.
+
+Clock width: the kernel works in int32; ``proposal_batch`` rebases int64
+host clocks when they fit a 31-bit window above zero and falls back to the
+sequential loop otherwise (real-time microsecond clocks — the window
+machinery of ops/table_ops.ClockWindow belongs to the device-resident
+serving path, not this host seam).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.ids import ProcessId, ShardId
+from fantoch_tpu.core.kvs import Key
+from fantoch_tpu.protocol.common.table_clocks import VoteRange, Votes
+
+_INT32_MAX = (1 << 31) - 1
+
+
+class BatchedKeyClocks:
+    """SequentialKeyClocks semantics over a dense clock array."""
+
+    __slots__ = ("process_id", "shard_id", "_key_index", "_keys", "_clocks", "_count")
+
+    def __init__(self, process_id: ProcessId, shard_id: ShardId):
+        self.process_id = process_id
+        self.shard_id = shard_id
+        self._key_index: Dict[Key, int] = {}
+        self._keys: List[Key] = []
+        self._clocks = np.zeros(64, dtype=np.int64)
+        self._count = 0
+
+    # --- registry ---
+
+    def _index(self, key: Key) -> int:
+        idx = self._key_index.get(key)
+        if idx is None:
+            idx = self._count
+            self._key_index[key] = idx
+            self._keys.append(key)
+            self._count += 1
+            if idx >= len(self._clocks):
+                grown = np.zeros(len(self._clocks) * 2, dtype=np.int64)
+                grown[: len(self._clocks)] = self._clocks
+                self._clocks = grown
+        return idx
+
+    def init_clocks(self, cmd: Command) -> None:
+        for key in cmd.keys(self.shard_id):
+            self._index(key)
+
+    # --- scalar SequentialKeyClocks interface ---
+
+    def proposal(self, cmd: Command, min_clock: int) -> Tuple[int, Votes]:
+        clock = max(min_clock, self._cmd_clock(cmd) + 1)
+        votes = Votes()
+        self.detached(cmd, clock, votes)
+        return clock, votes
+
+    def detached(self, cmd: Command, up_to: int, votes: Votes) -> None:
+        for key in cmd.keys(self.shard_id):
+            self._maybe_bump(key, up_to, votes)
+
+    def detached_all(self, up_to: int, votes: Votes) -> None:
+        # vectorized sweep over every registered key (the clock-bump event
+        # touches the whole table, newt.rs:983-1006)
+        count = self._count
+        current = self._clocks[:count]
+        behind = np.nonzero(current < up_to)[0]
+        for idx in behind.tolist():
+            votes.add(
+                self._keys[idx],
+                VoteRange(self.process_id, int(current[idx]) + 1, up_to),
+            )
+        current[behind] = up_to
+
+    @classmethod
+    def parallel(cls) -> bool:
+        return False
+
+    def _cmd_clock(self, cmd: Command) -> int:
+        return max(
+            (int(self._clocks[self._index(key)]) for key in cmd.keys(self.shard_id)),
+            default=0,
+        )
+
+    def _maybe_bump(self, key: Key, up_to: int, votes: Votes) -> None:
+        idx = self._index(key)
+        current = int(self._clocks[idx])
+        if current < up_to:
+            votes.add(key, VoteRange(self.process_id, current + 1, up_to))
+            self._clocks[idx] = up_to
+
+    # --- the batched proposal seam ---
+
+    def proposal_batch(
+        self, cmds: List[Command], min_clocks: List[int]
+    ) -> List[Tuple[int, Votes]]:
+        """Clocks + votes for a whole batch, preserving batch order within
+        each key (== running ``proposal`` sequentially).  Single-key
+        commands with window-sized clocks go through the device kernel;
+        anything else falls back to the sequential loop."""
+        assert len(cmds) == len(min_clocks)
+        batch = len(cmds)
+        if batch == 0:
+            return []
+        keys: List[Key] = []
+        single = True
+        for cmd in cmds:
+            if cmd.key_count(self.shard_id) != 1:
+                single = False
+                break
+            keys.append(next(iter(cmd.keys(self.shard_id))))
+        if single:
+            out = self._proposal_batch_kernel(keys, min_clocks)
+            if out is not None:
+                return out
+        return [self.proposal(cmd, mc) for cmd, mc in zip(cmds, min_clocks)]
+
+    def _proposal_batch_kernel(
+        self, keys: List[Key], min_clocks: List[int]
+    ) -> Optional[List[Tuple[int, Votes]]]:
+        import jax.numpy as jnp
+
+        from fantoch_tpu.ops.table_ops import batched_clock_proposal
+
+        batch = len(keys)
+        key_idx = np.fromiter(
+            (self._index(k) for k in keys), np.int32, batch
+        )
+        mins = np.asarray(min_clocks, dtype=np.int64)
+        # pad the key table to pow2 so XLA compiles O(log) programs as the
+        # registry grows; pad the batch with private pad-bucket rows
+        kcap = _pow2(max(self._count, 1) + 1)
+        bcap = _pow2(batch)
+        prior = np.zeros(kcap, dtype=np.int64)
+        prior[: self._count] = self._clocks[: self._count]
+        hi = max(int(prior.max()), int(mins.max()) if batch else 0)
+        if hi + bcap + 1 > _INT32_MAX:
+            return None  # real-time micros clocks: sequential fallback
+        pk = np.full(bcap, kcap - 1, dtype=np.int32)  # pad bucket
+        pm = np.zeros(bcap, dtype=np.int32)
+        pk[:batch] = key_idx
+        pm[:batch] = mins.astype(np.int32)
+        clock, vote_start, new_prior = batched_clock_proposal(
+            jnp.asarray(prior.astype(np.int32)), jnp.asarray(pk), jnp.asarray(pm)
+        )
+        clock = np.asarray(clock)[:batch].astype(np.int64)
+        vote_start = np.asarray(vote_start)[:batch].astype(np.int64)
+        new_prior = np.asarray(new_prior).astype(np.int64)
+        self._clocks[: self._count] = new_prior[: self._count]
+        out: List[Tuple[int, Votes]] = []
+        for i in range(batch):
+            votes = Votes()
+            votes.set(
+                keys[i],
+                [VoteRange(self.process_id, int(vote_start[i]), int(clock[i]))],
+            )
+            out.append((int(clock[i]), votes))
+        return out
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p

@@ -204,7 +204,12 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
         self.bp = BaseProcess(
             process_id, shard_id, config, fast_quorum_size, write_quorum_size
         )
-        self.key_clocks = KeyClocks(process_id, shard_id)
+        if config.batched_table_executor:
+            from fantoch_tpu.protocol.common.table_batched import BatchedKeyClocks
+
+            self.key_clocks = BatchedKeyClocks(process_id, shard_id)
+        else:
+            self.key_clocks = KeyClocks(process_id, shard_id)
         self._cmds: CommandsInfo[NewtInfo] = CommandsInfo(
             process_id,
             shard_id,
@@ -266,6 +271,26 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
 
     def submit(self, dot: Optional[Dot], cmd: Command, time: SysTime) -> None:
         self._handle_submit(dot, cmd, target_shard=True)
+
+    def submit_batch(self, pairs, time: SysTime) -> None:
+        """Batched submit seam: one kernel-batched clock proposal covers
+        every command (BatchedKeyClocks.proposal_batch), then the per-dot
+        MCollect fan-out proceeds as usual.  Falls back to per-command
+        submits when the clocks are not array-backed."""
+        proposal_batch = getattr(self.key_clocks, "proposal_batch", None)
+        if proposal_batch is None:
+            for dot, cmd in pairs:
+                self.submit(dot, cmd, time)
+            return
+        dots = [
+            dot if dot is not None else self.bp.next_dot() for dot, _ in pairs
+        ]
+        cmds = [cmd for _, cmd in pairs]
+        for dot, cmd in zip(dots, cmds):
+            self.partial_submit_actions(dot, cmd, target_shard=True)
+        results = proposal_batch(cmds, [0] * len(cmds))
+        for dot, cmd, (clock, process_votes) in zip(dots, cmds, results):
+            self._emit_mcollect(dot, cmd, clock, process_votes)
 
     def handle(self, from_, from_shard_id, msg, time):
         if isinstance(msg, MCollect):
@@ -330,6 +355,11 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
         # shipped in the MCollect (skip_fast_ack: quorum members can commit
         # without the ack round) or kept for the MCollectAck aggregation
         clock, process_votes = self.key_clocks.proposal(cmd, 0)
+        self._emit_mcollect(dot, cmd, clock, process_votes)
+
+    def _emit_mcollect(
+        self, dot: Dot, cmd: Command, clock: int, process_votes: Votes
+    ) -> None:
         if self._skip_fast_ack:
             coordinator_votes = process_votes
         else:

@@ -472,6 +472,9 @@ class ProcessRuntime:
     async def _worker_task(self, position: int) -> None:
         queue = self.workers.queue(position)
         process = self.process
+        # protocols with a batched submit seam (Newt's kernel-batched clock
+        # proposals) take runs of queued submits in one call
+        submit_batch = getattr(process, "submit_batch", None)
         while True:
             item = await queue.get()
             kind = item[0]
@@ -480,7 +483,15 @@ class ProcessRuntime:
                 process.handle(from_, from_shard, msg, self.time)
             elif kind == "submit":
                 _, dot, cmd = item
-                process.submit(dot, cmd, self.time)
+                if submit_batch is not None:
+                    # drain the run of consecutive submits queued behind us
+                    pairs = [(dot, cmd)]
+                    while queue.qsize() and queue._queue[0][0] == "submit":  # noqa: SLF001
+                        _, d2, c2 = queue.get_nowait()
+                        pairs.append((d2, c2))
+                    submit_batch(pairs, self.time)
+                else:
+                    process.submit(dot, cmd, self.time)
             elif kind == "event":
                 process.handle_event(item[1], self.time)
             elif kind == "executed":

@@ -24,7 +24,28 @@ from fantoch_tpu.protocol.common.graph_deps import Dependency
 TIME = RunTime()
 SHARD = 0
 
-GRAPHS = [DependencyGraph, BatchedDependencyGraph]
+def BatchedNative(pid, shard, config):
+    """Batched graph pinned to the native C++ host resolver (forcing it
+    without the toolchain raises, so skip there instead of silently
+    re-testing the XLA path)."""
+    from fantoch_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    return BatchedDependencyGraph(
+        pid, shard, config.with_(host_native_resolver=True)
+    )
+
+
+def BatchedXLA(pid, shard, config):
+    """Batched graph pinned to the XLA device kernels (the TPU path; on
+    CPU backends the auto default would pick native, dropping coverage)."""
+    return BatchedDependencyGraph(
+        pid, shard, config.with_(host_native_resolver=False)
+    )
+
+
+GRAPHS = [DependencyGraph, BatchedNative, BatchedXLA]
 
 
 def dep(dot):
@@ -62,9 +83,11 @@ def shuffle_it(n, args):
     for perm in itertools.permutations(args):
         perm = list(perm)
         assert check_termination(n, perm) == expected
-        # the batched device resolver must agree with the host oracle on
-        # the per-key order, on every delivery permutation
-        assert check_termination(n, perm, BatchedDependencyGraph) == expected
+        # the batched resolver (both cores: XLA kernels and the native
+        # host Tarjan) must agree with the host oracle on the per-key
+        # order, on every delivery permutation
+        assert check_termination(n, perm, BatchedXLA) == expected
+        assert check_termination(n, perm, BatchedNative) == expected
 
 
 @pytest.mark.parametrize("graph_cls", GRAPHS)
@@ -157,3 +180,79 @@ def test_add_random():
     for _ in range(10):
         args = random_adds(n, 3, rng)
         shuffle_it(n, args)
+
+
+def _big_backward_batch(batch, conflict_every=2):
+    """A > _STRUCTURE_THRESHOLD batch of latest-per-key backward chains
+    (the arrival-order fast-path shape) as handle_add_arrays columns."""
+    import numpy as np
+
+    from fantoch_tpu.ops.frontier import pack_dots
+
+    src = np.ones(batch, dtype=np.int64)
+    seq = np.arange(1, batch + 1, dtype=np.int64)
+    key = np.arange(batch, dtype=np.int32) % conflict_every
+    last = {}
+    dep = np.full((batch, 1), -1, dtype=np.int64)
+    for i in range(batch):
+        prev = last.get(int(key[i]))
+        if prev is not None:
+            dep[i, 0] = (1 << 32) | (prev + 1)
+        last[int(key[i])] = i
+    cmds = [
+        make_cmd(Dot(1, i + 1), [f"key{key[i]}"]) for i in range(batch)
+    ]
+    return src, seq, key, dep, cmds
+
+
+def test_arrival_order_fast_path_and_array_drain():
+    """Large backward-dep batches take the host arrival-order fast path:
+    emission equals arrival order, and the array drain yields the same
+    order as the Command drain without materializing objects."""
+    import numpy as np
+
+    batch = 5000  # > _STRUCTURE_THRESHOLD
+    src, seq, key, dep, cmds = _big_backward_batch(batch)
+
+    graph = BatchedDependencyGraph(1, SHARD, Config(3, 1))
+    graph.handle_add_arrays(src, seq, key, dep, cmds, TIME)
+    executed = graph.commands_to_execute()
+    assert [c.rifl for c in executed] == [c.rifl for c in cmds]
+
+    # array drain: same order as columns, no object materialization
+    graph2 = BatchedDependencyGraph(1, SHARD, Config(3, 1))
+    graph2.record_order_arrays = True
+    graph2.handle_add_arrays(src, seq, key, dep, cmds, TIME)
+    graph2.resolve_now(TIME)
+    o_src, o_seq = graph2.take_order_arrays()
+    assert (o_src == src).all() and (o_seq == seq).all()
+    assert not graph2.commands_to_execute()  # no object mirror kept
+
+
+def test_fast_path_skipped_when_missing_blocked():
+    """A missing dependency disables the arrival-order shortcut: the
+    blocked suffix of its chain stays pending until the dep arrives."""
+    import numpy as np
+
+    batch = 5000
+    src, seq, key, dep, cmds = _big_backward_batch(batch)
+    # row 0 (head of chain key0) depends on a dot nobody committed yet
+    missing_dot = (2 << 32) | 1
+    dep[0, 0] = missing_dot
+
+    graph = BatchedDependencyGraph(1, SHARD, Config(3, 1))
+    graph.handle_add_arrays(src, seq, key, dep, cmds, TIME)
+    executed = graph.commands_to_execute()
+    # chain on key0 is fully blocked behind the missing dep; key1 executes
+    key0_count = int((key == 0).sum())
+    assert len(executed) == batch - key0_count
+    assert all(c.rifl.sequence % 2 == 0 for c in executed)  # key1 rows only
+
+    # the missing dot arrives: everything drains in chain order
+    graph.handle_add(
+        Dot(2, 1), make_cmd(Dot(2, 1), ["key0"]), [], TIME
+    )
+    late = graph.commands_to_execute()
+    assert len(late) == key0_count + 1
+    key0_rifls = [c.rifl for c in late if c.rifl != Rifl(2, 1)]
+    assert key0_rifls == [c.rifl for c in cmds if c.rifl.sequence % 2 == 1]

@@ -1,0 +1,178 @@
+"""Batched Newt/Tempo table path: kernel-batched clock proposals
+(protocol/common/table_batched.py) and vectorized executor stability
+(executor/table.py handle_batch), oracle-checked against the sequential
+host twins and exercised end-to-end through the simulator and the real
+TCP runner with ``Config.batched_table_executor``.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from fantoch_tpu.core import Command, Config, KVOp, Rifl
+from fantoch_tpu.protocol import Newt
+from fantoch_tpu.protocol.common.table_batched import BatchedKeyClocks
+from fantoch_tpu.protocol.common.table_clocks import SequentialKeyClocks, Votes
+
+from harness import sim_test
+
+SHARD = 0
+
+
+def put_cmd(i, keys):
+    return Command.from_keys(
+        Rifl(1, i + 1), SHARD, {k: (KVOp.put(""),) for k in keys}
+    )
+
+
+def votes_of(votes: Votes):
+    return {k: [(v.by, v.start, v.end) for v in rs] for k, rs in votes}
+
+
+def test_batched_key_clocks_scalar_equivalence():
+    """Scalar proposal/detached/detached_all match SequentialKeyClocks on
+    a random interleaving (including multi-key commands)."""
+    rng = random.Random(0)
+    seq = SequentialKeyClocks(1, SHARD)
+    bat = BatchedKeyClocks(1, SHARD)
+    for i in range(300):
+        kind = rng.randrange(3)
+        keys = rng.sample(["a", "b", "c", "d", "e"], rng.randrange(1, 3))
+        cmd = put_cmd(i, keys)
+        if kind == 0:
+            min_clock = rng.randrange(0, 20)
+            cs, vs = seq.proposal(cmd, min_clock)
+            cb, vb = bat.proposal(cmd, min_clock)
+            assert (cs, votes_of(vs)) == (cb, votes_of(vb))
+        elif kind == 1:
+            up_to = rng.randrange(0, 25)
+            vs, vb = Votes(), Votes()
+            seq.detached(cmd, up_to, vs)
+            bat.detached(cmd, up_to, vb)
+            assert votes_of(vs) == votes_of(vb)
+        else:
+            up_to = rng.randrange(0, 25)
+            vs, vb = Votes(), Votes()
+            seq.detached_all(up_to, vs)
+            bat.detached_all(up_to, vb)
+            assert votes_of(vs) == votes_of(vb)
+
+
+def test_batched_proposal_kernel_equivalence():
+    """proposal_batch (the batched_clock_proposal kernel) assigns the
+    same clocks and consumed vote ranges as running the sequential twin
+    command by command — including same-key runs inside one batch."""
+    rng = random.Random(1)
+    seq = SequentialKeyClocks(1, SHARD)
+    bat = BatchedKeyClocks(1, SHARD)
+    next_id = 0
+    for _round in range(5):
+        batch, mins, cmds = [], [], []
+        for _ in range(rng.randrange(1, 40)):
+            key = f"k{rng.randrange(6)}"
+            cmd = put_cmd(next_id, [key])
+            next_id += 1
+            cmds.append(cmd)
+            mins.append(rng.randrange(0, 30))
+        expected = [seq.proposal(c, m) for c, m in zip(cmds, mins)]
+        got = bat.proposal_batch(cmds, mins)
+        for (ce, ve), (cg, vg) in zip(expected, got):
+            assert ce == cg
+            assert votes_of(ve) == votes_of(vg)
+        # interleave a detached round so later batches start from bumped
+        # clocks on both sides
+        bump = put_cmd(next_id, ["k0", "k3"])
+        next_id += 1
+        vs, vb = Votes(), Votes()
+        seq.detached(bump, 40 * (_round + 1), vs)
+        bat.detached(bump, 40 * (_round + 1), vb)
+        assert votes_of(vs) == votes_of(vb)
+
+
+def test_batched_proposal_multikey_fallback():
+    """Multi-key commands in a batch route through the sequential loop
+    with identical results."""
+    seq = SequentialKeyClocks(1, SHARD)
+    bat = BatchedKeyClocks(1, SHARD)
+    cmds = [put_cmd(0, ["x"]), put_cmd(1, ["x", "y"]), put_cmd(2, ["y"])]
+    mins = [0, 0, 5]
+    expected = [seq.proposal(c, m) for c, m in zip(cmds, mins)]
+    got = bat.proposal_batch(cmds, mins)
+    for (ce, ve), (cg, vg) in zip(expected, got):
+        assert ce == cg and votes_of(ve) == votes_of(vg)
+
+
+def test_stable_clocks_kernel_vs_partition():
+    """The device stable_clocks kernel and the numpy partition agree over
+    a wide random frontier matrix (both sides of the executor's
+    _KERNEL_THRESHOLD switch)."""
+    from fantoch_tpu.executor.table import TableExecutor
+
+    config = Config(5, 1, newt_detached_send_interval_ms=5,
+                    batched_table_executor=True)
+    ex = TableExecutor(1, SHARD, config)
+    rng = np.random.default_rng(2)
+    frontiers = rng.integers(0, 1 << 40, size=(128, 5))  # > threshold
+    col = 5 - ex._stability_threshold
+    expected = np.sort(frontiers, axis=1)[:, col]
+    assert (ex._stable_clocks(frontiers) == expected).all()
+    small = frontiers[:8]
+    assert (ex._stable_clocks(small) == expected[:8]).all()
+
+
+@pytest.mark.parametrize("n,f", [(3, 1), (5, 2)])
+def test_sim_newt_batched_table(n, f):
+    """Newt sims with the batched table path: same oracle (monitor
+    agreement inside sim_test) as the sequential configuration, and the
+    slow-path profile matches the sequential run."""
+    def cfg(batched):
+        return Config(
+            n=n, f=f, newt_detached_send_interval_ms=100,
+            batched_table_executor=batched,
+        )
+
+    assert sim_test(Newt, cfg(True), seed=1) == sim_test(Newt, cfg(False), seed=1)
+
+
+def test_run_newt_batched_table_localhost():
+    """Real TCP cluster with batched table path: the worker groups queued
+    submits through Newt.submit_batch and the executors run the
+    vectorized stability pass; monitor agreement asserted by the harness."""
+    import asyncio
+
+    from fantoch_tpu.client import ConflictRateKeyGen, Workload
+    from fantoch_tpu.run.harness import run_localhost_cluster
+
+    config = Config(
+        3, 1,
+        newt_detached_send_interval_ms=50,
+        batched_table_executor=True,
+        executor_monitor_execution_order=True,
+        gc_interval_ms=50,
+        executor_executed_notification_interval_ms=50,
+    )
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=1,
+        commands_per_client=10,
+        payload_size=1,
+    )
+    runtimes, clients = asyncio.run(
+        run_localhost_cluster(Newt, config, workload, clients_per_process=2)
+    )
+    assert len(clients) == 6
+    for client in clients.values():
+        assert client.issued_commands == 10
+    # per-key order agreement across all processes
+    monitors = []
+    for runtime in runtimes.values():
+        for executor in runtime.executors:
+            m = executor.monitor()
+            if m is not None:
+                monitors.append(m)
+    assert monitors
+    first = monitors[0]
+    for other in monitors[1:]:
+        assert first == other
