@@ -1,0 +1,18 @@
+# Test/bench entry points (the reference pins quality with Makefile:3-7 —
+# fmt + clippy + `cargo test` under a quickcheck budget; here the suite +
+# dryrun + bench are the equivalent gates).
+.PHONY: test test-fast dryrun bench
+
+test:
+	python -m pytest tests/ -x -q
+
+# the CI-shrunk load (tests/harness.py COMMANDS_PER_CLIENT, hypothesis
+# max_examples both scale down under CI=true)
+test-fast:
+	CI=true python -m pytest tests/ -x -q
+
+dryrun:
+	python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+bench:
+	python bench.py

@@ -73,7 +73,7 @@ class KVStore:
         Reference: fantoch/src/kvs.rs:37-56 (monitored execute).
         """
         if self._monitor is not None:
-            self._monitor.add(key, rifl)
+            self._monitor.add(key, rifl, read=op.is_read)
         return self._do_execute(key, op)
 
     def _do_execute(self, key: Key, op: KVOp) -> KVOpResult:

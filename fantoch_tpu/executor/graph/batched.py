@@ -38,11 +38,15 @@ which the device order preserves.  Whole-batch order may interleave
 *independent* commands differently, which the correctness argument
 explicitly permits (fantoch/src/executor/monitor.rs agreement is per key).
 
-Partial replication: the array fast path is single-shard; with
-``shard_count > 1`` this class defers to the host ``DependencyGraph``
-machinery (cross-shard Request/RequestReply plumbing untouched), so
-multi-shard stays correct while the tensorized path covers the
-throughput-critical single-shard configuration.
+Partial replication (round 4 — VERDICT r3 item 6): the array path now
+covers ``shard_count > 1`` too.  The backlog keeps the original
+``Dependency`` objects per row (shard sets must survive for cross-shard
+requests); after a resolve, MISSING deps whose shard set excludes this
+shard produce one info request each to the dep's target shard
+(fantoch_ps/src/executor/graph/index.rs:171-205), and the secondary
+(request-serving) executor answers peer shards straight from the
+primary's array backlog — including *pending* rows, which is what breaks
+cross-shard dependency cycles (mod.rs:300-375).
 """
 
 from __future__ import annotations
@@ -151,7 +155,17 @@ class BatchedDependencyGraph(DependencyGraph):
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
         super().__init__(process_id, shard_id, config)
-        self._array_mode = config.shard_count == 1
+        self._array_mode = True
+        self._multi_shard = config.shard_count > 1
+        if self._multi_shard:
+            # multi-shard bookkeeping (single-shard pays none of this):
+            # packed dot -> (cmd, deps) for request serving from the
+            # backlog; packed dep dot -> shard set; the set of remote deps
+            # already requested; the primary graph a secondary serves from
+            self._by_dot: dict = {}
+            self._dep_shards: dict = {}
+            self._requested: set = set()
+            self._primary: Optional["BatchedDependencyGraph"] = None
         if self._array_mode:
             from fantoch_tpu.core.ids import all_process_ids
 
@@ -204,6 +218,10 @@ class BatchedDependencyGraph(DependencyGraph):
         """The tensorized seam: the protocol's commit buffer lands here as
         whole arrays — no per-command Python in the executor."""
         assert self.executor_index == 0 and self._array_mode
+        assert not self._multi_shard, (
+            "array adds carry no shard sets; multi-shard commits arrive as "
+            "per-command GraphAdd (graph_protocol.py commit buffer gating)"
+        )
         tms = np.full(len(cmds), float(time.millis()), np.float64)
         self._backlog.append_arrays(
             dot_src.astype(np.int64, copy=False),
@@ -226,6 +244,18 @@ class BatchedDependencyGraph(DependencyGraph):
             for d in deps
             if d.dot != dot  # self-dependency pruned (tarjan.py:129)
         )
+        if self._multi_shard:
+            # shard sets must survive: cross-shard requests need them, and
+            # request replies forward the full Dependency list
+            self._by_dot[(int(dot.source) << 32) | int(dot.sequence)] = (
+                cmd, list(deps)
+            )
+            for d in deps:
+                if d.shards is not None:
+                    self._dep_shards.setdefault(
+                        (int(d.dot.source) << 32) | int(d.dot.sequence),
+                        d.shards,
+                    )
         self._backlog.append_one(
             int(dot.source), int(dot.sequence), khash, float(time.millis()), packed, cmd
         )
@@ -258,7 +288,89 @@ class BatchedDependencyGraph(DependencyGraph):
                 self.handle_add(info.dot, info.cmd, info.deps, time)
             else:
                 self._frontier.add(info.dot.source, info.dot.sequence)
+                self._added_to_executed_clock.add(info.dot)
+                packed = (int(info.dot.source) << 32) | int(info.dot.sequence)
+                self._dep_shards.pop(packed, None)
+                self._requested.discard(packed)
                 self._dirty = True
+
+    # --- cross-shard request serving (secondary executor; mod.rs:300-375) ---
+
+    def share_vertex_index(self, primary: "DependencyGraph") -> None:
+        super().share_vertex_index(primary)
+        if self._multi_shard:
+            self._primary = primary  # serve requests from the array backlog
+
+    def process_requests(self, from_shard: ShardId, dots, time: SysTime) -> None:
+        """Answer a peer shard's dependency-info request from the primary's
+        array backlog — including rows still *pending* there (answering
+        only executed dots deadlocks cross-shard dependency cycles)."""
+        if not self._array_mode:
+            return super().process_requests(from_shard, dots, time)
+        assert self.executor_index > 0
+        from fantoch_tpu.executor.graph.deps_graph import (
+            RequestReplyExecuted,
+            RequestReplyInfo,
+        )
+
+        source = self._primary if self._primary is not None else self
+        for dot in dots:
+            packed = (int(dot.source) << 32) | int(dot.sequence)
+            entry = source._by_dot.get(packed)
+            if entry is not None:
+                cmd, deps = entry
+                assert not cmd.replicated_by(from_shard), (
+                    f"{dot} is replicated by requesting shard {from_shard}"
+                )
+                self._out_request_replies.setdefault(from_shard, []).append(
+                    RequestReplyInfo(dot, cmd, deps)
+                )
+            elif self._frontier.contains(dot.source, dot.sequence) or (
+                source is not self
+                and source._frontier.contains(dot.source, dot.sequence)
+            ):
+                self._out_request_replies.setdefault(from_shard, []).append(
+                    RequestReplyExecuted(dot)
+                )
+            else:
+                # not known yet: buffer and retry on cleanup
+                self._buffered_in_requests.setdefault(from_shard, set()).add(dot)
+
+    def _note_emitted(self, src_rows, seq_rows) -> None:
+        """Multi-shard emit bookkeeping: drop served entries (and the
+        request/shard-set records for executed deps — the PendingIndex
+        removes on execution too, index.rs remove) and record the executed
+        dots for the GraphExecuted broadcast (to_executors)."""
+        if not self._multi_shard:
+            return
+        for p in pack_dots(src_rows, seq_rows).tolist():
+            self._by_dot.pop(p, None)
+            self._dep_shards.pop(p, None)
+            self._requested.discard(p)
+            self._added_to_executed_clock.add(Dot(p >> 32, p & 0xFFFFFFFF))
+
+    def _request_missing(self, dep_rows, deps, remaining_mask) -> None:
+        """One info request per first-sighted missing dep whose shard set
+        excludes this shard (PendingIndex.index semantics,
+        index.rs:171-205); local missing deps arrive via local commits."""
+        miss_slots = (dep_rows == MISSING) & remaining_mask[:, None]
+        if not miss_slots.any():
+            return
+        requests = 0
+        for packed in np.unique(deps[miss_slots]).tolist():
+            if packed in self._requested:
+                continue
+            self._requested.add(packed)
+            shards = self._dep_shards.get(packed)
+            if shards is None or self._shard_id in shards:
+                continue
+            dot = Dot(packed >> 32, packed & 0xFFFFFFFF)
+            self._out_requests.setdefault(
+                dot.target_shard(self._config.n), set()
+            ).add(dot)
+            requests += 1
+        if requests:
+            self._metrics.aggregate(ExecutorMetricsKind.OUT_REQUESTS, requests)
 
     # --- lazy resolution at the output drains ---
 
@@ -274,11 +386,13 @@ class BatchedDependencyGraph(DependencyGraph):
         if not self._array_mode:
             return super().monitor_pending(time)
         self._flush(time)
-        # liveness watchdog (index.rs:53-103 analog): after a resolve, every
-        # still-pending row must be (transitively) missing-blocked — the
-        # device kernel resolves everything else.  If rows are old but no
-        # missing dependency exists in the whole backlog, an execution was
-        # lost: panic loudly.
+        # liveness watchdog (index.rs:53-103): after a resolve, every
+        # still-pending row must be *transitively* missing-blocked — the
+        # resolvers emit everything else.  A per-row check (not the r3
+        # whole-backlog aggregate): an old row whose dependency closure
+        # contains no missing dep means an execution was lost (e.g. a
+        # dropped executed-notification) — panic naming the dots, exactly
+        # like the reference's per-command pending monitor.
         if not self._backlog.count:
             return
         src, seq, _key, tms, deps = self._backlog.columns()
@@ -288,10 +402,29 @@ class BatchedDependencyGraph(DependencyGraph):
         if not old.any():
             return
         dep_rows = self._map_deps(src, seq, deps)
-        if not (dep_rows == MISSING).any():
+        batch = len(src)
+        blocked = (dep_rows == MISSING).any(axis=1)
+        # forward-propagate blockedness to dependents, vectorized with an
+        # early exit the moment every old row is covered (the common case:
+        # one or two passes; the full fixpoint only runs on the panic path)
+        valid = dep_rows >= 0
+        safe = np.clip(dep_rows, 0, batch - 1)
+        while True:
+            lost = old & ~blocked
+            if not lost.any():
+                return
+            grown = blocked | np.where(valid, blocked[safe], False).any(axis=1)
+            if (grown == blocked).all():
+                break
+            blocked = grown
+        if lost.any():
+            dots = [
+                Dot(int(src[i]), int(seq[i]))
+                for i in np.nonzero(lost)[0][:8]
+            ]
             raise AssertionError(
-                f"p{self._process_id}: {int(old.sum())} commands pending "
-                "without missing dependencies"
+                f"p{self._process_id}: {int(lost.sum())} commands pending "
+                f"without missing dependencies: {dots}"
             )
 
     def _flush(self, time: Optional[SysTime] = None) -> None:
@@ -468,6 +601,7 @@ class BatchedDependencyGraph(DependencyGraph):
             else:
                 self._to_execute.extend(self._backlog.cmds)
             self._frontier.add_batch(src, seq)
+            self._note_emitted(src, seq)
             now = float(time.millis())
             self._metrics.collect_many(
                 ExecutorMetricsKind.EXECUTION_DELAY, np.maximum(now - tms, 0.0)
@@ -484,7 +618,9 @@ class BatchedDependencyGraph(DependencyGraph):
                 if len(emitted):
                     self._emit_rows(emitted, src, seq, tms, time)
                     remaining_mask[emitted] = False
-                self._shrink_backlog(remaining_mask, src, seq, key, tms, deps)
+                self._shrink_backlog(
+                    remaining_mask, src, seq, key, tms, deps, dep_rows
+                )
                 return
 
         # compress to functional form when every row has <= 1 live dep
@@ -592,9 +728,26 @@ class BatchedDependencyGraph(DependencyGraph):
             )
             remaining_mask[oracle_emitted] = False
 
-        self._shrink_backlog(remaining_mask, src, seq, key, tms, deps)
+        self._shrink_backlog(remaining_mask, src, seq, key, tms, deps, dep_rows)
 
-    def _shrink_backlog(self, remaining_mask, src, seq, key, tms, deps) -> None:
+    def _shrink_backlog(
+        self, remaining_mask, src, seq, key, tms, deps, dep_rows=None
+    ) -> None:
+        if self._multi_shard and dep_rows is not None:
+            self._request_missing(dep_rows, deps, remaining_mask)
+        if self._multi_shard and len(self._dep_shards) > 4 * max(
+            int(remaining_mask.sum()), 64
+        ):
+            # amortized GC of the dep-shard / requested records: only deps
+            # still referenced by surviving rows matter (a dep that
+            # executed before its dependent arrived would otherwise leak
+            # forever — _note_emitted only covers locally emitted dots).
+            # Dropping an in-flight request record at worst re-requests.
+            live = set(deps[remaining_mask][deps[remaining_mask] >= 0].tolist())
+            self._dep_shards = {
+                p: s for p, s in self._dep_shards.items() if p in live
+            }
+            self._requested &= live
         keep = np.nonzero(remaining_mask)[0]
         cmds = self._backlog.cmds
         self._backlog.replace(
@@ -637,6 +790,7 @@ class BatchedDependencyGraph(DependencyGraph):
             # at 250k rows (list.__getitem__ on ints, one C-level loop)
             self._to_execute.extend(map(cmds.__getitem__, rows.tolist()))
         self._frontier.add_batch(src[rows], seq[rows])
+        self._note_emitted(src[rows], seq[rows])
         now = float(time.millis())
         self._metrics.collect_many(
             ExecutorMetricsKind.EXECUTION_DELAY, np.maximum(now - tms[rows], 0.0)
@@ -747,6 +901,7 @@ class BatchedDependencyGraph(DependencyGraph):
         rows = np.array(emitted_rows, dtype=np.int64)
         if len(rows):
             self._frontier.add_batch(src[rows], seq[rows])
+            self._note_emitted(src[rows], seq[rows])
         assert len(rows) == len(stuck_rows), (
             f"stuck residue not fully resolvable: {len(rows)}/{len(stuck_rows)}"
         )

@@ -15,9 +15,17 @@ from fantoch_tpu.core.kvs import Key
 class ExecutionOrderMonitor:
     def __init__(self) -> None:
         self._order_per_key: Dict[Key, List[Rifl]] = {}
+        # (key, rifl) pairs recorded as reads: with the KeyDeps read/write
+        # split (graph_deps.py), reads commute and their relative order is
+        # legitimately unordered — agreement checks compare write orders.
+        # Keyed per (key, rifl), not rifl: a mixed command could read one
+        # key and write another, and its writes must stay in the check.
+        self._reads: set = set()
 
-    def add(self, key: Key, rifl: Rifl) -> None:
+    def add(self, key: Key, rifl: Rifl, read: bool = False) -> None:
         self._order_per_key.setdefault(key, []).append(rifl)
+        if read:
+            self._reads.add((key, rifl))
 
     def merge(self, other: "ExecutionOrderMonitor") -> None:
         """Merge a disjoint-key monitor (multiple key-parallel executors)."""
@@ -26,9 +34,17 @@ class ExecutionOrderMonitor:
                 "different monitors should operate on different keys"
             )
             self._order_per_key[key] = rifls
+        self._reads |= other._reads
 
     def get_order(self, key: Key) -> Optional[List[Rifl]]:
         return self._order_per_key.get(key)
+
+    def get_write_order(self, key: Key) -> Optional[List[Rifl]]:
+        """Per-key order restricted to writes (reads commute; see add)."""
+        order = self._order_per_key.get(key)
+        if order is None:
+            return None
+        return [r for r in order if (key, r) not in self._reads]
 
     def keys(self) -> Iterator[Key]:
         return iter(self._order_per_key.keys())

@@ -2,18 +2,23 @@
 
 Reference: fantoch_exp/src/bench.rs:43-260 (run the protocol + client
 binaries with generated flags, wait for completion, pull metrics files)
-and testbed/local.rs (the localhost testbed).  Each experiment leaves a
-results directory::
+over a testbed (testbed/local.rs for localhost, testbed/baremetal.rs for
+SSH host lists — see fantoch_tpu/exp/testbed.py).  Each experiment leaves
+a results directory::
 
     <output_dir>/<config.name()>/
         manifest.json        — the ExperimentConfig + outcome summary
         client_data.pkl      — per-client latency data (client binary)
         client_summary.json  — the client binary's stdout summary
-        metrics_p*.gz        — per-process metrics snapshots
-        execution_p*.log     — per-process execution logs
+        metrics_p*.gz        — per-process metrics snapshots (pulled)
+        execution_p*.log     — per-process execution logs (pulled)
         server_p*.log        — server stdout/stderr
+        resources.csv        — driver-machine resource samples (dstat)
 
-which fantoch_tpu.plot's ResultsDB indexes.
+which fantoch_tpu.plot's ResultsDB indexes.  One driver body serves every
+testbed: the testbed object owns addressing, launch transport, and
+artifact pull (so a real SSH cluster differs from localhost only in the
+HostsTestbed constructor).
 """
 
 from __future__ import annotations
@@ -25,9 +30,13 @@ import signal
 import subprocess
 import sys
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 from fantoch_tpu.exp.config import ExperimentConfig
+
+# server artifacts land here relative to each process's workdir, then are
+# pulled into the experiment dir
+_RESULTS_REL = "testbed_results"
 
 
 def _cli_env() -> Dict[str, str]:
@@ -42,34 +51,59 @@ def _cli_env() -> Dict[str, str]:
 def run_experiment(
     config: ExperimentConfig,
     output_dir: str,
-    testbed: str = "localhost",
+    testbed="localhost",
     client_timeout_s: int = 600,
 ) -> Dict:
-    """Run one experiment end to end; returns the manifest dict."""
-    if testbed != "localhost":
+    """Run one experiment end to end; returns the manifest dict.
+
+    ``testbed``: "localhost" (subprocesses on this machine), or a
+    :class:`fantoch_tpu.exp.testbed.HostsTestbed` (SSH host list — the
+    baremetal.rs analog: stage the tree, launch remotely, pull results).
+    A caller-provided HostsTestbed is caller-owned (reuse it across a
+    sweep); its locally staged copies are removed in a ``finally`` here
+    since stage() re-creates them on demand."""
+    from fantoch_tpu.exp.testbed import HostsTestbed, LocalTestbed
+
+    if testbed == "localhost":
+        testbed = LocalTestbed()
+    elif not isinstance(testbed, HostsTestbed):
         raise NotImplementedError(
-            f"testbed {testbed!r}: the reference's AWS/baremetal orchestration "
-            "(fantoch_exp/src/testbed/{aws,baremetal}.rs over tsunami/rusoto) "
-            "has no cloud access in this environment; use 'localhost'"
+            f"testbed {testbed!r}: the reference's AWS orchestration "
+            "(fantoch_exp/src/testbed/aws.rs over tsunami/rusoto) has no "
+            "cloud access in this environment; use 'localhost' or a "
+            "HostsTestbed (exp/testbed.py)"
         )
+    try:
+        return _run_experiment_testbed(
+            config, output_dir, testbed, client_timeout_s
+        )
+    finally:
+        if not testbed.use_ssh:
+            testbed.cleanup()
+
+
+def _run_experiment_testbed(
+    config: ExperimentConfig,
+    output_dir: str,
+    testbed,
+    client_timeout_s: int,
+) -> Dict:
     from fantoch_tpu.core.ids import process_ids
-    from fantoch_tpu.run.harness import free_port
+    from fantoch_tpu.exp.monitor import ResourceMonitor
 
     exp_dir = os.path.join(output_dir, config.name())
     os.makedirs(exp_dir, exist_ok=True)
+    testbed.stage()
+    testbed.prepare(exp_dir)
 
     shard_ids = {s: list(process_ids(s, config.n)) for s in range(config.shard_count)}
     all_pids = [(pid, s) for s, ids in shard_ids.items() for pid in ids]
     offset_of = {pid: pid - shard_ids[s][0] for pid, s in all_pids}
-    peer_ports = {pid: free_port() for pid, _ in all_pids}
-    client_ports = {pid: free_port() for pid, _ in all_pids}
+    host_of = {pid: i for i, (pid, _s) in enumerate(all_pids)}
 
-    env = _cli_env()
     servers = []
     logs = []
-    # dstat analog: machine resource CSV for the plot layer's tables
-    from fantoch_tpu.exp.monitor import ResourceMonitor
-
+    # dstat analog: driver-machine resource CSV for the plot layer's tables
     monitor = ResourceMonitor(os.path.join(exp_dir, "resources.csv"))
     monitor.start()
     try:
@@ -83,30 +117,36 @@ def run_experiment(
                     closest = other_ids[offset]
                     peers.append(closest)
                     sorted_entries.append(f"{closest}:{other}")
-            addresses = ",".join(f"{p}=127.0.0.1:{peer_ports[p]}" for p in peers)
+            addresses = ",".join(
+                f"{p}={testbed.addr(host_of[p])}:{testbed.peer_port(p)}"
+                for p in peers
+            )
             args = config.server_args(
                 pid,
                 shard,
-                peer_ports[pid],
-                client_ports[pid],
+                testbed.peer_port(pid),
+                testbed.client_port(pid),
                 addresses,
                 ",".join(sorted_entries),
-                observe_dir=exp_dir,
+                observe_dir=_RESULTS_REL,  # workdir-relative; pulled below
             )
             log = open(os.path.join(exp_dir, f"server_p{pid}.log"), "w")
             logs.append(log)
             servers.append(
-                subprocess.Popen(
-                    [sys.executable, "-m", "fantoch_tpu.bin.server", *args],
-                    stdout=log,
-                    stderr=subprocess.STDOUT,
-                    env=env,
+                testbed.spawn(
+                    host_of[pid],
+                    "fantoch_tpu.bin.server",
+                    args,
+                    log,
+                    pre_dirs=[_RESULTS_REL],
                 )
             )
 
-        # clients attach to the offset-0 process of every shard
+        # clients run on the driver machine against the offset-0 process of
+        # every shard
         client_addresses = ",".join(
-            f"{s}=127.0.0.1:{client_ports[ids[0]]}" for s, ids in shard_ids.items()
+            f"{s}={testbed.addr(host_of[ids[0]])}:{testbed.client_port(ids[0])}"
+            for s, ids in shard_ids.items()
         )
         n_clients = config.clients_per_process * config.n
         client = subprocess.run(
@@ -123,7 +163,7 @@ def run_experiment(
             capture_output=True,
             text=True,
             timeout=client_timeout_s,
-            env=env,
+            env=_cli_env(),
         )
         if client.returncode != 0:
             raise RuntimeError(
@@ -146,9 +186,21 @@ def run_experiment(
         for log in logs:
             log.close()
 
+    # pull per-process artifacts back from the machines that produced them
+    pulled = []
+    for pid, _shard in all_pids:
+        for rel in (f"metrics_p{pid}.gz", f"execution_p{pid}.log"):
+            if testbed.pull(
+                host_of[pid],
+                f"{_RESULTS_REL}/{rel}",
+                os.path.join(exp_dir, rel),
+            ):
+                pulled.append(rel)
+
     manifest = {
         "config": config.to_dict(),
         "name": config.name(),
+        "testbed": {**testbed.describe(), "pulled": pulled},
         "outcome": {
             "commands": summary["commands"],
             "latency_ms": summary["latency_ms"],
@@ -166,7 +218,7 @@ def run_sweep(
     base: ExperimentConfig,
     output_dir: str,
     clients_sweep,
-    testbed: str = "localhost",
+    testbed="localhost",
     client_timeout_s: int = 600,
 ) -> list:
     """The reference's main experiment shape: the same protocol config at

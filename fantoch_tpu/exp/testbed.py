@@ -1,0 +1,277 @@
+"""Host-list (SSH/baremetal) testbed: plain machines, no cloud API.
+
+Reference: fantoch_exp/src/testbed/baremetal.rs — the reference reads a
+machines file, sets each host up over SSH (tsunami's baremetal provider),
+launches the protocol/client binaries remotely, and pulls artifacts back.
+The analog here:
+
+* ``HostsTestbed([...])`` takes ``user@host`` entries; ``stage()`` rsyncs
+  the repo to every distinct host, ``spawn()`` launches a framework
+  binary on host *i* via ``ssh host 'cd <dir> && python -m ...'``, and
+  ``pull()`` copies result files back.
+* ``use_ssh=False`` runs the SAME built command strings through
+  ``bash -c`` against a locally staged copy — the whole orchestration
+  layer (staging, remote command construction, artifact pull) runs and is
+  testable on machines with no sshd (this rig), and a real cluster only
+  changes the transport.
+
+``exp.bench.run_experiment(config, out, testbed=HostsTestbed(...))``
+drives a whole experiment through it; ``LocalTestbed`` implements the
+same interface with plain subprocesses on this machine (the localhost
+testbed of testbed/local.rs), so the experiment driver has ONE body.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+
+class LocalTestbed:
+    """Subprocesses on this machine behind the HostsTestbed interface."""
+
+    use_ssh = False
+    hosts: List[str] = ["localhost"]
+
+    def __init__(self) -> None:
+        self._ports: Dict[int, int] = {}
+        self._workdir: Optional[str] = None
+
+    def describe(self) -> Dict:
+        return {"kind": "localhost"}
+
+    def addr(self, _index: int) -> str:
+        return "127.0.0.1"
+
+    def _port(self, slot: int) -> int:
+        from fantoch_tpu.run.harness import free_port
+
+        if slot not in self._ports:
+            self._ports[slot] = free_port()
+        return self._ports[slot]
+
+    def peer_port(self, pid: int) -> int:
+        return self._port(pid)
+
+    def client_port(self, pid: int) -> int:
+        return self._port(10_000 + pid)
+
+    def stage(self) -> None:
+        pass
+
+    def prepare(self, exp_dir: str) -> None:
+        """The experiment dir doubles as the (only) workdir: artifacts
+        land in place and pull() is a no-op existence check."""
+        self._workdir = exp_dir
+
+    def spawn(
+        self,
+        index: int,
+        module: str,
+        args: List[str],
+        stdout,
+        pre_dirs: Optional[List[str]] = None,
+    ) -> subprocess.Popen:
+        assert self._workdir is not None, "prepare(exp_dir) first"
+        env = dict(os.environ)
+        env["FANTOCH_PLATFORM"] = env.get("FANTOCH_PLATFORM", "cpu")
+        env.pop("JAX_PLATFORMS", None)
+        repo = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        for d in pre_dirs or []:
+            os.makedirs(os.path.join(self._workdir, d), exist_ok=True)
+        return subprocess.Popen(
+            [sys.executable, "-m", module, *args],
+            stdout=stdout,
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=self._workdir,
+        )
+
+    def pull(self, _index: int, remote_rel: str, local_path: str) -> bool:
+        src = os.path.join(self._workdir or "", remote_rel)
+        if not os.path.exists(src):
+            return False
+        if os.path.abspath(src) != os.path.abspath(local_path):
+            shutil.copyfile(src, local_path)
+        return True
+
+    def cleanup(self) -> None:
+        pass
+
+_SSH_OPTS = [
+    "-o", "StrictHostKeyChecking=no",
+    "-o", "BatchMode=yes",
+]
+_STAGE_EXCLUDES = [".git", "__pycache__", ".jax_cache", ".pytest_cache"]
+
+
+class HostsTestbed:
+    """A list of SSH-reachable machines serving as the cluster."""
+
+    def __init__(
+        self,
+        hosts: List[str],
+        *,
+        use_ssh: bool = True,
+        remote_dir: str = "~/fantoch_tpu_run",
+        python: str = "python3",
+        base_port: int = 7800,
+        repo_dir: Optional[str] = None,
+    ):
+        assert hosts, "a hosts testbed needs at least one host"
+        self.hosts = list(hosts)
+        self.use_ssh = use_ssh
+        self.remote_dir = remote_dir
+        self.python = python
+        self.base_port = base_port
+        self.repo_dir = repo_dir or os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        self._local_dirs: Dict[str, str] = {}  # per-host staged copy (local mode)
+
+    def describe(self) -> Dict:
+        return {"kind": "hosts", "hosts": self.hosts, "ssh": self.use_ssh}
+
+    def prepare(self, exp_dir: str) -> None:
+        pass  # artifacts live in the per-host workdirs until pull()
+
+    def __enter__(self) -> "HostsTestbed":
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        self.cleanup()
+
+    # --- addressing ---
+
+    def addr(self, index: int) -> str:
+        """The TCP address peers/clients dial for host ``index``."""
+        if not self.use_ssh:
+            return "127.0.0.1"
+        host = self.hosts[index % len(self.hosts)]
+        return host.split("@", 1)[-1]
+
+    def peer_port(self, pid: int) -> int:
+        return self.base_port + pid
+
+    def client_port(self, pid: int) -> int:
+        return self.base_port + 1000 + pid
+
+    # --- staging (baremetal.rs setup: clone/sync the tree per machine) ---
+
+    def stage(self) -> None:
+        if self.use_ssh:
+            for host in dict.fromkeys(self.hosts):
+                subprocess.run(
+                    [
+                        "rsync", "-az", "--delete",
+                        *[f"--exclude={e}" for e in _STAGE_EXCLUDES],
+                        "-e", "ssh " + " ".join(_SSH_OPTS),
+                        f"{self.repo_dir}/",
+                        f"{host}:{self.remote_dir}/",
+                    ],
+                    check=True,
+                    capture_output=True,
+                    timeout=300,
+                )
+            return
+        # local mode: one staged copy per distinct host entry, so the
+        # launched processes genuinely run out of the staged tree
+        import tempfile
+
+        for host in dict.fromkeys(self.hosts):
+            if host in self._local_dirs:
+                continue
+            dst = tempfile.mkdtemp(prefix=f"fantoch_stage_{host.replace('@', '_')}_")
+            shutil.copytree(
+                self.repo_dir,
+                dst,
+                dirs_exist_ok=True,
+                ignore=shutil.ignore_patterns(*_STAGE_EXCLUDES),
+            )
+            self._local_dirs[host] = dst
+
+    def _workdir(self, index: int) -> str:
+        host = self.hosts[index % len(self.hosts)]
+        if self.use_ssh:
+            return self.remote_dir
+        return self._local_dirs[host]
+
+    # --- launch / pull ---
+
+    def _remote_command(
+        self,
+        index: int,
+        module: str,
+        args: List[str],
+        pre_dirs: Optional[List[str]] = None,
+    ) -> str:
+        """The command string a remote shell runs (identical in both
+        transports — that's the point of the local mode)."""
+        argv = " ".join(shlex.quote(a) for a in args)
+        mkdirs = "".join(
+            f"mkdir -p {shlex.quote(d)} && " for d in (pre_dirs or [])
+        )
+        # exec: the launched python replaces the shell, so teardown signals
+        # (SIGINT locally, connection-close SIGHUP over ssh) reach it.
+        # -u JAX_PLATFORMS: a caller's backend override must not leak into
+        # the staged servers (the localhost testbed scrubs it the same way)
+        return (
+            f"cd {self._workdir(index)} && {mkdirs}"
+            f"exec env -u JAX_PLATFORMS PYTHONPATH=. FANTOCH_PLATFORM=cpu "
+            f"{shlex.quote(self._python_for(index))} -m {module} {argv}"
+        )
+
+    def _python_for(self, index: int) -> str:
+        # local mode must use THIS interpreter (the remote default python3
+        # may not carry the deps)
+        return self.python if self.use_ssh else sys.executable
+
+    def spawn(
+        self,
+        index: int,
+        module: str,
+        args: List[str],
+        stdout,
+        pre_dirs: Optional[List[str]] = None,
+    ) -> subprocess.Popen:
+        command = self._remote_command(index, module, args, pre_dirs)
+        if self.use_ssh:
+            host = self.hosts[index % len(self.hosts)]
+            argv = ["ssh", *_SSH_OPTS, host, command]
+        else:
+            argv = ["bash", "-c", command]
+        return subprocess.Popen(
+            argv, stdout=stdout, stderr=subprocess.STDOUT
+        )
+
+    def pull(self, index: int, remote_rel: str, local_path: str) -> bool:
+        """Copy one artifact back from host ``index``; False if absent."""
+        if self.use_ssh:
+            host = self.hosts[index % len(self.hosts)]
+            out = subprocess.run(
+                [
+                    "scp", *_SSH_OPTS,
+                    f"{host}:{self.remote_dir}/{remote_rel}",
+                    local_path,
+                ],
+                capture_output=True,
+                timeout=120,
+            )
+            return out.returncode == 0
+        src = os.path.join(self._workdir(index), remote_rel)
+        if not os.path.exists(src):
+            return False
+        shutil.copyfile(src, local_path)
+        return True
+
+    def cleanup(self) -> None:
+        for path in self._local_dirs.values():
+            shutil.rmtree(path, ignore_errors=True)
+        self._local_dirs.clear()

@@ -470,3 +470,325 @@ def jit_protocol_step(mesh: Mesh, live_replicas: int | None = None):
         functools.partial(protocol_step, mesh=mesh, live_replicas=live_replicas),
         donate_argnums=(0,),
     )
+
+
+# ---------------------------------------------------------------------------
+# Newt/Tempo on the mesh: timestamp consensus + stability
+# ---------------------------------------------------------------------------
+
+
+class NewtMeshState(NamedTuple):
+    """Device-resident Newt replica state over the mesh.
+
+    ``key_clock[R, K]``: per-replica timestamp clock per key bucket (the
+    SequentialKeyClocks map, fantoch_ps/src/protocol/common/table/clocks/
+    keys/sequential.rs:9-105).  ``vote_frontier[R, K]``: per-replica
+    contiguous vote frontier per key (the RangeEventSet frontier of the
+    VotesTable, collapsed to a watermark in this dense round-based regime
+    where votes are always consumed contiguously).
+
+    Pending buffer: commands a previous round could not *execute* —
+    either uncommitted (degraded quorum; ``pend_clock == -1``) or
+    committed-but-unstable (their timestamp above the stability
+    watermark; ``pend_clock`` holds the committed clock).  Slot empty iff
+    ``pend_key == KEY_PAD``.
+    """
+
+    key_clock: jax.Array  # int32[R, K]
+    vote_frontier: jax.Array  # int32[R, K]
+    pend_key: jax.Array  # int32[Pcap]
+    pend_src: jax.Array  # int32[Pcap]
+    pend_seq: jax.Array  # int32[Pcap]
+    pend_clock: jax.Array  # int32[Pcap] (-1 = not committed)
+
+
+class NewtStepOutput(NamedTuple):
+    """Outputs over the W = Pcap + B working rows (pending first)."""
+
+    order: jax.Array  # int32[W] — stable rows first, (clock, dot) sorted
+    executed: jax.Array  # bool[W] — committed AND stable this round
+    committed: jax.Array  # bool[W]
+    fast_path: jax.Array  # bool[W]
+    clock: jax.Array  # int32[W] — committed timestamp (-1 uncommitted)
+    slow_paths: jax.Array  # int32[]
+    stable_watermark: jax.Array  # int32[] — min stable clock over keys seen
+    pending: jax.Array  # int32[]
+    pend_dropped: jax.Array  # int32[]
+
+
+def newt_quorum_sizes(
+    num_replicas: int, f: int, tiny_quorums: bool = False
+) -> Tuple[int, int, int]:
+    """(fast_quorum, write_quorum, stability_threshold) — the shared
+    protocol-fact formula (Config.newt_quorum_sizes, newt.rs:90-100)."""
+    from fantoch_tpu.core.config import Config
+
+    return Config(
+        num_replicas, f, newt_tiny_quorums=tiny_quorums
+    ).newt_quorum_sizes()
+
+
+def init_newt_state(
+    mesh: Mesh,
+    num_replicas: int,
+    key_buckets: int = 4096,
+    pending_capacity: int = 256,
+) -> NewtMeshState:
+    sharding = NamedSharding(mesh, P(REPLICA_AXIS, None))
+    zeros_rk = jax.device_put(
+        jnp.zeros((num_replicas, key_buckets), dtype=jnp.int32), sharding
+    )
+    rep = NamedSharding(mesh, P())
+    cap = pending_capacity
+
+    def pend(value):
+        return jax.device_put(jnp.full((cap,), value, dtype=jnp.int32), rep)
+
+    return NewtMeshState(
+        zeros_rk,
+        jax.device_put(jnp.zeros((num_replicas, key_buckets), jnp.int32), sharding),
+        pend(KEY_PAD), pend(-1), pend(-1), pend(-1),
+    )
+
+
+def _segmented_proposal(prior_of_row, key_full, work):
+    """Per-replica batched clock proposal over the working set: same-key
+    rows receive consecutive clocks continuing from the replica's prior —
+    the tensorized ``SequentialKeyClocks::proposal`` over one round, the
+    same segmented max-scan as ops/table_ops.batched_clock_proposal.
+
+    ``prior_of_row``: int32[r_blk, W] — the proposing replica's current
+    clock for each row's key.  Returns proposals of the same shape.
+    """
+    widx = jnp.arange(work, dtype=jnp.int32)
+    perm = jnp.argsort(key_full, stable=True).astype(jnp.int32)
+    k_sorted = key_full[perm]
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), k_sorted[1:] != k_sorted[:-1]]
+    )
+    seg_id = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+    group_first = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(seg_start, widx, 0)
+    )
+    rank = widx - group_first
+
+    def seg_max(a, b):
+        a_seg, a_val = a
+        b_seg, b_val = b
+        return b_seg, jnp.where(a_seg == b_seg, jnp.maximum(a_val, b_val), b_val)
+
+    base = prior_of_row[:, perm] + 1  # [r_blk, W] in sorted order
+    _, running = jax.lax.associative_scan(
+        seg_max,
+        (jnp.broadcast_to(seg_id, base.shape), base - rank),
+        axis=-1,
+    )
+    clock_sorted = rank + running
+    return jnp.zeros_like(base).at[:, perm].set(clock_sorted)
+
+
+def newt_protocol_step(
+    state: NewtMeshState,
+    key: jax.Array,  # int32[B] — single key bucket per command
+    dot_src: jax.Array,  # int32[B]
+    dot_seq: jax.Array,  # int32[B]
+    *,
+    mesh: Mesh,
+    f: int = 1,
+    tiny_quorums: bool = False,
+    live_replicas: int | None = None,
+) -> Tuple[NewtMeshState, NewtStepOutput]:
+    """One batched Newt round: timestamp proposal, max aggregation over
+    the fast quorum, count-of-max fast path, Synod accept for misses, and
+    stability-ordered execution (newt.rs:272-338 + 527-546; stability =
+    fantoch_ps/src/executor/table/mod.rs:247-270).
+
+    Collective layout: proposals are per-replica local work on the
+    key-clock shard; the commit clock is a ``pmax`` over the fast quorum;
+    the fast-path count-of-max and the Synod ack count are ``psum``s; the
+    per-key stable clock is an order statistic over an ``all_gather`` of
+    the vote frontiers along ``replica``.
+    """
+    num_replicas, key_buckets = state.key_clock.shape
+    batch = key.shape[0]
+    pend_cap = state.pend_key.shape[0]
+    work = pend_cap + batch
+    fast_quorum, write_quorum, stability_threshold = newt_quorum_sizes(
+        num_replicas, f, tiny_quorums
+    )
+    if live_replicas is None:
+        live_replicas = num_replicas
+    replica_blocks = num_replicas // mesh.shape[REPLICA_AXIS]
+    int_min = jnp.iinfo(jnp.int32).min
+
+    def step(
+        key_clock, vote_frontier, pend_key, pend_src, pend_seq, pend_clock,
+        key_l, src_l, seq_l,
+    ):
+        key_new = jax.lax.all_gather(key_l, BATCH_AXIS, tiled=True)
+        src_new = jax.lax.all_gather(src_l, BATCH_AXIS, tiled=True)
+        seq_new = jax.lax.all_gather(seq_l, BATCH_AXIS, tiled=True)
+
+        widx = jnp.arange(work, dtype=jnp.int32)
+        key_cat = jnp.concatenate([pend_key, key_new])  # [W]
+        valid = key_cat != KEY_PAD
+        src_f = jnp.where(valid, jnp.concatenate([pend_src, src_new]), 0)
+        seq_f = jnp.where(valid, jnp.concatenate([pend_seq, seq_new]), 0)
+        prior_clock = jnp.concatenate(
+            [pend_clock, jnp.full((batch,), -1, jnp.int32)]
+        )  # committed clock carried from earlier rounds, -1 = none
+        already_committed = prior_clock >= 0
+
+        # pad rows / already-committed rows must not consume proposals:
+        # give them private out-of-range keys so they form singleton runs
+        propose = valid & ~already_committed
+        key_full = jnp.where(propose, key_cat, key_buckets + widx)
+        safe_key = jnp.minimum(key_full, key_buckets - 1)
+
+        # per-replica-block proposals: prior = this replica's key clock
+        prior_rows = jnp.where(
+            propose[None, :], key_clock[:, safe_key], 0
+        )  # [r_blk, W]
+        proposal = _segmented_proposal(prior_rows, key_full, work)  # [r_blk, W]
+
+        # MCollectAck max-aggregation over the fast quorum (the first
+        # fast_quorum global replica rows)
+        row = (
+            jax.lax.axis_index(REPLICA_AXIS) * replica_blocks
+            + jnp.arange(replica_blocks, dtype=jnp.int32)
+        )
+        in_fq = (row < fast_quorum)[:, None]
+        fq_max = jax.lax.pmax(
+            jnp.where(in_fq, proposal, int_min).max(axis=0), REPLICA_AXIS
+        )  # [W]
+        # fast path iff the max clock was reported by >= f quorum members
+        # (newt.rs:527-546 via QuorumClocks max_count)
+        reports = jax.lax.psum(
+            (in_fq & (proposal == fq_max[None, :])).astype(jnp.int32).sum(axis=0),
+            REPLICA_AXIS,
+        )
+        fast = (reports >= f) & propose
+
+        # Synod ballot-0 accept round for fast-path misses (live replicas
+        # ack; commit at write_quorum = f + 1)
+        live = (row < live_replicas)[:, None]
+        acks = jax.lax.psum(
+            (live & ~fast[None, :]).astype(jnp.int32).sum(axis=0), REPLICA_AXIS
+        )
+        newly_committed = (fast | (acks >= write_quorum)) & propose
+        committed = already_committed | newly_committed
+        clock = jnp.where(
+            newly_committed, fq_max, jnp.where(already_committed, prior_clock, -1)
+        )
+        slow_paths = (propose & ~fast).sum().astype(jnp.int32)
+
+        # vote/frontier update: live replicas chase every committed clock
+        # with (detached) votes — scatter-max into both tables
+        upd = jnp.where(
+            live & committed[None, :] & valid[None, :], clock[None, :], 0
+        )  # [r_blk, W]
+        new_key_clock = key_clock.at[:, safe_key].max(
+            jnp.where(propose[None, :], upd, 0)
+        )
+        # committed carried rows also vote (their key_full is private; use
+        # the real key for the frontier scatter)
+        real_key = jnp.minimum(jnp.where(valid, key_cat, 0), key_buckets - 1)
+        new_frontier = vote_frontier.at[:, real_key].max(upd)
+        # also reflect proposals consumed by this round in the key clock
+        new_key_clock = jnp.where(
+            live, jnp.maximum(new_key_clock, new_frontier), new_key_clock
+        )
+
+        # stability: per-key (n - threshold)-th smallest frontier across
+        # ALL replicas (mod.rs:247-270) — gather the replica axis
+        full_frontier = jax.lax.all_gather(
+            new_frontier, REPLICA_AXIS, tiled=True
+        )  # [R, K]
+        stable_clock = jnp.sort(full_frontier, axis=0)[
+            num_replicas - stability_threshold
+        ]  # [K]
+        executed = committed & valid & (clock <= stable_clock[real_key])
+
+        # execution order: stable rows by (clock, dot) — the VotesTable
+        # sort id (mod.rs:18)
+        sort_key = jnp.where(executed, clock, jnp.iinfo(jnp.int32).max)
+        order = jnp.lexsort((seq_f, src_f, sort_key)).astype(jnp.int32)
+
+        # pending carry: valid unexecuted rows (uncommitted or unstable)
+        carry = valid & ~executed
+        carry_order = jnp.argsort(
+            jnp.where(carry, widx, jnp.iinfo(jnp.int32).max)
+        ).astype(jnp.int32)
+        take = carry_order[:pend_cap]
+        is_carry = carry[take]
+        new_pend_key = jnp.where(is_carry, key_cat[take], KEY_PAD)
+        new_pend_src = jnp.where(is_carry, src_f[take], -1)
+        new_pend_seq = jnp.where(is_carry, seq_f[take], -1)
+        new_pend_clock = jnp.where(is_carry, clock[take], -1)
+        pending = carry.sum().astype(jnp.int32)
+        pend_dropped = jnp.maximum(pending - pend_cap, 0).astype(jnp.int32)
+
+        seen = jnp.zeros((key_buckets,), bool).at[real_key].max(valid)
+        watermark = jnp.where(seen, stable_clock, jnp.iinfo(jnp.int32).max).min()
+
+        return (
+            new_key_clock, new_frontier,
+            new_pend_key, new_pend_src, new_pend_seq, new_pend_clock,
+            order, executed, committed, fast & valid, clock,
+            slow_paths, watermark,
+            jnp.minimum(pending, pend_cap), pend_dropped,
+        )
+
+    specs_in = (
+        P(REPLICA_AXIS, None),  # key_clock
+        P(REPLICA_AXIS, None),  # vote_frontier
+        P(), P(), P(), P(),  # pending buffer
+        P(BATCH_AXIS), P(BATCH_AXIS), P(BATCH_AXIS),
+    )
+    specs_out = (
+        P(REPLICA_AXIS, None),
+        P(REPLICA_AXIS, None),
+        P(), P(), P(), P(),  # pending buffer
+        P(), P(), P(), P(), P(),  # order/executed/committed/fast/clock
+        P(), P(), P(), P(),  # slow/watermark/pending/dropped
+    )
+    fn = shard_map(
+        step, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False
+    )
+    (
+        kc, vf, pk, ps_, pq, pc,
+        order, executed, committed, fast, clock,
+        slow, watermark, pending, dropped,
+    ) = fn(
+        state.key_clock, state.vote_frontier,
+        state.pend_key, state.pend_src, state.pend_seq, state.pend_clock,
+        key, dot_src, dot_seq,
+    )
+    return (
+        NewtMeshState(kc, vf, pk, ps_, pq, pc),
+        NewtStepOutput(
+            order, executed, committed, fast, clock,
+            slow, watermark, pending, dropped,
+        ),
+    )
+
+
+def jit_newt_step(
+    mesh: Mesh,
+    f: int = 1,
+    tiny_quorums: bool = False,
+    live_replicas: int | None = None,
+):
+    """jit-compiled Newt round with donated device-resident state."""
+    import functools
+
+    return jax.jit(
+        functools.partial(
+            newt_protocol_step,
+            mesh=mesh,
+            f=f,
+            tiny_quorums=tiny_quorums,
+            live_replicas=live_replicas,
+        ),
+        donate_argnums=(0,),
+    )

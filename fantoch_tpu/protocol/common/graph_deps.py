@@ -35,63 +35,106 @@ class Dependency:
         return Dependency(dot, None)
 
 
-class KeyDeps:
-    """Latest-per-key conflict index (deps/keys/sequential.rs:8-145).
+class _LatestRW:
+    """Per-key (latest read, latest write) slots (locked.rs:10-15)."""
 
-    The reference has Sequential (plain map) and Locked (per-key RwLock)
-    variants for worker parallelism; here one implementation serves both
-    (see fantoch_tpu/protocol/info.py for the rationale).  The batched
-    device counterpart — the intra-batch latest-per-key chain — lives in
-    fantoch_tpu/parallel/mesh_step.py (_intra_batch_chain) and
+    __slots__ = ("read", "write")
+
+    def __init__(self) -> None:
+        self.read: Optional[Dependency] = None
+        self.write: Optional[Dependency] = None
+
+
+class KeyDeps:
+    """Latest-per-key conflict index with the read/write split
+    (deps/keys/locked.rs:10-122): a read-only command depends only on the
+    latest *write* on each key (reads commute) and becomes the latest
+    read; a write depends on the latest read AND write and becomes the
+    latest write.  Read-heavy workloads thus commit with far fewer
+    dependencies than the latest-*access* index of sequential.rs.
+
+    The reference has Sequential (plain map, no split) and Locked (per-key
+    RwLock, with the split) variants for worker parallelism; here one
+    implementation serves both (see fantoch_tpu/protocol/info.py for the
+    rationale) and adopts the Locked variant's sharper conflict relation.
+    The batched device counterpart — the intra-batch latest-per-key chain
+    — lives in fantoch_tpu/parallel/mesh_step.py (_intra_batch_chain) and
     fantoch_tpu/ops/table_ops.py (scatter-max key clocks).
     """
 
     def __init__(self, shard_id: ShardId):
         self._shard_id = shard_id
-        self._latest: Dict[Key, Dependency] = {}
+        self._latest: Dict[Key, _LatestRW] = {}
         self._noop_latest: Optional[Dependency] = None
 
     def add_cmd(
         self, dot: Dot, cmd: Command, past: Optional[Set[Dependency]] = None
     ) -> Set[Dependency]:
-        """Record `dot` as the latest on each of `cmd`'s keys; returns its
-        dependencies (latest prior commands on those keys + latest noop),
-        seeded with `past` (remote deps) if given."""
+        """Record `dot` on each of `cmd`'s keys; returns its dependencies,
+        seeded with `past` (remote deps) if given (locked.rs:84-128)."""
         deps: Set[Dependency] = set(past) if past else set()
         new_dep = Dependency.from_cmd(dot, cmd)
+        read_only = cmd.read_only
         for key in cmd.keys(self._shard_id):
-            prev = self._latest.get(key)
-            if prev is not None:
-                deps.add(prev)
-            self._latest[key] = new_dep
+            entry = self._latest.get(key)
+            if entry is None:
+                entry = _LatestRW()
+                self._latest[key] = entry
+            if read_only:
+                if entry.write is not None:
+                    deps.add(entry.write)
+                entry.read = new_dep
+            else:
+                if entry.read is not None:
+                    deps.add(entry.read)
+                    # clear the read slot: this write now depends on it, so
+                    # later writes are ordered after it transitively — the
+                    # reference keeps it (locked.rs:108-110) and ships one
+                    # permanently redundant dep per subsequent write
+                    entry.read = None
+                if entry.write is not None:
+                    deps.add(entry.write)
+                entry.write = new_dep
         if self._noop_latest is not None:
             deps.add(self._noop_latest)
         return deps
 
     def add_noop(self, dot: Dot) -> Set[Dependency]:
         """A noop conflicts with everything: depends on every key's latest
-        plus the previous noop."""
+        read and write plus the previous noop (locked.rs:130-170)."""
         deps: Set[Dependency] = set()
         prev = self._noop_latest
         self._noop_latest = Dependency.from_noop(dot)
         if prev is not None:
             deps.add(prev)
-        deps.update(self._latest.values())
+        for entry in self._latest.values():
+            if entry.read is not None:
+                deps.add(entry.read)
+            if entry.write is not None:
+                deps.add(entry.write)
         return deps
 
-    # test-only queries (deps/keys/sequential.rs:44-58)
+    # test-only queries (locked.rs:172-187)
     def cmd_deps(self, cmd: Command) -> Set[Dot]:
         deps: Set[Dot] = set()
         if self._noop_latest is not None:
             deps.add(self._noop_latest.dot)
         for key in cmd.keys(self._shard_id):
-            dep = self._latest.get(key)
-            if dep is not None:
-                deps.add(dep.dot)
+            entry = self._latest.get(key)
+            if entry is not None:
+                if entry.read is not None:
+                    deps.add(entry.read.dot)
+                if entry.write is not None:
+                    deps.add(entry.write.dot)
         return deps
 
     def noop_deps(self) -> Set[Dot]:
-        deps = {d.dot for d in self._latest.values()}
+        deps: Set[Dot] = set()
+        for entry in self._latest.values():
+            if entry.read is not None:
+                deps.add(entry.read.dot)
+            if entry.write is not None:
+                deps.add(entry.write.dot)
         if self._noop_latest is not None:
             deps.add(self._noop_latest.dot)
         return deps

@@ -17,6 +17,39 @@ from typing import Any, List, Optional
 from fantoch_tpu.core.command import Command, CommandResult
 from fantoch_tpu.core.ids import ClientId, Dot, ProcessId, ShardId
 from fantoch_tpu.run.routing import WorkerIndex, resolve_index
+from fantoch_tpu.utils import logger
+
+
+class WarnQueue(asyncio.Queue):
+    """Queue that warns when its depth crosses a threshold — the analog of
+    the reference's bounded channels (fantoch/src/run/task/chan.rs:36-58,
+    warn-then-block on full).  Producers here are synchronous handlers on
+    one cooperative loop, so blocking them would deadlock the consumer;
+    instead the overload signal surfaces loudly (once per doubling above
+    the threshold, so a runaway queue keeps shouting but doesn't spam)."""
+
+    def __init__(self, name: str, warn_size: int = 8192):
+        super().__init__()
+        self._warn_name = name
+        self._warn_size = warn_size
+        self._warn_next = warn_size
+
+    def put_nowait(self, item: Any) -> None:  # type: ignore[override]
+        super().put_nowait(item)
+        if self.qsize() >= self._warn_next:
+            logger.warning(
+                "queue %s is full (%d items >= %d): consumer falling behind",
+                self._warn_name,
+                self.qsize(),
+                self._warn_next,
+            )
+            self._warn_next *= 2
+
+    def get_nowait(self) -> Any:  # type: ignore[override]
+        item = super().get_nowait()
+        if self.qsize() < self._warn_size:
+            self._warn_next = self._warn_size
+        return item
 
 
 # --- handshakes (prelude.rs:38-50) ---
@@ -94,7 +127,9 @@ class ToPool:
 
     def __init__(self, name: str, size: int):
         self.name = name
-        self._queues: List[asyncio.Queue] = [asyncio.Queue() for _ in range(size)]
+        self._queues: List[asyncio.Queue] = [
+            WarnQueue(f"{name}[{i}]") for i in range(size)
+        ]
 
     @property
     def size(self) -> int:

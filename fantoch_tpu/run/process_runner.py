@@ -52,6 +52,7 @@ from fantoch_tpu.run.prelude import (
     Submit,
     ToClient,
     ToPool,
+    WarnQueue,
 )
 from fantoch_tpu.run.routing import worker_dot_index_shift
 from fantoch_tpu.run.rw import Rw, connect_with_retry, serialize
@@ -90,12 +91,13 @@ class _PeerLinks:
             random.choice(self.queues).put_nowait(frame)
 
 
-class _StampingQueue(asyncio.Queue):
+class _StampingQueue(WarnQueue):
     """Queue whose items carry their entry time — the delay line's source
-    (delay.rs timestamps messages on entry, :6-39)."""
+    (delay.rs timestamps messages on entry, :6-39).  Inherits the
+    warn-on-depth overload signal (delayed links back up first)."""
 
-    def __init__(self, loop: asyncio.AbstractEventLoop):
-        super().__init__()
+    def __init__(self, name: str, loop: asyncio.AbstractEventLoop):
+        super().__init__(name)
         self._stamp_loop = loop
 
     def put_nowait(self, item: Any) -> None:  # type: ignore[override]
@@ -314,12 +316,14 @@ class ProcessRuntime:
                     # entering, so entry times are stamped at put (a burst
                     # still leaves one delay later, not serialized at one
                     # frame per delay)
-                    queue = _StampingQueue(asyncio.get_running_loop())
-                    delayed: asyncio.Queue = asyncio.Queue()
+                    queue = _StampingQueue(
+                        f"delay->p{peer_id}", asyncio.get_running_loop()
+                    )
+                    delayed: asyncio.Queue = WarnQueue(f"writer->p{peer_id}")
                     self.spawn(self._delay_task(queue, delayed, delay_ms))
                     self.spawn(self._writer_task(rw, delayed))
                 else:
-                    queue = asyncio.Queue()
+                    queue = WarnQueue(f"writer->p{peer_id}")
                     self.spawn(self._writer_task(rw, queue))
                 links.queues.append(queue)
                 self._peer_writers[peer_id] = links

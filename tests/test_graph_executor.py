@@ -306,3 +306,32 @@ def test_stuck_misclassification_never_executes_past_missing():
     assert len(drained) == n + 1
     assert drained[0].rifl == Rifl(2, 1)
     assert [c.rifl.sequence for c in drained[1:]] == list(range(1, n + 1))
+
+
+def test_monitor_pending_panics_on_lost_execution():
+    """Per-row liveness watchdog (index.rs:53-103): a row whose whole
+    dependency closure is executed/present but which never executed means
+    a lost execution — monitor_pending must panic on it, while genuinely
+    missing-blocked rows never trip it."""
+    import numpy as np
+    import pytest as _pytest
+
+    from fantoch_tpu.core.timing import SimTime
+
+    time = SimTime()
+    graph = BatchedDependencyGraph(1, SHARD, Config(3, 1))
+    ghost = Dot(2, 7)
+    # a row blocked on a genuinely missing dep: never panics
+    graph.handle_add(Dot(1, 1), make_cmd(Dot(1, 1), ["a"]), [dep(ghost)], time)
+    assert graph.commands_to_execute() == []
+    time.add_millis(5000)
+    graph.monitor_pending(time)  # old but missing-blocked: fine
+
+    # simulate a lost execution: the ghost executes elsewhere but the
+    # re-resolve notification is lost (frontier learns the dot, nothing
+    # marks the backlog dirty)
+    graph._frontier.add(ghost.source, ghost.sequence)
+    graph._dirty = False
+    time.add_millis(5000)
+    with _pytest.raises(AssertionError, match="without missing"):
+        graph.monitor_pending(time)

@@ -297,3 +297,119 @@ def test_pending_commands_commit_after_quorum_recovers(mesh):
     assert int(out2.pending) == 0
     # every replica executed both rounds
     assert state.frontier.tolist() == [2 * batch] * num_replicas
+
+
+# --- Newt timestamp round on the mesh ---
+
+
+def _newt_setup(mesh, f=1, key_buckets=64, live_replicas=None, pending=64):
+    num_replicas = 2 * mesh.shape[mesh_step.REPLICA_AXIS]
+    batch = 8 * mesh.shape[mesh_step.BATCH_AXIS]
+    state = mesh_step.init_newt_state(
+        mesh, num_replicas, key_buckets=key_buckets, pending_capacity=pending
+    )
+    step = mesh_step.jit_newt_step(mesh, f=f, live_replicas=live_replicas)
+    return num_replicas, batch, state, step
+
+
+def test_newt_step_commits_and_stabilizes(mesh):
+    """A healthy round commits everything on the fast path (identical
+    replica clocks -> every quorum member reports the same max) and the
+    whole batch is stable-ordered by (clock, dot) per key."""
+    num_replicas, batch, state, step = _newt_setup(mesh)
+    rng = np.random.default_rng(0)
+    key = jnp.asarray(rng.integers(0, 4, size=batch), jnp.int32)
+    src = jnp.asarray(rng.integers(1, num_replicas + 1, size=batch), jnp.int32)
+    seq = jnp.arange(batch, dtype=jnp.int32)
+    state, out = step(state, key, src, seq)
+    executed = np.asarray(out.executed)
+    assert executed.sum() == batch
+    assert np.asarray(out.fast_path).sum() == batch
+    assert int(out.slow_paths) == 0
+    assert int(out.pending) == 0
+    # (clock, dot)-sorted execution, per-key clocks strictly increasing
+    order = np.asarray(out.order)
+    clock = np.asarray(out.clock)
+    pend_cap = state.pend_key.shape[0]
+    keys_w = np.concatenate([np.full(pend_cap, -1, np.int32), np.asarray(key)])
+    last = {}
+    for w in order:
+        if not executed[w]:
+            continue
+        k = int(keys_w[w])
+        assert last.get(k, 0) < clock[w]
+        last[k] = int(clock[w])
+
+
+def test_newt_clocks_continue_across_rounds(mesh):
+    """Round 2 proposals continue above round 1's committed clocks per
+    key (the device key-clock table carries)."""
+    num_replicas, batch, state, step = _newt_setup(mesh)
+    key = jnp.asarray(np.zeros(batch), jnp.int32)  # one hot key
+    src = jnp.asarray(np.ones(batch), jnp.int32)
+    state, out1 = step(state, key, src, jnp.arange(batch, dtype=jnp.int32))
+    state, out2 = step(
+        state, key, src, jnp.arange(batch, 2 * batch, dtype=jnp.int32)
+    )
+    c1 = np.asarray(out1.clock)[np.asarray(out1.executed)]
+    c2 = np.asarray(out2.clock)[np.asarray(out2.executed)]
+    assert len(c1) == len(c2) == batch
+    assert c2.min() > c1.max()
+
+
+def test_newt_degraded_quorum_carries_pending(mesh):
+    """With fewer live replicas than the write quorum, slow-path commands
+    cannot commit; they carry in the pending buffer and commit + execute
+    once the quorum recovers."""
+    num_replicas, batch, state, step = _newt_setup(mesh)
+    key = jnp.asarray(np.zeros(batch), jnp.int32)
+    src = jnp.asarray(np.ones(batch), jnp.int32)
+    # stagger replica 0's key clock so the first proposal's max is unique
+    # to one replica (max_count < f is impossible at f=1; force the slow
+    # path by staggering so that the max is reported once... at f=1 a
+    # single report satisfies the fast path, so instead degrade below the
+    # write quorum AND the fast path by staggering every quorum member
+    # differently via distinct priors)
+    kc = np.array(state.key_clock)
+    for r in range(num_replicas):
+        kc[r, 0] = r * 10  # all replicas disagree on the hot key's clock
+    state = state._replace(
+        key_clock=jax.device_put(jnp.asarray(kc), state.key_clock.sharding)
+    )
+    degraded = mesh_step.jit_newt_step(mesh, f=1, live_replicas=0)
+    state, out = degraded(state, key, src, jnp.arange(batch, dtype=jnp.int32))
+    # fast path needs the max reported >= f times: the max proposal comes
+    # only from the staggered top replica if it is in the fast quorum...
+    # at f=1 one report suffices, so fast commits still happen; what must
+    # NOT happen is slow-path commits with zero live replicas
+    committed = np.asarray(out.committed)
+    fast = np.asarray(out.fast_path)
+    assert (committed == fast).all(), "slow path must not commit with no live acks"
+    carried = int(out.pending)
+    # fast-path commits with no live replicas cannot stabilize either
+    # (frontiers never advance), so they carry too
+    assert carried == batch - np.asarray(out.executed).sum()
+
+    # recovery: everything (carried + nothing new) commits and executes
+    recovered = mesh_step.jit_newt_step(mesh, f=1)
+    empty = jnp.full((batch,), mesh_step.KEY_PAD, jnp.int32)
+    zeros = jnp.zeros((batch,), jnp.int32)
+    state, out2 = recovered(state, empty, zeros, zeros)
+    assert int(out2.pending) == 0
+    assert np.asarray(out2.executed).sum() == carried
+
+
+def test_newt_stability_with_lagging_minority(mesh):
+    """With a minority of replicas dead, commits still stabilize: the
+    (n - threshold)-th smallest frontier ignores the laggards (the Newt
+    stability condition, mod.rs:247-270)."""
+    num_replicas, batch, state, step = _newt_setup(mesh)
+    f = 1
+    live = num_replicas - f  # one dead replica
+    partial = mesh_step.jit_newt_step(mesh, f=f, live_replicas=live)
+    key = jnp.asarray(np.arange(batch) % 3, jnp.int32)
+    src = jnp.asarray(np.ones(batch), jnp.int32)
+    state, out = partial(state, key, src, jnp.arange(batch, dtype=jnp.int32))
+    assert np.asarray(out.executed).sum() == batch, (
+        "a lagging minority must not block timestamp stability"
+    )

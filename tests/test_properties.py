@@ -17,6 +17,11 @@ Targets the algebraic core where randomized inputs bite hardest:
 import os
 import sys
 
+# the reference CI caps quickcheck at a budget (QUICKCHECK_TESTS); under
+# CI=true we shrink hypothesis the same way
+_CI = bool(os.environ.get("CI"))
+
+
 import numpy as np
 import pytest
 from hypothesis import given, settings
@@ -29,7 +34,7 @@ from fantoch_tpu.core.clocks import AboveExSet
 # --- AboveExSet vs set model -------------------------------------------------
 
 
-@settings(max_examples=300, deadline=None)
+@settings(max_examples=300 // 4 if _CI else 300, deadline=None)
 @given(st.lists(st.integers(min_value=1, max_value=64), max_size=64))
 def test_above_ex_set_matches_set_model(events):
     eset = AboveExSet()
@@ -47,7 +52,7 @@ def test_above_ex_set_matches_set_model(events):
     assert eset.frontier == f
 
 
-@settings(max_examples=200, deadline=None)
+@settings(max_examples=200 // 4 if _CI else 200, deadline=None)
 @given(
     st.lists(
         st.tuples(
@@ -70,7 +75,7 @@ def test_above_ex_set_add_range_matches_model(ranges):
 # --- VoteRange compression ---------------------------------------------------
 
 
-@settings(max_examples=300, deadline=None)
+@settings(max_examples=300 // 4 if _CI else 300, deadline=None)
 @given(
     st.lists(
         st.tuples(
@@ -104,7 +109,7 @@ def test_vote_range_compression_preserves_votes(ranges):
 # --- dot packing -------------------------------------------------------------
 
 
-@settings(max_examples=200, deadline=None)
+@settings(max_examples=200 // 4 if _CI else 200, deadline=None)
 @given(
     st.lists(
         st.tuples(
@@ -147,7 +152,7 @@ def functional_graphs(draw):
     )
 
 
-@settings(max_examples=60, deadline=None)
+@settings(max_examples=60 // 4 if _CI else 60, deadline=None)
 @given(functional_graphs())
 def test_keyed_resolver_matches_oracle_property(args):
     from test_ops_resolve import assert_keyed_matches_oracle
@@ -155,7 +160,7 @@ def test_keyed_resolver_matches_oracle_property(args):
     assert_keyed_matches_oracle(3, args)
 
 
-@settings(max_examples=60, deadline=None)
+@settings(max_examples=60 // 4 if _CI else 60, deadline=None)
 @given(functional_graphs())
 def test_native_resolver_matches_oracle_property(args):
     from test_native import csr_from_args

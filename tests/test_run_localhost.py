@@ -341,3 +341,11 @@ def test_run_ping_sort_orders_by_latency():
     )
     order = [pid for pid, _ in runtimes[1].sorted_processes]
     assert order == [1, 2, 3], f"delayed peer must sort last: {order}"
+
+
+def test_run_atlas_3_1_two_shards_batched_graph():
+    """Partial replication over real TCP with the tensorized graph
+    executor (VERDICT r3 item 6 done-criterion)."""
+    run_multi_shard_cluster(
+        Atlas, Config(n=3, f=1, batched_graph_executor=True), shard_count=2
+    )

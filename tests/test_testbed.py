@@ -1,0 +1,42 @@
+"""Hosts (SSH/baremetal-analog) testbed: a full experiment through
+exp.testbed.HostsTestbed in local-exec mode — staging, remote-command
+construction, launch, artifact pull — over 127.0.0.1 entries
+(fantoch_exp/src/testbed/baremetal.rs is the reference shape; a real
+cluster only changes the transport to ssh/rsync/scp)."""
+
+import json
+import os
+
+from fantoch_tpu.exp.bench import run_experiment
+from fantoch_tpu.exp.config import ExperimentConfig
+from fantoch_tpu.exp.testbed import HostsTestbed
+from fantoch_tpu.run.harness import free_port
+
+
+def test_hosts_testbed_experiment(tmp_path):
+    testbed = HostsTestbed(
+        ["127.0.0.1", "127.0.0.1", "127.0.0.1"],
+        use_ssh=False,
+        base_port=free_port(),
+    )
+    config = ExperimentConfig(
+        protocol="epaxos", n=3, f=1,
+        clients_per_process=1, commands_per_client=5,
+        conflict_rate=50, keys_per_command=1, payload_size=1,
+    )
+    try:
+        manifest = run_experiment(config, str(tmp_path), testbed=testbed,
+                                  client_timeout_s=180)
+    finally:
+        testbed.cleanup()
+    assert manifest["outcome"]["commands"] == 15
+    assert manifest["testbed"]["kind"] == "hosts"
+    exp_dir = tmp_path / config.name()
+    assert (exp_dir / "manifest.json").exists()
+    assert (exp_dir / "client_summary.json").exists()
+    # artifacts pulled back from the staged workdirs
+    pulled = manifest["testbed"]["pulled"]
+    assert any(p.startswith("metrics_p") for p in pulled), pulled
+    assert any(p.startswith("execution_p") for p in pulled), pulled
+    summary = json.loads((exp_dir / "client_summary.json").read_text())
+    assert summary["commands"] == 15
