@@ -40,12 +40,9 @@ _RESULTS_REL = "testbed_results"
 
 
 def _cli_env() -> Dict[str, str]:
-    env = dict(os.environ)
-    env["FANTOCH_PLATFORM"] = env.get("FANTOCH_PLATFORM", "cpu")
-    env.pop("JAX_PLATFORMS", None)
-    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    return env
+    from fantoch_tpu.exp.testbed import cli_env
+
+    return cli_env()
 
 
 def run_experiment(

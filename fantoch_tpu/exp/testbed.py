@@ -31,6 +31,21 @@ import sys
 from typing import Dict, List, Optional
 
 
+def cli_env(platform: str = "cpu") -> Dict[str, str]:
+    """Environment scrub for framework subprocesses: pin the backend via
+    FANTOCH_PLATFORM (in-Python forcing — a JAX_PLATFORMS env var hangs
+    interpreter start under TPU sitecustomize hooks, so it is stripped),
+    and put the repo on PYTHONPATH."""
+    env = dict(os.environ)
+    env["FANTOCH_PLATFORM"] = env.get("FANTOCH_PLATFORM", platform)
+    env.pop("JAX_PLATFORMS", None)
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
 class LocalTestbed:
     """Subprocesses on this machine behind the HostsTestbed interface."""
 
@@ -77,13 +92,7 @@ class LocalTestbed:
         pre_dirs: Optional[List[str]] = None,
     ) -> subprocess.Popen:
         assert self._workdir is not None, "prepare(exp_dir) first"
-        env = dict(os.environ)
-        env["FANTOCH_PLATFORM"] = env.get("FANTOCH_PLATFORM", "cpu")
-        env.pop("JAX_PLATFORMS", None)
-        repo = os.path.dirname(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        )
-        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env = cli_env()
         for d in pre_dirs or []:
             os.makedirs(os.path.join(self._workdir, d), exist_ok=True)
         return subprocess.Popen(
@@ -123,6 +132,7 @@ class HostsTestbed:
         remote_dir: str = "~/fantoch_tpu_run",
         python: str = "python3",
         base_port: int = 7800,
+        platform: str = "cpu",
         repo_dir: Optional[str] = None,
     ):
         assert hosts, "a hosts testbed needs at least one host"
@@ -131,6 +141,10 @@ class HostsTestbed:
         self.remote_dir = remote_dir
         self.python = python
         self.base_port = base_port
+        # backend the remote servers force in-Python (a TPU cluster passes
+        # platform="tpu" — the transport is the only other difference from
+        # a localhost run)
+        self.platform = platform
         self.repo_dir = repo_dir or os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
@@ -224,7 +238,8 @@ class HostsTestbed:
         # the staged servers (the localhost testbed scrubs it the same way)
         return (
             f"cd {self._workdir(index)} && {mkdirs}"
-            f"exec env -u JAX_PLATFORMS PYTHONPATH=. FANTOCH_PLATFORM=cpu "
+            f"exec env -u JAX_PLATFORMS PYTHONPATH=. "
+            f"FANTOCH_PLATFORM={shlex.quote(self.platform)} "
             f"{shlex.quote(self._python_for(index))} -m {module} {argv}"
         )
 

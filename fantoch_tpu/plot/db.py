@@ -84,11 +84,23 @@ class ResultsDB:
     def __len__(self) -> int:
         return len(self.results)
 
-    def search(self, **filters: Any) -> List[ExperimentResult]:
-        """Results whose config matches every given field, e.g.
-        ``db.search(protocol="epaxos", f=1)``."""
+    def search(self, where=None, **filters: Any) -> List[ExperimentResult]:
+        """Results whose config matches every given field; a filter value
+        may be a predicate over the field (the Search-refine shape of
+        fantoch_plot/src/db), and ``where`` an arbitrary predicate over
+        the whole result.  E.g.::
+
+            db.search(protocol="epaxos", f=1)
+            db.search(clients_per_process=lambda c: c >= 4)
+            db.search(where=lambda r: r.outcome["throughput_cmds_per_s"] > 1e5)
+        """
         out = []
         for result in self.results:
-            if all(result.config.get(k) == v for k, v in filters.items()):
+            ok = all(
+                v(result.config.get(k)) if callable(v)
+                else result.config.get(k) == v
+                for k, v in filters.items()
+            )
+            if ok and (where is None or where(result)):
                 out.append(result)
         return out

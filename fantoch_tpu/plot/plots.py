@@ -180,3 +180,96 @@ def fast_path_split(results: List[ExperimentResult], path: str) -> str:
     fig.savefig(path, dpi=120)
     plt.close(fig)
     return path
+
+
+def heatmap(
+    results: List[ExperimentResult],
+    path: str,
+    x_field: str = "workers",
+    y_field: str = "executors",
+    value: str = "throughput_cmds_per_s",
+) -> str:
+    """Config-grid heatmap (lib.rs heatmap_plot:870-917 analog): one cell
+    per (x_field, y_field) config pair, colored by an outcome metric —
+    the reference uses it for per-process CPU over protocol x clients;
+    any two ExperimentConfig fields work here."""
+    xs = sorted({r.config[x_field] for r in results})
+    ys = sorted({r.config[y_field] for r in results})
+    grid = np.full((len(ys), len(xs)), np.nan)
+    for r in results:
+        i = ys.index(r.config[y_field])
+        j = xs.index(r.config[x_field])
+        cell = r.outcome[value]
+        if np.isnan(grid[i, j]) or cell > grid[i, j]:
+            grid[i, j] = cell  # several client counts: keep the max
+    fig, ax = plt.subplots(figsize=(1.2 + len(xs), 1.0 + len(ys)))
+    im = ax.imshow(grid, origin="lower", aspect="auto", cmap="viridis")
+    for i in range(len(ys)):
+        for j in range(len(xs)):
+            if not np.isnan(grid[i, j]):
+                ax.text(j, i, f"{grid[i, j]:.0f}", ha="center", va="center",
+                        color="w", fontsize=8)
+    ax.set_xticks(range(len(xs)), [str(x) for x in xs])
+    ax.set_yticks(range(len(ys)), [str(y) for y in ys])
+    ax.set_xlabel(x_field)
+    ax.set_ylabel(y_field)
+    fig.colorbar(im, ax=ax, label=value)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+def intra_machine_scalability(
+    results: List[ExperimentResult], path: str, x_field: str = "workers"
+) -> str:
+    """Max throughput as intra-process parallelism grows (lib.rs
+    intra_machine_scalability_plot:919-974): one line per protocol, x =
+    the parallelism knob, y = best throughput over client counts."""
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    by_proto = {}
+    for r in results:
+        by_proto.setdefault(r.config["protocol"], {})
+        knob = r.config[x_field]
+        cur = by_proto[r.config["protocol"]].get(knob, 0)
+        by_proto[r.config["protocol"]][knob] = max(
+            cur, r.outcome["throughput_cmds_per_s"]
+        )
+    for proto, series in sorted(by_proto.items()):
+        xs = sorted(series)
+        ax.plot(xs, [series[x] for x in xs], marker="o", label=proto)
+    ax.set_xlabel(x_field)
+    ax.set_ylabel("max throughput (cmds/s)")
+    ax.legend()
+    ax.grid(alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+def inter_machine_scalability(results: List[ExperimentResult], path: str) -> str:
+    """Grouped throughput bars as the site count grows (lib.rs
+    inter_machine_scalability_plot:976-1120): x = n, one bar per
+    protocol, height = best throughput over client counts."""
+    ns = sorted({r.config["n"] for r in results})
+    protos = sorted({r.config["protocol"] for r in results})
+    best = {}
+    for r in results:
+        key = (r.config["protocol"], r.config["n"])
+        best[key] = max(best.get(key, 0), r.outcome["throughput_cmds_per_s"])
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    width = 0.8 / max(len(protos), 1)
+    xs = np.arange(len(ns))
+    for j, proto in enumerate(protos):
+        vals = [best.get((proto, n), 0) for n in ns]
+        ax.bar(xs + j * width, vals, width, label=proto)
+    ax.set_xticks(xs + width * (len(protos) - 1) / 2)
+    ax.set_xticklabels([f"n={n}" for n in ns])
+    ax.set_ylabel("max throughput (cmds/s)")
+    ax.legend()
+    ax.grid(axis="y", alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path

@@ -47,7 +47,10 @@ class WarnQueue(asyncio.Queue):
 
     def get_nowait(self) -> Any:  # type: ignore[override]
         item = super().get_nowait()
-        if self.qsize() < self._warn_size:
+        # hysteresis: re-arm only once the queue genuinely drained (half
+        # the threshold) — a queue hovering AT the threshold must not warn
+        # on every put
+        if self.qsize() < self._warn_size // 2:
             self._warn_next = self._warn_size
         return item
 

@@ -352,6 +352,12 @@ class ProcessRuntime:
         if self.execution_logger is not None:
             self.spawn(self._execution_log_flush_task())
         if self.tracer_show_interval_ms is not None:
+            # the span-subscriber analog: enabling the tracer installs
+            # latency spans over the hot paths automatically
+            # (fantoch_prof/src/lib.rs:78-136 via utils/prof.py)
+            from fantoch_tpu.utils import prof
+
+            prof.auto_instrument()
             self.spawn(self._tracer_task())
         self._connected.set()
 

@@ -51,6 +51,81 @@ def profiled(fn: Callable) -> Callable:
     return wrapper
 
 
+# --- auto-instrumentation (the span-subscriber analog) ---------------------
+#
+# The reference annotates hot functions with #[instrument] and the
+# ProfSubscriber aggregates every span automatically
+# (fantoch_prof/src/lib.rs:78-136).  Python's analog: install wrappers
+# over the framework's hot-path methods at runtime — no call-site edits,
+# one switch to turn the tripwire on.
+
+# (class path, method) pairs covering the reference's instrumented set
+# (fantoch's #[instrument] spans sit on the protocol handlers and the
+# executor entry points)
+_HOT_PATHS = [
+    ("fantoch_tpu.protocol.base:Protocol", ("submit", "handle", "handle_event")),
+    ("fantoch_tpu.executor.base:Executor", ("handle", "handle_batch")),
+    (
+        "fantoch_tpu.executor.graph.deps_graph:DependencyGraph",
+        ("handle_add", "commands_to_execute"),
+    ),
+]
+_instrumented: list = []
+
+
+def _wrap_method(cls, name: str) -> None:
+    fn = cls.__dict__.get(name)
+    if fn is None or getattr(fn, "_prof_wrapped", False):
+        return
+    wrapped = profiled(fn)
+    wrapped._prof_wrapped = True  # type: ignore[attr-defined]
+    setattr(cls, name, wrapped)
+    _instrumented.append((cls, name, fn))
+
+
+def auto_instrument(extra: Iterator = ()) -> int:
+    """Install latency spans over the framework's hot paths (and any
+    ``extra`` (cls, method-names) pairs): every subclass handler inherits
+    the span through the base class unless it overrides the method, in
+    which case the override is wrapped too.  Returns the number of
+    methods instrumented; ``uninstrument()`` restores them."""
+    import importlib
+
+    count = 0
+    specs = list(_HOT_PATHS)
+    for spec in specs:
+        path, methods = spec
+        module_name, cls_name = path.split(":")
+        cls = getattr(importlib.import_module(module_name), cls_name)
+        targets = [cls] + [c for c in _all_subclasses(cls)]
+        for target in targets:
+            for method in methods:
+                before = len(_instrumented)
+                _wrap_method(target, method)
+                count += len(_instrumented) - before
+    for cls, methods in extra:
+        for method in methods:
+            before = len(_instrumented)
+            _wrap_method(cls, method)
+            count += len(_instrumented) - before
+    return count
+
+
+def _all_subclasses(cls) -> set:
+    out = set()
+    for sub in cls.__subclasses__():
+        out.add(sub)
+        out |= _all_subclasses(sub)
+    return out
+
+
+def uninstrument() -> None:
+    """Undo auto_instrument (restores the original methods)."""
+    while _instrumented:
+        cls, name, fn = _instrumented.pop()
+        setattr(cls, name, fn)
+
+
 def snapshot() -> Dict[str, Histogram]:
     """Copy of the collected histograms (name -> Histogram)."""
     with _lock:

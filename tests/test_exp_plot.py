@@ -90,3 +90,54 @@ def test_run_experiments_db_and_plots(tmp_path):
     # the monitor wrote at least the header during the run
     for result in db.results:
         assert os.path.exists(os.path.join(result.path, "resources.csv"))
+
+
+def test_scalability_and_heatmap_plots(tmp_path):
+    """The lib.rs:870-1120 analogs over synthetic manifests: heatmap over
+    a config grid, intra-machine (workers) and inter-machine (n)
+    scalability, plus predicate search over the DB."""
+    import json
+
+    out = tmp_path / "grid"
+    cases = [
+        ("epaxos", 3, 1, 1, 900.0),
+        ("epaxos", 3, 2, 1, 1500.0),
+        ("epaxos", 3, 1, 2, 1100.0),
+        ("epaxos", 3, 2, 2, 2400.0),
+        ("epaxos", 5, 2, 2, 2000.0),
+        ("newt", 3, 1, 1, 800.0),
+        ("newt", 5, 1, 1, 700.0),
+    ]
+    for i, (proto, n, workers, executors, thr) in enumerate(cases):
+        cfg = ExperimentConfig(
+            proto, n, 1, workers=workers, executors=executors,
+            clients_per_process=i + 1,  # distinct names
+        )
+        exp_dir = out / cfg.name()
+        exp_dir.mkdir(parents=True)
+        (exp_dir / "manifest.json").write_text(json.dumps({
+            "config": cfg.to_dict(),
+            "name": cfg.name(),
+            "outcome": {
+                "commands": 10,
+                "latency_ms": {"p50": 5.0},
+                "wall_s": 1.0,
+                "throughput_cmds_per_s": thr,
+            },
+        }))
+    db = ResultsDB(str(out))
+    assert len(db) == len(cases)
+
+    # predicate search (the Search-refine analog)
+    assert len(db.search(protocol="epaxos")) == 5
+    assert len(db.search(workers=lambda w: w >= 2)) == 3
+    fast = db.search(where=lambda r: r.outcome["throughput_cmds_per_s"] > 1000)
+    assert len(fast) == 4
+
+    grid = db.search(protocol="epaxos", n=3)
+    p = plots.heatmap(grid, str(tmp_path / "heat.png"))
+    assert os.path.getsize(p) > 1000
+    p = plots.intra_machine_scalability(grid, str(tmp_path / "intra.png"))
+    assert os.path.getsize(p) > 1000
+    p = plots.inter_machine_scalability(db.results, str(tmp_path / "inter.png"))
+    assert os.path.getsize(p) > 1000

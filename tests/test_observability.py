@@ -103,3 +103,38 @@ def test_cluster_observability_and_replay(tmp_path):
         summary = replay_execution_log(path, EPaxos, pid, 0, config)
         # every key of every command produces one executor result
         assert summary["results"] == 15 * 2  # keys_per_command = 2
+
+
+def test_prof_auto_instrument_spans():
+    """The span-subscriber analog (fantoch_prof/src/lib.rs:78-136):
+    auto_instrument wraps the hot-path methods of every protocol/executor
+    subclass; driving a whole sim populates per-function histograms with
+    no call-site edits; uninstrument restores the originals."""
+    from fantoch_tpu.core.config import Config
+    from fantoch_tpu.protocol import EPaxos
+    from fantoch_tpu.utils import prof
+
+    import sys as _sys, os as _os
+    _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+    from harness import sim_test
+
+    prof.reset()
+    count = prof.auto_instrument()
+    try:
+        assert count > 0
+        sim_test(EPaxos, Config(3, 1))
+        snap = prof.snapshot()
+        protocol_spans = [k for k in snap if k.endswith(".handle")]
+        executor_spans = [k for k in snap if "handle_batch" in k]
+        assert protocol_spans, sorted(snap)
+        assert executor_spans, sorted(snap)
+        assert all(snap[k].count > 0 for k in protocol_spans)
+        formatted = prof.format_snapshot()
+        assert "p99" in formatted
+    finally:
+        prof.uninstrument()
+        prof.reset()
+    # originals restored: no double-wrapping markers left behind
+    from fantoch_tpu.protocol.graph_protocol import GraphProtocol
+
+    assert not getattr(GraphProtocol.handle, "_prof_wrapped", False)
