@@ -9,7 +9,7 @@ test:
 # the CI-shrunk load (tests/harness.py COMMANDS_PER_CLIENT, hypothesis
 # max_examples both scale down under CI=true)
 test-fast:
-	CI=true python -m pytest tests/ -x -q
+	CI=true python -m pytest tests/ -x -q -m "not slow"
 
 dryrun:
 	python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"

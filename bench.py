@@ -228,6 +228,11 @@ def child_main(mode: str) -> None:
     except Exception as exc:  # noqa: BLE001
         print(f"# table-path bench failed: {exc!r}", file=sys.stderr)
         record["table_error"] = repr(exc)[:200]
+    try:
+        record.update(bench_device_serving())
+    except Exception as exc:  # noqa: BLE001
+        print(f"# device-serving bench failed: {exc!r}", file=sys.stderr)
+        record["serving_error"] = repr(exc)[:200]
 
     print(json.dumps(record), flush=True)
 
@@ -503,6 +508,47 @@ def bench_table_path(batch: int = 100_000, keys: int = 4096, n: int = 3):
         "table_cmds_per_s": int(
             batch / ((batched_ms + exec_batched_ms) / 1000.0)
         ),
+    }
+
+
+def bench_device_serving(
+    total: int = 32_768, batch: int = 4096, conflict: float = 0.5, n: int = 3
+):
+    """The served TPU path (run/device_runner.DeviceDriver): real Command
+    objects through the device protocol round — batch assembly, the
+    donated-state jit dispatch, and KVStore execution in device order —
+    measured as steady-state rounds (first round excluded: it compiles).
+    This is the round trip a `--device-step` server pays per batch."""
+    import numpy as np
+
+    from fantoch_tpu.core import Command, Dot, KVOp, Rifl
+    from fantoch_tpu.run.device_runner import DeviceDriver
+
+    rng = np.random.default_rng(21)
+    hot = rng.random(total) < conflict
+    keys = np.where(hot, 0, 1 + rng.integers(0, 4096, size=total))
+    cmds = [
+        (
+            Dot(1, i + 1),
+            Command.from_single(
+                Rifl(1, i + 1), 0, f"sk{keys[i]}", KVOp.put("")
+            ),
+        )
+        for i in range(total)
+    ]
+    driver = DeviceDriver(n, batch_size=batch, key_buckets=8192)
+    driver.step(cmds[:batch])  # compile + warm
+    t0 = time.perf_counter()
+    served = 0
+    for start in range(batch, total, batch):
+        served += len(driver.step(cmds[start : start + batch]))
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+    rounds = (total - batch) // batch
+    assert served == total - batch, f"served {served}/{total - batch}"
+    return {
+        "serving_batch": batch,
+        "serving_round_ms": round(wall_ms / rounds, 2),
+        "serving_cmds_per_s": int(served / (wall_ms / 1000.0)),
     }
 
 

@@ -34,8 +34,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--protocol", required=True,
                         help="basic|epaxos|atlas|newt|caesar|fpaxos; with "
                         "--device-step the protocol round runs as one device "
-                        "program (EPaxos-style dep-commit) and this flag only "
-                        "labels the deployment")
+                        "program: 'newt' serves the timestamp-consensus round, "
+                        "anything else the EPaxos-style dep-commit round")
     parser.add_argument("--id", type=int, default=None,
                         help="process id (required without --device-step)")
     parser.add_argument("--shard-id", type=int, default=0)
@@ -95,6 +95,7 @@ async def serve_device_step(args: argparse.Namespace) -> None:
     runtime = DeviceRuntime(
         config,
         (args.ip, args.client_port),
+        protocol=args.protocol,
         process_id=process_id,
         batch_size=args.device_batch,
         key_buckets=args.device_key_buckets,

@@ -714,11 +714,20 @@ def newt_protocol_step(
         sort_key = jnp.where(executed, clock, jnp.iinfo(jnp.int32).max)
         order = jnp.lexsort((seq_f, src_f, sort_key)).astype(jnp.int32)
 
-        # pending carry: valid unexecuted rows (uncommitted or unstable)
+        # pending carry: valid unexecuted rows (uncommitted or unstable).
+        # Committed rows take priority — their clocks already entered the
+        # key/vote tables, so dropping one would force a re-proposal at a
+        # higher clock and break the committed (clock, dot) order; an
+        # uncommitted drop merely retries.  Within each class, working
+        # order is preserved (stable sort keys).
         carry = valid & ~executed
-        carry_order = jnp.argsort(
-            jnp.where(carry, widx, jnp.iinfo(jnp.int32).max)
-        ).astype(jnp.int32)
+        work32 = jnp.int32(work)
+        carry_rank = jnp.where(
+            carry,
+            jnp.where(committed, widx, widx + work32),
+            jnp.iinfo(jnp.int32).max,
+        )
+        carry_order = jnp.argsort(carry_rank).astype(jnp.int32)
         take = carry_order[:pend_cap]
         is_carry = carry[take]
         new_pend_key = jnp.where(is_carry, key_cat[take], KEY_PAD)

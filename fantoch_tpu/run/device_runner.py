@@ -231,6 +231,165 @@ class DeviceDriver:
         return out
 
 
+class NewtDeviceDriver:
+    """Host control loop around the device-resident Newt timestamp round
+    (parallel/mesh_step.newt_protocol_step): proposals, pmax commit
+    clocks, count-of-max fast path and order-statistic stability all run
+    as one device program; the host executes stable commands in
+    (clock, dot) order against the KVStore.
+
+    Single-key commands only (the Newt mesh round models one key bucket
+    per command); multi-key workloads serve through the table/TCP path.
+    Commands are identified by their dot (timestamp ordering needs no
+    gid), so the registry keys on packed (source, sequence).
+    """
+
+    def __init__(
+        self,
+        num_replicas: int,
+        *,
+        f: int = 1,
+        tiny_quorums: bool = False,
+        batch_size: int = 256,
+        key_buckets: int = 4096,
+        pending_capacity: int = 256,
+        live_replicas: Optional[int] = None,
+        shard_id: ShardId = 0,
+        monitor_execution_order: bool = False,
+        mesh=None,
+    ):
+        from fantoch_tpu.parallel import mesh_step
+
+        self.shard_id = shard_id
+        self.batch_size = batch_size
+        self.key_buckets = key_buckets
+        self._mesh = (
+            mesh
+            if mesh is not None
+            else mesh_step.make_mesh(num_replicas=num_replicas)
+        )
+        self._state = mesh_step.init_newt_state(
+            self._mesh,
+            num_replicas,
+            key_buckets=key_buckets,
+            pending_capacity=pending_capacity,
+        )
+        self._step = mesh_step.jit_newt_step(
+            self._mesh, f=f, tiny_quorums=tiny_quorums, live_replicas=live_replicas
+        )
+        self._cmds: Dict[int, Tuple[Dot, Command]] = {}  # packed dot -> entry
+        self._requeue: List[Tuple[Dot, Command]] = []
+        # host mirror of the device pending buffer's (src, seq) identity
+        # columns (the step outputs index working rows = pending + batch;
+        # identities never need a device round-trip)
+        cap = pending_capacity
+        self._pend_src = np.zeros(cap, dtype=np.int32)
+        self._pend_seq = np.zeros(cap, dtype=np.int32)
+        self.store = KVStore(monitor_execution_order)
+        self.rounds = 0
+        self.fast_paths = 0
+        self.slow_paths = 0
+        self.executed = 0
+        self.stable_watermark = 0
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._cmds)
+
+    def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
+        import jax.numpy as jnp
+
+        from fantoch_tpu.parallel.mesh_step import KEY_PAD
+
+        assert len(batch) <= self.batch_size
+        b = self.batch_size
+        key = np.full(b, KEY_PAD, dtype=np.int32)
+        src = np.zeros(b, dtype=np.int32)
+        seq = np.zeros(b, dtype=np.int32)
+        for i, (dot, cmd) in enumerate(batch):
+            keys = list(cmd.keys(self.shard_id))
+            assert len(keys) == 1, (
+                "the Newt device round serves single-key commands; "
+                f"got {len(keys)} keys"
+            )
+            # int32 device columns: a wrapped sequence would alias an
+            # in-flight registry key — fail loudly like the gid guard
+            assert dot.sequence < 2**31 - 1, "dot sequence exhausts int32"
+            key[i] = key_hash(keys[0]) % self.key_buckets
+            src[i] = dot.source
+            seq[i] = dot.sequence
+            self._cmds[(int(src[i]) << 32) | int(seq[i])] = (dot, cmd)
+
+        # this round's working-row identities: pending buffer first
+        work_src = np.concatenate([self._pend_src, src])
+        work_seq = np.concatenate([self._pend_seq, seq])
+
+        self._state, out = self._step(
+            self._state, jnp.asarray(key), jnp.asarray(src), jnp.asarray(seq)
+        )
+        self.rounds += 1
+
+        order = np.asarray(out.order)
+        executed = np.asarray(out.executed)
+        committed = np.asarray(out.committed)
+        self.stable_watermark = int(out.stable_watermark)
+        self.slow_paths += int(out.slow_paths)
+        # fast/slow tallies are commit-time facts: a fast-committed command
+        # may only *stabilize* (execute) rounds later, when the flag is no
+        # longer set — counting at execution would undercount
+        self.fast_paths += int(np.asarray(out.fast_path).sum())
+
+        results: List[ExecutorResult] = []
+        for w in order.tolist():
+            if not executed[w]:
+                continue
+            packed = (int(work_src[w]) << 32) | int(work_seq[w])
+            entry = self._cmds.pop(packed, None)
+            if entry is None:
+                continue  # pad row
+            _dot, cmd = entry
+            results.extend(cmd.execute(self.shard_id, self.store))
+            self.executed += 1
+
+        # after the pops, registry keys == this round's carried rows.
+        # Mirror the device's carry: committed rows first (both classes in
+        # working order), first pend_cap kept.  An *uncommitted* overflow
+        # row re-enters the submit queue under the same dot (a retry); a
+        # committed drop can never be replayed safely (its clock already
+        # entered the replicas' tables) — the carry prioritization makes
+        # that a genuine capacity overload, which fails loudly.
+        pend_cap = len(self._pend_src)
+        carried = [
+            w
+            for w in range(len(work_src))
+            if ((int(work_src[w]) << 32) | int(work_seq[w])) in self._cmds
+        ]
+        carried.sort(key=lambda w: (not committed[w], w))
+        kept, dropped = carried[:pend_cap], carried[pend_cap:]
+        self._pend_src = np.zeros(pend_cap, dtype=np.int32)
+        self._pend_seq = np.zeros(pend_cap, dtype=np.int32)
+        for slot, w in enumerate(kept):
+            self._pend_src[slot] = work_src[w]
+            self._pend_seq[slot] = work_seq[w]
+        for w in dropped:
+            if committed[w]:
+                raise RuntimeError(
+                    "newt device pending buffer overflowed with committed-"
+                    "but-unstable commands: raise pending_capacity (a "
+                    "committed clock cannot be re-proposed)"
+                )
+            packed = (int(work_src[w]) << 32) | int(work_seq[w])
+            entry = self._cmds.pop(packed, None)
+            if entry is not None:
+                logger.warning("newt device pending overflow: re-queueing %s", entry[0])
+                self._requeue.append(entry)
+        return results
+
+    def take_requeue(self) -> List[Tuple[Dot, Command]]:
+        out, self._requeue = self._requeue, []
+        return out
+
+
 class _DeviceClientSession:
     """Server side of one client connection against the device driver
     (the client.rs:79-260 role, minus dot routing — the driver orders)."""
@@ -298,6 +457,7 @@ class DeviceRuntime:
         config: Config,
         client_addr: Address,
         *,
+        protocol: str = "epaxos",
         process_id: ProcessId = 1,
         batch_size: int = 256,
         key_buckets: int = 4096,
@@ -313,16 +473,34 @@ class DeviceRuntime:
         self.config = config
         self.process_id = process_id
         self.client_addr = client_addr
-        self.driver = DeviceDriver(
-            config.n,
-            batch_size=batch_size,
-            key_buckets=key_buckets,
-            key_width=key_width,
-            pending_capacity=pending_capacity,
-            live_replicas=live_replicas,
-            monitor_execution_order=monitor_execution_order,
-            mesh=mesh,
-        )
+        if protocol == "newt":
+            assert key_width == 1, (
+                "the Newt device round serves single-key commands; "
+                "key_width > 1 would fail per-command at serve time"
+            )
+            self.driver = NewtDeviceDriver(
+                config.n,
+                f=config.f,
+                tiny_quorums=config.newt_tiny_quorums,
+                batch_size=batch_size,
+                key_buckets=key_buckets,
+                pending_capacity=pending_capacity,
+                live_replicas=live_replicas,
+                monitor_execution_order=monitor_execution_order,
+                mesh=mesh,
+            )
+        else:
+            # the EPaxos-style dep-commit round serves every other label
+            self.driver = DeviceDriver(
+                config.n,
+                batch_size=batch_size,
+                key_buckets=key_buckets,
+                key_width=key_width,
+                pending_capacity=pending_capacity,
+                live_replicas=live_replicas,
+                monitor_execution_order=monitor_execution_order,
+                mesh=mesh,
+            )
         self.dot_gen = AtomicIdGen(process_id)
         self.client_sessions: Dict[ClientId, _DeviceClientSession] = {}
         self._submit_queue: Deque[Tuple[Dot, Command]] = deque()

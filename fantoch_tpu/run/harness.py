@@ -161,6 +161,7 @@ async def run_device_server(
     workload: Workload,
     client_count: int,
     *,
+    protocol: str = "epaxos",
     batch_size: int = 64,
     key_buckets: int = 1024,
     key_width: int = 1,
@@ -178,6 +179,7 @@ async def run_device_server(
     runtime = DeviceRuntime(
         config,
         ("127.0.0.1", port),
+        protocol=protocol,
         batch_size=batch_size,
         key_buckets=key_buckets,
         key_width=key_width,

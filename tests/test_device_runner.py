@@ -136,3 +136,46 @@ def test_device_runtime_multi_key_tcp():
         assert client.issued_commands == 5
     assert runtime.driver.executed == 10
     assert runtime.driver.in_flight == 0
+
+
+def test_newt_driver_hot_key_chain():
+    """The Newt device driver orders a hot key by (clock, dot) and the
+    key clock carries across rounds (second protocol family served)."""
+    from fantoch_tpu.run.device_runner import NewtDeviceDriver
+
+    d = NewtDeviceDriver(3, batch_size=16, key_buckets=64,
+                         monitor_execution_order=True)
+    batch = [
+        (Dot(1, i + 1), Command.from_single(Rifl(1, i + 1), 0, "hot", KVOp.put(str(i))))
+        for i in range(10)
+    ]
+    results = d.step(batch)
+    assert [r.op_results[0] for r in results] == [None] + [str(i) for i in range(9)]
+    assert d.executed == 10 and d.in_flight == 0
+    assert d.fast_paths == 10  # identical replica clocks: all fast
+    (r,) = d.step(
+        [(Dot(1, 11), Command.from_single(Rifl(1, 11), 0, "hot", KVOp.put("x")))]
+    )
+    assert r.op_results[0] == "9"
+
+
+def test_device_runtime_newt_tcp_serving():
+    """Real TCP clients served through the Newt timestamp round."""
+    config = Config(3, 1, shard_count=1)
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=1,
+        commands_per_client=COMMANDS_PER_CLIENT,
+        payload_size=1,
+    )
+    runtime, clients = asyncio.run(
+        run_device_server(
+            config, workload, client_count=4, batch_size=32, protocol="newt"
+        )
+    )
+    assert len(clients) == 4
+    for client in clients.values():
+        assert client.issued_commands == COMMANDS_PER_CLIENT
+    assert runtime.driver.executed == 4 * COMMANDS_PER_CLIENT
+    assert runtime.driver.in_flight == 0

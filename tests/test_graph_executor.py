@@ -138,6 +138,7 @@ def test_transitive_conflicts_assumption_regression_2(graph_cls):
     assert a != b
 
 
+@pytest.mark.slow
 def test_cycle():
     d1, d2, d3 = Dot(1, 1), Dot(2, 1), Dot(3, 1)
     args = [(d1, None, {d3}), (d2, None, {d1}), (d3, None, {d2})]
@@ -174,6 +175,7 @@ def random_adds(n, events_per_process, rng):
     return [(dot, sorted(keys[dot]), deps[dot]) for dot in dots]
 
 
+@pytest.mark.slow
 def test_add_random():
     rng = random.Random(0)
     n = 2
