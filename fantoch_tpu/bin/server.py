@@ -77,7 +77,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--multiplexing", type=int, default=1,
                         help="TCP connections per peer (random writer pick, "
                         "process.rs:71-97)")
-    parser.add_argument("--metrics-file", default=None)
+    parser.add_argument("--metrics-file", default=None,
+                        help="periodic crash-consistent snapshots; gzip+pickle "
+                        "ProcessMetrics normally, JSON round/path tallies "
+                        "under --device-step")
     parser.add_argument("--metrics-interval", type=int, default=5000, metavar="MS")
     parser.add_argument("--execution-log", default=None)
     parser.add_argument("--tracer-show-interval", type=int, default=None, metavar="MS")
@@ -102,6 +105,8 @@ async def serve_device_step(args: argparse.Namespace) -> None:
         key_width=args.device_key_width,
         pending_capacity=args.device_pending,
         monitor_execution_order=config.executor_monitor_execution_order,
+        metrics_file=args.metrics_file,
+        metrics_interval_ms=args.metrics_interval,
     )
     await runtime.start()
     print(

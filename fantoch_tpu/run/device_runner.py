@@ -465,6 +465,8 @@ class DeviceRuntime:
         pending_capacity: int = 256,
         live_replicas: Optional[int] = None,
         monitor_execution_order: bool = False,
+        metrics_file: Optional[str] = None,
+        metrics_interval_ms: int = 5000,
         mesh=None,
     ):
         assert config.shard_count == 1, "device-step serving is single-shard"
@@ -502,6 +504,8 @@ class DeviceRuntime:
                 mesh=mesh,
             )
         self.dot_gen = AtomicIdGen(process_id)
+        self.metrics_file = metrics_file
+        self.metrics_interval_ms = metrics_interval_ms
         self.client_sessions: Dict[ClientId, _DeviceClientSession] = {}
         self._submit_queue: Deque[Tuple[Dot, Command]] = deque()
         self._work = asyncio.Event()
@@ -540,11 +544,41 @@ class DeviceRuntime:
         server = await asyncio.start_server(self._on_client, *self.client_addr)
         self._servers = [server]
         self.spawn(self._driver_task())
+        if self.metrics_file is not None:
+            self.spawn(self._metrics_task())
+
+    def _write_metrics_snapshot(self) -> None:
+        """Crash-consistent JSON tallies of the device rounds (the
+        metrics-logger analog for the serving mode — round/path counts
+        instead of per-message histograms; NOTE the on-disk format is JSON,
+        not the process runner's gzip+pickle ProcessMetrics)."""
+        from fantoch_tpu.run.observe import write_json_snapshot
+
+        d = self.driver
+        write_json_snapshot(
+            self.metrics_file,
+            {
+                "rounds": d.rounds,
+                "executed": d.executed,
+                "fast_paths": d.fast_paths,
+                "slow_paths": d.slow_paths,
+                "in_flight": d.in_flight,
+                "stable_watermark": d.stable_watermark,
+                "queued": len(self._submit_queue),
+            },
+        )
+
+    async def _metrics_task(self) -> None:
+        while True:
+            await asyncio.sleep(self.metrics_interval_ms / 1000)
+            self._write_metrics_snapshot()
 
     async def stop(self) -> None:
         tasks = list(self._tasks)
         self._teardown()
         await asyncio.gather(*tasks, return_exceptions=True)
+        if self.metrics_file is not None:
+            self._write_metrics_snapshot()
 
     # --- client plane ---
 

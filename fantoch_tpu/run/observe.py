@@ -45,6 +45,18 @@ def write_metrics_snapshot(path: str, metrics: ProcessMetrics) -> None:
     os.replace(tmp, path)
 
 
+def write_json_snapshot(path: str, obj) -> None:
+    """Crash-consistent JSON snapshot (same tmp+rename discipline as the
+    pickle variant); used by the device-serving runtime, whose metrics are
+    round/path tallies rather than per-message histograms."""
+    import json
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(obj, fh)
+    os.replace(tmp, path)
+
+
 def read_metrics_snapshot(path: str) -> ProcessMetrics:
     with gzip.open(path, "rb") as fh:
         out = pickle.load(fh)

@@ -131,6 +131,8 @@ def test_cli_device_step_server(tmp_path):
             "--client-port", str(port),
             "--device-batch", "32",
             "-n", "3", "-f", "1",
+            "--metrics-file", str(tmp_path / "device_metrics.json"),
+            "--metrics-interval", "300",
         ],
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
@@ -154,6 +156,9 @@ def test_cli_device_step_server(tmp_path):
         assert summary["clients"] == 2
         assert summary["commands"] == 20
         assert summary["latency_ms"]["p50"] is not None
+        time.sleep(0.5)
+        snap = json.loads((tmp_path / "device_metrics.json").read_text())
+        assert snap["executed"] >= 1 and snap["rounds"] >= 1
     finally:
         server.send_signal(signal.SIGINT)
         try:
