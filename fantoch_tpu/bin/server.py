@@ -114,8 +114,14 @@ async def serve_device_step(args: argparse.Namespace) -> None:
         f"{args.ip}:{args.client_port}",
         flush=True,
     )
-    await runtime.failed.wait()
-    raise SystemExit(f"p{process_id} failed: {runtime.failure!r}")
+    try:
+        await runtime.failed.wait()
+        raise SystemExit(f"p{process_id} failed: {runtime.failure!r}")
+    finally:
+        # runs under task cancellation too (Ctrl-C through asyncio.run):
+        # short serves must still leave a final metrics snapshot
+        if runtime.metrics_file is not None:
+            runtime._write_metrics_snapshot()
 
 
 async def serve(args: argparse.Namespace) -> None:

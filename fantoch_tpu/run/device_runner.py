@@ -508,6 +508,8 @@ class DeviceRuntime:
         self.metrics_interval_ms = metrics_interval_ms
         self.client_sessions: Dict[ClientId, _DeviceClientSession] = {}
         self._submit_queue: Deque[Tuple[Dot, Command]] = deque()
+        self._tallies: Dict[str, int] = {}
+        self._publish_tallies()
         self._work = asyncio.Event()
         self._tasks: set = set()
         self._servers: List[Any] = []
@@ -547,6 +549,22 @@ class DeviceRuntime:
         if self.metrics_file is not None:
             self.spawn(self._metrics_task())
 
+    def _publish_tallies(self) -> None:
+        """Called on the event-loop thread between device rounds (never
+        concurrently with driver.step, which runs to completion on the
+        pool thread before the loop resumes): the snapshot task reads this
+        consistent copy, not live counters mid-mutation."""
+        d = self.driver
+        self._tallies = {
+            "rounds": d.rounds,
+            "executed": d.executed,
+            "fast_paths": d.fast_paths,
+            "slow_paths": d.slow_paths,
+            "in_flight": d.in_flight,
+            "stable_watermark": d.stable_watermark,
+            "queued": len(self._submit_queue),
+        }
+
     def _write_metrics_snapshot(self) -> None:
         """Crash-consistent JSON tallies of the device rounds (the
         metrics-logger analog for the serving mode — round/path counts
@@ -554,19 +572,7 @@ class DeviceRuntime:
         not the process runner's gzip+pickle ProcessMetrics)."""
         from fantoch_tpu.run.observe import write_json_snapshot
 
-        d = self.driver
-        write_json_snapshot(
-            self.metrics_file,
-            {
-                "rounds": d.rounds,
-                "executed": d.executed,
-                "fast_paths": d.fast_paths,
-                "slow_paths": d.slow_paths,
-                "in_flight": d.in_flight,
-                "stable_watermark": d.stable_watermark,
-                "queued": len(self._submit_queue),
-            },
-        )
+        write_json_snapshot(self.metrics_file, dict(self._tallies))
 
     async def _metrics_task(self) -> None:
         while True:
@@ -614,3 +620,4 @@ class DeviceRuntime:
             # result flushes stay live during the round
             results = await loop.run_in_executor(None, driver.step, batch)
             self._deliver(results)
+            self._publish_tallies()
