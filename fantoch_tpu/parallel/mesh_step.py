@@ -801,3 +801,207 @@ def jit_newt_step(
         ),
         donate_argnums=(0,),
     )
+
+
+# ---------------------------------------------------------------------------
+# leader-based (FPaxos / MultiPaxos) slot round: the third consensus class
+# ---------------------------------------------------------------------------
+
+
+class PaxosMeshState(NamedTuple):
+    """Device state for the leader-based slot round.
+
+    ``next_slot``: the leader's next log slot.  ``exec_frontier``: slots
+    executed so far (execution is in contiguous slot order — the
+    SlotExecutor contract, fantoch_tpu/executor/slot.py).  Pending buffer
+    carries accepted-but-uncommitted commands with their slots (a leader
+    retries the SAME slot after a failed accept round — MultiPaxos
+    slot stickiness, fantoch_tpu/protocol/common/multi_synod.py)."""
+
+    next_slot: jax.Array  # int32[]
+    exec_frontier: jax.Array  # int32[] — slots < this executed
+    pend_slot: jax.Array  # int32[Pcap] (-1 empty)
+    pend_src: jax.Array  # int32[Pcap]
+    pend_seq: jax.Array  # int32[Pcap]
+
+
+class PaxosStepOutput(NamedTuple):
+    order: jax.Array  # int32[W] — executed rows in slot order first
+    executed: jax.Array  # bool[W]
+    committed: jax.Array  # bool[W]
+    slot: jax.Array  # int32[W] (-1 = pad row)
+    pending: jax.Array  # int32[]
+    pend_dropped: jax.Array  # int32[]
+
+
+def init_paxos_state(
+    mesh: Mesh, pending_capacity: int = 256
+) -> PaxosMeshState:
+    rep = NamedSharding(mesh, P())
+
+    def pend(value):
+        return jax.device_put(
+            jnp.full((pending_capacity,), value, dtype=jnp.int32), rep
+        )
+
+    return PaxosMeshState(
+        jax.device_put(jnp.int32(0), rep),
+        jax.device_put(jnp.int32(0), rep),
+        pend(-1), pend(-1), pend(-1),
+    )
+
+
+def paxos_protocol_step(
+    state: PaxosMeshState,
+    valid: jax.Array,  # bool[B] — real command rows (pads False)
+    dot_src: jax.Array,  # int32[B]
+    dot_seq: jax.Array,  # int32[B]
+    *,
+    mesh: Mesh,
+    f: int = 1,
+    num_replicas: int | None = None,
+    live_replicas: int | None = None,
+) -> Tuple[PaxosMeshState, PaxosStepOutput]:
+    """One leader-based accept round for a batch of commands
+    (fantoch_tpu/protocol/fpaxos.py over MultiSynod; quorum = f + 1).
+
+    Replica 0 is the leader: it assigns consecutive slots (pending rows
+    keep their previous slots — MultiPaxos slot stickiness) and runs the
+    accept round for the whole batch at once — acceptor acks are a
+    ``psum`` over the live replicas; a slot commits at f + 1 acks.
+    Execution is strictly contiguous in slot order: committed slots above
+    a gap (an uncommitted earlier slot) wait in the pending buffer,
+    exactly the SlotExecutor semantics.
+    """
+    if num_replicas is None:
+        num_replicas = 2 * mesh.shape[REPLICA_AXIS]
+    batch = valid.shape[0]
+    pend_cap = state.pend_slot.shape[0]
+    work = pend_cap + batch
+    quorum = f + 1
+    if live_replicas is None:
+        live_replicas = num_replicas
+    replica_blocks = num_replicas // mesh.shape[REPLICA_AXIS]
+    int_max = jnp.iinfo(jnp.int32).max
+
+    def step(
+        next_slot, exec_frontier, pend_slot, pend_src, pend_seq,
+        valid_l, src_l, seq_l,
+    ):
+        valid_new = jax.lax.all_gather(valid_l, BATCH_AXIS, tiled=True)
+        src_new = jax.lax.all_gather(src_l, BATCH_AXIS, tiled=True)
+        seq_new = jax.lax.all_gather(seq_l, BATCH_AXIS, tiled=True)
+
+        widx = jnp.arange(work, dtype=jnp.int32)
+        carried = pend_slot >= 0
+        valid_cat = jnp.concatenate([carried, valid_new])
+        src_f = jnp.concatenate([pend_src, src_new])
+        seq_f = jnp.concatenate([pend_seq, seq_new])
+
+        # leader slot assignment: pending rows keep their slots; new valid
+        # rows get consecutive slots from next_slot (prefix-sum ranks)
+        is_new = jnp.concatenate([jnp.zeros((pend_cap,), bool), valid_new])
+        new_rank = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+        slot_pend = jnp.concatenate(
+            [pend_slot, jnp.full((batch,), -1, jnp.int32)]
+        )
+        slot = jnp.where(
+            slot_pend >= 0,
+            slot_pend,
+            jnp.where(is_new, next_slot + new_rank, -1),
+        )
+
+        # accept round: every live replica acks every proposed slot
+        # (ballot-0 leader; crashed replicas stay silent) — the ack count
+        # is one scalar psum of live acceptors
+        row = (
+            jax.lax.axis_index(REPLICA_AXIS) * replica_blocks
+            + jnp.arange(replica_blocks, dtype=jnp.int32)
+        )
+        live = row < live_replicas  # [r_blk]
+        acks = jax.lax.psum(live.astype(jnp.int32).sum(), REPLICA_AXIS)
+        committed = valid_cat & (slot >= 0) & (acks >= quorum)
+
+        # contiguous slot execution: sort committed slots and count the
+        # run that extends exec_frontier without a gap
+        sort_slot = jnp.where(committed, slot, int_max)
+        order = jnp.argsort(sort_slot).astype(jnp.int32)
+        ordered_slots = sort_slot[order]
+        pos = jnp.arange(work, dtype=jnp.int32)
+        contiguous = ordered_slots == exec_frontier + pos
+        # prefix of the sorted committed slots with no gap
+        run = jnp.cumprod(contiguous.astype(jnp.int32)) == 1
+        executed_sorted = run & (ordered_slots < int_max)
+        executed = jnp.zeros((work,), bool).at[order].set(executed_sorted)
+        n_exec = executed_sorted.sum().astype(jnp.int32)
+        new_frontier = exec_frontier + n_exec
+
+        # pending carry in SLOT order (lowest first): the in-flight slots
+        # are exactly [exec_frontier, next_slot), so keeping the lowest
+        # pend_cap makes any overflow drop the top slots — which the slot
+        # counter then ROLLS BACK, keeping the log dense.  Without the
+        # rollback a dropped slot is an un-fillable hole that freezes the
+        # contiguous frontier forever (livelock).  Dropped commands are
+        # reported via pend_dropped and must be resubmitted by the caller
+        # (in this dense round model no acceptor holds durable state for
+        # an unexecuted slot, so reassigning it is safe).
+        carry = valid_cat & ~executed
+        carry_order = jnp.argsort(jnp.where(carry, slot, int_max)).astype(jnp.int32)
+        take = carry_order[:pend_cap]
+        is_carry = carry[take]
+        new_pend_slot = jnp.where(is_carry, slot[take], -1)
+        new_pend_src = jnp.where(is_carry, src_f[take], -1)
+        new_pend_seq = jnp.where(is_carry, seq_f[take], -1)
+        pending = carry.sum().astype(jnp.int32)
+        dropped = jnp.maximum(pending - pend_cap, 0).astype(jnp.int32)
+
+        new_next = next_slot + is_new.sum().astype(jnp.int32) - dropped
+        return (
+            new_next, new_frontier,
+            new_pend_slot, new_pend_src, new_pend_seq,
+            order, executed, committed, slot,
+            jnp.minimum(pending, pend_cap),
+            dropped,
+        )
+
+    specs_in = (
+        P(), P(), P(), P(), P(),
+        P(BATCH_AXIS), P(BATCH_AXIS), P(BATCH_AXIS),
+    )
+    specs_out = (P(),) * 11
+    fn = shard_map(
+        step, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False
+    )
+    (
+        next_slot, frontier, ps_, px, pq,
+        order, executed, committed, slot, pending, dropped,
+    ) = fn(
+        state.next_slot, state.exec_frontier,
+        state.pend_slot, state.pend_src, state.pend_seq,
+        valid, dot_src, dot_seq,
+    )
+    return (
+        PaxosMeshState(next_slot, frontier, ps_, px, pq),
+        PaxosStepOutput(order, executed, committed, slot, pending, dropped),
+    )
+
+
+def jit_paxos_step(
+    mesh: Mesh,
+    f: int = 1,
+    num_replicas: int | None = None,
+    live_replicas: int | None = None,
+):
+    """jit-compiled leader-based slot round with donated state."""
+    import functools
+
+    return jax.jit(
+        functools.partial(
+            paxos_protocol_step,
+            mesh=mesh,
+            f=f,
+            num_replicas=num_replicas,
+            live_replicas=live_replicas,
+        ),
+        donate_argnums=(0,),
+    )

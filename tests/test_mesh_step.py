@@ -413,3 +413,65 @@ def test_newt_stability_with_lagging_minority(mesh):
     assert np.asarray(out.executed).sum() == batch, (
         "a lagging minority must not block timestamp stability"
     )
+
+
+# --- leader-based (FPaxos/MultiPaxos) slot round ---
+
+
+def test_paxos_step_slot_order_and_recovery(mesh):
+    """The third consensus class on the mesh: a healthy round commits and
+    executes the whole batch in contiguous slot order; with fewer live
+    acceptors than f+1 nothing commits and rows carry with their slots
+    (MultiPaxos slot stickiness); recovery commits the SAME slots and the
+    frontier resumes contiguously."""
+    batch = 8 * mesh.shape[mesh_step.BATCH_AXIS]
+    state = mesh_step.init_paxos_state(mesh, pending_capacity=2 * batch)
+    step = mesh_step.jit_paxos_step(mesh, f=1)
+    valid = jnp.ones(batch, bool)
+    src = jnp.ones(batch, jnp.int32)
+    seq = jnp.arange(batch, dtype=jnp.int32)
+
+    state, out = step(state, valid, src, seq)
+    executed = np.asarray(out.executed)
+    slots = np.asarray(out.slot)
+    assert executed.sum() == batch
+    assert sorted(slots[executed].tolist()) == list(range(batch))
+    assert int(state.exec_frontier) == batch
+
+    degraded = mesh_step.jit_paxos_step(mesh, f=1, live_replicas=1)
+    state, out2 = degraded(state, valid, src, seq + batch)
+    assert np.asarray(out2.executed).sum() == 0
+    assert int(out2.pending) == batch
+
+    state, out3 = step(state, jnp.zeros(batch, bool), src, seq)
+    ex3 = np.asarray(out3.executed)
+    slots3 = np.asarray(out3.slot)
+    assert ex3.sum() == batch
+    assert sorted(slots3[ex3].tolist()) == list(range(batch, 2 * batch))
+    assert int(state.exec_frontier) == 2 * batch
+
+
+def test_paxos_overflow_reclaims_slots(mesh):
+    """Pending overflow must not wedge the slot log: dropped rows are the
+    HIGHEST slots and the slot counter rolls back over them, so later
+    rounds re-fill a dense log and the contiguous frontier never freezes
+    (the livelock a naive drop creates)."""
+    batch = 8 * mesh.shape[mesh_step.BATCH_AXIS]
+    cap = batch // 2
+    state = mesh_step.init_paxos_state(mesh, pending_capacity=cap)
+    degraded = mesh_step.jit_paxos_step(mesh, f=1, live_replicas=1)
+    valid = jnp.ones(batch, bool)
+    src = jnp.ones(batch, jnp.int32)
+    seq = jnp.arange(batch, dtype=jnp.int32)
+    state, out1 = degraded(state, valid, src, seq)
+    assert int(out1.pend_dropped) == batch - cap
+    # the dropped (highest) slots were reclaimed
+    assert int(state.next_slot) == cap
+
+    # recovery: the carried low slots commit; new commands take the
+    # reclaimed slot numbers — the log stays dense and fully executes
+    step = mesh_step.jit_paxos_step(mesh, f=1)
+    state, out2 = step(state, valid, src, seq + batch)
+    assert np.asarray(out2.executed).sum() == cap + batch
+    assert int(state.exec_frontier) == cap + batch
+    assert int(state.next_slot) == cap + batch
