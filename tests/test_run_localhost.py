@@ -349,3 +349,51 @@ def test_run_atlas_3_1_two_shards_batched_graph():
     run_multi_shard_cluster(
         Atlas, Config(n=3, f=1, batched_graph_executor=True), shard_count=2
     )
+
+
+def test_warn_queue_threshold_and_hysteresis():
+    """WarnQueue warns once per doubling above the threshold and re-arms
+    only after the queue genuinely drains (half the threshold) — a queue
+    hovering AT the threshold must not warn per put (chan.rs:36-58
+    warn-on-full analog for the cooperative loop)."""
+    import logging
+
+    from fantoch_tpu.run.prelude import WarnQueue
+
+    async def scenario():
+        q = WarnQueue("t", warn_size=8)
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        logger = logging.getLogger("fantoch_tpu")
+        handler = Capture()
+        logger.addHandler(handler)
+        old_level = logger.level
+        logger.setLevel(logging.WARNING)
+        try:
+            for i in range(8):
+                q.put_nowait(i)
+            assert len(records) == 1  # crossed the threshold once
+            # hover at the threshold: get/put cycles must not re-warn
+            for i in range(50):
+                q.get_nowait()
+                q.put_nowait(i)
+            assert len(records) == 1
+            # runaway growth: one more warning per doubling
+            for i in range(8, 16):
+                q.put_nowait(i)
+            assert len(records) == 2
+            # drain below half the threshold re-arms
+            while q.qsize() > 3:
+                q.get_nowait()
+            for i in range(10):
+                q.put_nowait(i)
+            assert len(records) == 3
+        finally:
+            logger.removeHandler(handler)
+            logger.setLevel(old_level)
+
+    asyncio.run(scenario())
