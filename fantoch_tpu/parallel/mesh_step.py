@@ -496,7 +496,7 @@ class NewtMeshState(NamedTuple):
 
     key_clock: jax.Array  # int32[R, K]
     vote_frontier: jax.Array  # int32[R, K]
-    pend_key: jax.Array  # int32[Pcap]
+    pend_key: jax.Array  # int32[Pcap, KW] (KEY_PAD = empty slot/row)
     pend_src: jax.Array  # int32[Pcap]
     pend_seq: jax.Array  # int32[Pcap]
     pend_clock: jax.Array  # int32[Pcap] (-1 = not committed)
@@ -533,6 +533,7 @@ def init_newt_state(
     num_replicas: int,
     key_buckets: int = 4096,
     pending_capacity: int = 256,
+    key_width: int = 1,
 ) -> NewtMeshState:
     sharding = NamedSharding(mesh, P(REPLICA_AXIS, None))
     zeros_rk = jax.device_put(
@@ -541,13 +542,14 @@ def init_newt_state(
     rep = NamedSharding(mesh, P())
     cap = pending_capacity
 
-    def pend(value):
-        return jax.device_put(jnp.full((cap,), value, dtype=jnp.int32), rep)
+    def pend(shape, value):
+        return jax.device_put(jnp.full(shape, value, dtype=jnp.int32), rep)
 
     return NewtMeshState(
         zeros_rk,
         jax.device_put(jnp.zeros((num_replicas, key_buckets), jnp.int32), sharding),
-        pend(KEY_PAD), pend(-1), pend(-1), pend(-1),
+        pend((cap, key_width), KEY_PAD),
+        pend((cap,), -1), pend((cap,), -1), pend((cap,), -1),
     )
 
 
@@ -589,7 +591,7 @@ def _segmented_proposal(prior_of_row, key_full, work):
 
 def newt_protocol_step(
     state: NewtMeshState,
-    key: jax.Array,  # int32[B] — single key bucket per command
+    key: jax.Array,  # int32[B] or int32[B, KW] key buckets (KEY_PAD pads)
     dot_src: jax.Array,  # int32[B]
     dot_seq: jax.Array,  # int32[B]
     *,
@@ -608,9 +610,22 @@ def newt_protocol_step(
     the fast-path count-of-max and the Synod ack count are ``psum``s; the
     per-key stable clock is an order statistic over an ``all_gather`` of
     the vote frontiers along ``replica``.
+
+    Multi-key commands (KW > 1): each key slot proposes within its key's
+    run independently and the row's proposal is the max over its slots —
+    within one round two conflicting commands may therefore tie, breaking
+    by dot in the (clock, dot) sort id (the host twin's strictly
+    sequential within-round clocks are a refinement; across rounds the
+    committed clock still strictly dominates every key it touched).  A
+    command executes when its clock is stable on EVERY key it touches.
     """
     num_replicas, key_buckets = state.key_clock.shape
-    batch = key.shape[0]
+    if key.ndim == 1:
+        key = key[:, None]
+    batch, key_width = key.shape
+    assert key_width == state.pend_key.shape[1], (
+        "key width must match init_newt_state(key_width=...)"
+    )
     pend_cap = state.pend_key.shape[0]
     work = pend_cap + batch
     fast_quorum, write_quorum, stability_threshold = newt_quorum_sizes(
@@ -625,13 +640,13 @@ def newt_protocol_step(
         key_clock, vote_frontier, pend_key, pend_src, pend_seq, pend_clock,
         key_l, src_l, seq_l,
     ):
-        key_new = jax.lax.all_gather(key_l, BATCH_AXIS, tiled=True)
+        key_new = jax.lax.all_gather(key_l, BATCH_AXIS, tiled=True)  # [B, KW]
         src_new = jax.lax.all_gather(src_l, BATCH_AXIS, tiled=True)
         seq_new = jax.lax.all_gather(seq_l, BATCH_AXIS, tiled=True)
 
         widx = jnp.arange(work, dtype=jnp.int32)
-        key_cat = jnp.concatenate([pend_key, key_new])  # [W]
-        valid = key_cat != KEY_PAD
+        key_cat = jnp.concatenate([pend_key, key_new], axis=0)  # [W, KW]
+        valid = (key_cat != KEY_PAD).any(axis=-1)
         src_f = jnp.where(valid, jnp.concatenate([pend_src, src_new]), 0)
         seq_f = jnp.where(valid, jnp.concatenate([pend_seq, seq_new]), 0)
         prior_clock = jnp.concatenate(
@@ -639,17 +654,31 @@ def newt_protocol_step(
         )  # committed clock carried from earlier rounds, -1 = none
         already_committed = prior_clock >= 0
 
-        # pad rows / already-committed rows must not consume proposals:
-        # give them private out-of-range keys so they form singleton runs
+        # pad slots / already-committed rows must not consume proposals:
+        # private out-of-range keys make them singleton runs
         propose = valid & ~already_committed
-        key_full = jnp.where(propose, key_cat, key_buckets + widx)
-        safe_key = jnp.minimum(key_full, key_buckets - 1)
+        real_slot = valid[:, None] & (key_cat != KEY_PAD)  # [W, KW]
+        propose_slot = propose[:, None] & real_slot
+        slot_iota = jnp.arange(work * key_width, dtype=jnp.int32).reshape(
+            work, key_width
+        )
+        key_full = jnp.where(propose_slot, key_cat, key_buckets + slot_iota)
+        safe_key = jnp.minimum(key_full, key_buckets - 1)  # [W, KW]
 
-        # per-replica-block proposals: prior = this replica's key clock
+        # per-replica-block per-slot proposals over the flattened slots;
+        # the row's proposal is the max over its real slots
         prior_rows = jnp.where(
-            propose[None, :], key_clock[:, safe_key], 0
-        )  # [r_blk, W]
-        proposal = _segmented_proposal(prior_rows, key_full, work)  # [r_blk, W]
+            propose_slot[None], key_clock[:, safe_key], 0
+        )  # [r_blk, W, KW]
+        slot_prop = _segmented_proposal(
+            prior_rows.reshape(replica_blocks, work * key_width),
+            key_full.reshape(work * key_width),
+            work * key_width,
+        ).reshape(replica_blocks, work, key_width)
+        proposal = jnp.where(
+            propose_slot[None], slot_prop, int_min
+        ).max(axis=-1)  # [r_blk, W]
+        proposal = jnp.where(propose[None, :], proposal, 0)
 
         # MCollectAck max-aggregation over the fast quorum (the first
         # fast_quorum global replica rows)
@@ -683,31 +712,63 @@ def newt_protocol_step(
         slow_paths = (propose & ~fast).sum().astype(jnp.int32)
 
         # vote/frontier update: live replicas chase every committed clock
-        # with (detached) votes — scatter-max into both tables
+        # with (detached) votes on EVERY key the command touches —
+        # scatter-max into both tables over the key slots
         upd = jnp.where(
-            live & committed[None, :] & valid[None, :], clock[None, :], 0
-        )  # [r_blk, W]
+            live[..., None] & (committed[None, :, None] & real_slot[None]),
+            clock[None, :, None],
+            0,
+        )  # [r_blk, W, KW]
         new_key_clock = key_clock.at[:, safe_key].max(
-            jnp.where(propose[None, :], upd, 0)
+            jnp.where(propose_slot[None], upd, 0)
         )
         # committed carried rows also vote (their key_full is private; use
         # the real key for the frontier scatter)
-        real_key = jnp.minimum(jnp.where(valid, key_cat, 0), key_buckets - 1)
+        real_key = jnp.minimum(
+            jnp.where(real_slot, key_cat, 0), key_buckets - 1
+        )  # [W, KW]
         new_frontier = vote_frontier.at[:, real_key].max(upd)
         # also reflect proposals consumed by this round in the key clock
+        # (live is [r_blk, 1]: broadcasts over the key axis)
         new_key_clock = jnp.where(
             live, jnp.maximum(new_key_clock, new_frontier), new_key_clock
         )
 
         # stability: per-key (n - threshold)-th smallest frontier across
-        # ALL replicas (mod.rs:247-270) — gather the replica axis
+        # ALL replicas (mod.rs:247-270) — gather the replica axis; a
+        # command executes once its clock is stable on ALL its keys
         full_frontier = jax.lax.all_gather(
             new_frontier, REPLICA_AXIS, tiled=True
         )  # [R, K]
         stable_clock = jnp.sort(full_frontier, axis=0)[
             num_replicas - stability_threshold
         ]  # [K]
-        executed = committed & valid & (clock <= stable_clock[real_key])
+        slot_stable = jnp.where(
+            real_slot, clock[:, None] <= stable_clock[real_key], True
+        )
+        fully_stable = committed & valid & slot_stable.all(axis=-1)
+        # per-key holdback (multi-key only matters): a command stable on
+        # key A but blocked by its other key must also block every
+        # HIGHER-(clock, dot) command on A, or A's timestamp order breaks
+        # across rounds (the reference avoids this by executing per-key
+        # ops independently; whole-command execution needs the gate).
+        # rank = position in the global (clock, dot) order; a key's
+        # holdback is the min rank among its committed-but-blocked rows.
+        safe_clock = jnp.where(committed & valid, clock, jnp.iinfo(jnp.int32).max)
+        order_cd = jnp.lexsort((seq_f, src_f, safe_clock)).astype(jnp.int32)
+        rank_of = jnp.zeros((work,), jnp.int32).at[order_cd].set(
+            jnp.arange(work, dtype=jnp.int32)
+        )
+        blocked = committed & valid & ~fully_stable
+        hold = jnp.full((key_buckets,), work, jnp.int32).at[real_key].min(
+            jnp.where(
+                blocked[:, None] & real_slot, rank_of[:, None], jnp.int32(work)
+            )
+        )
+        clear = jnp.where(
+            real_slot, rank_of[:, None] < hold[real_key], True
+        ).all(axis=-1)
+        executed = fully_stable & clear
 
         # execution order: stable rows by (clock, dot) — the VotesTable
         # sort id (mod.rs:18)
@@ -730,14 +791,14 @@ def newt_protocol_step(
         carry_order = jnp.argsort(carry_rank).astype(jnp.int32)
         take = carry_order[:pend_cap]
         is_carry = carry[take]
-        new_pend_key = jnp.where(is_carry, key_cat[take], KEY_PAD)
+        new_pend_key = jnp.where(is_carry[:, None], key_cat[take], KEY_PAD)
         new_pend_src = jnp.where(is_carry, src_f[take], -1)
         new_pend_seq = jnp.where(is_carry, seq_f[take], -1)
         new_pend_clock = jnp.where(is_carry, clock[take], -1)
         pending = carry.sum().astype(jnp.int32)
         pend_dropped = jnp.maximum(pending - pend_cap, 0).astype(jnp.int32)
 
-        seen = jnp.zeros((key_buckets,), bool).at[real_key].max(valid)
+        seen = jnp.zeros((key_buckets,), bool).at[real_key].max(real_slot)
         watermark = jnp.where(seen, stable_clock, jnp.iinfo(jnp.int32).max).min()
 
         return (

@@ -55,6 +55,20 @@ from fantoch_tpu.utils import key_hash, logger
 Address = Tuple[str, int]
 
 
+def _bucket_row(cmd: Command, shard_id: ShardId, key_buckets: int, key_width: int):
+    """Distinct key buckets for one command (device key-row contract: a
+    row must not repeat a bucket — colliding keys dedup, which only
+    coarsens conflicts)."""
+    buckets = sorted({
+        key_hash(k) % key_buckets for k in cmd.keys(shard_id)
+    })
+    assert 1 <= len(buckets) <= key_width, (
+        f"command touches {len(buckets)} key buckets but the device state "
+        f"was initialized with key_width={key_width}"
+    )
+    return buckets
+
+
 class DeviceDriver:
     """Host control loop around the donated-state device protocol step.
 
@@ -132,18 +146,7 @@ class DeviceDriver:
     # --- the serving round ---
 
     def _bucket_row(self, cmd: Command) -> List[int]:
-        """Distinct key buckets for one command (device key-row contract:
-        a row must not repeat a bucket — colliding keys dedup, which only
-        coarsens conflicts)."""
-        buckets = sorted({
-            key_hash(k) % self.key_buckets for k in cmd.keys(self.shard_id)
-        })
-        assert len(buckets) >= 1, "command with no keys on this shard"
-        assert len(buckets) <= self.key_width, (
-            f"command touches {len(buckets)} key buckets but the device "
-            f"state was initialized with key_width={self.key_width}"
-        )
-        return buckets
+        return _bucket_row(cmd, self.shard_id, self.key_buckets, self.key_width)
 
     def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
         """One device round over up to ``batch_size`` new commands (the
@@ -238,10 +241,10 @@ class NewtDeviceDriver:
     as one device program; the host executes stable commands in
     (clock, dot) order against the KVStore.
 
-    Single-key commands only (the Newt mesh round models one key bucket
-    per command); multi-key workloads serve through the table/TCP path.
-    Commands are identified by their dot (timestamp ordering needs no
-    gid), so the registry keys on packed (source, sequence).
+    Commands carry up to ``key_width`` key buckets (a command executes
+    once its clock is stable on every key it touches).  Commands are
+    identified by their dot (timestamp ordering needs no gid), so the
+    registry keys on packed (source, sequence).
     """
 
     def __init__(
@@ -252,6 +255,7 @@ class NewtDeviceDriver:
         tiny_quorums: bool = False,
         batch_size: int = 256,
         key_buckets: int = 4096,
+        key_width: int = 1,
         pending_capacity: int = 256,
         live_replicas: Optional[int] = None,
         shard_id: ShardId = 0,
@@ -263,6 +267,7 @@ class NewtDeviceDriver:
         self.shard_id = shard_id
         self.batch_size = batch_size
         self.key_buckets = key_buckets
+        self.key_width = key_width
         self._mesh = (
             mesh
             if mesh is not None
@@ -273,6 +278,7 @@ class NewtDeviceDriver:
             num_replicas,
             key_buckets=key_buckets,
             pending_capacity=pending_capacity,
+            key_width=key_width,
         )
         self._step = mesh_step.jit_newt_step(
             self._mesh, f=f, tiny_quorums=tiny_quorums, live_replicas=live_replicas
@@ -303,19 +309,15 @@ class NewtDeviceDriver:
 
         assert len(batch) <= self.batch_size
         b = self.batch_size
-        key = np.full(b, KEY_PAD, dtype=np.int32)
+        key = np.full((b, self.key_width), KEY_PAD, dtype=np.int32)
         src = np.zeros(b, dtype=np.int32)
         seq = np.zeros(b, dtype=np.int32)
         for i, (dot, cmd) in enumerate(batch):
-            keys = list(cmd.keys(self.shard_id))
-            assert len(keys) == 1, (
-                "the Newt device round serves single-key commands; "
-                f"got {len(keys)} keys"
-            )
+            buckets = _bucket_row(cmd, self.shard_id, self.key_buckets, self.key_width)
             # int32 device columns: a wrapped sequence would alias an
             # in-flight registry key — fail loudly like the gid guard
             assert dot.sequence < 2**31 - 1, "dot sequence exhausts int32"
-            key[i] = key_hash(keys[0]) % self.key_buckets
+            key[i, : len(buckets)] = buckets
             src[i] = dot.source
             seq[i] = dot.sequence
             self._cmds[(int(src[i]) << 32) | int(seq[i])] = (dot, cmd)
@@ -476,16 +478,13 @@ class DeviceRuntime:
         self.process_id = process_id
         self.client_addr = client_addr
         if protocol == "newt":
-            assert key_width == 1, (
-                "the Newt device round serves single-key commands; "
-                "key_width > 1 would fail per-command at serve time"
-            )
             self.driver = NewtDeviceDriver(
                 config.n,
                 f=config.f,
                 tiny_quorums=config.newt_tiny_quorums,
                 batch_size=batch_size,
                 key_buckets=key_buckets,
+                key_width=key_width,
                 pending_capacity=pending_capacity,
                 live_replicas=live_replicas,
                 monitor_execution_order=monitor_execution_order,

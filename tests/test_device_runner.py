@@ -179,3 +179,49 @@ def test_device_runtime_newt_tcp_serving():
         assert client.issued_commands == COMMANDS_PER_CLIENT
     assert runtime.driver.executed == 4 * COMMANDS_PER_CLIENT
     assert runtime.driver.in_flight == 0
+
+
+def test_newt_driver_multi_key():
+    """Multi-key commands through the Newt device driver: per-key
+    previous-value chains stay consistent (a command executes only once
+    stable on every key)."""
+    from fantoch_tpu.run.device_runner import NewtDeviceDriver
+
+    d = NewtDeviceDriver(3, batch_size=16, key_buckets=64, key_width=2,
+                         monitor_execution_order=True)
+    cmds = []
+    for i in range(6):
+        keys = {"a": (KVOp.put(f"a{i}"),)} if i % 2 else {
+            "a": (KVOp.put(f"a{i}"),),
+            "b": (KVOp.put(f"b{i}"),),
+        }
+        cmds.append((Dot(1, i + 1), Command.from_keys(Rifl(1, i + 1), 0, keys)))
+    results = d.step(cmds)
+    assert d.executed == 6 and d.in_flight == 0
+    by_key = {}
+    for r in results:
+        by_key.setdefault(r.key, []).append(r.op_results[0])
+    assert by_key["a"] == [None, "a0", "a1", "a2", "a3", "a4"]
+    assert by_key["b"] == [None, "b0", "b2"]
+
+
+def test_device_runtime_newt_multi_key_tcp():
+    """keys_per_command=2 served through the Newt timestamp round."""
+    config = Config(3, 1, shard_count=1)
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=2,
+        commands_per_client=5,
+        payload_size=1,
+    )
+    runtime, clients = asyncio.run(
+        run_device_server(
+            config, workload, client_count=2, batch_size=16,
+            key_width=2, protocol="newt",
+        )
+    )
+    for client in clients.values():
+        assert client.issued_commands == 5
+    assert runtime.driver.executed == 10
+    assert runtime.driver.in_flight == 0

@@ -475,3 +475,112 @@ def test_paxos_overflow_reclaims_slots(mesh):
     assert np.asarray(out2.executed).sum() == cap + batch
     assert int(state.exec_frontier) == cap + batch
     assert int(state.next_slot) == cap + batch
+
+
+def test_newt_multikey_round(mesh):
+    """Multi-key commands through the Newt mesh round: every command
+    commits and executes once its clock is stable on ALL its keys, per-key
+    (clock, dot) order is monotone within the round, and round-2 clocks on
+    the same keys strictly dominate round 1's commits."""
+    num_replicas = 2 * mesh.shape[mesh_step.REPLICA_AXIS]
+    batch = 8 * mesh.shape[mesh_step.BATCH_AXIS]
+    state = mesh_step.init_newt_state(
+        mesh, num_replicas, key_buckets=64, pending_capacity=64, key_width=2
+    )
+    step = mesh_step.jit_newt_step(mesh, f=1)
+    rng = np.random.default_rng(3)
+    keys = jnp.asarray(
+        np.stack([rng.choice(6, size=2, replace=False) for _ in range(batch)]),
+        dtype=jnp.int32,
+    )
+    src = jnp.asarray(rng.integers(1, num_replicas + 1, size=batch), jnp.int32)
+    seq = jnp.arange(batch, dtype=jnp.int32)
+    state, out = step(state, keys, src, seq)
+    executed = np.asarray(out.executed)
+    clock = np.asarray(out.clock)
+    order = np.asarray(out.order)
+    assert executed.sum() == batch
+    # per-key clocks non-decreasing along the execution order
+    pend_cap = state.pend_key.shape[0]
+    keys_np = np.asarray(keys)
+    last = {}
+    for w in order:
+        if not executed[w]:
+            continue
+        for k in keys_np[w - pend_cap]:
+            assert last.get(int(k), -1) <= clock[w]
+            last[int(k)] = int(clock[w])
+    r1_max = clock[executed].max()
+
+    # round 2 on the same key space strictly dominates per key
+    state, out2 = step(state, keys, src, seq + batch)
+    c2 = np.asarray(out2.clock)
+    e2 = np.asarray(out2.executed)
+    assert e2.sum() == batch
+    for w in np.nonzero(e2)[0]:
+        for k in keys_np[w - pend_cap]:
+            assert c2[w] > last[int(k)]  # strict per-key domination
+    assert c2[e2].max() > r1_max
+
+
+def test_newt_multikey_holdback_preserves_per_key_order(mesh):
+    """Regression (r4 review): a multi-key command stable on key A but
+    blocked by key B must hold back higher-clocked commands on A, or A's
+    (clock, dot) execution order breaks across rounds.  Staged state: key
+    0's stability watermark is far ahead (1000) while key 1 lags at 0; a
+    carried committed command D{0,1} at clock 5 stays blocked (minority
+    of live replicas, so its votes cannot stabilize key 1); a fresh
+    command F{0} commits at clock 101 <= stable(key 0) — without the
+    holdback it would execute past D on key 0."""
+    num_replicas = 2 * mesh.shape[mesh_step.REPLICA_AXIS]
+    batch = 8 * mesh.shape[mesh_step.BATCH_AXIS]
+    state = mesh_step.init_newt_state(
+        mesh, num_replicas, key_buckets=8, pending_capacity=8, key_width=2
+    )
+    vf = np.array(state.vote_frontier)
+    vf[:, 0] = 1000  # key 0 pre-stable far ahead
+    kc = np.array(state.key_clock)
+    kc[:, 0] = 100
+    pend_key = np.full((8, 2), mesh_step.KEY_PAD, np.int32)
+    pend_key[0] = [0, 1]  # D{0,1}, committed at clock 5
+    pend = lambda a: jax.device_put(jnp.asarray(a, dtype=jnp.int32))
+    state = state._replace(
+        vote_frontier=jax.device_put(jnp.asarray(vf), state.vote_frontier.sharding),
+        key_clock=jax.device_put(jnp.asarray(kc), state.key_clock.sharding),
+        pend_key=pend(pend_key),
+        pend_src=pend([1] + [-1] * 7),
+        pend_seq=pend([1] + [-1] * 7),
+        pend_clock=pend([5] + [-1] * 7),
+    )
+    # minority-live round: D's carried votes cannot stabilize key 1
+    step1 = mesh_step.jit_newt_step(mesh, f=1, live_replicas=1)
+    keys = np.full((batch, 2), mesh_step.KEY_PAD, np.int32)
+    keys[0, 0] = 0  # F{0}
+    state, out = step1(
+        state,
+        jnp.asarray(keys),
+        jnp.asarray(np.r_[2, np.zeros(batch - 1)].astype(np.int32)),
+        jnp.asarray(np.r_[1, np.zeros(batch - 1)].astype(np.int32)),
+    )
+    executed = np.asarray(out.executed)
+    committed = np.asarray(out.committed)
+    clock = np.asarray(out.clock)
+    assert committed[8] and clock[8] > 100, "F must commit above key 0's clock"
+    assert committed[0] and not executed[0], "D stays blocked by key 1"
+    assert not executed[8], (
+        "F executed past the lower-clocked blocked command D on key 0"
+    )
+    assert int(out.pending) == 2
+
+    # full-live round: D's votes stabilize key 1; D then F execute in
+    # (clock, dot) order
+    step2 = mesh_step.jit_newt_step(mesh, f=1)
+    empty = jnp.full((batch, 2), mesh_step.KEY_PAD, jnp.int32)
+    zeros = jnp.zeros((batch,), jnp.int32)
+    state, out2 = step2(state, empty, zeros, zeros)
+    ex2 = np.asarray(out2.executed)
+    clock2 = np.asarray(out2.clock)
+    order2 = np.asarray(out2.order)
+    assert ex2.sum() == 2
+    ex_rows = [w for w in order2 if ex2[w]]
+    assert clock2[ex_rows[0]] < clock2[ex_rows[1]], "D must execute before F"
