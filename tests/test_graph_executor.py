@@ -337,3 +337,59 @@ def test_monitor_pending_panics_on_lost_execution():
     time.add_millis(5000)
     with _pytest.raises(AssertionError, match="without missing"):
         graph.monitor_pending(time)
+
+
+def test_large_multikey_adversarial_batch_staged_branch():
+    """Large (> _STRUCTURE_THRESHOLD) multi-key backlogs with adversarial
+    (permuted) arrival leave the in-jit fast path; on the XLA path they
+    route through the staged frontier peeler and must fully resolve with
+    per-key order intact."""
+    import numpy as np
+
+    from fantoch_tpu.ops.frontier import pack_dots
+
+    n = 5000  # > _STRUCTURE_THRESHOLD (stays on the staged branch)
+    rng = random.Random(9)
+    nkeys = 64
+    key_of = [(i % nkeys, (i * 7 + 1) % nkeys) for i in range(n)]
+    last = {}
+    deps = []
+    for i in range(n):
+        row = set()
+        for k in key_of[i]:
+            if k in last and last[k] != i:
+                row.add(last[k])
+            last[k] = i
+        deps.append(row)
+    perm = list(range(n))
+    rng.shuffle(perm)  # adversarial arrival
+
+    src = np.ones(n, dtype=np.int64)
+    seq = np.array([perm[pos] + 1 for pos in range(n)], dtype=np.int64)
+    key_col = np.full(n, -1, dtype=np.int32)  # multi-key: general path
+    width = max(len(d) for d in deps)
+    dep_dots = np.full((n, width), -1, dtype=np.int64)
+    for pos in range(n):
+        orig = perm[pos]
+        for j, d in enumerate(sorted(deps[orig])):
+            dep_dots[pos, j] = pack_dots(
+                np.asarray([1], dtype=np.int64), np.asarray([d + 1], dtype=np.int64)
+            )[0]
+    cmds = [
+        make_cmd(Dot(1, perm[pos] + 1), [f"m{k}" for k in set(key_of[perm[pos]])])
+        for pos in range(n)
+    ]
+
+    graph = BatchedDependencyGraph(
+        1, SHARD, Config(3, 1, host_native_resolver=False)
+    )
+    graph.handle_add_arrays(src, seq, key_col, dep_dots, cmds, TIME)
+    executed = graph.commands_to_execute()
+    assert len(executed) == n
+    # per-key execution order must match dependency (original) order
+    seen = {}
+    for cmd in executed:
+        orig = cmd.rifl.sequence - 1
+        for k in set(key_of[orig]):
+            assert seen.get(k, -1) < orig
+            seen[k] = orig
