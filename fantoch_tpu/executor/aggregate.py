@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 from fantoch_tpu.core.command import Command, CommandResult
 from fantoch_tpu.core.ids import ProcessId, Rifl, ShardId
 from fantoch_tpu.executor.base import ExecutorResult
+from fantoch_tpu.utils import logger
 
 
 class AggregatePending:
@@ -86,8 +87,14 @@ class AggregatePending:
                 self._early_count += 1
                 while self._early_count > self.EARLY_CAP:
                     # dicts iterate in insertion order: drop the oldest rifl
-                    self._early_count -= len(
-                        self._early.pop(next(iter(self._early)))
+                    evicted = next(iter(self._early))
+                    self._early_count -= len(self._early.pop(evicted))
+                    # if the evicted rifl's wait_for was merely racing (not
+                    # dead), its command will now hang silently — leave a
+                    # trail so a wedged client is diagnosable
+                    logger.warning(
+                        "early-partial cap: evicting partials for rifl %s",
+                        evicted,
                     )
             return None
         if cmd_result.add_partial(executor_result.key, executor_result.op_results):

@@ -55,13 +55,18 @@ from fantoch_tpu.utils import key_hash, logger
 Address = Tuple[str, int]
 
 
+def _buckets(cmd: Command, shard_id: ShardId, key_buckets: int) -> List[int]:
+    """Distinct key buckets for one command — the single definition shared
+    by the driver's row builder and the session-boundary validator, so the
+    two can never drift (colliding keys dedup, which only coarsens
+    conflicts)."""
+    return sorted({key_hash(k) % key_buckets for k in cmd.keys(shard_id)})
+
+
 def _bucket_row(cmd: Command, shard_id: ShardId, key_buckets: int, key_width: int):
-    """Distinct key buckets for one command (device key-row contract: a
-    row must not repeat a bucket — colliding keys dedup, which only
-    coarsens conflicts)."""
-    buckets = sorted({
-        key_hash(k) % key_buckets for k in cmd.keys(shard_id)
-    })
+    """Key-bucket row for one command (device key-row contract: a row must
+    not repeat a bucket)."""
+    buckets = _buckets(cmd, shard_id, key_buckets)
     assert 1 <= len(buckets) <= key_width, (
         f"command touches {len(buckets)} key buckets but the device state "
         f"was initialized with key_width={key_width}"
@@ -172,7 +177,10 @@ class DeviceDriver:
             row = self._bucket_row(cmd)
             key[i, : len(row)] = row
             src[i] = dot.source
-            seq[i] = dot.sequence & 0x7FFFFFFF
+            # int32 device ordering columns: a wrapped sequence would
+            # silently alias tie-breaks — fail loudly like the Newt driver
+            assert dot.sequence < 2**31 - 1, "dot sequence exhausts int32"
+            seq[i] = dot.sequence
             self._cmds[self._next_gid + i] = (dot, cmd)
 
         self._state, out = self._step(
@@ -392,6 +400,12 @@ class NewtDeviceDriver:
         return out
 
 
+class ProtocolError(Exception):
+    """A client broke the wire contract: kills only its session, never
+    the runtime (the per-connection failure isolation of the reference's
+    client task, fantoch/src/run/task/process.rs:320-325)."""
+
+
 class _DeviceClientSession:
     """Server side of one client connection against the device driver
     (the client.rs:79-260 role, minus dot routing — the driver orders)."""
@@ -417,30 +431,76 @@ class _DeviceClientSession:
             self._flush_needed.clear()
             await self.rw.flush()
 
-    async def run(self) -> None:
-        hi = await self.rw.recv()
-        assert isinstance(hi, ClientHi)
-        self.client_ids = hi.client_ids
-        for client_id in self.client_ids:
-            self.runtime.client_sessions[client_id] = self
-        await self.rw.send(ClientHiAck())
-        flusher = self.runtime.spawn(self._flush_loop())
-        while True:
-            msg = await self.rw.recv()
-            if msg is None:
-                break
-            assert not isinstance(msg, Register), (
-                "device-step serving is single-shard; Register (multi-shard "
-                "partial registration) has no meaning here"
+    def _reject(self, cmd: Command, why: str) -> None:
+        """Reply with an empty (zero-key) CommandResult — the client's
+        bookkeeping keys on the rifl alone — instead of letting a
+        malformed command reach the driver and trip an assert there."""
+        from fantoch_tpu.core.command import CommandResult
+
+        logger.warning(
+            "rejecting command %s from client %s: %s",
+            cmd.rifl, cmd.rifl.source, why,
+        )
+        self.rw.write(ToClient(CommandResult(cmd.rifl, 0)))
+        self._flush_needed.set()
+
+    def _validate(self, cmd: Command) -> Optional[str]:
+        """The session-boundary twin of the driver's `_bucket_row`
+        contract; returns the rejection reason for commands the compiled
+        device state cannot carry."""
+        driver = self.runtime.driver
+        buckets = _buckets(cmd, driver.shard_id, driver.key_buckets)
+        if not buckets:
+            return "command touches no keys on this shard"
+        if len(buckets) > driver.key_width:
+            return (
+                f"command touches {len(buckets)} key buckets but the device "
+                f"state was compiled with key_width={driver.key_width}"
             )
-            assert isinstance(msg, Submit)
-            cmd = msg.cmd
-            self.pending.wait_for(cmd)
-            dot = self.runtime.dot_gen.next_id()
-            self.runtime.submit(dot, cmd)
-        flusher.cancel()
-        for client_id in self.client_ids:
-            self.runtime.client_sessions.pop(client_id, None)
+        return None
+
+    async def run(self) -> None:
+        try:
+            hi = await self.rw.recv()
+            if hi is None:
+                return  # clean close before handshake (port probe)
+            if not isinstance(hi, ClientHi):
+                raise ProtocolError(f"expected ClientHi, got {hi!r}")
+            self.client_ids = hi.client_ids
+            for client_id in self.client_ids:
+                self.runtime.client_sessions[client_id] = self
+            await self.rw.send(ClientHiAck())
+            flusher = self.runtime.spawn(self._flush_loop(), fatal=False)
+            try:
+                while True:
+                    msg = await self.rw.recv()
+                    if msg is None:
+                        break
+                    if isinstance(msg, Register):
+                        raise ProtocolError(
+                            "device-step serving is single-shard; Register "
+                            "(multi-shard partial registration) has no "
+                            "meaning here"
+                        )
+                    if not isinstance(msg, Submit):
+                        raise ProtocolError(f"unexpected message {msg!r}")
+                    cmd = msg.cmd
+                    why = self._validate(cmd)
+                    if why is not None:
+                        self._reject(cmd, why)
+                        continue
+                    self.pending.wait_for(cmd)
+                    dot = self.runtime.dot_gen.next_id()
+                    self.runtime.submit(dot, cmd)
+            finally:
+                flusher.cancel()
+        finally:
+            for client_id in self.client_ids:
+                self.runtime.client_sessions.pop(client_id, None)
+            # always close the transport: a session dying on ProtocolError
+            # must leave the client an EOF, not a silent hang, and the
+            # server must not leak the fd
+            self.rw.close()
 
 
 class DeviceRuntime:
@@ -517,9 +577,15 @@ class DeviceRuntime:
 
     # --- lifecycle (mirrors ProcessRuntime's loud-failure contract) ---
 
-    def spawn(self, coro) -> asyncio.Task:
+    def spawn(self, coro, *, fatal: bool = True) -> asyncio.Task:
+        """``fatal=True`` tasks (the driver loop, metrics) take the whole
+        runtime down on crash; ``fatal=False`` tasks (per-client sessions)
+        die alone — one misbehaving connection must not stop serving the
+        others (fantoch/src/run/task/process.rs:320-325)."""
         task = asyncio.ensure_future(coro)
-        task.add_done_callback(self._on_task_done)
+        task.add_done_callback(
+            self._on_task_done if fatal else self._on_session_done
+        )
         self._tasks.add(task)
         return task
 
@@ -534,6 +600,14 @@ class DeviceRuntime:
                 self.failure = exc
                 self.failed.set()
             self._teardown()
+
+    def _on_session_done(self, task: asyncio.Task) -> None:
+        self._tasks.discard(task)
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            logger.warning("device client session closed with error: %r", exc)
 
     def _teardown(self) -> None:
         for task in list(self._tasks):
@@ -589,7 +663,7 @@ class DeviceRuntime:
 
     async def _on_client(self, reader, writer) -> None:
         session = _DeviceClientSession(self, Rw(reader, writer))
-        self.spawn(session.run())
+        self.spawn(session.run(), fatal=False)
 
     def submit(self, dot: Dot, cmd: Command) -> None:
         self._submit_queue.append((dot, cmd))
@@ -598,14 +672,26 @@ class DeviceRuntime:
     def _deliver(self, results: List[ExecutorResult]) -> None:
         for result in results:
             session = self.client_sessions.get(result.rifl.source)
-            if session is not None:
+            if session is None:
+                continue
+            try:
                 session.deliver(result)
+            except (ConnectionError, OSError) as exc:
+                # runs on the (fatal) driver task: a half-closed client
+                # connection must cost only its own results — but only
+                # transport faults are session-scoped; logic errors
+                # (aggregation invariants) still fail the runtime loudly
+                logger.warning(
+                    "dropping result for client %s (dead session): %r",
+                    result.rifl.source, exc,
+                )
 
     # --- the serving loop ---
 
     async def _driver_task(self) -> None:
         loop = asyncio.get_running_loop()
         driver = self.driver
+        idle_rounds = 0  # empty-input rounds yielding no results
         while True:
             if not self._submit_queue and driver.in_flight == 0:
                 self._work.clear()
@@ -620,3 +706,22 @@ class DeviceRuntime:
             results = await loop.run_in_executor(None, driver.step, batch)
             self._deliver(results)
             self._publish_tallies()
+            # commands stuck in the device pending buffer (degraded quorum)
+            # with no new submissions would otherwise hot-spin empty device
+            # rounds; back off — interruptibly, so a submit arriving
+            # mid-backoff starts the next round immediately
+            if not batch and not results:
+                idle_rounds += 1
+                backoff = min(0.001 * (2 ** min(idle_rounds, 6)), 0.05)
+                self._work.clear()
+                # a submit that landed while driver.step ran set _work
+                # before the clear — check the queue itself, not the event
+                if not self._submit_queue:
+                    try:
+                        await asyncio.wait_for(
+                            self._work.wait(), timeout=backoff
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+            else:
+                idle_rounds = 0

@@ -61,6 +61,20 @@ from fantoch_tpu.utils import key_hash, logger
 Address = Tuple[str, int]
 
 
+def _peek_is_submit(queue: "asyncio.Queue") -> bool:
+    """True when the queue's head item is a submit, without dequeuing.
+    Peeks CPython's asyncio.Queue internals behind a guard: if the
+    implementation detail ever changes we degrade to per-command submits
+    (correct, just unbatched) instead of crashing the worker."""
+    inner = getattr(queue, "_queue", None)
+    if inner is None or not queue.qsize():
+        return False
+    try:
+        return inner[0][0] == "submit"
+    except (IndexError, KeyError, TypeError):
+        return False
+
+
 def executor_index(info: Any, size: int) -> Optional[int]:
     """Executor routing: by key hash when the info names a key
     (fantoch/src/executor/mod.rs:161-166), else executor 0.  A ``key``
@@ -496,7 +510,7 @@ class ProcessRuntime:
                 if submit_batch is not None:
                     # drain the run of consecutive submits queued behind us
                     pairs = [(dot, cmd)]
-                    while queue.qsize() and queue._queue[0][0] == "submit":  # noqa: SLF001
+                    while _peek_is_submit(queue):
                         _, d2, c2 = queue.get_nowait()
                         pairs.append((d2, c2))
                     submit_batch(pairs, self.time)

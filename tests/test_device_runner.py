@@ -205,6 +205,84 @@ def test_newt_driver_multi_key():
     assert by_key["b"] == [None, "b0", "b2"]
 
 
+def test_device_runtime_survives_bad_client():
+    """A client submitting a command wider than the compiled key_width is
+    rejected at the session boundary with an empty CommandResult — the
+    driver's asserts are unreachable from the network, the bad session
+    keeps serving valid commands, and a concurrent well-behaved client
+    completes its workload (per-connection failure isolation,
+    fantoch/src/run/task/process.rs:320-325)."""
+    from fantoch_tpu.run.device_runner import DeviceRuntime
+    from fantoch_tpu.run.harness import free_port
+    from fantoch_tpu.run.client_runner import run_clients
+    from fantoch_tpu.run.prelude import ClientHi, ClientHiAck, Submit, ToClient
+    from fantoch_tpu.run.rw import Rw
+    from fantoch_tpu.utils import key_hash
+
+    key_buckets = 64
+    # two keys guaranteed to land in distinct buckets (over-wide for kw=1)
+    key_a = "a"
+    key_b = next(
+        k
+        for k in (f"b{i}" for i in range(1000))
+        if key_hash(k) % key_buckets != key_hash(key_a) % key_buckets
+    )
+
+    async def go():
+        config = Config(3, 1, shard_count=1)
+        port = free_port()
+        runtime = DeviceRuntime(
+            config,
+            ("127.0.0.1", port),
+            batch_size=16,
+            key_buckets=key_buckets,
+            key_width=1,
+            monitor_execution_order=True,
+        )
+        await runtime.start()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            rw = Rw(reader, writer)
+            await rw.send(ClientHi([99]))
+            assert isinstance(await rw.recv(), ClientHiAck)
+            # over-wide submit: rejected, not crashed
+            bad = Command.from_keys(
+                Rifl(99, 1), 0,
+                {key_a: (KVOp.put("x"),), key_b: (KVOp.put("y"),)},
+            )
+            await rw.send(Submit(bad))
+            reply = await rw.recv()
+            assert isinstance(reply, ToClient)
+            assert reply.cmd_result.rifl == Rifl(99, 1)
+            assert reply.cmd_result.ready  # zero-key error result
+            # the same session still serves valid commands afterwards
+            good = Command.from_single(Rifl(99, 2), 0, key_a, KVOp.put("z"))
+            await rw.send(Submit(good))
+            reply = await rw.recv()
+            assert isinstance(reply, ToClient)
+            assert reply.cmd_result.rifl == Rifl(99, 2)
+            writer.close()
+
+            # a concurrent well-behaved client completes its workload
+            workload = Workload(
+                shard_count=1,
+                key_gen=ConflictRateKeyGen(50),
+                keys_per_command=1,
+                commands_per_client=5,
+                payload_size=1,
+            )
+            clients = await run_clients([1], {0: ("127.0.0.1", port)}, workload)
+            assert clients[1].issued_commands == 5
+            assert runtime.failure is None
+        finally:
+            await runtime.stop()
+        return runtime
+
+    runtime = asyncio.run(go())
+    # the rejected command never reached the driver
+    assert runtime.driver.executed == 1 + 5
+
+
 def test_device_runtime_newt_multi_key_tcp():
     """keys_per_command=2 served through the Newt timestamp round."""
     config = Config(3, 1, shard_count=1)
