@@ -74,7 +74,58 @@ def _bucket_row(cmd: Command, shard_id: ShardId, key_buckets: int, key_width: in
     return buckets
 
 
-class DeviceDriver:
+class _DriverCore:
+    """The host-side machinery every device driver shares: the in-flight
+    command registry, the overflow requeue channel, the KVStore, and the
+    serving tallies (the BaseProcess metrics twin).  Keeping it in one
+    place keeps the three protocol drivers from silently diverging on
+    the registry/requeue contract."""
+
+    def _init_core(
+        self,
+        shard_id: ShardId,
+        batch_size: int,
+        key_buckets: int,
+        monitor_execution_order: bool,
+    ) -> None:
+        self.shard_id = shard_id
+        self.batch_size = batch_size
+        self.key_buckets = key_buckets
+        # commands in flight: registered at step entry, dropped at execution
+        self._cmds: Dict[int, Tuple[Dot, Command]] = {}
+        self._requeue: List[Tuple[Dot, Command]] = []
+        self.store = KVStore(monitor_execution_order)
+        self.rounds = 0
+        self.fast_paths = 0
+        self.slow_paths = 0
+        self.executed = 0
+        self.stable_watermark = 0
+
+    @property
+    def in_flight(self) -> int:
+        """Commands registered but not yet executed (device pending)."""
+        return len(self._cmds)
+
+    def take_requeue(self) -> List[Tuple[Dot, Command]]:
+        """Commands dropped by a device pending-buffer overflow, to be fed
+        into the next batch by the caller."""
+        out, self._requeue = self._requeue, []
+        return out
+
+    @staticmethod
+    def _packed(src, seq) -> int:
+        """Registry key for dot-identified commands."""
+        return (int(src) << 32) | int(seq)
+
+    @staticmethod
+    def _check_seq(dot: Dot) -> None:
+        # int32 device ordering columns: a wrapped sequence would silently
+        # alias registry keys / tie-breaks — fail loudly, identically in
+        # every driver
+        assert dot.sequence < 2**31 - 1, "dot sequence exhausts int32"
+
+
+class DeviceDriver(_DriverCore):
     """Host control loop around the donated-state device protocol step.
 
     One ``step()`` call = one full commit+execute round for every replica
@@ -111,9 +162,7 @@ class DeviceDriver:
     ):
         from fantoch_tpu.parallel import mesh_step
 
-        self.shard_id = shard_id
-        self.batch_size = batch_size
-        self.key_buckets = key_buckets
+        self._init_core(shard_id, batch_size, key_buckets, monitor_execution_order)
         self.key_width = key_width
         self._mesh = (
             mesh
@@ -131,22 +180,6 @@ class DeviceDriver:
             self._mesh, live_replicas=live_replicas
         )
         self._next_gid = 0  # host mirror of state.next_gid
-        # commands in flight: registered at step entry, dropped at execution
-        self._cmds: Dict[int, Tuple[Dot, Command]] = {}
-        self.store = KVStore(monitor_execution_order)
-        # rounds / fast-path / slow-path tallies (BaseProcess metrics twin)
-        self.rounds = 0
-        self.fast_paths = 0
-        self.slow_paths = 0
-        self.executed = 0
-        self.stable_watermark = 0
-
-    # --- introspection ---
-
-    @property
-    def in_flight(self) -> int:
-        """Commands registered but not yet executed (device pending)."""
-        return len(self._cmds)
 
     # --- the serving round ---
 
@@ -177,9 +210,7 @@ class DeviceDriver:
             row = self._bucket_row(cmd)
             key[i, : len(row)] = row
             src[i] = dot.source
-            # int32 device ordering columns: a wrapped sequence would
-            # silently alias tie-breaks — fail loudly like the Newt driver
-            assert dot.sequence < 2**31 - 1, "dot sequence exhausts int32"
+            self._check_seq(dot)
             seq[i] = dot.sequence
             self._cmds[self._next_gid + i] = (dot, cmd)
 
@@ -227,22 +258,14 @@ class DeviceDriver:
                 "device pending buffer overflowed: re-queueing %d commands",
                 len(dropped),
             )
-            self._requeue = getattr(self, "_requeue", [])
             for gid in dropped:
                 entry = self._cmds.pop(gid, None)
                 if entry is not None:
                     self._requeue.append(entry)
         return results
 
-    def take_requeue(self) -> List[Tuple[Dot, Command]]:
-        """Commands dropped by a device pending-buffer overflow, to be fed
-        into the next batch by the caller."""
-        out = getattr(self, "_requeue", [])
-        self._requeue = []
-        return out
 
-
-class NewtDeviceDriver:
+class NewtDeviceDriver(_DriverCore):
     """Host control loop around the device-resident Newt timestamp round
     (parallel/mesh_step.newt_protocol_step): proposals, pmax commit
     clocks, count-of-max fast path and order-statistic stability all run
@@ -272,9 +295,7 @@ class NewtDeviceDriver:
     ):
         from fantoch_tpu.parallel import mesh_step
 
-        self.shard_id = shard_id
-        self.batch_size = batch_size
-        self.key_buckets = key_buckets
+        self._init_core(shard_id, batch_size, key_buckets, monitor_execution_order)
         self.key_width = key_width
         self._mesh = (
             mesh
@@ -291,24 +312,12 @@ class NewtDeviceDriver:
         self._step = mesh_step.jit_newt_step(
             self._mesh, f=f, tiny_quorums=tiny_quorums, live_replicas=live_replicas
         )
-        self._cmds: Dict[int, Tuple[Dot, Command]] = {}  # packed dot -> entry
-        self._requeue: List[Tuple[Dot, Command]] = []
         # host mirror of the device pending buffer's (src, seq) identity
         # columns (the step outputs index working rows = pending + batch;
         # identities never need a device round-trip)
         cap = pending_capacity
         self._pend_src = np.zeros(cap, dtype=np.int32)
         self._pend_seq = np.zeros(cap, dtype=np.int32)
-        self.store = KVStore(monitor_execution_order)
-        self.rounds = 0
-        self.fast_paths = 0
-        self.slow_paths = 0
-        self.executed = 0
-        self.stable_watermark = 0
-
-    @property
-    def in_flight(self) -> int:
-        return len(self._cmds)
 
     def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
         import jax.numpy as jnp
@@ -322,13 +331,11 @@ class NewtDeviceDriver:
         seq = np.zeros(b, dtype=np.int32)
         for i, (dot, cmd) in enumerate(batch):
             buckets = _bucket_row(cmd, self.shard_id, self.key_buckets, self.key_width)
-            # int32 device columns: a wrapped sequence would alias an
-            # in-flight registry key — fail loudly like the gid guard
-            assert dot.sequence < 2**31 - 1, "dot sequence exhausts int32"
+            self._check_seq(dot)
             key[i, : len(buckets)] = buckets
             src[i] = dot.source
             seq[i] = dot.sequence
-            self._cmds[(int(src[i]) << 32) | int(seq[i])] = (dot, cmd)
+            self._cmds[self._packed(dot.source, dot.sequence)] = (dot, cmd)
 
         # this round's working-row identities: pending buffer first
         work_src = np.concatenate([self._pend_src, src])
@@ -353,7 +360,7 @@ class NewtDeviceDriver:
         for w in order.tolist():
             if not executed[w]:
                 continue
-            packed = (int(work_src[w]) << 32) | int(work_seq[w])
+            packed = self._packed(work_src[w], work_seq[w])
             entry = self._cmds.pop(packed, None)
             if entry is None:
                 continue  # pad row
@@ -372,7 +379,7 @@ class NewtDeviceDriver:
         carried = [
             w
             for w in range(len(work_src))
-            if ((int(work_src[w]) << 32) | int(work_seq[w])) in self._cmds
+            if self._packed(work_src[w], work_seq[w]) in self._cmds
         ]
         carried.sort(key=lambda w: (not committed[w], w))
         kept, dropped = carried[:pend_cap], carried[pend_cap:]
@@ -381,6 +388,7 @@ class NewtDeviceDriver:
         for slot, w in enumerate(kept):
             self._pend_src[slot] = work_src[w]
             self._pend_seq[slot] = work_seq[w]
+        requeued = 0
         for w in dropped:
             if committed[w]:
                 raise RuntimeError(
@@ -388,11 +396,16 @@ class NewtDeviceDriver:
                     "but-unstable commands: raise pending_capacity (a "
                     "committed clock cannot be re-proposed)"
                 )
-            packed = (int(work_src[w]) << 32) | int(work_seq[w])
+            packed = self._packed(work_src[w], work_seq[w])
             entry = self._cmds.pop(packed, None)
             if entry is not None:
-                logger.warning("newt device pending overflow: re-queueing %s", entry[0])
+                requeued += 1
                 self._requeue.append(entry)
+        if requeued:
+            logger.warning(
+                "newt device pending overflow: re-queueing %d commands",
+                requeued,
+            )
         return results
 
     def take_requeue(self) -> List[Tuple[Dot, Command]]:
@@ -404,6 +417,145 @@ class ProtocolError(Exception):
     """A client broke the wire contract: kills only its session, never
     the runtime (the per-connection failure isolation of the reference's
     client task, fantoch/src/run/task/process.rs:320-325)."""
+
+
+class PaxosDeviceDriver(_DriverCore):
+    """Host control loop around the device-resident leader-based slot
+    round (parallel/mesh_step.paxos_protocol_step): replica 0 assigns
+    consecutive slots, acceptor acks are one psum, and execution is
+    strictly contiguous in slot order — the FPaxos/MultiSynod class
+    (fantoch_ps/src/bin/fpaxos.rs served through fantoch/src/run/mod.rs:105)
+    as a mesh program.
+
+    Commands need no key rows (the slot log totally orders them), so
+    ``key_width`` is None: the session validator accepts any width.  The
+    registry keys on packed (source, sequence); the host mirrors the
+    device's slot-ordered pending carry to track identities across
+    degraded rounds.
+    """
+
+    key_width = None  # slot order needs no key rows: any command width
+
+    def __init__(
+        self,
+        num_replicas: int,
+        *,
+        f: int = 1,
+        batch_size: int = 256,
+        key_buckets: int = 4096,
+        pending_capacity: int = 256,
+        live_replicas: Optional[int] = None,
+        shard_id: ShardId = 0,
+        monitor_execution_order: bool = False,
+        mesh=None,
+    ):
+        from fantoch_tpu.parallel import mesh_step
+
+        self._init_core(shard_id, batch_size, key_buckets, monitor_execution_order)
+        self._mesh = (
+            mesh
+            if mesh is not None
+            else mesh_step.make_mesh(num_replicas=num_replicas)
+        )
+        self._state = mesh_step.init_paxos_state(
+            self._mesh, pending_capacity=pending_capacity
+        )
+        self._step = mesh_step.jit_paxos_step(
+            self._mesh,
+            f=f,
+            num_replicas=num_replicas,
+            live_replicas=live_replicas,
+        )
+        # host mirror of the device pending buffer's identity columns
+        # (valid = slot >= 0, matching PaxosMeshState.pend_slot);
+        # fast_paths stays 0 — leader-based: every commit is the one path
+        cap = pending_capacity
+        self._pend_slot = np.full(cap, -1, dtype=np.int64)
+        self._pend_src = np.zeros(cap, dtype=np.int32)
+        self._pend_seq = np.zeros(cap, dtype=np.int32)
+
+    def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
+        import jax.numpy as jnp
+
+        assert len(batch) <= self.batch_size
+        b = self.batch_size
+        valid = np.zeros(b, dtype=bool)
+        src = np.zeros(b, dtype=np.int32)
+        seq = np.zeros(b, dtype=np.int32)
+        for i, (dot, cmd) in enumerate(batch):
+            self._check_seq(dot)
+            valid[i] = True
+            src[i] = dot.source
+            seq[i] = dot.sequence
+            self._cmds[self._packed(dot.source, dot.sequence)] = (dot, cmd)
+
+        # this round's working-row identities: pending buffer first
+        work_valid = np.concatenate([self._pend_slot >= 0, valid])
+        work_src = np.concatenate([self._pend_src, src])
+        work_seq = np.concatenate([self._pend_seq, seq])
+
+        self._state, out = self._step(
+            self._state, jnp.asarray(valid), jnp.asarray(src), jnp.asarray(seq)
+        )
+        self.rounds += 1
+
+        order = np.asarray(out.order)
+        executed = np.asarray(out.executed)
+        slot = np.asarray(out.slot)
+        self.stable_watermark = int(self._state.exec_frontier)
+        # every commit in the leader class takes the same (slow) path: one
+        # accept round — mirror the tally convention of the object runner
+        self.slow_paths += int(executed.sum())
+
+        results: List[ExecutorResult] = []
+        for w in order.tolist():
+            if not executed[w]:
+                continue
+            packed = self._packed(work_src[w], work_seq[w])
+            entry = self._cmds.pop(packed, None)
+            if entry is None:
+                continue  # pad row
+            _dot, cmd = entry
+            results.extend(cmd.execute(self.shard_id, self.store))
+            self.executed += 1
+
+        # mirror the device's pending carry: unexecuted valid rows in SLOT
+        # order, lowest pend_cap kept.  Overflow rows are the HIGHEST slots
+        # and the device rolled its slot counter back over them (the log
+        # stays dense), so re-queueing them under the same dot is safe: no
+        # acceptor holds durable state for a rolled-back slot.
+        pend_cap = len(self._pend_slot)
+        carried = [
+            w
+            for w in range(len(work_src))
+            if work_valid[w] and not executed[w]
+        ]
+        carried.sort(key=lambda w: int(slot[w]))
+        kept, dropped = carried[:pend_cap], carried[pend_cap:]
+        self._pend_slot = np.full(pend_cap, -1, dtype=np.int64)
+        self._pend_src = np.zeros(pend_cap, dtype=np.int32)
+        self._pend_seq = np.zeros(pend_cap, dtype=np.int32)
+        for i, w in enumerate(kept):
+            self._pend_slot[i] = slot[w]
+            self._pend_src[i] = work_src[w]
+            self._pend_seq[i] = work_seq[w]
+        requeued = 0
+        for w in dropped:
+            packed = self._packed(work_src[w], work_seq[w])
+            entry = self._cmds.pop(packed, None)
+            if entry is not None:
+                requeued += 1
+                self._requeue.append(entry)
+        if requeued:
+            logger.warning(
+                "paxos device pending overflow: re-queueing %d commands",
+                requeued,
+            )
+        return results
+
+    def take_requeue(self) -> List[Tuple[Dot, Command]]:
+        out, self._requeue = self._requeue, []
+        return out
 
 
 class _DeviceClientSession:
@@ -452,7 +604,8 @@ class _DeviceClientSession:
         buckets = _buckets(cmd, driver.shard_id, driver.key_buckets)
         if not buckets:
             return "command touches no keys on this shard"
-        if len(buckets) > driver.key_width:
+        # key_width None = the driver needs no key rows (slot-ordered)
+        if driver.key_width is not None and len(buckets) > driver.key_width:
             return (
                 f"command touches {len(buckets)} key buckets but the device "
                 f"state was compiled with key_width={driver.key_width}"
@@ -545,6 +698,17 @@ class DeviceRuntime:
                 batch_size=batch_size,
                 key_buckets=key_buckets,
                 key_width=key_width,
+                pending_capacity=pending_capacity,
+                live_replicas=live_replicas,
+                monitor_execution_order=monitor_execution_order,
+                mesh=mesh,
+            )
+        elif protocol == "fpaxos":
+            self.driver = PaxosDeviceDriver(
+                config.n,
+                f=config.f,
+                batch_size=batch_size,
+                key_buckets=key_buckets,
                 pending_capacity=pending_capacity,
                 live_replicas=live_replicas,
                 monitor_execution_order=monitor_execution_order,
@@ -707,10 +871,13 @@ class DeviceRuntime:
             self._deliver(results)
             self._publish_tallies()
             # commands stuck in the device pending buffer (degraded quorum)
-            # with no new submissions would otherwise hot-spin empty device
-            # rounds; back off — interruptibly, so a submit arriving
-            # mid-backoff starts the next round immediately
-            if not batch and not results:
+            # with no new submissions would otherwise hot-spin device
+            # rounds — including overflow-requeue cycles, whose batches are
+            # non-empty but commit nothing; back off whenever a round made
+            # no progress and no fresh submissions wait — interruptibly,
+            # so a submit arriving mid-backoff starts the next round
+            # immediately
+            if not results and not self._submit_queue:
                 idle_rounds += 1
                 backoff = min(0.001 * (2 ** min(idle_rounds, 6)), 0.05)
                 self._work.clear()

@@ -205,6 +205,175 @@ def test_newt_driver_multi_key():
     assert by_key["b"] == [None, "b0", "b2"]
 
 
+def test_paxos_driver_slot_chain():
+    """The leader-based slot round behind the driver seam: execution is
+    contiguous slot order == submission order, the key chain reflects it,
+    and the frontier carries across rounds (third protocol family
+    served; fantoch_ps/src/bin/fpaxos.rs analog)."""
+    from fantoch_tpu.run.device_runner import PaxosDeviceDriver
+
+    d = PaxosDeviceDriver(3, f=1, batch_size=16, monitor_execution_order=True)
+    batch = [
+        (Dot(1, i + 1), Command.from_single(Rifl(1, i + 1), 0, "hot", KVOp.put(str(i))))
+        for i in range(10)
+    ]
+    results = d.step(batch)
+    assert [r.op_results[0] for r in results] == [None] + [str(i) for i in range(9)]
+    assert d.executed == 10 and d.in_flight == 0
+    assert d.stable_watermark == 10
+    (r,) = d.step(
+        [(Dot(1, 11), Command.from_single(Rifl(1, 11), 0, "hot", KVOp.put("x")))]
+    )
+    assert r.op_results[0] == "9"
+    assert d.stable_watermark == 11
+
+
+def test_paxos_driver_degraded_requeue_recovery():
+    """Slot stickiness + overflow slot-rollback at the driver seam: a
+    degraded round commits nothing, overflow beyond the pending buffer
+    re-queues the highest slots, and after recovery every command
+    executes exactly once in a dense slot log."""
+    from fantoch_tpu.parallel import mesh_step
+    from fantoch_tpu.run.device_runner import PaxosDeviceDriver
+
+    d = PaxosDeviceDriver(
+        3, f=1, batch_size=8, pending_capacity=4,
+        live_replicas=1, monitor_execution_order=True,
+    )
+    batch = [
+        (Dot(1, i + 1), Command.from_single(Rifl(1, i + 1), 0, "k", KVOp.put(str(i))))
+        for i in range(8)
+    ]
+    assert d.step(batch) == []
+    requeued = d.take_requeue()
+    # 8 valid rows, capacity 4: the 4 highest slots were dropped and
+    # their commands re-queued under their original dots
+    assert [dot.sequence for dot, _ in requeued] == [5, 6, 7, 8]
+    assert d.in_flight == 4
+
+    # recovery: all replicas answer again (the runtime would re-jit the
+    # step the same way on failure-detector feedback)
+    d._step = mesh_step.jit_paxos_step(d._mesh, f=1, num_replicas=3)
+    results = d.step(requeued)
+    assert d.executed == 8 and d.in_flight == 0
+    # carried slots (0-3) execute before the reassigned ones; per-key
+    # chain shows every put exactly once
+    assert [r.op_results[0] for r in results] == [None, "0", "1", "2", "3", "4", "5", "6"]
+    order = d.store.monitor.get_order("k")
+    assert len(order) == len(set(order)) == 8
+
+
+def test_device_runtime_paxos_tcp_serving():
+    """Real TCP clients served through the leader-based slot round:
+    --device-step --protocol fpaxos end-to-end."""
+    config = Config(3, 1, shard_count=1)
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=2,  # slot order needs no key rows: any width
+        commands_per_client=COMMANDS_PER_CLIENT,
+        payload_size=1,
+    )
+    runtime, clients = asyncio.run(
+        run_device_server(
+            config, workload, client_count=4, batch_size=32, protocol="fpaxos"
+        )
+    )
+    assert len(clients) == 4
+    for client in clients.values():
+        assert client.issued_commands == COMMANDS_PER_CLIENT
+    driver = runtime.driver
+    assert driver.executed == 4 * COMMANDS_PER_CLIENT
+    assert driver.in_flight == 0
+    # two keys per command: a rifl appears once in each touched key's order
+    monitor = driver.store.monitor
+    for key in monitor.keys():
+        order = monitor.get_order(key)
+        assert len(order) == len(set(order))
+
+
+def test_newt_runtime_requeue_after_degraded_round():
+    """VERDICT r4 weak #5: a Newt command that overflows the pending
+    buffer in a degraded round re-enters the submit queue under the same
+    dot and completes after recovery — through the real TCP runtime —
+    with per-key order intact.
+
+    Topology chosen to produce *uncommitted* (requeue-able) overflow:
+    n=5, f=2, one live replica.  The first degraded round still commits
+    its batch on the fast path (all proposals agree: max-count f is met),
+    but those commands cannot stabilize without live voters; from the
+    next round the lone live replica's clock has diverged, the fast path
+    misses (max reported by 1 < f) and Synod gets 1 < f+1 acks, so later
+    commands stay uncommitted.  With 24 hot-key commands against a
+    16-slot pending buffer the committed backlog (8, carried with
+    priority) plus uncommitted rows overflow — the overflowed uncommitted
+    tail cycles through take_requeue() under its original dots."""
+    from fantoch_tpu.parallel import mesh_step
+    from fantoch_tpu.run.client_runner import run_clients
+    from fantoch_tpu.run.device_runner import DeviceRuntime
+    from fantoch_tpu.run.harness import free_port
+
+    async def go():
+        config = Config(5, 2, shard_count=1)
+        port = free_port()
+        runtime = DeviceRuntime(
+            config,
+            ("127.0.0.1", port),
+            protocol="newt",
+            batch_size=8,
+            key_buckets=64,
+            pending_capacity=16,
+            live_replicas=1,
+            monitor_execution_order=True,
+        )
+        await runtime.start()
+        try:
+            workload = Workload(
+                shard_count=1,
+                key_gen=ConflictRateKeyGen(100),  # one hot key: max contention
+                keys_per_command=1,
+                commands_per_client=8,
+                payload_size=1,
+            )
+            # open-loop clients keep submitting without waiting, pushing
+            # past the pending capacity while degraded
+            client_task = asyncio.ensure_future(
+                run_clients(
+                    [1, 2, 3], {0: ("127.0.0.1", port)}, workload,
+                    open_loop_interval_ms=5,
+                )
+            )
+            # wait until all 24 commands are in flight with rounds cycling
+            # and nothing executing: 24 > pending_capacity=16 proves the
+            # overflow tail is living in the requeue loop
+            driver = runtime.driver
+            for _ in range(400):
+                await asyncio.sleep(0.025)
+                if driver.rounds >= 6 and driver.in_flight == 24:
+                    break
+            assert driver.in_flight == 24 and driver.rounds >= 6
+            assert driver.executed == 0
+            # recovery: swap in the healthy step (what a failure-detector
+            # integration would do); in-flight commands must now commit
+            driver._step = mesh_step.jit_newt_step(
+                driver._mesh, f=config.f, tiny_quorums=False
+            )
+            clients = await client_task
+            for client in clients.values():
+                assert client.issued_commands == 8
+            assert driver.executed == 24
+            assert driver.in_flight == 0
+            order = driver.store.monitor.get_order(
+                next(iter(driver.store.monitor.keys()))
+            )
+            assert len(order) == len(set(order)) == 24
+            assert runtime.failure is None
+        finally:
+            await runtime.stop()
+
+    asyncio.run(go())
+
+
 def test_device_runtime_survives_bad_client():
     """A client submitting a command wider than the compiled key_width is
     rejected at the session boundary with an empty CommandResult — the
