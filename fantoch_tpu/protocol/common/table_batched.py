@@ -135,9 +135,21 @@ class BatchedKeyClocks:
                 return out
         return [self.proposal(cmd, mc) for cmd, mc in zip(cmds, min_clocks)]
 
-    def _proposal_batch_kernel(
-        self, keys: List[Key], min_clocks: List[int]
-    ) -> Optional[List[Tuple[int, Votes]]]:
+    def proposal_batch_arrays(
+        self, keys: List[Key], min_clocks
+    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Array-native proposal seam (VERDICT r4 #4): clocks + consumed
+        vote-range starts as int64 columns, NO Votes/VoteRange objects —
+        the per-command object building that floors the host path at
+        ~4.5 us/cmd happens only at whatever boundary actually needs
+        objects.  The vote consumed by row ``i`` is
+        ``[vote_start[i], clock[i]]`` by this process.
+
+        Returns None when clocks exceed the 31-bit kernel window
+        (real-time micros; callers fall back to the sequential loop).
+        Semantics: identical to running ``proposal`` sequentially —
+        same-key commands get consecutive clocks in batch order
+        (fantoch_ps/src/protocol/common/table/votes.rs:133 ranges)."""
         import jax.numpy as jnp
 
         from fantoch_tpu.ops.table_ops import batched_clock_proposal
@@ -167,8 +179,17 @@ class BatchedKeyClocks:
         vote_start = np.asarray(vote_start)[:batch].astype(np.int64)
         new_prior = np.asarray(new_prior).astype(np.int64)
         self._clocks[: self._count] = new_prior[: self._count]
+        return clock, vote_start
+
+    def _proposal_batch_kernel(
+        self, keys: List[Key], min_clocks: List[int]
+    ) -> Optional[List[Tuple[int, Votes]]]:
+        arrays = self.proposal_batch_arrays(keys, min_clocks)
+        if arrays is None:
+            return None
+        clock, vote_start = arrays
         out: List[Tuple[int, Votes]] = []
-        for i in range(batch):
+        for i in range(len(keys)):
             votes = Votes()
             votes.set(
                 keys[i],

@@ -76,10 +76,23 @@ def _bucket_row(cmd: Command, shard_id: ShardId, key_buckets: int, key_width: in
 
 class _DriverCore:
     """The host-side machinery every device driver shares: the in-flight
-    command registry, the overflow requeue channel, the KVStore, and the
-    serving tallies (the BaseProcess metrics twin).  Keeping it in one
-    place keeps the three protocol drivers from silently diverging on
-    the registry/requeue contract."""
+    command registry, the overflow requeue channel, the KVStore, the
+    serving tallies (the BaseProcess metrics twin), and the 31-bit
+    dot-sequence window.  Keeping it in one place keeps the three
+    protocol drivers from silently diverging on the registry/requeue
+    contract.
+
+    Sequence windowing: dots are unbounded host ints, device columns are
+    int32.  The device only ever *compares* sequences among in-flight
+    rows (tie-breaking, identity mirrors), so columns carry
+    ``sequence - seq_base`` and the base advances to the oldest in-flight
+    sequence whenever the window would overflow — the ClockWindow design
+    of fantoch_tpu/ops/table_ops.py applied to dots (reference GC keeps
+    dot state bounded the same way, fantoch/src/protocol/gc.rs:72-116).
+    """
+
+    # leave headroom so a full batch plus in-round growth never wraps
+    SEQ_WINDOW_MAX = 2**31 - (1 << 20)
 
     def _init_core(
         self,
@@ -94,6 +107,8 @@ class _DriverCore:
         # commands in flight: registered at step entry, dropped at execution
         self._cmds: Dict[int, Tuple[Dot, Command]] = {}
         self._requeue: List[Tuple[Dot, Command]] = []
+        self._seq_base = 0  # device seq column = dot.sequence - seq_base
+        self.seq_epochs = 0  # window advances (observability)
         self.store = KVStore(monitor_execution_order)
         self.rounds = 0
         self.fast_paths = 0
@@ -114,15 +129,64 @@ class _DriverCore:
 
     @staticmethod
     def _packed(src, seq) -> int:
-        """Registry key for dot-identified commands."""
+        """Registry key for dot-identified commands (device-window seq)."""
         return (int(src) << 32) | int(seq)
 
-    @staticmethod
-    def _check_seq(dot: Dot) -> None:
-        # int32 device ordering columns: a wrapped sequence would silently
-        # alias registry keys / tie-breaks — fail loudly, identically in
-        # every driver
-        assert dot.sequence < 2**31 - 1, "dot sequence exhausts int32"
+    # --- the 31-bit dot-sequence window ---
+
+    def _device_seq(self, dot: Dot) -> int:
+        seq = dot.sequence - self._seq_base
+        assert 0 <= seq < 2**31 - 1, (
+            f"dot sequence {dot.sequence} outside the device window "
+            f"(base {self._seq_base}); _ensure_seq_window must run first"
+        )
+        return seq
+
+    def _ensure_seq_window(self, batch: List[Tuple[Dot, Command]]) -> None:
+        """Advance the sequence window if this batch would overflow it.
+
+        The new base is the oldest sequence still relevant to the device:
+        min over in-flight registry dots, requeued dots, and the incoming
+        batch.  Live device comparisons all involve rows at or above it,
+        so the uniform shift is order-preserving; the driver-specific
+        ``_shift_seq_state`` rebases device-resident and mirrored
+        sequence columns."""
+        if not batch:
+            return
+        top = max(dot.sequence for dot, _ in batch) - self._seq_base
+        if top < self.SEQ_WINDOW_MAX:
+            return
+        live = [dot.sequence for dot, _ in batch]
+        live += [dot.sequence for dot, _ in self._cmds.values()]
+        live += [dot.sequence for dot, _ in self._requeue]
+        floor = min(live)
+        shift = floor - self._seq_base
+        assert shift > 0, (
+            "sequence window cannot advance: an in-flight command is "
+            f"pinned {top - self.SEQ_WINDOW_MAX} below the overflow"
+        )
+        self._seq_base = floor
+        self.seq_epochs += 1
+        self._on_seq_window_advanced(shift)
+        logger.info(
+            "advanced dot-sequence window to base %d (epoch %d)",
+            floor, self.seq_epochs,
+        )
+
+    def _on_seq_window_advanced(self, shift: int) -> None:
+        """Rebase driver-held sequence state after a window advance: the
+        registry (where keyed on packed dots), device-resident pend_seq
+        columns, and any host mirrors.  Driver-specific."""
+        raise NotImplementedError
+
+    def _rekey_registry_for_window(self) -> None:
+        """Shared helper for dot-keyed registries (Newt/Paxos): recompute
+        packed keys under the new seq_base."""
+        self._cmds = {
+            self._packed(dot.source, dot.sequence - self._seq_base): entry
+            for entry in self._cmds.values()
+            for dot in (entry[0],)
+        }
 
 
 class DeviceDriver(_DriverCore):
@@ -180,11 +244,82 @@ class DeviceDriver(_DriverCore):
             self._mesh, live_replicas=live_replicas
         )
         self._next_gid = 0  # host mirror of state.next_gid
+        self._frontier_base = 0  # executed-count carried across gid epochs
+        self.gid_epochs = 0
 
     # --- the serving round ---
 
     def _bucket_row(self, cmd: Command) -> List[int]:
         return _bucket_row(cmd, self.shard_id, self.key_buckets, self.key_width)
+
+    # gid space is int32 and the key clock holds raw gids; when the space
+    # nears exhaustion the epoch resets — rebase clock/frontier/pending
+    # against the oldest in-flight gid instead of dying by assert
+    # (the ClockWindow design of ops/table_ops.py applied to gids; the
+    # reference's GC keeps dot state bounded forever the same way,
+    # fantoch/src/protocol/gc.rs:72-116)
+    GID_RESET_THRESHOLD = 2**31 - (1 << 20)
+
+    def _gid_epoch_reset(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        st = self._state
+        # after a step, registry keys == the gids still carried on-device
+        delta = min(self._cmds.keys(), default=self._next_gid)
+        if delta <= 0:
+            raise RuntimeError(
+                "gid epoch reset ineffective: a command from gid 0 is "
+                "still in flight"
+            )
+        key_clock = np.asarray(st.key_clock, dtype=np.int64)
+        # entries older than the oldest live gid clamp to -1 ("no live
+        # predecessor") — exactly their meaning to dep pruning, which
+        # treats out-of-working-set deps as already executed
+        key_clock = np.where(key_clock >= delta, key_clock - delta, -1)
+        pend_gid = np.asarray(st.pend_gid, dtype=np.int64)
+        pend_gid = np.where(pend_gid >= 0, pend_gid - delta, -1)
+        frontier = np.asarray(st.frontier, dtype=np.int64)
+        fmin = int(frontier.min())
+        self._frontier_base += fmin
+        self._state = st._replace(
+            key_clock=jax.device_put(
+                jnp.asarray(key_clock.astype(np.int32)), st.key_clock.sharding
+            ),
+            frontier=jax.device_put(
+                jnp.asarray((frontier - fmin).astype(np.int32)),
+                st.frontier.sharding,
+            ),
+            next_gid=jax.device_put(
+                jnp.int32(self._next_gid - delta), st.next_gid.sharding
+            ),
+            pend_gid=jax.device_put(
+                jnp.asarray(pend_gid.astype(np.int32)), st.pend_gid.sharding
+            ),
+        )
+        self._next_gid -= delta
+        self._cmds = {g - delta: v for g, v in self._cmds.items()}
+        self.gid_epochs += 1
+        logger.info(
+            "gid epoch reset: rebased by %d (epoch %d, next_gid %d)",
+            delta, self.gid_epochs, self._next_gid,
+        )
+
+    def _on_seq_window_advanced(self, shift: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        # registry keys are gids — only the device pend_seq column carries
+        # window sequences (dead slots are masked by pend_gid on-device)
+        st = self._state
+        pend_seq = np.asarray(st.pend_seq, dtype=np.int64) - shift
+        pend_gid = np.asarray(st.pend_gid)
+        pend_seq = np.where(pend_gid >= 0, pend_seq, -1)
+        self._state = st._replace(
+            pend_seq=jax.device_put(
+                jnp.asarray(pend_seq.astype(np.int32)), st.pend_seq.sharding
+            )
+        )
 
     def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
         """One device round over up to ``batch_size`` new commands (the
@@ -203,15 +338,19 @@ class DeviceDriver(_DriverCore):
         key = np.full((b, self.key_width), KEY_PAD, dtype=np.int32)
         src = np.zeros(b, dtype=np.int32)
         seq = np.zeros(b, dtype=np.int32)
-        # gid space is int32 and the key clock holds raw gids; exhausting
-        # it needs an epoch reset (rebase clock + frontier), not wraparound
-        assert self._next_gid + b < 2**31 - 1, "gid space exhausted"
+        if self._next_gid + b >= self.GID_RESET_THRESHOLD:
+            self._gid_epoch_reset()
+            if self._next_gid + b >= 2**31 - 1:
+                raise RuntimeError(
+                    "gid space exhausted: a long-stuck in-flight command "
+                    "pins the epoch (oldest live gid too old to rebase)"
+                )
+        self._ensure_seq_window(batch)
         for i, (dot, cmd) in enumerate(batch):
             row = self._bucket_row(cmd)
             key[i, : len(row)] = row
             src[i] = dot.source
-            self._check_seq(dot)
-            seq[i] = dot.sequence
+            seq[i] = self._device_seq(dot)
             self._cmds[self._next_gid + i] = (dot, cmd)
 
         self._state, out = self._step(
@@ -224,7 +363,7 @@ class DeviceDriver(_DriverCore):
         resolved = np.asarray(out.resolved)
         gids = np.asarray(out.gids)
         fast = np.asarray(out.fast_path)
-        self.stable_watermark = int(out.stable)
+        self.stable_watermark = self._frontier_base + int(out.stable)
 
         results: List[ExecutorResult] = []
         for w in order.tolist():
@@ -318,6 +457,63 @@ class NewtDeviceDriver(_DriverCore):
         cap = pending_capacity
         self._pend_src = np.zeros(cap, dtype=np.int32)
         self._pend_seq = np.zeros(cap, dtype=np.int32)
+        self._clock_floor = 0  # timestamps GC'd below this (host int)
+        self.clock_epochs = 0
+
+    # timestamp clocks are int32 and grow ~1 per conflicting command per
+    # bucket; when the stable watermark nears the cap, advance the clock
+    # window (ops/table_ops.ClockWindow semantics: every live comparison
+    # happens above the GC'd stable floor, so the uniform shift is
+    # order-preserving; below-floor entries clamp to the bottom)
+    CLOCK_RESET_THRESHOLD = 2**31 - (1 << 22)
+
+    def _advance_clock_window(self, floor: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from fantoch_tpu.ops.table_ops import shift_table
+
+        st = self._state
+        pend_clock = np.asarray(st.pend_clock, dtype=np.int64)
+        live = pend_clock >= 0
+        # committed-but-unstable clocks sit strictly above the stable
+        # floor (stable would have executed them), so none clamp
+        assert (pend_clock[live] > floor).all(), (
+            "carried committed clock at/below the stable floor"
+        )
+        pend_clock = np.where(live, pend_clock - floor, -1)
+        self._state = st._replace(
+            key_clock=shift_table(st.key_clock, floor),
+            vote_frontier=shift_table(st.vote_frontier, floor),
+            pend_clock=jax.device_put(
+                jnp.asarray(pend_clock.astype(np.int32)),
+                st.pend_clock.sharding,
+            ),
+        )
+        self._clock_floor += floor
+        self.clock_epochs += 1
+        logger.info(
+            "advanced newt clock window by %d (epoch %d)",
+            floor, self.clock_epochs,
+        )
+
+    def _on_seq_window_advanced(self, shift: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self._rekey_registry_for_window()
+        # dead mirror/device slots are masked by pend_key on-device and
+        # match no registry key on the host — blind shift is safe
+        self._pend_seq = (
+            self._pend_seq.astype(np.int64) - shift
+        ).astype(np.int32)
+        st = self._state
+        pend_seq = np.asarray(st.pend_seq, dtype=np.int64) - shift
+        self._state = st._replace(
+            pend_seq=jax.device_put(
+                jnp.asarray(pend_seq.astype(np.int32)), st.pend_seq.sharding
+            )
+        )
 
     def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
         import jax.numpy as jnp
@@ -325,17 +521,17 @@ class NewtDeviceDriver(_DriverCore):
         from fantoch_tpu.parallel.mesh_step import KEY_PAD
 
         assert len(batch) <= self.batch_size
+        self._ensure_seq_window(batch)
         b = self.batch_size
         key = np.full((b, self.key_width), KEY_PAD, dtype=np.int32)
         src = np.zeros(b, dtype=np.int32)
         seq = np.zeros(b, dtype=np.int32)
         for i, (dot, cmd) in enumerate(batch):
             buckets = _bucket_row(cmd, self.shard_id, self.key_buckets, self.key_width)
-            self._check_seq(dot)
             key[i, : len(buckets)] = buckets
             src[i] = dot.source
-            seq[i] = dot.sequence
-            self._cmds[self._packed(dot.source, dot.sequence)] = (dot, cmd)
+            seq[i] = self._device_seq(dot)
+            self._cmds[self._packed(dot.source, seq[i])] = (dot, cmd)
 
         # this round's working-row identities: pending buffer first
         work_src = np.concatenate([self._pend_src, src])
@@ -349,7 +545,13 @@ class NewtDeviceDriver(_DriverCore):
         order = np.asarray(out.order)
         executed = np.asarray(out.executed)
         committed = np.asarray(out.committed)
-        self.stable_watermark = int(out.stable_watermark)
+        device_wm = int(out.stable_watermark)
+        # int_max = "no keys seen this round" sentinel: skip both the
+        # report and the window check
+        if device_wm < 2**31 - 1:
+            self.stable_watermark = self._clock_floor + device_wm
+            if device_wm >= self.CLOCK_RESET_THRESHOLD:
+                self._advance_clock_window(device_wm)
         self.slow_paths += int(out.slow_paths)
         # fast/slow tallies are commit-time facts: a fast-committed command
         # may only *stabilize* (execute) rounds later, when the flag is no
@@ -407,10 +609,6 @@ class NewtDeviceDriver(_DriverCore):
                 requeued,
             )
         return results
-
-    def take_requeue(self) -> List[Tuple[Dot, Command]]:
-        out, self._requeue = self._requeue, []
-        return out
 
 
 class ProtocolError(Exception):
@@ -473,21 +671,91 @@ class PaxosDeviceDriver(_DriverCore):
         self._pend_slot = np.full(cap, -1, dtype=np.int64)
         self._pend_src = np.zeros(cap, dtype=np.int32)
         self._pend_seq = np.zeros(cap, dtype=np.int32)
+        self._slot_base = 0  # slots below base + exec_frontier executed
+        self._next_slot = 0  # host mirror of state.next_slot
+        self.slot_epochs = 0
+
+    # the slot log is an int32 counter growing one per command; rebase
+    # against the contiguous exec frontier (every live slot is at or
+    # above it) before it can wrap
+    SLOT_RESET_THRESHOLD = 2**31 - (1 << 20)
+
+    def _slot_epoch_reset(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        st = self._state
+        delta = int(st.exec_frontier)
+        if delta <= 0:
+            raise RuntimeError(
+                "slot log exhausted: nothing executed, the frontier "
+                "cannot rebase the slot space"
+            )
+        pend_slot = np.asarray(st.pend_slot, dtype=np.int64)
+        live = pend_slot >= 0
+        assert (pend_slot[live] >= delta).all(), (
+            "carried slot below the contiguous exec frontier"
+        )
+        pend_slot = np.where(live, pend_slot - delta, -1)
+        self._state = st._replace(
+            next_slot=jax.device_put(
+                jnp.int32(self._next_slot - delta), st.next_slot.sharding
+            ),
+            exec_frontier=jax.device_put(
+                jnp.int32(0), st.exec_frontier.sharding
+            ),
+            pend_slot=jax.device_put(
+                jnp.asarray(pend_slot.astype(np.int32)), st.pend_slot.sharding
+            ),
+        )
+        self._pend_slot = np.where(
+            self._pend_slot >= 0, self._pend_slot - delta, -1
+        )
+        self._next_slot -= delta
+        self._slot_base += delta
+        self.slot_epochs += 1
+        logger.info(
+            "paxos slot epoch reset: rebased by %d (epoch %d)",
+            delta, self.slot_epochs,
+        )
+
+    def _on_seq_window_advanced(self, shift: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self._rekey_registry_for_window()
+        self._pend_seq = (
+            self._pend_seq.astype(np.int64) - shift
+        ).astype(np.int32)
+        st = self._state
+        pend_seq = np.asarray(st.pend_seq, dtype=np.int64) - shift
+        self._state = st._replace(
+            pend_seq=jax.device_put(
+                jnp.asarray(pend_seq.astype(np.int32)), st.pend_seq.sharding
+            )
+        )
 
     def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
         import jax.numpy as jnp
 
         assert len(batch) <= self.batch_size
+        if self._next_slot + self.batch_size >= self.SLOT_RESET_THRESHOLD:
+            self._slot_epoch_reset()
+            if self._next_slot + self.batch_size >= 2**31 - 1:
+                raise RuntimeError(
+                    "slot log exhausted: the contiguous exec frontier is "
+                    "pinned too far behind to rebase"
+                )
+        self._ensure_seq_window(batch)
         b = self.batch_size
         valid = np.zeros(b, dtype=bool)
         src = np.zeros(b, dtype=np.int32)
         seq = np.zeros(b, dtype=np.int32)
         for i, (dot, cmd) in enumerate(batch):
-            self._check_seq(dot)
             valid[i] = True
             src[i] = dot.source
-            seq[i] = dot.sequence
-            self._cmds[self._packed(dot.source, dot.sequence)] = (dot, cmd)
+            seq[i] = self._device_seq(dot)
+            self._cmds[self._packed(dot.source, seq[i])] = (dot, cmd)
 
         # this round's working-row identities: pending buffer first
         work_valid = np.concatenate([self._pend_slot >= 0, valid])
@@ -502,7 +770,9 @@ class PaxosDeviceDriver(_DriverCore):
         order = np.asarray(out.order)
         executed = np.asarray(out.executed)
         slot = np.asarray(out.slot)
-        self.stable_watermark = int(self._state.exec_frontier)
+        # device slot counter: + new valid rows, - rolled-back overflow
+        self._next_slot += len(batch) - int(out.pend_dropped)
+        self.stable_watermark = self._slot_base + int(self._state.exec_frontier)
         # every commit in the leader class takes the same (slow) path: one
         # accept round — mirror the tally convention of the object runner
         self.slow_paths += int(executed.sum())
@@ -552,10 +822,6 @@ class PaxosDeviceDriver(_DriverCore):
                 requeued,
             )
         return results
-
-    def take_requeue(self) -> List[Tuple[Dot, Command]]:
-        out, self._requeue = self._requeue, []
-        return out
 
 
 class _DeviceClientSession:

@@ -103,6 +103,144 @@ def test_batched_proposal_multikey_fallback():
         assert ce == cg and votes_of(ve) == votes_of(vg)
 
 
+def test_proposal_batch_arrays_matches_objects():
+    """proposal_batch_arrays returns the same clocks and consumed ranges
+    as the object path (which itself equals the sequential twin) — the
+    array seam is just the object loop deleted."""
+    rng = random.Random(7)
+    bat_obj = BatchedKeyClocks(1, SHARD)
+    bat_arr = BatchedKeyClocks(1, SHARD)
+    next_id = 0
+    for _round in range(4):
+        keys, mins, cmds = [], [], []
+        for _ in range(rng.randrange(1, 50)):
+            key = f"k{rng.randrange(5)}"
+            keys.append(key)
+            cmds.append(put_cmd(next_id, [key]))
+            next_id += 1
+            mins.append(rng.randrange(0, 30))
+        expected = bat_obj.proposal_batch(cmds, mins)
+        clock, start = bat_arr.proposal_batch_arrays(keys, mins)
+        for i, (ce, ve) in enumerate(expected):
+            assert int(clock[i]) == ce
+            ((_k, [(by, s, e)]),) = list(
+                (k, [(v.by, v.start, v.end) for v in rs]) for k, rs in ve
+            )
+            assert (by, s, e) == (1, int(start[i]), int(clock[i]))
+
+
+def test_handle_batch_arrays_oracle_equivalence():
+    """The array-native executor seam executes exactly what the
+    per-info object path executes, in the same per-key order — across a
+    round that leaves unstable tails buffered and a second round whose
+    votes flush them (the buffered-merge path)."""
+    from fantoch_tpu.core import Dot, RunTime
+    from fantoch_tpu.executor.table import (
+        TableExecutor,
+        TableVotes,
+        TableVotesArrays,
+    )
+    from fantoch_tpu.protocol.common.table_clocks import VoteRange
+
+    rng = random.Random(3)
+    n = 3
+    time = RunTime()
+
+    def executors():
+        cfg_a = Config(n, 1, batched_table_executor=True,
+                       executor_monitor_execution_order=True)
+        cfg_b = Config(n, 1, batched_table_executor=False,
+                       executor_monitor_execution_order=True)
+        return TableExecutor(1, SHARD, cfg_a), TableExecutor(1, SHARD, cfg_b)
+
+    ex_arrays, ex_oracle = executors()
+    key_clock = {}
+    next_seq = 1
+
+    def make_round(voters_full):
+        """Rows with per-key consecutive clocks; coordinator always votes
+        its consumed range, `voters_full` processes vote the full prefix."""
+        nonlocal next_seq
+        B = rng.randrange(5, 40)
+        keys, rows = [], []
+        for _ in range(B):
+            key = f"k{rng.randrange(4)}"
+            clock = key_clock.get(key, 0) + 1
+            key_clock[key] = clock
+            keys.append(key)
+            rows.append((key, clock, next_seq))
+            next_seq += 1
+        infos = []
+        vote_row, vote_by, vote_start, vote_end = [], [], [], []
+        for i, (key, clock, seq) in enumerate(rows):
+            votes = [VoteRange(1, clock, clock)]
+            vote_row.append(i); vote_by.append(1)
+            vote_start.append(clock); vote_end.append(clock)
+            for p in voters_full:
+                votes.append(VoteRange(p, 1, clock))
+                vote_row.append(i); vote_by.append(p)
+                vote_start.append(1); vote_end.append(clock)
+            infos.append(
+                TableVotes(Dot(1, seq), clock, Rifl(1, seq), key,
+                           (KVOp.put(f"v{seq}"),), votes)
+            )
+        arrays = TableVotesArrays(
+            keys=keys,
+            dot_src=np.full(B, 1, dtype=np.int64),
+            dot_seq=np.array([r[2] for r in rows], dtype=np.int64),
+            clock=np.array([r[1] for r in rows], dtype=np.int64),
+            rifl_src=np.full(B, 1, dtype=np.int64),
+            rifl_seq=np.array([r[2] for r in rows], dtype=np.int64),
+            ops=[(KVOp.put(f"v{r[2]}"),) for r in rows],
+            vote_row=np.array(vote_row, dtype=np.int64),
+            vote_by=np.array(vote_by, dtype=np.int64),
+            vote_start=np.array(vote_start, dtype=np.int64),
+            vote_end=np.array(vote_end, dtype=np.int64),
+        )
+        return infos, arrays
+
+    def drain(ex):
+        out = []
+        while True:
+            r = ex.to_clients()
+            if r is None:
+                return out
+            out.append((r.rifl, r.key, r.op_results))
+
+    # round 1: only the coordinator votes -> below the stability
+    # threshold, everything buffers
+    infos, arrays = make_round(voters_full=[])
+    ex_arrays.handle_batch_arrays(arrays, time)
+    for info in infos:
+        ex_oracle.handle(info, time)
+    assert drain(ex_arrays) == drain(ex_oracle) == []
+
+    # round 2: processes 2 and 3 vote full prefixes -> everything
+    # (including the buffered round-1 tails) stabilizes; the arrays path
+    # takes the buffered-merge branch
+    infos, arrays = make_round(voters_full=[2, 3])
+    ex_arrays.handle_batch_arrays(arrays, time)
+    for info in infos:
+        ex_oracle.handle(info, time)
+    got, want = drain(ex_arrays), drain(ex_oracle)
+    assert sorted(got, key=str) == sorted(want, key=str)
+    # per-key execution order is the contract — compare the monitors
+    mon_a, mon_b = ex_arrays.monitor(), ex_oracle.monitor()
+    assert set(mon_a.keys()) == set(mon_b.keys())
+    for key in mon_a.keys():
+        assert mon_a.get_order(key) == mon_b.get_order(key)
+
+    # round 3: mixed — one voter short on a random subset leaves a tail
+    infos, arrays = make_round(voters_full=[2])
+    ex_arrays.handle_batch_arrays(arrays, time)
+    for info in infos:
+        ex_oracle.handle(info, time)
+    got, want = drain(ex_arrays), drain(ex_oracle)
+    assert sorted(got, key=str) == sorted(want, key=str)
+    for key in mon_a.keys():
+        assert mon_a.get_order(key) == mon_b.get_order(key)
+
+
 def test_stable_clocks_kernel_vs_partition():
     """The device stable_clocks kernel and the numpy partition agree over
     a wide random frontier matrix (both sides of the executor's
