@@ -220,6 +220,7 @@ def protocol_step(
     *,
     mesh: Mesh,
     live_replicas: int | None = None,
+    shard_count: int = 1,
 ) -> Tuple[ReplicaState, StepOutput]:
     """One batched commit+execute round over the (replica, batch) mesh.
 
@@ -234,6 +235,18 @@ def protocol_step(
     With fewer than write_quorum live replicas, slow-path commands do NOT
     commit this round (and neither does anything depending on them).
     Default: all replicas live.
+
+    ``shard_count`` (partial replication, the mesh-native answer to
+    fantoch_ps/src/protocol/partial.rs + the cross-shard dep requests of
+    fantoch_ps/src/executor/graph/mod.rs:279-408): the replica rows
+    factor into ``shard_count`` shards of ``R / shard_count`` replicas
+    each, and key bucket ``b`` belongs to shard ``b % shard_count``.
+    Quorums are per shard *per key slot* — a multi-shard command commits
+    only when every touched shard's quorum agrees — and a replica's key
+    clock learns only its own shard's buckets.  Cross-shard dependencies
+    need no request RPCs at all: the working set is globally visible on
+    the mesh, so the resolver orders a multi-shard command after ALL its
+    deps (both shards') in the same gather it uses for one shard.
     """
     num_replicas, key_buckets = state.key_clock.shape
     if key.ndim == 1:
@@ -244,7 +257,11 @@ def protocol_step(
     )
     pend_cap = state.pend_gid.shape[0]
     work = pend_cap + batch  # working rows: pending buffer first, then new
-    fast_quorum, write_quorum = quorum_sizes(num_replicas)
+    assert num_replicas % shard_count == 0, (
+        "replica rows must factor into shard_count equal shards"
+    )
+    per_shard = num_replicas // shard_count
+    fast_quorum, write_quorum = quorum_sizes(per_shard)
     if live_replicas is None:
         live_replicas = num_replicas
     replica_blocks = num_replicas // mesh.shape[REPLICA_AXIS]
@@ -288,16 +305,26 @@ def protocol_step(
             chain >= 0, gid[jnp.maximum(chain, 0)], prior
         )  # [r_blk, W, KW]
 
-        # 3. MCollectAck fan-in over the *fast quorum* = the first
-        # fast_quorum global replica rows (distance-sorted quorum,
-        # base.rs:59-131).  Fast path iff all fast-quorum replicas
+        # 3. MCollectAck fan-in over each key slot's *shard* fast quorum =
+        # the first fast_quorum member rows of the shard owning the slot's
+        # bucket (distance-sorted quorum, base.rs:59-131; bucket b belongs
+        # to shard b % shard_count).  Fast path iff every quorum replica
         # reported the same deps on every key slot (check_union,
-        # epaxos.rs:339-345).
+        # epaxos.rs:339-345) — for a multi-shard command that is every
+        # touched shard's quorum at once.  Pad slots have no real bucket:
+        # their dep is -1 on every replica, so any shard's quorum agrees.
         row = (
             jax.lax.axis_index(REPLICA_AXIS) * replica_blocks
             + jnp.arange(replica_blocks, dtype=jnp.int32)
         )  # global replica row ids of this block
-        in_fq = (row < fast_quorum)[:, None, None]  # [r_blk, 1, 1]
+        slot_shard = jnp.where(
+            real_slot, key_cat % shard_count, 0
+        )  # [W, KW]
+        row_shard = (row // per_shard)[:, None, None]  # [r_blk, 1, 1]
+        row_member = (row % per_shard)[:, None, None]
+        in_fq = (row_shard == slot_shard[None]) & (
+            row_member < fast_quorum
+        )  # [r_blk, W, KW]
         fq_max = jax.lax.pmax(
             jnp.where(in_fq, dep_gid, int_min).max(axis=0), REPLICA_AXIS
         )  # [W, KW]
@@ -312,16 +339,22 @@ def protocol_step(
         final_gid = fq_max  # [W, KW]
 
         # Synod accept round for fast-path misses: every *live* replica
-        # accepts the ballot-0 proposal (no competing coordinator within a
-        # round; crashed replicas don't respond); acks are counted with a
-        # psum and the command commits once acks >= write_quorum (f+1).
-        # This is the MConsensusAck fan-in.
+        # of a slot's shard accepts the ballot-0 proposal (no competing
+        # coordinator within a round; crashed replicas don't respond);
+        # acks are a per-shard psum and a command commits once EVERY
+        # touched shard reaches write_quorum (f+1).  This is the
+        # MConsensusAck fan-in (+ the per-shard aggregation of
+        # partial.rs:37-142, collapsed into the same round).
         live = (row < live_replicas)[:, None]  # [r_blk, 1]
-        accept = live & ~fast[None, :]
-        acks = jax.lax.psum(
-            accept.astype(jnp.int32).sum(axis=0), REPLICA_AXIS
-        )  # [W]
-        committed = (fast | (acks >= write_quorum)) & valid
+        shard_live_local = jnp.zeros((shard_count,), jnp.int32).at[
+            row // per_shard
+        ].add(live[:, 0].astype(jnp.int32))
+        shard_live = jax.lax.psum(shard_live_local, REPLICA_AXIS)  # [S]
+        acks_slot = shard_live[slot_shard]  # [W, KW]
+        slow_ok = jnp.where(
+            real_slot, acks_slot >= write_quorum, True
+        ).all(axis=-1)
+        committed = (fast | slow_ok) & valid
         slow_paths = ((~fast) & valid).sum().astype(jnp.int32)
 
         # 4. batched resolution of the committed working set.  A final dep
@@ -355,12 +388,17 @@ def protocol_step(
         executed = res.resolved & committed
 
         # 5. state update: every *live* replica learns the *executed* dots
-        # (scatter-max by key slot; later commands in the batch win).  Only
-        # executed gids enter the key clock: the next round prunes
+        # on the buckets of ITS OWN shard (scatter-max by key slot; later
+        # commands in the batch win) — a shard's replicas never store
+        # other shards' key state (partial replication).  Only executed
+        # gids enter the key clock: the next round prunes
         # out-of-working-set deps as already-executed (step 4), which is
         # only sound if the clock never holds an unexecuted gid.
+        own_slot = row_shard == slot_shard[None]  # [r_blk, W, KW]
         clock_upd = jnp.where(
-            live[..., None] & (executed[None, :, None] & real_slot[None]),
+            live[..., None]
+            & own_slot
+            & (executed[None, :, None] & real_slot[None]),
             gid[None, :, None],
             jnp.int32(-1),
         )  # [r_blk, W, KW]
@@ -462,12 +500,19 @@ def protocol_step(
     )
 
 
-def jit_protocol_step(mesh: Mesh, live_replicas: int | None = None):
+def jit_protocol_step(
+    mesh: Mesh, live_replicas: int | None = None, shard_count: int = 1
+):
     """jit-compiled step with donated device-resident state."""
     import functools
 
     return jax.jit(
-        functools.partial(protocol_step, mesh=mesh, live_replicas=live_replicas),
+        functools.partial(
+            protocol_step,
+            mesh=mesh,
+            live_replicas=live_replicas,
+            shard_count=shard_count,
+        ),
         donate_argnums=(0,),
     )
 

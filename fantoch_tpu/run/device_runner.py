@@ -22,10 +22,21 @@ directly); ``DeviceRuntime`` wraps it in the TCP client plane speaking the
 exact wire protocol of fantoch_tpu/run/prelude.py, so ``bin/client.py``
 and ``run_clients`` work unchanged against a device-step server.
 
-Scope: single-shard (full replication).  The mesh models all n replicas —
-on real TPU pods the replica axis spans mesh slices wired by ICI, which is
-exactly the deployment the reference reaches with one TCP mesh per
-geo-replica pair.
+The mesh models all replicas — on real TPU pods the replica axis spans
+mesh slices wired by ICI, which is exactly the deployment the reference
+reaches with one TCP mesh per geo-replica pair.
+
+Partial replication (``Config.shard_count > 1``, epaxos-class): ONE mesh
+carries every shard — shard s owns key buckets ``b % shard_count == s``
+and replica rows ``[s*n, (s+1)*n)``; quorums are per shard per key slot
+(mesh_step.protocol_step sharded mode).  Cross-shard dependencies
+resolve inside the shared working set — the mesh-native answer to the
+reference's cross-shard dep request RPCs
+(fantoch_ps/src/executor/graph/mod.rs:279-408).  The client plane keeps
+the per-shard-server wire contract: clients connect once per shard
+(every shard maps to this server's address), Submit rides the target
+shard's connection, and each touched shard answers with its own
+CommandResult over that same connection.
 """
 
 from __future__ import annotations
@@ -55,18 +66,39 @@ from fantoch_tpu.utils import key_hash, logger
 Address = Tuple[str, int]
 
 
-def _buckets(cmd: Command, shard_id: ShardId, key_buckets: int) -> List[int]:
+def _buckets(
+    cmd: Command, shard_id: ShardId, key_buckets: int, shard_count: int = 1
+) -> List[int]:
     """Distinct key buckets for one command — the single definition shared
     by the driver's row builder and the session-boundary validator, so the
     two can never drift (colliding keys dedup, which only coarsens
-    conflicts)."""
-    return sorted({key_hash(k) % key_buckets for k in cmd.keys(shard_id)})
+    conflicts).
+
+    Sharded (shard_count > 1): buckets span EVERY shard the command
+    touches, and bucket ``b`` encodes its owner as ``b % shard_count``
+    (the sharded-key-axis contract of mesh_step.protocol_step); the
+    ``shard_id`` argument is ignored — the unified mesh orders the whole
+    command."""
+    if shard_count == 1:
+        return sorted({key_hash(k) % key_buckets for k in cmd.keys(shard_id)})
+    per_shard = key_buckets // shard_count
+    return sorted({
+        sid + shard_count * (key_hash(k) % per_shard)
+        for sid in cmd.shards()
+        for k in cmd.keys(sid)
+    })
 
 
-def _bucket_row(cmd: Command, shard_id: ShardId, key_buckets: int, key_width: int):
+def _bucket_row(
+    cmd: Command,
+    shard_id: ShardId,
+    key_buckets: int,
+    key_width: int,
+    shard_count: int = 1,
+):
     """Key-bucket row for one command (device key-row contract: a row must
     not repeat a bucket)."""
-    buckets = _buckets(cmd, shard_id, key_buckets)
+    buckets = _buckets(cmd, shard_id, key_buckets, shard_count)
     assert 1 <= len(buckets) <= key_width, (
         f"command touches {len(buckets)} key buckets but the device state "
         f"was initialized with key_width={key_width}"
@@ -102,6 +134,7 @@ class _DriverCore:
         monitor_execution_order: bool,
     ) -> None:
         self.shard_id = shard_id
+        self.shard_count = 1  # DeviceDriver overrides in sharded mode
         self.batch_size = batch_size
         self.key_buckets = key_buckets
         # commands in flight: registered at step entry, dropped at execution
@@ -161,10 +194,14 @@ class _DriverCore:
         live += [dot.sequence for dot, _ in self._requeue]
         floor = min(live)
         shift = floor - self._seq_base
-        assert shift > 0, (
-            "sequence window cannot advance: an in-flight command is "
-            f"pinned {top - self.SEQ_WINDOW_MAX} below the overflow"
-        )
+        new_top = top - shift
+        if shift <= 0 or new_top >= 2**31 - 1:
+            # a long-pinned in-flight dot keeps the window span >= 2^31:
+            # no rebase can fit it — fail loudly (asserts vanish under -O)
+            raise RuntimeError(
+                "dot-sequence window cannot advance: oldest in-flight "
+                f"sequence {floor} leaves a span of {new_top} >= 2^31"
+            )
         self._seq_base = floor
         self.seq_epochs += 1
         self._on_seq_window_advanced(shift)
@@ -175,9 +212,26 @@ class _DriverCore:
 
     def _on_seq_window_advanced(self, shift: int) -> None:
         """Rebase driver-held sequence state after a window advance: the
-        registry (where keyed on packed dots), device-resident pend_seq
-        columns, and any host mirrors.  Driver-specific."""
-        raise NotImplementedError
+        dot-keyed registry, the host (src, seq) pending mirror, and the
+        device-resident pend_seq column — the Newt/Paxos shape.  (Dead
+        mirror/device slots are masked by their key/slot columns and
+        match no registry key, so the blind shift is safe.)  DeviceDriver
+        overrides: its registry keys on gids and its device pend is
+        masked by pend_gid."""
+        import jax
+        import jax.numpy as jnp
+
+        self._rekey_registry_for_window()
+        self._pend_seq = (
+            self._pend_seq.astype(np.int64) - shift
+        ).astype(np.int32)
+        st = self._state
+        pend_seq = np.asarray(st.pend_seq, dtype=np.int64) - shift
+        self._state = st._replace(
+            pend_seq=jax.device_put(
+                jnp.asarray(pend_seq.astype(np.int32)), st.pend_seq.sharding
+            )
+        )
 
     def _rekey_registry_for_window(self) -> None:
         """Shared helper for dot-keyed registries (Newt/Paxos): recompute
@@ -221,6 +275,7 @@ class DeviceDriver(_DriverCore):
         pending_capacity: int = 256,
         live_replicas: Optional[int] = None,
         shard_id: ShardId = 0,
+        shard_count: int = 1,
         monitor_execution_order: bool = False,
         mesh=None,
     ):
@@ -228,20 +283,29 @@ class DeviceDriver(_DriverCore):
 
         self._init_core(shard_id, batch_size, key_buckets, monitor_execution_order)
         self.key_width = key_width
+        # partial replication on one mesh: num_replicas is PER SHARD; the
+        # state holds shard_count * num_replicas replica rows and bucket
+        # b % shard_count encodes the owning shard (protocol_step's
+        # sharded-key-axis mode)
+        self.shard_count = shard_count
+        assert key_buckets % shard_count == 0, (
+            "key_buckets must split evenly across shards"
+        )
+        total_rows = shard_count * num_replicas
         self._mesh = (
             mesh
             if mesh is not None
-            else mesh_step.make_mesh(num_replicas=num_replicas)
+            else mesh_step.make_mesh(num_replicas=total_rows)
         )
         self._state = mesh_step.init_state(
             self._mesh,
-            num_replicas,
+            total_rows,
             key_buckets=key_buckets,
             pending_capacity=pending_capacity,
             key_width=key_width,
         )
         self._step = mesh_step.jit_protocol_step(
-            self._mesh, live_replicas=live_replicas
+            self._mesh, live_replicas=live_replicas, shard_count=shard_count
         )
         self._next_gid = 0  # host mirror of state.next_gid
         self._frontier_base = 0  # executed-count carried across gid epochs
@@ -250,7 +314,10 @@ class DeviceDriver(_DriverCore):
     # --- the serving round ---
 
     def _bucket_row(self, cmd: Command) -> List[int]:
-        return _bucket_row(cmd, self.shard_id, self.key_buckets, self.key_width)
+        return _bucket_row(
+            cmd, self.shard_id, self.key_buckets, self.key_width,
+            self.shard_count,
+        )
 
     # gid space is int32 and the key clock holds raw gids; when the space
     # nears exhaustion the epoch resets — rebase clock/frontier/pending
@@ -374,7 +441,15 @@ class DeviceDriver(_DriverCore):
             if entry is None:
                 continue  # padding row (registered by no one)
             _dot, cmd = entry
-            results.extend(cmd.execute(self.shard_id, self.store))
+            if self.shard_count == 1:
+                results.extend(cmd.execute(self.shard_id, self.store))
+            else:
+                # the unified mesh owns every shard's keyspace: execute
+                # each touched shard's portion at the command's single
+                # execution point (partials per key, as the per-shard
+                # executors would emit them)
+                for sid in cmd.shards():
+                    results.extend(cmd.execute(sid, self.store))
             self.executed += 1
             if fast[w]:
                 self.fast_paths += 1
@@ -458,6 +533,7 @@ class NewtDeviceDriver(_DriverCore):
         self._pend_src = np.zeros(cap, dtype=np.int32)
         self._pend_seq = np.zeros(cap, dtype=np.int32)
         self._clock_floor = 0  # timestamps GC'd below this (host int)
+        self._max_clock = 0  # highest committed device clock seen
         self.clock_epochs = 0
 
     # timestamp clocks are int32 and grow ~1 per conflicting command per
@@ -497,24 +573,6 @@ class NewtDeviceDriver(_DriverCore):
             floor, self.clock_epochs,
         )
 
-    def _on_seq_window_advanced(self, shift: int) -> None:
-        import jax
-        import jax.numpy as jnp
-
-        self._rekey_registry_for_window()
-        # dead mirror/device slots are masked by pend_key on-device and
-        # match no registry key on the host — blind shift is safe
-        self._pend_seq = (
-            self._pend_seq.astype(np.int64) - shift
-        ).astype(np.int32)
-        st = self._state
-        pend_seq = np.asarray(st.pend_seq, dtype=np.int64) - shift
-        self._state = st._replace(
-            pend_seq=jax.device_put(
-                jnp.asarray(pend_seq.astype(np.int32)), st.pend_seq.sharding
-            )
-        )
-
     def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
         import jax.numpy as jnp
 
@@ -546,12 +604,27 @@ class NewtDeviceDriver(_DriverCore):
         executed = np.asarray(out.executed)
         committed = np.asarray(out.committed)
         device_wm = int(out.stable_watermark)
+        # overflow trigger = the MAX committed clock (a hot key's clock
+        # races ahead while cold keys pin the min watermark); the rebase
+        # floor is still the stable watermark — the only provably-safe
+        # shift
+        clocks = np.asarray(out.clock)
+        if clocks.size:
+            self._max_clock = max(self._max_clock, int(clocks.max()))
         # int_max = "no keys seen this round" sentinel: skip both the
         # report and the window check
         if device_wm < 2**31 - 1:
             self.stable_watermark = self._clock_floor + device_wm
-            if device_wm >= self.CLOCK_RESET_THRESHOLD:
+            if self._max_clock >= self.CLOCK_RESET_THRESHOLD and device_wm > 0:
                 self._advance_clock_window(device_wm)
+                self._max_clock -= device_wm
+                if self._max_clock >= self.CLOCK_RESET_THRESHOLD:
+                    raise RuntimeError(
+                        "newt clock window pinned: the stable floor lags "
+                        "the hot key's clock by >= the whole window "
+                        "(raise pending_capacity or investigate stalled "
+                        "voters)"
+                    )
         self.slow_paths += int(out.slow_paths)
         # fast/slow tallies are commit-time facts: a fast-committed command
         # may only *stabilize* (execute) rounds later, when the flag is no
@@ -719,22 +792,6 @@ class PaxosDeviceDriver(_DriverCore):
             delta, self.slot_epochs,
         )
 
-    def _on_seq_window_advanced(self, shift: int) -> None:
-        import jax
-        import jax.numpy as jnp
-
-        self._rekey_registry_for_window()
-        self._pend_seq = (
-            self._pend_seq.astype(np.int64) - shift
-        ).astype(np.int32)
-        st = self._state
-        pend_seq = np.asarray(st.pend_seq, dtype=np.int64) - shift
-        self._state = st._replace(
-            pend_seq=jax.device_put(
-                jnp.asarray(pend_seq.astype(np.int32)), st.pend_seq.sharding
-            )
-        )
-
     def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
         import jax.numpy as jnp
 
@@ -831,17 +888,51 @@ class _DeviceClientSession:
     def __init__(self, runtime: "DeviceRuntime", rw: Rw):
         self.runtime = runtime
         self.rw = rw
-        self.pending = AggregatePending(
-            runtime.process_id, runtime.driver.shard_id
+        # one aggregation per shard: a multi-shard command answers with
+        # one CommandResult PER SHARD (the per-shard-server contract the
+        # client plane counts on, run/client_runner.py submit()); the
+        # unified mesh server emits them all over the submit connection.
+        driver = runtime.driver
+        sids = (
+            range(driver.shard_count)
+            if driver.shard_count > 1
+            else (driver.shard_id,)  # single-shard may sit on any shard id
         )
+        self.pending_by_shard: Dict[ShardId, AggregatePending] = {
+            sid: AggregatePending(runtime.process_id, sid) for sid in sids
+        }
+        # rifl -> (key -> owning shard), alive while results are pending
+        self._key_shard: Dict[Rifl, Dict[str, ShardId]] = {}
+        self._shards_left: Dict[Rifl, int] = {}
         self.client_ids: List[ClientId] = []
         self._flush_needed = asyncio.Event()
 
-    def deliver(self, result: ExecutorResult) -> None:
-        done = self.pending.add_executor_result(result)
+    def track(self, cmd: Command) -> None:
+        """Register a submitted command for result aggregation."""
+        for sid in cmd.shards():
+            self.pending_by_shard[sid].wait_for(cmd)
+        self._key_shard[cmd.rifl] = {
+            key: sid for sid, key in cmd.all_keys()
+        }
+        self._shards_left[cmd.rifl] = cmd.shard_count
+
+    def deliver(self, result: ExecutorResult) -> bool:
+        """Route one per-key partial; returns True when the rifl is fully
+        answered (all shards' CommandResults written)."""
+        shards = self._key_shard.get(result.rifl)
+        if shards is None:
+            return True  # stale (session re-registered the rifl, or bug)
+        sid = shards[result.key]
+        done = self.pending_by_shard[sid].add_executor_result(result)
         if done is not None:
             self.rw.write(ToClient(done))
             self._flush_needed.set()
+            self._shards_left[result.rifl] -= 1
+            if self._shards_left[result.rifl] == 0:
+                del self._key_shard[result.rifl]
+                del self._shards_left[result.rifl]
+                return True
+        return False
 
     async def _flush_loop(self) -> None:
         while True:
@@ -867,9 +958,26 @@ class _DeviceClientSession:
         contract; returns the rejection reason for commands the compiled
         device state cannot carry."""
         driver = self.runtime.driver
-        buckets = _buckets(cmd, driver.shard_id, driver.key_buckets)
+        # sharded: a shard id outside the compiled range would alias
+        # another shard's buckets on-device (safe_key clamping) — reject
+        # it at the wire, like any other contract breakage
+        if driver.shard_count > 1:
+            for sid in cmd.shards():
+                if not 0 <= sid < driver.shard_count:
+                    return (
+                        f"command names shard {sid} but the server is "
+                        f"compiled for {driver.shard_count} shard(s)"
+                    )
+        elif cmd.shard_count > 1:
+            return (
+                "multi-shard command submitted to a single-shard "
+                "device server"
+            )
+        buckets = _buckets(
+            cmd, driver.shard_id, driver.key_buckets, driver.shard_count
+        )
         if not buckets:
-            return "command touches no keys on this shard"
+            return "command touches no keys"
         # key_width None = the driver needs no key rows (slot-ordered)
         if driver.key_width is not None and len(buckets) > driver.key_width:
             return (
@@ -886,16 +994,20 @@ class _DeviceClientSession:
             if not isinstance(hi, ClientHi):
                 raise ProtocolError(f"expected ClientHi, got {hi!r}")
             self.client_ids = hi.client_ids
-            for client_id in self.client_ids:
-                self.runtime.client_sessions[client_id] = self
             await self.rw.send(ClientHiAck())
             flusher = self.runtime.spawn(self._flush_loop(), fatal=False)
+            sharded = self.runtime.driver.shard_count > 1
             try:
                 while True:
                     msg = await self.rw.recv()
                     if msg is None:
                         break
                     if isinstance(msg, Register):
+                        if sharded:
+                            # the unified mesh executes every shard's
+                            # portion behind the submit session; per-shard
+                            # registration has nothing to set up
+                            continue
                         raise ProtocolError(
                             "device-step serving is single-shard; Register "
                             "(multi-shard partial registration) has no "
@@ -908,14 +1020,14 @@ class _DeviceClientSession:
                     if why is not None:
                         self._reject(cmd, why)
                         continue
-                    self.pending.wait_for(cmd)
+                    self.track(cmd)
+                    self.runtime.rifl_sessions[cmd.rifl] = self
                     dot = self.runtime.dot_gen.next_id()
                     self.runtime.submit(dot, cmd)
             finally:
                 flusher.cancel()
         finally:
-            for client_id in self.client_ids:
-                self.runtime.client_sessions.pop(client_id, None)
+            self.runtime.drop_session(self)
             # always close the transport: a session dying on ProtocolError
             # must leave the client an EOF, not a silent hang, and the
             # server must not leak the fd
@@ -950,12 +1062,19 @@ class DeviceRuntime:
         metrics_interval_ms: int = 5000,
         mesh=None,
     ):
-        assert config.shard_count == 1, "device-step serving is single-shard"
         from fantoch_tpu.core.ids import AtomicIdGen
 
         self.config = config
         self.process_id = process_id
         self.client_addr = client_addr
+        if protocol != "epaxos":
+            # the sharded key axis is built on the dep-commit round; the
+            # timestamp/leader classes serve full replication only (their
+            # host/object runners cover partial replication)
+            assert config.shard_count == 1, (
+                f"device-step sharding serves the epaxos-class round; "
+                f"{protocol} serving is single-shard"
+            )
         if protocol == "newt":
             self.driver = NewtDeviceDriver(
                 config.n,
@@ -989,13 +1108,17 @@ class DeviceRuntime:
                 key_width=key_width,
                 pending_capacity=pending_capacity,
                 live_replicas=live_replicas,
+                shard_count=config.shard_count,
                 monitor_execution_order=monitor_execution_order,
                 mesh=mesh,
             )
         self.dot_gen = AtomicIdGen(process_id)
         self.metrics_file = metrics_file
         self.metrics_interval_ms = metrics_interval_ms
-        self.client_sessions: Dict[ClientId, _DeviceClientSession] = {}
+        # results route to the session that submitted the rifl (a client
+        # holds one connection per shard; only the target shard's carries
+        # the Submit)
+        self.rifl_sessions: Dict[Rifl, _DeviceClientSession] = {}
         self._submit_queue: Deque[Tuple[Dot, Command]] = deque()
         self._tallies: Dict[str, int] = {}
         self._publish_tallies()
@@ -1099,13 +1222,23 @@ class DeviceRuntime:
         self._submit_queue.append((dot, cmd))
         self._work.set()
 
+    def drop_session(self, session: "_DeviceClientSession") -> None:
+        """Forget a closed session's in-flight rifls (their results have
+        nowhere to go; the driver still executes them for the cluster)."""
+        stale = [
+            rifl for rifl, s in self.rifl_sessions.items() if s is session
+        ]
+        for rifl in stale:
+            del self.rifl_sessions[rifl]
+
     def _deliver(self, results: List[ExecutorResult]) -> None:
         for result in results:
-            session = self.client_sessions.get(result.rifl.source)
+            session = self.rifl_sessions.get(result.rifl)
             if session is None:
-                continue
+                continue  # session closed mid-flight
             try:
-                session.deliver(result)
+                if session.deliver(result):
+                    del self.rifl_sessions[result.rifl]
             except (ConnectionError, OSError) as exc:
                 # runs on the (fatal) driver task: a half-closed client
                 # connection must cost only its own results — but only

@@ -190,7 +190,9 @@ async def run_device_server(
     client_task = asyncio.ensure_future(
         run_clients(
             list(range(1, client_count + 1)),
-            {0: ("127.0.0.1", port)},
+            # the unified mesh server owns every shard: all shard ids map
+            # to its one address (clients open one connection per shard)
+            {s: ("127.0.0.1", port) for s in range(config.shard_count)},
             workload,
             open_loop_interval_ms=open_loop_interval_ms,
         )

@@ -584,3 +584,109 @@ def test_newt_multikey_holdback_preserves_per_key_order(mesh):
     assert ex2.sum() == 2
     ex_rows = [w for w in order2 if ex2[w]]
     assert clock2[ex_rows[0]] < clock2[ex_rows[1]], "D must execute before F"
+
+
+# ---------------------------------------------------------------------------
+# partial replication on ONE mesh: sharded key axis + per-shard quorums
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_step_cross_shard_dependencies(mesh):
+    """shard_count=2 on one mesh (6 replica rows = 2 shards x 3): a
+    multi-shard command orders after its dependency chains on BOTH
+    shards' buckets in one round — the mesh-native form of the
+    cross-shard dep requests of fantoch_ps/src/executor/graph/
+    mod.rs:279-408 — and each shard's replicas learn only their own
+    buckets' key state."""
+    m = mesh_step.make_mesh(num_replicas=6)
+    state = mesh_step.init_state(m, 6, key_buckets=64, key_width=2)
+    step = mesh_step.jit_protocol_step(m, shard_count=2)
+    KP = mesh_step.KEY_PAD
+
+    # bucket 4 -> shard 0, bucket 5 -> shard 1 (b % 2)
+    # rows: two on each shard's bucket, then a multi-shard row, then one
+    # more on each bucket — the multi row must land between them on BOTH
+    key = jnp.asarray(
+        [[4, KP], [5, KP], [4, KP], [5, KP], [4, 5], [4, KP], [5, KP]]
+        + [[KP, KP]] * 1,
+        dtype=jnp.int32,
+    )
+    batch = key.shape[0]
+    src = jnp.ones((batch,), jnp.int32)
+    seq = jnp.arange(batch, dtype=jnp.int32)
+    state, out = step(state, key, src, seq)
+    gids = np.asarray(out.gids)
+    resolved = np.asarray(out.resolved)
+    order = np.asarray(out.order)
+    valid = gids >= 0
+    assert resolved[valid].all(), "healthy sharded round must resolve all"
+
+    # positions in the execution order (working rows: pend_cap offset)
+    pend_cap = state.pend_gid.shape[0]
+    pos = {int(gids[w]): i for i, w in enumerate(order) if gids[w] >= 0}
+    g = lambda i: i  # gid == batch index here (fresh state, next_gid=0)
+    multi = pos[g(4)]
+    assert pos[g(0)] < pos[g(2)] < multi < pos[g(5)]  # shard-0 chain
+    assert pos[g(1)] < pos[g(3)] < multi < pos[g(6)]  # shard-1 chain
+
+    # ownership: shard-0 rows (0..2) never learned bucket 5, shard-1
+    # rows (3..5) never learned bucket 4
+    kc = np.asarray(state.key_clock)
+    assert (kc[0:3, 5] == -1).all() and (kc[3:6, 4] == -1).all()
+    assert (kc[0:3, 4] >= 0).all() and (kc[3:6, 5] >= 0).all()
+
+
+def test_sharded_step_degraded_shard_blocks_multi_shard(mesh):
+    """A dead majority in ONE shard blocks that shard's slow-path
+    commands AND any multi-shard command touching it, while the healthy
+    shard keeps committing; recovery commits the carried rows."""
+    m = mesh_step.make_mesh(num_replicas=6)
+    state = mesh_step.init_state(m, 6, key_buckets=64, key_width=2)
+    healthy = mesh_step.jit_protocol_step(m, shard_count=2)
+    KP = mesh_step.KEY_PAD
+
+    # round 1 (healthy): seed both buckets so the clocks hold real gids
+    key1 = jnp.asarray([[4, KP], [5, KP]], dtype=jnp.int32)
+    state, out1 = step_pad(healthy, state, key1)
+    assert np.asarray(out1.resolved)[np.asarray(out1.gids) >= 0].all()
+
+    # stagger shard 1's member-0 view of bucket 5 (rows 3..5 are shard 1;
+    # fq = members 0,1 = rows 3,4): fast path must miss there
+    kc = np.array(state.key_clock)
+    kc[3, 5] = 0  # an older *executed* gid (gid 0 was row 0 of round 1)
+    state = state._replace(
+        key_clock=jax.device_put(jnp.asarray(kc), state.key_clock.sharding)
+    )
+
+    # round 2 under a dead shard-1 majority (rows 0..3 live = shard 0
+    # full + shard 1 member 0 only): shard-0 command commits; the
+    # bucket-5 command and the multi-shard command carry
+    degraded = mesh_step.jit_protocol_step(m, shard_count=2, live_replicas=4)
+    key2 = jnp.asarray([[4, KP], [5, KP], [4, 5]], dtype=jnp.int32)
+    state, out2 = step_pad(degraded, state, key2, seq0=10)
+    gids2 = np.asarray(out2.gids)
+    res2 = np.asarray(out2.resolved)
+    rows2 = res2[gids2 >= 0]  # batch rows in order (pads commit as no-ops)
+    assert rows2[0], "the shard-0 command must commit"
+    assert not rows2[1] and not rows2[2], (
+        "the bucket-5 and multi-shard commands must carry"
+    )
+    assert int(out2.pending) == 2
+
+    # round 3 recovered: carried rows commit and resolve
+    state, out3 = step_pad(healthy, state, None, batch=3)
+    gids3 = np.asarray(out3.gids)
+    assert np.asarray(out3.resolved)[gids3 >= 0].all()
+    assert int(out3.pending) == 0
+
+
+def step_pad(step, state, key, seq0=0, batch=None):
+    """Run one step, padding the key matrix to a mesh-divisible batch."""
+    KP = mesh_step.KEY_PAD
+    b = 8  # divisible by any batch-axis factor of 8 devices
+    full = jnp.full((b, state.pend_key.shape[1]), KP, dtype=jnp.int32)
+    if key is not None:
+        full = full.at[: key.shape[0]].set(key)
+    src = jnp.ones((b,), jnp.int32)
+    seq = jnp.arange(seq0, seq0 + b, dtype=jnp.int32)
+    return step(state, full, src, seq)
