@@ -50,6 +50,10 @@ class Config:
     # vectorized stability pass per executor batch
     # (fantoch_tpu/ops/table_ops.py at the executor/table.py seam)
     batched_table_executor: bool = False
+    # batch Caesar's predecessor executor: two-phase countdown resolution
+    # as one device kernel per batch (fantoch_tpu/ops/pred_resolve.py at
+    # the executor/pred.py seam)
+    batched_pred_executor: bool = False
     # resolver choice for the batched graph executor on *CPU* backends:
     # None = auto (the native C++ SCC resolver, fantoch_tpu/native, when
     # its toolchain is available — a single-threaded host loop beats CPU

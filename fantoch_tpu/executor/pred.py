@@ -161,11 +161,88 @@ class PredecessorsGraph:
         self._to_execute.append(vertex.cmd)
         self._try_phase_two_pending(dot, time)
 
+    # --- the batched seam (ops/pred_resolve.py) ---
+
+    # dep fan-out above this width falls back to the per-info path (the
+    # kernel's dep matrix is [B, W]; Caesar deps are lower-clock conflict
+    # sets, chain-like under per-key workloads)
+    KERNEL_MAX_WIDTH = 32
+
+    def add_batch(self, infos, time: SysTime) -> None:
+        """Batched add: one device kernel resolves the whole batch's
+        two-phase countdown; only the blocked residue enters the
+        per-vertex pending indexes.  Semantics identical to calling
+        ``add`` per info (oracle-equivalence tested)."""
+        import numpy as np
+
+        from fantoch_tpu.ops.graph_resolve import MISSING, TERMINAL
+        from fantoch_tpu.ops.pred_resolve import resolve_pred
+
+        infos = [i for i in infos]
+        width = max((len(i.deps) for i in infos), default=0)
+        if width > self.KERNEL_MAX_WIDTH:
+            for info in infos:
+                self.add(info.dot, info.cmd, info.clock, info.deps, time)
+            return
+        B = len(infos)
+        if B == 0:
+            return
+        row_of = {info.dot: r for r, info in enumerate(infos)}
+        width = max(width, 1)
+        deps = np.full((B, width), TERMINAL, dtype=np.int32)
+        for r, info in enumerate(infos):
+            s = 0
+            for dep in info.deps:
+                if dep == info.dot:
+                    continue  # self-dependency, dropped like `add` does
+                if self._executed_clock.contains(dep.source, dep.sequence):
+                    continue  # TERMINAL
+                in_batch = row_of.get(dep)
+                if in_batch is not None:
+                    deps[r, s] = in_batch
+                else:
+                    # not executed and not in this batch: either entirely
+                    # unknown or committed-but-blocked in the host graph —
+                    # both block the kernel; the residue path waits on it
+                    deps[r, s] = MISSING
+                s += 1
+        # Caesar clocks are unique (seq, process) pairs: the kernel's
+        # (clock, src, seq) lex key carries them exactly
+        clock = np.fromiter((i.clock.seq for i in infos), np.int32, B)
+        src = np.fromiter((i.clock.process_id for i in infos), np.int32, B)
+        seq = np.zeros(B, dtype=np.int32)
+        import jax.numpy as jnp
+
+        res = resolve_pred(
+            jnp.asarray(deps), jnp.asarray(clock), jnp.asarray(src),
+            jnp.asarray(seq), jnp.ones((B,), bool),
+        )
+        executed = np.asarray(res.executed)
+        order = np.asarray(res.order)
+        for r in order.tolist():
+            if not executed[r]:
+                continue
+            info = infos[r]
+            # the kernel executed it: record commit+execution and wake any
+            # host-graph vertices waiting on this dot in either phase
+            added = self._committed_clock.add(info.dot.source, info.dot.sequence)
+            assert added, "commands are committed exactly once"
+            added = self._executed_clock.add(info.dot.source, info.dot.sequence)
+            assert added
+            self._to_execute.append(info.cmd)
+            self._try_phase_one_pending(info.dot, time)
+            self._try_phase_two_pending(info.dot, time)
+        # blocked residue: the ordinary per-vertex path owns it from here
+        for r, info in enumerate(infos):
+            if not executed[r]:
+                self.add(info.dot, info.cmd, info.clock, info.deps, time)
+
 
 class PredecessorsExecutor(Executor):
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
         self._shard_id = shard_id
         self._execute_at_commit = config.execute_at_commit
+        self._batched = config.batched_pred_executor
         self._graph = PredecessorsGraph(process_id, config)
         self._store = KVStore(config.executor_monitor_execution_order)
         self._to_clients: Deque[ExecutorResult] = deque()
@@ -175,6 +252,20 @@ class PredecessorsExecutor(Executor):
             self._execute(info.cmd)
             return
         self._graph.add(info.dot, info.cmd, info.clock, info.deps, time)
+        self._drain()
+
+    def handle_batch(self, infos, time) -> None:
+        """Batched seam: with ``Config.batched_pred_executor`` the whole
+        batch's two-phase countdown resolves as one device kernel
+        (ops/pred_resolve.py); otherwise per-info."""
+        if not self._batched or self._execute_at_commit:
+            for info in infos:
+                self.handle(info, time)
+            return
+        self._graph.add_batch(infos, time)
+        self._drain()
+
+    def _drain(self) -> None:
         while True:
             cmd = self._graph.command_to_execute()
             if cmd is None:

@@ -1,0 +1,97 @@
+"""Device kernel for Caesar's two-phase predecessor ordering.
+
+Reference: fantoch_ps/src/executor/pred/mod.rs:132-186 — a committed
+command executes after (phase 1) every dependency is committed and
+(phase 2) every LOWER-clock dependency is executed.  Timestamps are
+unique and totally ordered, so there are no cycles to collapse; the host
+twin (fantoch_tpu/executor/pred.py) maintains the two phases as
+per-vertex countdown counters fed by pending indexes.
+
+The device formulation batches both countdowns: dependencies are an
+``int32[B, W]`` slot matrix (row indices into the batch, ``TERMINAL`` for
+already-executed/absent deps, ``MISSING`` for uncommitted ones), and one
+``lax.while_loop`` executes the monotone fixpoint
+
+    executable(v) = committed(v) and for every dep slot d of v:
+                      d is TERMINAL, or executed(d), or clock(d) > clock(v)
+
+— each iteration is one scatter-free vectorized pass (the countdown
+decrements of the host twin become a masked ``all`` over the dep matrix),
+and at least one clock-minimal executable vertex finalizes per iteration,
+so ``B`` iterations bound the loop; the early-exit fires as soon as a
+pass makes no progress (missing-blocked residue waits for a later batch).
+
+Output order is (clock, dot)-sorted among the executed — exactly the
+commit-timestamp order the PredecessorsExecutor promises for conflicts.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fantoch_tpu.ops.graph_resolve import MISSING, TERMINAL
+
+
+class PredResolution(NamedTuple):
+    order: jax.Array  # int32[B] — executed rows first, (clock, dot) sorted
+    executed: jax.Array  # bool[B]
+
+
+@jax.jit
+def resolve_pred(
+    deps: jax.Array,  # int32[B, W] row indices / TERMINAL / MISSING
+    clock: jax.Array,  # int32[B] — committed timestamp (unique with dot)
+    dot_src: jax.Array,  # int32[B]
+    dot_seq: jax.Array,  # int32[B]
+    committed: jax.Array,  # bool[B] — False rows are pads / uncommitted
+) -> PredResolution:
+    batch, _width = deps.shape
+    int_max = jnp.iinfo(jnp.int32).max
+    safe = jnp.maximum(deps, 0)
+
+    # phase 2's lower-clock comparison, precomputed per slot: a dep with a
+    # HIGHER (clock, dot) never blocks (it executes after us)
+    my_key = (clock, dot_src, dot_seq)
+    dep_key = (clock[safe], dot_src[safe], dot_seq[safe])
+
+    def lex_gt(a, b):
+        """a > b on (clock, src, seq) triples, vectorized."""
+        (ac, as_, aq), (bc, bs, bq) = a, b
+        return (
+            (ac > bc)
+            | ((ac == bc) & (as_ > bs))
+            | ((ac == bc) & (as_ == bs) & (aq > bq))
+        )
+
+    dep_higher = lex_gt(dep_key, tuple(k[:, None] for k in my_key))
+    # a dep slot never blocks iff it is TERMINAL (already executed /
+    # absent) or a COMMITTED dep with a higher (clock, dot) — phase 2
+    # skips those.  An uncommitted dep's clock is meaningless (it may yet
+    # commit lower), so MISSING and in-batch-uncommitted deps block
+    # phase 1 outright.
+    in_batch = deps >= 0
+    dep_committed = in_batch & committed[safe]
+    never_blocks = (deps == TERMINAL) | (dep_committed & dep_higher)
+
+    def body(state):
+        executed, _changed = state
+        dep_ok = never_blocks | (dep_committed & executed[safe])
+        new = committed & dep_ok.all(axis=1)
+        changed = (new & ~executed).any()
+        return new | executed, changed
+
+    def cond(state):
+        _executed, changed = state
+        return changed
+
+    executed0 = jnp.zeros((batch,), bool)
+    first, changed0 = body((executed0, jnp.bool_(True)))
+    executed, _ = jax.lax.while_loop(
+        cond, body, (first, changed0)
+    )
+    sort_clock = jnp.where(executed, clock, int_max)
+    order = jnp.lexsort((dot_seq, dot_src, sort_clock)).astype(jnp.int32)
+    return PredResolution(order, executed)

@@ -1111,3 +1111,353 @@ def jit_paxos_step(
         ),
         donate_argnums=(0,),
     )
+
+
+# ---------------------------------------------------------------------------
+# Caesar on the mesh: timestamp + predecessors with the wait condition —
+# the fourth consensus shape (fantoch_ps/src/protocol/caesar.rs:216-451,
+# execution = fantoch_ps/src/executor/pred/mod.rs:132-186)
+# ---------------------------------------------------------------------------
+
+
+class CaesarMeshState(NamedTuple):
+    """Device-resident Caesar replica state over the mesh.
+
+    ``key_clock[R, K]``: per-replica highest timestamp known per key
+    bucket (the per-key clock index of caesar.rs:786-838, collapsed to a
+    max in this dense round regime — predecessors below the executed
+    frontier are GC'd, so only the ceiling matters to new proposals).
+
+    Pending buffer: commands a previous round could not execute — either
+    uncommitted (``pend_clock == -1``: retry quorum unreachable) or
+    committed-but-blocked behind an uncommitted lower-clock conflict
+    (the wait condition; ``pend_clock`` holds the committed timestamp).
+    """
+
+    key_clock: jax.Array  # int32[R, K]
+    pend_key: jax.Array  # int32[Pcap, KW] (KEY_PAD = empty)
+    pend_src: jax.Array  # int32[Pcap]
+    pend_seq: jax.Array  # int32[Pcap]
+    pend_clock: jax.Array  # int32[Pcap] (-1 = not committed)
+
+
+class CaesarStepOutput(NamedTuple):
+    """Outputs over the W = Pcap + B working rows (pending first)."""
+
+    order: jax.Array  # int32[W] — executed rows first, (clock, dot) sorted
+    executed: jax.Array  # bool[W]
+    committed: jax.Array  # bool[W]
+    fast_path: jax.Array  # bool[W]
+    clock: jax.Array  # int32[W] — committed timestamp (-1 uncommitted)
+    slow_paths: jax.Array  # int32[] — retry (counter-proposal) rounds
+    watermark: jax.Array  # int32[] — max executed clock this round
+    pending: jax.Array  # int32[]
+    pend_dropped: jax.Array  # int32[]
+
+
+def init_caesar_state(
+    mesh: Mesh,
+    num_replicas: int,
+    key_buckets: int = 4096,
+    pending_capacity: int = 256,
+    key_width: int = 1,
+) -> CaesarMeshState:
+    sharding = NamedSharding(mesh, P(REPLICA_AXIS, None))
+    key_clock = jax.device_put(
+        jnp.zeros((num_replicas, key_buckets), dtype=jnp.int32), sharding
+    )
+    rep = NamedSharding(mesh, P())
+
+    def pend(shape, value):
+        return jax.device_put(
+            jnp.full(shape, value, dtype=jnp.int32), rep
+        )
+
+    cap = pending_capacity
+    return CaesarMeshState(
+        key_clock,
+        pend((cap, key_width), KEY_PAD),
+        pend((cap,), -1),
+        pend((cap,), -1),
+        pend((cap,), -1),
+    )
+
+
+def caesar_protocol_step(
+    state: CaesarMeshState,
+    key: jax.Array,  # int32[B] or int32[B, KW] key buckets (KEY_PAD pads)
+    dot_src: jax.Array,  # int32[B]
+    dot_seq: jax.Array,  # int32[B]
+    *,
+    mesh: Mesh,
+    num_replicas: int | None = None,
+    live_replicas: int | None = None,
+) -> Tuple[CaesarMeshState, CaesarStepOutput]:
+    """One batched Caesar round: timestamp proposal, fast-quorum (3n/4+1)
+    agreement, the MRetry counter-proposal as a second masked aggregation
+    in the same step, and wait-condition-gated execution in (clock, dot)
+    order (caesar.rs:216-451).
+
+    Collective layout: proposals are per-replica local work on the
+    key-clock shard; fast agreement is ``pmax == pmin`` over the fast
+    quorum; the retry clock is a ``pmax`` over the LIVE replicas (the
+    aggregated counter-proposal of MProposeAck ok=false) and commits iff
+    the live count reaches the write quorum (majority) — a ``psum``.
+
+    Execution models the PredecessorsExecutor's two phases in the dense
+    regime: per key bucket, committed rows execute in (clock, dot) order
+    up to the first uncommitted conflict (phase 1: a predecessor of
+    unknown fate blocks; phase 2: lower-clock predecessors execute
+    first); a multi-key row blocked on one bucket holds back every
+    higher-(clock, dot) row on its other buckets — the same gate the
+    Newt round uses, with commit-ness in place of vote stability.
+    """
+    R, key_buckets = state.key_clock.shape
+    if num_replicas is None:
+        num_replicas = R
+    if key.ndim == 1:
+        key = key[:, None]
+    batch, key_width = key.shape
+    assert key_width == state.pend_key.shape[1]
+    pend_cap = state.pend_key.shape[0]
+    work = pend_cap + batch
+    from fantoch_tpu.core.config import Config
+
+    fast_quorum, write_quorum = Config(num_replicas, 0).caesar_quorum_sizes()
+    if live_replicas is None:
+        live_replicas = num_replicas
+    replica_blocks = num_replicas // mesh.shape[REPLICA_AXIS]
+    int_min = jnp.iinfo(jnp.int32).min
+    int_max = jnp.iinfo(jnp.int32).max
+
+    def step(
+        key_clock, pend_key, pend_src, pend_seq, pend_clock,
+        key_l, src_l, seq_l,
+    ):
+        key_new = jax.lax.all_gather(key_l, BATCH_AXIS, tiled=True)
+        src_new = jax.lax.all_gather(src_l, BATCH_AXIS, tiled=True)
+        seq_new = jax.lax.all_gather(seq_l, BATCH_AXIS, tiled=True)
+
+        widx = jnp.arange(work, dtype=jnp.int32)
+        key_cat = jnp.concatenate([pend_key, key_new], axis=0)  # [W, KW]
+        valid = (key_cat != KEY_PAD).any(axis=-1)
+        src_f = jnp.where(valid, jnp.concatenate([pend_src, src_new]), 0)
+        seq_f = jnp.where(valid, jnp.concatenate([pend_seq, seq_new]), 0)
+        prior_clock = jnp.concatenate(
+            [pend_clock, jnp.full((batch,), -1, jnp.int32)]
+        )
+        already_committed = prior_clock >= 0
+
+        # timestamp proposal per replica block (clock ceiling + 1, with
+        # within-round same-bucket runs taking consecutive values) — the
+        # coordinator's Clock(seq, pid) assignment, computed by every
+        # replica from its own clock index (caesar.rs:247-263)
+        propose = valid & ~already_committed
+        real_slot = valid[:, None] & (key_cat != KEY_PAD)
+        propose_slot = propose[:, None] & real_slot
+        slot_iota = jnp.arange(work * key_width, dtype=jnp.int32).reshape(
+            work, key_width
+        )
+        key_full = jnp.where(propose_slot, key_cat, key_buckets + slot_iota)
+        safe_key = jnp.minimum(key_full, key_buckets - 1)
+        prior_rows = jnp.where(
+            propose_slot[None], key_clock[:, safe_key], 0
+        )  # [r_blk, W, KW]
+        slot_prop = _segmented_proposal(
+            prior_rows.reshape(replica_blocks, work * key_width),
+            key_full.reshape(work * key_width),
+            work * key_width,
+        ).reshape(replica_blocks, work, key_width)
+        proposal = jnp.where(
+            propose_slot[None], slot_prop, int_min
+        ).max(axis=-1)
+        proposal = jnp.where(propose[None, :], proposal, 0)  # [r_blk, W]
+
+        # fast path: the whole fast quorum (3n/4 + 1) reports the same
+        # timestamp — everyone said ok to the coordinator's proposal
+        # (caesar.rs MProposeAck ok=true unanimously)
+        row = (
+            jax.lax.axis_index(REPLICA_AXIS) * replica_blocks
+            + jnp.arange(replica_blocks, dtype=jnp.int32)
+        )
+        in_fq = (row < fast_quorum)[:, None]
+        fq_max = jax.lax.pmax(
+            jnp.where(in_fq, proposal, int_min).max(axis=0), REPLICA_AXIS
+        )
+        fq_min = jax.lax.pmin(
+            jnp.where(in_fq, proposal, int_max).min(axis=0), REPLICA_AXIS
+        )
+        fast = (fq_max == fq_min) & propose
+
+        # MRetry as a second masked aggregation in the same step: the
+        # counter-proposal clock is the max over every LIVE replica's
+        # proposal, and it commits iff a write quorum (majority) is live
+        # to ack it (caesar.rs:367-405 + MRetryAck counting)
+        live = (row < live_replicas)[:, None]
+        retry_clock = jax.lax.pmax(
+            jnp.where(live, proposal, int_min).max(axis=0), REPLICA_AXIS
+        )
+        live_count = jax.lax.psum(
+            live[:, 0].astype(jnp.int32).sum(), REPLICA_AXIS
+        )
+        slow_ok = (live_count >= write_quorum) & propose & ~fast
+        newly_committed = fast | slow_ok
+        committed = already_committed | newly_committed
+        clock = jnp.where(
+            newly_committed,
+            jnp.where(fast, fq_max, retry_clock),
+            jnp.where(already_committed, prior_clock, -1),
+        )
+        slow_paths = (propose & ~fast).sum().astype(jnp.int32)
+
+        # wait-condition-gated execution (the PredecessorsExecutor dense
+        # twin): per bucket, committed rows execute in (clock, dot) order
+        # up to the first blocked conflict.  Uncommitted rows hold their
+        # current (only-growing) counter-proposal clock — blocking
+        # higher-clock commits behind them is exactly phase 1's
+        # unknown-fate wait, and can only be conservative.
+        #
+        # Unlike Newt's gate, one pass is NOT enough here: commitment is
+        # not clock-monotone per bucket (an uncommitted retry can sit at
+        # a LOWER clock than a committed multi-key row), so a committed
+        # row held back on one bucket must transitively hold back every
+        # higher-(clock, dot) row on its OTHER buckets — a monotone
+        # fixpoint over the blocked set (grows only; <= W iterations,
+        # typically 1-2).
+        order_clock = jnp.where(committed, clock, retry_clock)
+        safe_clock = jnp.where(valid, order_clock, int_max)
+        order_cd = jnp.lexsort((seq_f, src_f, safe_clock)).astype(jnp.int32)
+        rank_of = jnp.zeros((work,), jnp.int32).at[order_cd].set(
+            jnp.arange(work, dtype=jnp.int32)
+        )
+        real_key = jnp.minimum(
+            jnp.where(real_slot, key_cat, 0), key_buckets - 1
+        )
+
+        def gate_clear(blocked):
+            hold = jnp.full((key_buckets,), work, jnp.int32).at[real_key].min(
+                jnp.where(
+                    blocked[:, None] & real_slot,
+                    rank_of[:, None],
+                    jnp.int32(work),
+                )
+            )
+            return jnp.where(
+                real_slot, rank_of[:, None] < hold[real_key], True
+            ).all(axis=-1)
+
+        def gate_body(state):
+            blocked, _changed = state
+            clear = gate_clear(blocked)
+            new_blocked = valid & (~committed | ~clear)
+            return new_blocked, (new_blocked & ~blocked).any()
+
+        blocked0 = valid & ~committed
+        blocked1, changed0 = gate_body((blocked0, jnp.bool_(True)))
+        blocked, _ = jax.lax.while_loop(
+            lambda s: s[1], gate_body, (blocked1, changed0)
+        )
+        clear = gate_clear(blocked)
+        executed = committed & valid & clear
+
+        # execution order among the executed: (clock, dot) — timestamp
+        # order among conflicts, the executor's contract
+        sort_key = jnp.where(executed, clock, int_max)
+        order = jnp.lexsort((seq_f, src_f, sort_key)).astype(jnp.int32)
+
+        # clock-index update: live replicas learn every committed
+        # timestamp on its buckets (clock_join) and their own consumed
+        # proposals — uncommitted proposals occupy the index too, which
+        # is what keeps later proposals strictly above them
+        # (the key-clock add of caesar.rs:786-838)
+        learn = jnp.maximum(
+            jnp.where(
+                committed[None, :, None] & real_slot[None],
+                clock[None, :, None],
+                0,
+            ),
+            jnp.where(propose_slot[None], proposal[..., None], 0),
+        )  # [r_blk, W, KW]
+        upd = jnp.where(live[..., None] & real_slot[None], learn, 0)
+        new_key_clock = key_clock.at[:, real_key].max(upd)
+
+        # pending carry: committed rows first (their timestamps are
+        # final — dropping one would have to re-propose at a different
+        # clock, breaking committed order), then uncommitted, working
+        # order within each class
+        carry = valid & ~executed
+        work32 = jnp.int32(work)
+        carry_rank = jnp.where(
+            carry,
+            jnp.where(committed, widx, widx + work32),
+            int_max,
+        )
+        carry_order = jnp.argsort(carry_rank).astype(jnp.int32)
+        take = carry_order[:pend_cap]
+        is_carry = carry[take]
+        new_pend_key = jnp.where(is_carry[:, None], key_cat[take], KEY_PAD)
+        new_pend_src = jnp.where(is_carry, src_f[take], -1)
+        new_pend_seq = jnp.where(is_carry, seq_f[take], -1)
+        new_pend_clock = jnp.where(is_carry, clock[take], -1)
+        pending = carry.sum().astype(jnp.int32)
+        pend_dropped = jnp.maximum(pending - pend_cap, 0).astype(jnp.int32)
+
+        watermark = jnp.where(executed, clock, 0).max()
+
+        return (
+            new_key_clock,
+            new_pend_key, new_pend_src, new_pend_seq, new_pend_clock,
+            order, executed, committed, fast, clock,
+            slow_paths, watermark,
+            jnp.minimum(pending, pend_cap), pend_dropped,
+        )
+
+    specs_in = (
+        P(REPLICA_AXIS, None),
+        P(), P(), P(), P(),
+        P(BATCH_AXIS), P(BATCH_AXIS), P(BATCH_AXIS),
+    )
+    specs_out = (
+        P(REPLICA_AXIS, None),
+        P(), P(), P(), P(),
+        P(), P(), P(), P(), P(),
+        P(), P(), P(), P(),
+    )
+    fn = shard_map(
+        step, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False
+    )
+    (
+        kc, pk, ps_, pq, pc,
+        order, executed, committed, fast, clock,
+        slow, watermark, pending, dropped,
+    ) = fn(
+        state.key_clock,
+        state.pend_key, state.pend_src, state.pend_seq, state.pend_clock,
+        key, dot_src, dot_seq,
+    )
+    return (
+        CaesarMeshState(kc, pk, ps_, pq, pc),
+        CaesarStepOutput(
+            order, executed, committed, fast, clock,
+            slow, watermark, pending, dropped,
+        ),
+    )
+
+
+def jit_caesar_step(
+    mesh: Mesh,
+    num_replicas: int | None = None,
+    live_replicas: int | None = None,
+):
+    """jit-compiled Caesar round with donated device-resident state."""
+    import functools
+
+    return jax.jit(
+        functools.partial(
+            caesar_protocol_step,
+            mesh=mesh,
+            num_replicas=num_replicas,
+            live_replicas=live_replicas,
+        ),
+        donate_argnums=(0,),
+    )

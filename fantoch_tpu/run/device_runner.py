@@ -233,6 +233,67 @@ class _DriverCore:
             )
         )
 
+    def _drain_and_mirror_carry(
+        self, out, work_src, work_seq, label: str, committed_noun: str
+    ) -> List[ExecutorResult]:
+        """The dot-keyed drivers' shared tail (Newt/Caesar): execute the
+        round's executed rows in device order against the KVStore, then
+        mirror the device's committed-first pending carry into the host
+        (src, seq) columns.  Committed overflow cannot be re-proposed
+        (its timestamp already entered the replicas' tables) and fails
+        loudly; uncommitted overflow re-queues under the original dot."""
+        order = np.asarray(out.order)
+        executed = np.asarray(out.executed)
+        committed = np.asarray(out.committed)
+        results: List[ExecutorResult] = []
+        for w in order.tolist():
+            if not executed[w]:
+                continue
+            packed = self._packed(work_src[w], work_seq[w])
+            entry = self._cmds.pop(packed, None)
+            if entry is None:
+                continue  # pad row
+            _dot, cmd = entry
+            results.extend(cmd.execute(self.shard_id, self.store))
+            self.executed += 1
+
+        # after the pops, registry keys == this round's carried rows;
+        # committed first in working order (both device carries sort
+        # committed rows ahead — carry_rank in the mesh steps)
+        pend_cap = len(self._pend_src)
+        carried = [
+            w
+            for w in range(len(work_src))
+            if self._packed(work_src[w], work_seq[w]) in self._cmds
+        ]
+        carried.sort(key=lambda w: (not committed[w], w))
+        kept, dropped = carried[:pend_cap], carried[pend_cap:]
+        self._pend_src = np.zeros(pend_cap, dtype=np.int32)
+        self._pend_seq = np.zeros(pend_cap, dtype=np.int32)
+        for slot, w in enumerate(kept):
+            self._pend_src[slot] = work_src[w]
+            self._pend_seq[slot] = work_seq[w]
+        requeued = 0
+        for w in dropped:
+            if committed[w]:
+                raise RuntimeError(
+                    f"{label} device pending buffer overflowed with "
+                    f"committed-but-{committed_noun} commands: raise "
+                    "pending_capacity (a committed timestamp cannot be "
+                    "re-proposed)"
+                )
+            packed = self._packed(work_src[w], work_seq[w])
+            entry = self._cmds.pop(packed, None)
+            if entry is not None:
+                requeued += 1
+                self._requeue.append(entry)
+        if requeued:
+            logger.warning(
+                "%s device pending overflow: re-queueing %d commands",
+                label, requeued,
+            )
+        return results
+
     def _rekey_registry_for_window(self) -> None:
         """Shared helper for dot-keyed registries (Newt/Paxos): recompute
         packed keys under the new seq_base."""
@@ -600,9 +661,6 @@ class NewtDeviceDriver(_DriverCore):
         )
         self.rounds += 1
 
-        order = np.asarray(out.order)
-        executed = np.asarray(out.executed)
-        committed = np.asarray(out.committed)
         device_wm = int(out.stable_watermark)
         # overflow trigger = the MAX committed clock (a hot key's clock
         # races ahead while cold keys pin the min watermark); the rebase
@@ -615,10 +673,14 @@ class NewtDeviceDriver(_DriverCore):
         # report and the window check
         if device_wm < 2**31 - 1:
             self.stable_watermark = self._clock_floor + device_wm
-            if self._max_clock >= self.CLOCK_RESET_THRESHOLD and device_wm > 0:
-                self._advance_clock_window(device_wm)
-                self._max_clock -= device_wm
+            if self._max_clock >= self.CLOCK_RESET_THRESHOLD:
+                if device_wm > 0:
+                    self._advance_clock_window(device_wm)
+                    self._max_clock -= device_wm
                 if self._max_clock >= self.CLOCK_RESET_THRESHOLD:
+                    # wm pinned at 0 (stalled voters) or lagging by the
+                    # whole window: no safe rebase exists — fail loudly
+                    # before int32 wraps
                     raise RuntimeError(
                         "newt clock window pinned: the stable floor lags "
                         "the hot key's clock by >= the whole window "
@@ -631,57 +693,111 @@ class NewtDeviceDriver(_DriverCore):
         # longer set — counting at execution would undercount
         self.fast_paths += int(np.asarray(out.fast_path).sum())
 
-        results: List[ExecutorResult] = []
-        for w in order.tolist():
-            if not executed[w]:
-                continue
-            packed = self._packed(work_src[w], work_seq[w])
-            entry = self._cmds.pop(packed, None)
-            if entry is None:
-                continue  # pad row
-            _dot, cmd = entry
-            results.extend(cmd.execute(self.shard_id, self.store))
-            self.executed += 1
+        return self._drain_and_mirror_carry(
+            out, work_src, work_seq, "newt", "unstable"
+        )
 
-        # after the pops, registry keys == this round's carried rows.
-        # Mirror the device's carry: committed rows first (both classes in
-        # working order), first pend_cap kept.  An *uncommitted* overflow
-        # row re-enters the submit queue under the same dot (a retry); a
-        # committed drop can never be replayed safely (its clock already
-        # entered the replicas' tables) — the carry prioritization makes
-        # that a genuine capacity overload, which fails loudly.
-        pend_cap = len(self._pend_src)
-        carried = [
-            w
-            for w in range(len(work_src))
-            if self._packed(work_src[w], work_seq[w]) in self._cmds
-        ]
-        carried.sort(key=lambda w: (not committed[w], w))
-        kept, dropped = carried[:pend_cap], carried[pend_cap:]
-        self._pend_src = np.zeros(pend_cap, dtype=np.int32)
-        self._pend_seq = np.zeros(pend_cap, dtype=np.int32)
-        for slot, w in enumerate(kept):
-            self._pend_src[slot] = work_src[w]
-            self._pend_seq[slot] = work_seq[w]
-        requeued = 0
-        for w in dropped:
-            if committed[w]:
-                raise RuntimeError(
-                    "newt device pending buffer overflowed with committed-"
-                    "but-unstable commands: raise pending_capacity (a "
-                    "committed clock cannot be re-proposed)"
-                )
-            packed = self._packed(work_src[w], work_seq[w])
-            entry = self._cmds.pop(packed, None)
-            if entry is not None:
-                requeued += 1
-                self._requeue.append(entry)
-        if requeued:
-            logger.warning(
-                "newt device pending overflow: re-queueing %d commands",
-                requeued,
+
+class CaesarDeviceDriver(_DriverCore):
+    """Host control loop around the device-resident Caesar round
+    (parallel/mesh_step.caesar_protocol_step): timestamp proposals over
+    the clock index, 3n/4+1 fast-quorum agreement, the MRetry
+    counter-proposal folded into the same step, and wait-condition-gated
+    execution in (clock, dot) order against the KVStore — the fourth
+    consensus shape on the device plane
+    (fantoch_ps/src/protocol/caesar.rs:216-451; execution =
+    fantoch_ps/src/executor/pred/mod.rs:132-186).
+
+    Host mirror/carry contract is the Newt driver's: commands key on
+    packed (source, window sequence); the pending mirror tracks the
+    device's committed-first carry; committed overflow cannot be
+    re-proposed (a committed timestamp is final) and fails loudly,
+    uncommitted overflow re-queues under the original dot.
+    """
+
+    # int32 timestamp headroom guard: Caesar has no per-key vote
+    # frontier to derive a provably-safe rebase floor from (the Newt
+    # driver's stable watermark), so exhaustion fails loudly instead of
+    # windowing — at one clock tick per conflicting command per bucket,
+    # that is > 2^31 conflicts on one bucket
+    CLOCK_GUARD = 2**31 - (1 << 22)
+
+    def __init__(
+        self,
+        num_replicas: int,
+        *,
+        batch_size: int = 256,
+        key_buckets: int = 4096,
+        key_width: int = 1,
+        pending_capacity: int = 256,
+        live_replicas: Optional[int] = None,
+        shard_id: ShardId = 0,
+        monitor_execution_order: bool = False,
+        mesh=None,
+    ):
+        from fantoch_tpu.parallel import mesh_step
+
+        self._init_core(shard_id, batch_size, key_buckets, monitor_execution_order)
+        self.key_width = key_width
+        self._mesh = (
+            mesh
+            if mesh is not None
+            else mesh_step.make_mesh(num_replicas=num_replicas)
+        )
+        self._state = mesh_step.init_caesar_state(
+            self._mesh,
+            num_replicas,
+            key_buckets=key_buckets,
+            pending_capacity=pending_capacity,
+            key_width=key_width,
+        )
+        self._step = mesh_step.jit_caesar_step(
+            self._mesh, num_replicas=num_replicas, live_replicas=live_replicas
+        )
+        cap = pending_capacity
+        self._pend_src = np.zeros(cap, dtype=np.int32)
+        self._pend_seq = np.zeros(cap, dtype=np.int32)
+
+    def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
+        import jax.numpy as jnp
+
+        from fantoch_tpu.parallel.mesh_step import KEY_PAD
+
+        assert len(batch) <= self.batch_size
+        self._ensure_seq_window(batch)
+        b = self.batch_size
+        key = np.full((b, self.key_width), KEY_PAD, dtype=np.int32)
+        src = np.zeros(b, dtype=np.int32)
+        seq = np.zeros(b, dtype=np.int32)
+        for i, (dot, cmd) in enumerate(batch):
+            buckets = _bucket_row(
+                cmd, self.shard_id, self.key_buckets, self.key_width
             )
-        return results
+            key[i, : len(buckets)] = buckets
+            src[i] = dot.source
+            seq[i] = self._device_seq(dot)
+            self._cmds[self._packed(dot.source, seq[i])] = (dot, cmd)
+
+        work_src = np.concatenate([self._pend_src, src])
+        work_seq = np.concatenate([self._pend_seq, seq])
+
+        self._state, out = self._step(
+            self._state, jnp.asarray(key), jnp.asarray(src), jnp.asarray(seq)
+        )
+        self.rounds += 1
+
+        wm = int(out.watermark)
+        if wm >= self.CLOCK_GUARD:
+            raise RuntimeError(
+                "caesar timestamp space nearing int32 exhaustion"
+            )
+        self.stable_watermark = max(self.stable_watermark, wm)
+        self.slow_paths += int(out.slow_paths)
+        self.fast_paths += int(np.asarray(out.fast_path).sum())
+
+        return self._drain_and_mirror_carry(
+            out, work_src, work_seq, "caesar", "blocked"
+        )
 
 
 class ProtocolError(Exception):
@@ -1067,12 +1183,13 @@ class DeviceRuntime:
         self.config = config
         self.process_id = process_id
         self.client_addr = client_addr
-        if protocol != "epaxos":
-            # the sharded key axis is built on the dep-commit round; the
+        if protocol in ("newt", "fpaxos", "caesar") and config.shard_count != 1:
+            # the sharded key axis is built on the dep-commit round
+            # (epaxos/atlas/basic all serve through it); the
             # timestamp/leader classes serve full replication only (their
             # host/object runners cover partial replication)
-            assert config.shard_count == 1, (
-                f"device-step sharding serves the epaxos-class round; "
+            raise ValueError(
+                f"device-step sharding serves the dep-commit round; "
                 f"{protocol} serving is single-shard"
             )
         if protocol == "newt":
@@ -1080,6 +1197,17 @@ class DeviceRuntime:
                 config.n,
                 f=config.f,
                 tiny_quorums=config.newt_tiny_quorums,
+                batch_size=batch_size,
+                key_buckets=key_buckets,
+                key_width=key_width,
+                pending_capacity=pending_capacity,
+                live_replicas=live_replicas,
+                monitor_execution_order=monitor_execution_order,
+                mesh=mesh,
+            )
+        elif protocol == "caesar":
+            self.driver = CaesarDeviceDriver(
+                config.n,
                 batch_size=batch_size,
                 key_buckets=key_buckets,
                 key_width=key_width,

@@ -690,3 +690,150 @@ def step_pad(step, state, key, seq0=0, batch=None):
     src = jnp.ones((b,), jnp.int32)
     seq = jnp.arange(seq0, seq0 + b, dtype=jnp.int32)
     return step(state, full, src, seq)
+
+
+# ---------------------------------------------------------------------------
+# Caesar on the mesh: the fourth consensus shape
+# ---------------------------------------------------------------------------
+
+
+def test_caesar_step_timestamp_order(mesh):
+    """A healthy Caesar round commits the whole batch on the fast path
+    (consistent clock views) and executes conflicts in (clock, dot)
+    order; the clock index carries across rounds."""
+    state = mesh_step.init_caesar_state(
+        mesh, 4, key_buckets=64, pending_capacity=16
+    )
+    step = mesh_step.jit_caesar_step(mesh, num_replicas=4)
+    batch = 8 * mesh.shape[mesh_step.BATCH_AXIS]
+    key = jnp.asarray([5] * batch, dtype=jnp.int32)  # one hot bucket
+    src = jnp.ones((batch,), jnp.int32)
+    seq = jnp.arange(batch, dtype=jnp.int32)
+    state, out = step(state, key, src, seq)
+    executed = np.asarray(out.executed)
+    clock = np.asarray(out.clock)
+    order = np.asarray(out.order)
+    valid = clock >= 0
+    assert executed[valid].all(), "healthy round executes everything"
+    assert bool(np.asarray(out.fast_path)[valid].all())
+    # within-round same-bucket commands take consecutive, unique clocks,
+    # executed in clock order
+    ex_rows = [w for w in order.tolist() if executed[w]]
+    ex_clocks = clock[ex_rows]
+    assert sorted(set(ex_clocks.tolist())) == ex_clocks.tolist()
+    # next round proposes above the carried ceiling
+    state, out2 = step(state, key[:batch], src, seq + batch)
+    clock2 = np.asarray(out2.clock)
+    assert clock2[clock2 >= 0].min() > ex_clocks.max()
+
+
+def test_caesar_step_degraded_wait_and_recovery(mesh):
+    """Divergent clock views force the retry (slow) path; with fewer
+    live replicas than the write quorum the retry cannot commit and the
+    command carries — blocking later commits on its bucket (the wait
+    condition) — and a recovered round commits and executes everything
+    in timestamp order."""
+    state = mesh_step.init_caesar_state(
+        mesh, 4, key_buckets=64, pending_capacity=16
+    )
+    healthy = mesh_step.jit_caesar_step(mesh, num_replicas=4)
+    batch = 8 * mesh.shape[mesh_step.BATCH_AXIS]
+    KP = mesh_step.KEY_PAD
+
+    # round 1 healthy on bucket 7: seeds the clock index
+    key1 = jnp.full((batch,), 7, dtype=jnp.int32)
+    src = jnp.ones((batch,), jnp.int32)
+    state, out1 = healthy(state, key1, src, jnp.arange(batch, dtype=jnp.int32))
+    assert np.asarray(out1.executed)[np.asarray(out1.clock) >= 0].all()
+
+    # stagger replica 0's bucket-7 ceiling: the next proposal diverges
+    # across the fast quorum -> retry path; live=1 < write quorum (3) ->
+    # uncommitted carry
+    kc = np.array(state.key_clock)
+    kc[0, 7] += 7
+    state = state._replace(
+        key_clock=jax.device_put(jnp.asarray(kc), state.key_clock.sharding)
+    )
+    degraded = mesh_step.jit_caesar_step(mesh, num_replicas=4, live_replicas=1)
+    key2 = jnp.full((batch,), KP, dtype=jnp.int32)
+    key2 = key2.at[0].set(7).at[1].set(7)
+    state, out2 = degraded(
+        state, key2, src, jnp.arange(batch, 2 * batch, dtype=jnp.int32)
+    )
+    committed2 = np.asarray(out2.committed)
+    valid2 = np.asarray((key2 != KP))
+    # working rows: pend_cap offset is 16
+    w0, w1 = 16, 17
+    assert not committed2[w0] and not committed2[w1]
+    assert int(out2.pending) == 2
+    assert int(out2.slow_paths) >= 2
+
+    # recovered round: the carried commands commit via retry and execute
+    state, out3 = healthy(
+        state, jnp.full((batch,), KP, dtype=jnp.int32), src,
+        jnp.arange(2 * batch, 3 * batch, dtype=jnp.int32),
+    )
+    executed3 = np.asarray(out3.executed)
+    clock3 = np.asarray(out3.clock)
+    assert executed3[:2].all(), "carried rows must execute after recovery"
+    assert int(out3.pending) == 0
+    # per-bucket timestamp order: the two carried rows' clocks are unique
+    assert clock3[0] != clock3[1]
+
+
+def test_caesar_wait_gate_transitive_holdback(mesh):
+    """A committed multi-key row held behind an uncommitted lower-clock
+    conflict on one bucket must transitively hold back higher-clock rows
+    on its OTHER buckets — commitment is not clock-monotone per bucket
+    in Caesar, so the gate is a fixpoint (review-caught: the one-pass
+    gate let X(22) execute before M(21) on their shared bucket)."""
+    state = mesh_step.init_caesar_state(
+        mesh, 4, key_buckets=64, pending_capacity=16, key_width=2
+    )
+    KP = mesh_step.KEY_PAD
+    kc = np.array(state.key_clock)
+    kc[:, 4] = 5
+    kc[0, 4] = 10  # divergent views on bucket 4
+    kc[:, 5] = 20
+    state = state._replace(
+        key_clock=jax.device_put(jnp.asarray(kc), state.key_clock.sharding)
+    )
+    degraded = mesh_step.jit_caesar_step(mesh, num_replicas=4, live_replicas=1)
+    batch = 8 * mesh.shape[mesh_step.BATCH_AXIS]
+    key = jnp.full((batch, 2), KP, dtype=jnp.int32)
+    key = key.at[0, 0].set(4)                 # A: bucket 4 only
+    key = key.at[1, 0].set(4).at[1, 1].set(5)  # M: buckets 4 and 5
+    key = key.at[2, 0].set(5)                 # X: bucket 5 only
+    src = jnp.ones((batch,), jnp.int32)
+    seq = jnp.arange(batch, dtype=jnp.int32)
+    state, out = degraded(state, key, src, seq)
+    committed = np.asarray(out.committed)
+    executed = np.asarray(out.executed)
+    w0 = 16  # pend_cap offset
+    A, M, X = w0, w0 + 1, w0 + 2
+    assert not committed[A], "divergent views + no write quorum: A waits"
+    assert committed[M] and committed[X], "M and X fast-commit"
+    # the fixpoint gate: M is held by A on bucket 4, and X must be held
+    # by M on bucket 5 — nothing executes
+    assert not executed[M] and not executed[X]
+    assert int(out.pending) == 3
+
+    # recovery: A commits via retry above everything; per-bucket
+    # timestamp order holds — M(21) before A and X on their buckets
+    healthy = mesh_step.jit_caesar_step(mesh, num_replicas=4)
+    state, out2 = healthy(
+        state, jnp.full((batch, 2), KP, dtype=jnp.int32), src,
+        jnp.arange(batch, 2 * batch, dtype=jnp.int32),
+    )
+    executed2 = np.asarray(out2.executed)
+    clock2 = np.asarray(out2.clock)
+    order2 = np.asarray(out2.order)
+    assert executed2[:3].all(), "recovered round executes all three"
+    pos = {w: i for i, w in enumerate(order2.tolist())}
+    # carried rows keep working order A, M, X in slots 0..2 of the pend
+    # buffer (committed-first carry: M, X, then A)
+    ex_clocks = sorted(clock2[w] for w in range(3))
+    # M committed at 21 executes before X (22) and before A (retry > 21)
+    m_slot = min(range(3), key=lambda w: clock2[w])
+    assert clock2[m_slot] == 21
+    assert all(pos[m_slot] < pos[w] for w in range(3) if w != m_slot)
