@@ -71,14 +71,14 @@ def slope_timed(run_k, k_lo: int, k_hi: int, iters: int):
     return (slope if slope > 0 else None), lo, hi
 
 
-def build_workload(batch: int, conflict: float, clients: int = 4096):
+def build_workload(batch: int, conflict: float, clients: int = 4096, seed: int = 42):
     """(key, dep, dot_src, dot_seq): conflicting commands chain on the hot
     key; private commands chain per client (latest-per-key sequential
     deps).  ``key`` is the per-command conflict-key id the protocol knows
     at commit time (KeyDeps is keyed by it)."""
     import numpy as np
 
-    rng = np.random.default_rng(42)
+    rng = np.random.default_rng(seed)
     hot = rng.random(batch) < conflict
     # key id 0 = hot key; else private per-client key
     key = np.where(hot, 0, 1 + rng.integers(0, clients, size=batch)).astype(np.int32)
@@ -233,6 +233,11 @@ def child_main(mode: str) -> None:
     except Exception as exc:  # noqa: BLE001
         print(f"# device-serving bench failed: {exc!r}", file=sys.stderr)
         record["serving_error"] = repr(exc)[:200]
+    try:
+        record.update(bench_local_pool())
+    except Exception as exc:  # noqa: BLE001
+        print(f"# local-pool bench failed: {exc!r}", file=sys.stderr)
+        record["pool_error"] = repr(exc)[:200]
 
     print(json.dumps(record), flush=True)
 
@@ -291,6 +296,52 @@ def bench_integrated_executor():
     wall_ms = min(run_once() for _ in range(3))
     order_ms = min(run_once(array_drain=True) for _ in range(3))
     return wall_ms, EXECUTOR_BATCH / (wall_ms / 1000.0), order_ms
+
+
+def bench_local_pool(total: int = 1 << 19, conflict: float = 0.5):
+    """Multi-process host scaling (VERDICT r4 #8): aggregate ordering
+    throughput through N key-sharded worker processes
+    (run/local_pool.OrderingPool — the pool.rs analog at process
+    granularity) at N=1 and N=4.  Records cpu_count so the scaling
+    ratio is interpretable: on a single-core host 4 processes cannot
+    beat 1 (they time-slice), and the row says so instead of hiding it.
+    """
+    import multiprocessing as mp
+
+    import numpy as np
+
+    from fantoch_tpu.run.local_pool import OrderingPool
+
+    out = {"pool_total": total, "pool_cpus": mp.cpu_count()}
+    # two disjoint dot ranges: chunk A warms each worker's compile/native
+    # load, chunk B is the measured run (re-adding the same dots would
+    # violate the committed-once invariant)
+    key_a, dep_a, src_a, seq_a = build_workload(total, conflict, seed=21)
+    key_b, dep_b, src_b, seq_b = build_workload(total, conflict, seed=22)
+    thr = {}
+    for workers in (1, 4):
+        shards_a = OrderingPool.shard_columns(
+            key_a, src_a.astype(np.int64), seq_a.astype(np.int64) + 1,
+            dep_a.astype(np.int64), workers,
+        )
+        shards_b = OrderingPool.shard_columns(
+            key_b, src_b.astype(np.int64),
+            seq_b.astype(np.int64) + 1 + total,
+            dep_b.astype(np.int64), workers,
+        )
+        with OrderingPool(workers) as pool:
+            pool.prepare(max(len(s[0]) for s in shards_a + shards_b))
+            pool.run_shards(shards_a)  # warm
+            t0 = time.perf_counter()
+            orders = pool.run_shards(shards_b)
+            dt = time.perf_counter() - t0
+        executed = sum(len(src) for src, _ in orders)
+        assert executed == total, f"pool ordered {executed}/{total}"
+        thr[workers] = total / dt
+        out[f"pool_ms_{workers}w"] = round(dt * 1000.0, 1)
+        out[f"pool_cmds_per_s_{workers}w"] = int(thr[workers])
+    out["pool_scaling_4w"] = round(thr[4] / thr[1], 2)
+    return out
 
 
 def bench_general_path(batch: int = 1 << 18, width: int = 4):

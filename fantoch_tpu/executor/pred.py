@@ -54,6 +54,13 @@ class _Vertex:
         self.start_time_ms = time.millis() if time is not None else 0
 
 
+def _pad_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 class _PendingIndex:
     """dep dot -> dots waiting on it (index.rs PendingIndex)."""
 
@@ -207,20 +214,30 @@ class PredecessorsGraph:
                     deps[r, s] = MISSING
                 s += 1
         # Caesar clocks are unique (seq, process) pairs: the kernel's
-        # (clock, src, seq) lex key carries them exactly
-        clock = np.fromiter((i.clock.seq for i in infos), np.int32, B)
-        src = np.fromiter((i.clock.process_id for i in infos), np.int32, B)
-        seq = np.zeros(B, dtype=np.int32)
+        # (clock, src, seq) lex key carries them exactly.  Pad batch and
+        # width to powers of two so XLA compiles O(log) distinct programs
+        # as queue-drain sizes vary (the batched.py precedent); pad rows
+        # ride the `committed=False` mask and never execute.
+        Bp, Wp = _pad_pow2(B), _pad_pow2(width)
+        deps_p = np.full((Bp, Wp), TERMINAL, dtype=np.int32)
+        deps_p[:B, :width] = deps
+        clock = np.zeros(Bp, dtype=np.int32)
+        clock[:B] = np.fromiter((i.clock.seq for i in infos), np.int32, B)
+        src = np.zeros(Bp, dtype=np.int32)
+        src[:B] = np.fromiter((i.clock.process_id for i in infos), np.int32, B)
+        seq = np.zeros(Bp, dtype=np.int32)
+        committed = np.zeros(Bp, dtype=bool)
+        committed[:B] = True
         import jax.numpy as jnp
 
         res = resolve_pred(
-            jnp.asarray(deps), jnp.asarray(clock), jnp.asarray(src),
-            jnp.asarray(seq), jnp.ones((B,), bool),
+            jnp.asarray(deps_p), jnp.asarray(clock), jnp.asarray(src),
+            jnp.asarray(seq), jnp.asarray(committed),
         )
         executed = np.asarray(res.executed)
         order = np.asarray(res.order)
         for r in order.tolist():
-            if not executed[r]:
+            if r >= B or not executed[r]:
                 continue
             info = infos[r]
             # the kernel executed it: record commit+execution and wake any
@@ -229,6 +246,10 @@ class PredecessorsGraph:
             assert added, "commands are committed exactly once"
             added = self._executed_clock.add(info.dot.source, info.dot.sequence)
             assert added
+            if time is not None:
+                # same-batch execution: zero delay, but the histogram must
+                # count every command the per-info path would count
+                self._metrics.collect(ExecutorMetricsKind.EXECUTION_DELAY, 0)
             self._to_execute.append(info.cmd)
             self._try_phase_one_pending(info.dot, time)
             self._try_phase_two_pending(info.dot, time)

@@ -185,6 +185,7 @@ def test_cli_simulation_sweep():
             assert stats["mean_ms"] >= 0
 
 
+@pytest.mark.slow
 def test_cli_simulation_sweep_parallel_matches_sequential():
     # --parallel fans points over spawn workers (the rayon analog);
     # deterministic sims must yield identical output either way

@@ -26,6 +26,7 @@ def test_non_localhost_testbed_rejected(tmp_path):
         run_experiment(cfg, str(tmp_path), testbed="aws")
 
 
+@pytest.mark.slow
 def test_run_sweep_throughput_latency_curve(tmp_path):
     # the reference's main experiment shape: one protocol at increasing
     # client counts -> a multi-point throughput-latency curve
@@ -43,6 +44,7 @@ def test_run_sweep_throughput_latency_curve(tmp_path):
     assert os.path.getsize(path) > 1000
 
 
+@pytest.mark.slow
 def test_run_experiments_db_and_plots(tmp_path):
     out = str(tmp_path / "results")
     configs = [

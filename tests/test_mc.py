@@ -174,6 +174,7 @@ def test_mc_epaxos_three_conflicting_commands_slow():
     assert result.complete and result.ok, result.violations[:1]
 
 
+@pytest.mark.slow
 def test_mc_newt_batched_table_path():
     """Model-check Newt over the BATCHED table path (array-backed key
     clocks + vectorized executor stability): every delivery interleaving
@@ -195,6 +196,7 @@ def test_mc_newt_batched_table_path():
     assert result.terminals > 0
 
 
+@pytest.mark.slow
 def test_mc_epaxos_batched_graph_executor():
     """Model-check EPaxos over the batched graph executor (array backlog +
     device/native resolvers at MC scope): exhaustive interleavings agree,

@@ -761,7 +761,6 @@ def test_caesar_step_degraded_wait_and_recovery(mesh):
         state, key2, src, jnp.arange(batch, 2 * batch, dtype=jnp.int32)
     )
     committed2 = np.asarray(out2.committed)
-    valid2 = np.asarray((key2 != KP))
     # working rows: pend_cap offset is 16
     w0, w1 = 16, 17
     assert not committed2[w0] and not committed2[w1]
@@ -830,9 +829,6 @@ def test_caesar_wait_gate_transitive_holdback(mesh):
     order2 = np.asarray(out2.order)
     assert executed2[:3].all(), "recovered round executes all three"
     pos = {w: i for i, w in enumerate(order2.tolist())}
-    # carried rows keep working order A, M, X in slots 0..2 of the pend
-    # buffer (committed-first carry: M, X, then A)
-    ex_clocks = sorted(clock2[w] for w in range(3))
     # M committed at 21 executes before X (22) and before A (retry > 21)
     m_slot = min(range(3), key=lambda w: clock2[w])
     assert clock2[m_slot] == 21

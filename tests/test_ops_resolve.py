@@ -384,6 +384,7 @@ def test_keyed_overflow_falls_back():
     assert [args[i][0] for i in order] == [dots[i] for i in range(8)]
 
 
+@pytest.mark.slow
 def test_keyed_random_vs_oracle():
     rng = random.Random(7)
     for trial in range(20):

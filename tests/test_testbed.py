@@ -4,6 +4,7 @@ construction, launch, artifact pull — over 127.0.0.1 entries
 (fantoch_exp/src/testbed/baremetal.rs is the reference shape; a real
 cluster only changes the transport to ssh/rsync/scp)."""
 
+import pytest
 import json
 import os
 
@@ -13,6 +14,7 @@ from fantoch_tpu.exp.testbed import HostsTestbed
 from fantoch_tpu.run.harness import free_port
 
 
+@pytest.mark.slow
 def test_hosts_testbed_experiment(tmp_path):
     testbed = HostsTestbed(
         ["127.0.0.1", "127.0.0.1", "127.0.0.1"],
