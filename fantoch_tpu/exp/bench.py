@@ -50,6 +50,7 @@ def run_experiment(
     output_dir: str,
     testbed="localhost",
     client_timeout_s: int = 600,
+    run_mode: str = "release",
 ) -> Dict:
     """Run one experiment end to end; returns the manifest dict.
 
@@ -58,9 +59,17 @@ def run_experiment(
     baremetal.rs analog: stage the tree, launch remotely, pull results).
     A caller-provided HostsTestbed is caller-owned (reuse it across a
     sweep); its locally staged copies are removed in a ``finally`` here
-    since stage() re-creates them on demand."""
+    since stage() re-creates them on demand.
+
+    ``run_mode``: "release" (plain servers) or "cprofile" — the
+    RunMode::Flamegraph/Heaptrack analog (fantoch_exp/src/lib.rs:26-67):
+    every server runs under cProfile, its .prof artifact is pulled with
+    the results, and a cumulative-time top-30 text rendering lands next
+    to it (cProfile dumps in a ``finally``, so the SIGINT teardown still
+    produces the artifact)."""
     from fantoch_tpu.exp.testbed import HostsTestbed, LocalTestbed
 
+    assert run_mode in ("release", "cprofile"), run_mode
     if testbed == "localhost":
         testbed = LocalTestbed()
     elif not isinstance(testbed, HostsTestbed):
@@ -72,7 +81,7 @@ def run_experiment(
         )
     try:
         return _run_experiment_testbed(
-            config, output_dir, testbed, client_timeout_s
+            config, output_dir, testbed, client_timeout_s, run_mode
         )
     finally:
         if not testbed.use_ssh:
@@ -84,6 +93,7 @@ def _run_experiment_testbed(
     output_dir: str,
     testbed,
     client_timeout_s: int,
+    run_mode: str = "release",
 ) -> Dict:
     from fantoch_tpu.core.ids import process_ids
     from fantoch_tpu.exp.monitor import ResourceMonitor
@@ -136,6 +146,11 @@ def _run_experiment_testbed(
                     args,
                     log,
                     pre_dirs=[_RESULTS_REL],
+                    profile_artifact=(
+                        f"{_RESULTS_REL}/profile_p{pid}.prof"
+                        if run_mode == "cprofile"
+                        else None
+                    ),
                 )
             )
 
@@ -185,18 +200,37 @@ def _run_experiment_testbed(
 
     # pull per-process artifacts back from the machines that produced them
     pulled = []
-    for pid, _shard in all_pids:
-        for rel in (f"metrics_p{pid}.gz", f"execution_p{pid}.log"):
-            if testbed.pull(
-                host_of[pid],
-                f"{_RESULTS_REL}/{rel}",
-                os.path.join(exp_dir, rel),
-            ):
-                pulled.append(rel)
+    artifacts = [f"metrics_p{pid}.gz" for pid, _ in all_pids]
+    artifacts += [f"execution_p{pid}.log" for pid, _ in all_pids]
+    if run_mode == "cprofile":
+        artifacts += [f"profile_p{pid}.prof" for pid, _ in all_pids]
+    pid_of_artifact = {a: int(a.rsplit("_p", 1)[1].split(".")[0]) for a in artifacts}
+    for rel in artifacts:
+        if testbed.pull(
+            host_of[pid_of_artifact[rel]],
+            f"{_RESULTS_REL}/{rel}",
+            os.path.join(exp_dir, rel),
+        ):
+            pulled.append(rel)
+    if run_mode == "cprofile":
+        # render each profile to text (the flamegraph-artifact analog:
+        # human-readable without tooling)
+        import pstats
+
+        for pid, _shard in all_pids:
+            prof = os.path.join(exp_dir, f"profile_p{pid}.prof")
+            if not os.path.exists(prof):
+                continue
+            txt = os.path.join(exp_dir, f"profile_p{pid}.txt")
+            with open(txt, "w") as fh:
+                stats = pstats.Stats(prof, stream=fh)
+                stats.sort_stats("cumulative").print_stats(30)
+            pulled.append(os.path.basename(txt))
 
     manifest = {
         "config": config.to_dict(),
         "name": config.name(),
+        "run_mode": run_mode,
         "testbed": {**testbed.describe(), "pulled": pulled},
         "outcome": {
             "commands": summary["commands"],

@@ -90,13 +90,25 @@ class LocalTestbed:
         args: List[str],
         stdout,
         pre_dirs: Optional[List[str]] = None,
+        profile_artifact: Optional[str] = None,
     ) -> subprocess.Popen:
+        """``profile_artifact``: workdir-relative .prof path — the server
+        runs under cProfile and writes its stats there on exit (the
+        RunMode::Flamegraph analog, fantoch_exp/src/lib.rs:26-67: a
+        profiler wraps the server binary and its artifact is pulled with
+        the results)."""
         assert self._workdir is not None, "prepare(exp_dir) first"
         env = cli_env()
         for d in pre_dirs or []:
             os.makedirs(os.path.join(self._workdir, d), exist_ok=True)
+        cmd = [sys.executable, "-m", module, *args]
+        if profile_artifact is not None:
+            cmd = [
+                sys.executable, "-m", "cProfile", "-o", profile_artifact,
+                "-m", module, *args,
+            ]
         return subprocess.Popen(
-            [sys.executable, "-m", module, *args],
+            cmd,
             stdout=stdout,
             stderr=subprocess.STDOUT,
             env=env,
@@ -225,12 +237,18 @@ class HostsTestbed:
         module: str,
         args: List[str],
         pre_dirs: Optional[List[str]] = None,
+        profile_artifact: Optional[str] = None,
     ) -> str:
         """The command string a remote shell runs (identical in both
         transports — that's the point of the local mode)."""
         argv = " ".join(shlex.quote(a) for a in args)
         mkdirs = "".join(
             f"mkdir -p {shlex.quote(d)} && " for d in (pre_dirs or [])
+        )
+        profile = (
+            f"-m cProfile -o {shlex.quote(profile_artifact)} "
+            if profile_artifact is not None
+            else ""
         )
         # exec: the launched python replaces the shell, so teardown signals
         # (SIGINT locally, connection-close SIGHUP over ssh) reach it.
@@ -240,7 +258,7 @@ class HostsTestbed:
             f"cd {self._workdir(index)} && {mkdirs}"
             f"exec env -u JAX_PLATFORMS PYTHONPATH=. "
             f"FANTOCH_PLATFORM={shlex.quote(self.platform)} "
-            f"{shlex.quote(self._python_for(index))} -m {module} {argv}"
+            f"{shlex.quote(self._python_for(index))} {profile}-m {module} {argv}"
         )
 
     def _python_for(self, index: int) -> str:
@@ -255,8 +273,11 @@ class HostsTestbed:
         args: List[str],
         stdout,
         pre_dirs: Optional[List[str]] = None,
+        profile_artifact: Optional[str] = None,
     ) -> subprocess.Popen:
-        command = self._remote_command(index, module, args, pre_dirs)
+        command = self._remote_command(
+            index, module, args, pre_dirs, profile_artifact
+        )
         if self.use_ssh:
             host = self.hosts[index % len(self.hosts)]
             argv = ["ssh", *_SSH_OPTS, host, command]

@@ -27,6 +27,28 @@ def test_non_localhost_testbed_rejected(tmp_path):
 
 
 @pytest.mark.slow
+def test_run_experiment_cprofile_mode(tmp_path):
+    """run_mode='cprofile' (the RunMode::Flamegraph analog): every
+    server runs under cProfile and the experiment pulls one .prof plus a
+    rendered .txt per process, alongside the ordinary artifacts."""
+    cfg = ExperimentConfig(
+        "epaxos", 3, 1, commands_per_client=4, conflict_rate=50
+    )
+    out = str(tmp_path / "prof")
+    manifest = run_experiment(cfg, out, run_mode="cprofile")
+    assert manifest["run_mode"] == "cprofile"
+    exp_dir = os.path.join(out, cfg.name())
+    for pid in (1, 2, 3):
+        prof = os.path.join(exp_dir, f"profile_p{pid}.prof")
+        txt = os.path.join(exp_dir, f"profile_p{pid}.txt")
+        assert os.path.exists(prof), f"missing {prof}"
+        assert os.path.exists(txt)
+        body = open(txt).read()
+        assert "cumulative" in body and "function calls" in body
+    assert manifest["outcome"]["commands"] == 4 * 3
+
+
+@pytest.mark.slow
 def test_run_sweep_throughput_latency_curve(tmp_path):
     # the reference's main experiment shape: one protocol at increasing
     # client counts -> a multi-point throughput-latency curve
