@@ -26,13 +26,13 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import signal
 import subprocess
 import sys
 import time
 from typing import Dict
 
 from fantoch_tpu.exp.config import ExperimentConfig
+from fantoch_tpu.utils import logger
 
 # server artifacts land here relative to each process's workdir, then are
 # pulled into the experiment dir
@@ -140,16 +140,20 @@ def _run_experiment_testbed(
             log = open(os.path.join(exp_dir, f"server_p{pid}.log"), "w")
             logs.append(log)
             servers.append(
-                testbed.spawn(
-                    host_of[pid],
-                    "fantoch_tpu.bin.server",
-                    args,
-                    log,
-                    pre_dirs=[_RESULTS_REL],
-                    profile_artifact=(
-                        f"{_RESULTS_REL}/profile_p{pid}.prof"
-                        if run_mode == "cprofile"
-                        else None
+                (
+                    pid,
+                    testbed.spawn(
+                        host_of[pid],
+                        "fantoch_tpu.bin.server",
+                        args,
+                        log,
+                        pre_dirs=[_RESULTS_REL],
+                        profile_artifact=(
+                            f"{_RESULTS_REL}/profile_p{pid}.prof"
+                            if run_mode == "cprofile"
+                            else None
+                        ),
+                        pidfile=f"{_RESULTS_REL}/server_p{pid}.pid",
                     ),
                 )
             )
@@ -188,9 +192,14 @@ def _run_experiment_testbed(
         time.sleep(0.7)
     finally:
         monitor.stop()
-        for proc in servers:
-            proc.send_signal(signal.SIGINT)
-        for proc in servers:
+        for pid, proc in servers:
+            # in-band on both transports: over ssh a plain client exit
+            # would SIGHUP-kill the remote python, skipping cProfile's
+            # dump and the final metrics snapshot
+            testbed.interrupt(
+                proc, host_of[pid], f"{_RESULTS_REL}/server_p{pid}.pid"
+            )
+        for _pid, proc in servers:
             try:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
@@ -200,18 +209,18 @@ def _run_experiment_testbed(
 
     # pull per-process artifacts back from the machines that produced them
     pulled = []
-    artifacts = [f"metrics_p{pid}.gz" for pid, _ in all_pids]
-    artifacts += [f"execution_p{pid}.log" for pid, _ in all_pids]
+    suffixes = ["metrics_p{pid}.gz", "execution_p{pid}.log"]
     if run_mode == "cprofile":
-        artifacts += [f"profile_p{pid}.prof" for pid, _ in all_pids]
-    pid_of_artifact = {a: int(a.rsplit("_p", 1)[1].split(".")[0]) for a in artifacts}
-    for rel in artifacts:
-        if testbed.pull(
-            host_of[pid_of_artifact[rel]],
-            f"{_RESULTS_REL}/{rel}",
-            os.path.join(exp_dir, rel),
-        ):
-            pulled.append(rel)
+        suffixes.append("profile_p{pid}.prof")
+    for pid, _shard in all_pids:
+        for pattern in suffixes:
+            rel = pattern.format(pid=pid)
+            if testbed.pull(
+                host_of[pid],
+                f"{_RESULTS_REL}/{rel}",
+                os.path.join(exp_dir, rel),
+            ):
+                pulled.append(rel)
     if run_mode == "cprofile":
         # render each profile to text (the flamegraph-artifact analog:
         # human-readable without tooling)
@@ -222,10 +231,15 @@ def _run_experiment_testbed(
             if not os.path.exists(prof):
                 continue
             txt = os.path.join(exp_dir, f"profile_p{pid}.txt")
-            with open(txt, "w") as fh:
-                stats = pstats.Stats(prof, stream=fh)
-                stats.sort_stats("cumulative").print_stats(30)
-            pulled.append(os.path.basename(txt))
+            try:
+                with open(txt, "w") as fh:
+                    stats = pstats.Stats(prof, stream=fh)
+                    stats.sort_stats("cumulative").print_stats(30)
+                pulled.append(os.path.basename(txt))
+            except Exception as exc:  # noqa: BLE001 — a SIGKILLed server
+                # leaves a truncated dump; the experiment's results must
+                # still be indexed
+                logger.warning("unreadable profile %s: %r", prof, exc)
 
     manifest = {
         "config": config.to_dict(),

@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 import shlex
 import shutil
+import signal
 import subprocess
 import sys
 from typing import Dict, List, Optional
@@ -91,12 +92,14 @@ class LocalTestbed:
         stdout,
         pre_dirs: Optional[List[str]] = None,
         profile_artifact: Optional[str] = None,
+        pidfile: Optional[str] = None,
     ) -> subprocess.Popen:
         """``profile_artifact``: workdir-relative .prof path — the server
         runs under cProfile and writes its stats there on exit (the
         RunMode::Flamegraph analog, fantoch_exp/src/lib.rs:26-67: a
         profiler wraps the server binary and its artifact is pulled with
-        the results)."""
+        the results).  ``pidfile`` is unused locally (interrupt() signals
+        the child directly)."""
         assert self._workdir is not None, "prepare(exp_dir) first"
         env = cli_env()
         for d in pre_dirs or []:
@@ -122,6 +125,11 @@ class LocalTestbed:
         if os.path.abspath(src) != os.path.abspath(local_path):
             shutil.copyfile(src, local_path)
         return True
+
+    def interrupt(self, proc: subprocess.Popen, _index: int, _pidfile_rel: str) -> None:
+        """Deliver SIGINT to a spawned server (local: straight to the
+        child — cProfile's finally-dump fires on KeyboardInterrupt)."""
+        proc.send_signal(signal.SIGINT)
 
     def cleanup(self) -> None:
         pass
@@ -238,6 +246,7 @@ class HostsTestbed:
         args: List[str],
         pre_dirs: Optional[List[str]] = None,
         profile_artifact: Optional[str] = None,
+        pidfile: Optional[str] = None,
     ) -> str:
         """The command string a remote shell runs (identical in both
         transports — that's the point of the local mode)."""
@@ -250,12 +259,19 @@ class HostsTestbed:
             if profile_artifact is not None
             else ""
         )
+        # $$ is the shell's pid, which exec turns into the python's pid:
+        # the pidfile gives interrupt() an in-band target over ssh (a
+        # plain ssh client exit only SIGHUPs the remote, which skips
+        # Python's KeyboardInterrupt path and any profiler dump)
+        pidf = (
+            f"echo $$ > {shlex.quote(pidfile)} && " if pidfile is not None else ""
+        )
         # exec: the launched python replaces the shell, so teardown signals
-        # (SIGINT locally, connection-close SIGHUP over ssh) reach it.
+        # (SIGINT locally, kill -INT via the pidfile over ssh) reach it.
         # -u JAX_PLATFORMS: a caller's backend override must not leak into
         # the staged servers (the localhost testbed scrubs it the same way)
         return (
-            f"cd {self._workdir(index)} && {mkdirs}"
+            f"cd {self._workdir(index)} && {mkdirs}{pidf}"
             f"exec env -u JAX_PLATFORMS PYTHONPATH=. "
             f"FANTOCH_PLATFORM={shlex.quote(self.platform)} "
             f"{shlex.quote(self._python_for(index))} {profile}-m {module} {argv}"
@@ -274,9 +290,10 @@ class HostsTestbed:
         stdout,
         pre_dirs: Optional[List[str]] = None,
         profile_artifact: Optional[str] = None,
+        pidfile: Optional[str] = None,
     ) -> subprocess.Popen:
         command = self._remote_command(
-            index, module, args, pre_dirs, profile_artifact
+            index, module, args, pre_dirs, profile_artifact, pidfile
         )
         if self.use_ssh:
             host = self.hosts[index % len(self.hosts)]
@@ -285,6 +302,26 @@ class HostsTestbed:
             argv = ["bash", "-c", command]
         return subprocess.Popen(
             argv, stdout=stdout, stderr=subprocess.STDOUT
+        )
+
+    def interrupt(self, proc: subprocess.Popen, index: int, pidfile_rel: str) -> None:
+        """Deliver SIGINT to the server behind ``proc``: locally the
+        exec'd python IS the child; over ssh, in-band via the pidfile
+        (connection teardown alone would SIGHUP-kill the remote python
+        without raising KeyboardInterrupt, losing profiler artifacts and
+        final metrics snapshots)."""
+        if not self.use_ssh:
+            proc.send_signal(signal.SIGINT)
+            return
+        host = self.hosts[index % len(self.hosts)]
+        pidpath = f"{self.remote_dir}/{pidfile_rel}"
+        subprocess.run(
+            [
+                "ssh", *_SSH_OPTS, host,
+                f"kill -INT $(cat {shlex.quote(pidpath)}) 2>/dev/null || true",
+            ],
+            capture_output=True,
+            timeout=30,
         )
 
     def pull(self, index: int, remote_rel: str, local_path: str) -> bool:
