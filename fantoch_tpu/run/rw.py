@@ -24,18 +24,25 @@ def serialize(value: Any) -> bytes:
 
 
 async def connect_with_retry(
-    addr: tuple, attempts: int = 100, backoff_s: float = 0.05
+    addr: tuple, attempts: int = 120, backoff_s: float = 0.05
 ) -> "Rw":
     """Open a connection, retrying while the peer boots
-    (process.rs:71-111; the client setup retries too, mod.rs:668-740)."""
+    (process.rs:71-111; the client setup retries too, mod.rs:668-740).
+
+    The backoff grows gently to ~1 s so the total budget is ~30 s: a
+    freshly spawned server pays an interpreter + jax import before it
+    can bind, which under a loaded single-core host exceeds a
+    constant-50 ms budget (observed as suite-load flakes)."""
     last: Optional[OSError] = None
+    delay = backoff_s
     for _ in range(attempts):
         try:
             reader, writer = await asyncio.open_connection(*addr)
             return Rw(reader, writer)
         except OSError as exc:
             last = exc
-            await asyncio.sleep(backoff_s)
+            await asyncio.sleep(delay)
+            delay = min(delay * 1.2, 1.0)
     raise ConnectionError(f"could not connect to {addr}: {last!r}")
 
 
