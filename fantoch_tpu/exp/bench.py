@@ -265,6 +265,7 @@ def run_sweep(
     clients_sweep,
     testbed="localhost",
     client_timeout_s: int = 600,
+    run_mode: str = "release",
 ) -> list:
     """The reference's main experiment shape: the same protocol config at
     increasing client counts (fantoch_exp/src/bin/main.rs clients_per
@@ -279,6 +280,7 @@ def run_sweep(
                 output_dir,
                 testbed=testbed,
                 client_timeout_s=client_timeout_s,
+                run_mode=run_mode,
             )
         )
     return manifests
