@@ -328,8 +328,18 @@ class ModelChecker:
                     local.append(copy.deepcopy(act.msg))
                 else:  # pragma: no cover
                     raise AssertionError(f"unknown action {act}")
-            for info in proto.to_executors_iter():
-                executor.handle(info, self._time)
+            # route through the batch seam when the executor has one: the
+            # model checker then exhaustively verifies the batched path's
+            # equivalence to the per-info path across every interleaving
+            # (batch sizes vary with how many infos each pump finds)
+            infos = list(proto.to_executors_iter())
+            if infos:
+                handle_batch = getattr(executor, "handle_batch", None)
+                if handle_batch is not None:
+                    handle_batch(infos, self._time)
+                else:
+                    for info in infos:
+                        executor.handle(info, self._time)
             for result in executor.to_clients_iter():
                 st.executed[pid].setdefault(result.key, []).append(result.rifl)
 

@@ -196,6 +196,27 @@ def test_mc_newt_batched_table_path():
     assert result.terminals > 0
 
 
+def test_mc_caesar_batched_pred_executor():
+    """Model-check Caesar over the BATCHED predecessor executor (the
+    two-phase countdown kernel, ops/pred_resolve.py): every delivery
+    interleaving agrees with the wait-condition semantics — the third
+    batched executor seam under exhaustive checking."""
+    from fantoch_tpu.protocol.caesar import Caesar
+
+    mc = ModelChecker(
+        Caesar,
+        Config(
+            3, 1, gc_interval_ms=100, caesar_wait_condition=True,
+            batched_pred_executor=True,
+        ),
+        [(1, put(1, 1, "A")), (2, put(2, 1, "A"))],
+        max_states=500_000,
+    )
+    result = mc.run()
+    assert result.complete and result.ok, result.violations[:1]
+    assert result.terminals > 0
+
+
 @pytest.mark.slow
 def test_mc_epaxos_batched_graph_executor():
     """Model-check EPaxos over the batched graph executor (array backlog +
