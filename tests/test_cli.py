@@ -119,6 +119,52 @@ def test_cli_cluster_end_to_end(tmp_path):
     assert replayed["results"] == 20  # 20 commands x 1 key
 
 
+def test_cli_device_step_sharded(tmp_path):
+    """Partial replication from the shell: one --device-step
+    --shard-count 2 server, the stock client with both shards pointed at
+    it and two-key (frequently cross-shard) commands."""
+    port = free_port()
+    server = subprocess.Popen(
+        [
+            sys.executable, "-m", "fantoch_tpu.bin.server",
+            "--protocol", "epaxos",
+            "--device-step",
+            "--client-port", str(port),
+            "--device-batch", "32",
+            "--device-key-width", "2",
+            "--device-key-buckets", "64",
+            "-n", "3", "-f", "1",
+            "--shard-count", "2",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=cli_env(),
+        cwd=REPO,
+    )
+    try:
+        out = run_tool(
+            "fantoch_tpu.bin.client",
+            [
+                "--ids", "1-2",
+                "--addresses", f"0=127.0.0.1:{port},1=127.0.0.1:{port}",
+                "--commands-per-client", "10",
+                "--keys-per-command", "2",
+                "--conflict-rate", "50",
+            ],
+            timeout=180,
+        )
+        summary = json.loads(out.strip().splitlines()[-1])
+        assert summary["clients"] == 2
+        assert summary["commands"] == 20
+    finally:
+        server.send_signal(signal.SIGINT)
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
 def test_cli_device_step_server(tmp_path):
     """The TPU serving path from the shell: one --device-step server, the
     stock client binary against it (same wire protocol)."""
