@@ -454,6 +454,7 @@ class DeviceDriver(_DriverCore):
         rest of the fixed batch is padding; excess raises).  Returns the
         per-key results of every command *executed* this round — which
         includes commands carried from previous degraded rounds."""
+        import jax
         import jax.numpy as jnp
 
         assert len(batch) <= self.batch_size, (
@@ -484,6 +485,12 @@ class DeviceDriver(_DriverCore):
         self._state, out = self._step(
             self._state, jnp.asarray(key), jnp.asarray(src), jnp.asarray(seq)
         )
+        # one pytree fetch: device_get issues async copies for every output
+        # leaf before blocking, so the round pays ONE device->host round
+        # trip instead of one per field (through a remote-dispatch tunnel
+        # each blocking np.asarray costs a full ~76 ms round trip —
+        # measured as ~7x the serving-round wall time, BENCH_DEV round 5)
+        out = jax.device_get(out)
         self._next_gid += b
         self.rounds += 1
 
@@ -635,6 +642,7 @@ class NewtDeviceDriver(_DriverCore):
         )
 
     def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
+        import jax
         import jax.numpy as jnp
 
         from fantoch_tpu.parallel.mesh_step import KEY_PAD
@@ -659,6 +667,8 @@ class NewtDeviceDriver(_DriverCore):
         self._state, out = self._step(
             self._state, jnp.asarray(key), jnp.asarray(src), jnp.asarray(seq)
         )
+        # one pytree fetch, one device->host round trip (see DeviceDriver)
+        out = jax.device_get(out)
         self.rounds += 1
 
         device_wm = int(out.stable_watermark)
@@ -759,6 +769,7 @@ class CaesarDeviceDriver(_DriverCore):
         self._pend_seq = np.zeros(cap, dtype=np.int32)
 
     def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
+        import jax
         import jax.numpy as jnp
 
         from fantoch_tpu.parallel.mesh_step import KEY_PAD
@@ -784,6 +795,8 @@ class CaesarDeviceDriver(_DriverCore):
         self._state, out = self._step(
             self._state, jnp.asarray(key), jnp.asarray(src), jnp.asarray(seq)
         )
+        # one pytree fetch, one device->host round trip (see DeviceDriver)
+        out = jax.device_get(out)
         self.rounds += 1
 
         wm = int(out.watermark)
@@ -909,6 +922,7 @@ class PaxosDeviceDriver(_DriverCore):
         )
 
     def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
+        import jax
         import jax.numpy as jnp
 
         assert len(batch) <= self.batch_size
@@ -938,6 +952,10 @@ class PaxosDeviceDriver(_DriverCore):
         self._state, out = self._step(
             self._state, jnp.asarray(valid), jnp.asarray(src), jnp.asarray(seq)
         )
+        # one pytree fetch, one device->host round trip (see DeviceDriver);
+        # the exec_frontier scalar rides the same fetch — a separate
+        # blocking read would cost a second full tunnel round trip
+        out, exec_frontier = jax.device_get((out, self._state.exec_frontier))
         self.rounds += 1
 
         order = np.asarray(out.order)
@@ -945,7 +963,7 @@ class PaxosDeviceDriver(_DriverCore):
         slot = np.asarray(out.slot)
         # device slot counter: + new valid rows, - rolled-back overflow
         self._next_slot += len(batch) - int(out.pend_dropped)
-        self.stable_watermark = self._slot_base + int(self._state.exec_frontier)
+        self.stable_watermark = self._slot_base + int(exec_frontier)
         # every commit in the leader class takes the same (slow) path: one
         # accept round — mirror the tally convention of the object runner
         self.slow_paths += int(executed.sum())
