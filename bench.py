@@ -659,11 +659,30 @@ def bench_device_serving(
         assert served == total - batch_size, f"served {served}/{total}"
         return round(wall_ms / rounds, 2), int(served / (wall_ms / 1000.0))
 
+    def measure_pipelined(batch_size: int):
+        """The saturated serving loop: dispatch round k+1 before draining
+        round k (DeviceDriver.step_pipelined), overlapping the device
+        round with the host emit loop."""
+        driver = DeviceDriver(n, batch_size=batch_size, key_buckets=8192)
+        driver.step(cmds[:batch_size])  # compile + warm
+        t0 = time.perf_counter()
+        served = 0
+        for start in range(batch_size, total, batch_size):
+            served += len(driver.step_pipelined(cmds[start : start + batch_size]))
+        served += len(driver.flush_pipeline())
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        rounds = (total - batch_size) // batch_size
+        assert served == total - batch_size, f"served {served}/{total}"
+        return round(wall_ms / rounds, 2), int(served / (wall_ms / 1000.0))
+
     round_ms, cmds_per_s = measure(batch)
+    pipe_ms, pipe_cps = measure_pipelined(batch)
     out = {
         "serving_batch": batch,
         "serving_round_ms": round_ms,
         "serving_cmds_per_s": cmds_per_s,
+        "serving_pipelined_round_ms": pipe_ms,
+        "serving_pipelined_cmds_per_s": pipe_cps,
     }
     for other in (1024, 16384):
         if total < 2 * other:

@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--device-key-buckets", type=int, default=4096)
     parser.add_argument("--device-key-width", type=int, default=1,
                         help="max conflict-key buckets per command")
+    parser.add_argument(
+        "--device-pipeline", choices=["auto", "on", "off"], default="auto",
+        help="dispatch/drain overlap for saturated serving (auto = on for "
+        "non-CPU backends; overlap needs a compute resource besides the "
+        "host cores)")
     parser.add_argument("--device-pending", type=int, default=256,
                         help="device pending-buffer capacity")
     parser.add_argument(
@@ -107,6 +112,8 @@ async def serve_device_step(args: argparse.Namespace) -> None:
         monitor_execution_order=config.executor_monitor_execution_order,
         metrics_file=args.metrics_file,
         metrics_interval_ms=args.metrics_interval,
+        pipeline=None if args.device_pipeline == "auto"
+        else args.device_pipeline == "on",
     )
     await runtime.start()
     print(

@@ -168,6 +168,7 @@ async def run_device_server(
     pending_capacity: int = 64,
     open_loop_interval_ms: Optional[int] = None,
     monitor_execution_order: bool = True,
+    pipeline: Optional[bool] = None,
 ):
     """Boot the TPU serving path (run/device_runner.py) on a localhost
     port and drive real TCP clients against it; returns
@@ -185,6 +186,7 @@ async def run_device_server(
         key_width=key_width,
         pending_capacity=pending_capacity,
         monitor_execution_order=monitor_execution_order,
+        pipeline=pipeline,
     )
     await runtime.start()
     client_task = asyncio.ensure_future(

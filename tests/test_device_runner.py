@@ -763,3 +763,97 @@ def test_device_runtime_newt_multi_key_tcp():
         assert client.issued_commands == 5
     assert runtime.driver.executed == 10
     assert runtime.driver.in_flight == 0
+
+
+def test_driver_pipelined_equivalence():
+    """step_pipelined returns each round's results one call late and, with
+    a final flush, produces exactly the sync driver's execution: same
+    per-round result values, same per-key monitor order, same tallies
+    (the overlap must be pure scheduling, never reordering)."""
+    def batches():
+        out, seq = [], 0
+        for r in range(6):
+            batch = []
+            for j in range(4):
+                seq += 1
+                key = "hot" if (seq % 2) else f"priv{j}"
+                batch.append(_put(1, seq, key, f"v{seq}"))
+            out.append(batch)
+        return out
+
+    d_sync, d_pipe = _driver(), _driver()
+    sync_rounds = [d_sync.step(b) for b in batches()]
+    pipe_rounds = [d_pipe.step_pipelined(b) for b in batches()]
+    assert pipe_rounds[0] == []  # one round of delivery lag
+    pipe_rounds.append(d_pipe.flush_pipeline())
+    assert not d_pipe.has_outstanding
+
+    def flat(rounds):
+        return [(r.rifl, r.key, tuple(r.op_results)) for rr in rounds for r in rr]
+
+    assert flat(pipe_rounds) == flat(sync_rounds)
+    # the lag is exactly one round: pipelined round k+1 == sync round k
+    assert flat(pipe_rounds[1:2]) == flat(sync_rounds[0:1])
+    assert d_pipe.executed == d_sync.executed == 24
+    assert d_pipe.in_flight == 0
+    for key in d_sync.store.monitor.keys():
+        assert (
+            d_pipe.store.monitor.get_order(key)
+            == d_sync.store.monitor.get_order(key)
+        )
+
+
+def test_pipelined_gid_reset_flushes_outstanding():
+    """The gid epoch reset rebases the registry that drain reads, so
+    step_pipelined must retire the outstanding round *before* resetting
+    (the early-flush branch); the reset then proceeds and chains stay
+    intact across it."""
+    d = _driver(batch_size=16)
+    assert d.step_pipelined([_put(1, 1, "k", "a")]) == []
+    assert d.has_outstanding
+    # lower the threshold on this instance so the next dispatch triggers
+    d.GID_RESET_THRESHOLD = d._next_gid + d.batch_size
+    r1 = d.step_pipelined([_put(1, 2, "k", "b")])
+    # the early flush returned round 1's results ahead of the reset
+    assert [r.op_results[0] for r in r1] == [None]
+    assert d.gid_epochs == 1 and d.has_outstanding
+    r2 = d.flush_pipeline()
+    assert [r.op_results[0] for r in r2] == ["a"]
+    assert d.executed == 2 and d.in_flight == 0
+    order = d.store.monitor.get_order("k")
+    assert len(order) == len(set(order)) == 2
+
+
+def test_device_runtime_pipelined_tcp_serving():
+    """Saturated serving engages the pipelined loop (batch_size smaller
+    than the standing queue) and still answers every client with per-key
+    order agreement — the TCP twin of the equivalence test."""
+    config = Config(3, 1, shard_count=1)
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=1,
+        commands_per_client=COMMANDS_PER_CLIENT,
+        payload_size=1,
+    )
+    runtime, clients = asyncio.run(
+        run_device_server(
+            config,
+            workload,
+            client_count=4,
+            batch_size=8,
+            open_loop_interval_ms=1,
+            pipeline=True,  # auto would disable it on the CPU test backend
+        )
+    )
+    for client in clients.values():
+        assert client.issued_commands == COMMANDS_PER_CLIENT
+        assert len(list(client.data().latency_data())) == COMMANDS_PER_CLIENT
+    driver = runtime.driver
+    assert driver.executed == 4 * COMMANDS_PER_CLIENT
+    assert driver.in_flight == 0 and not driver.has_outstanding
+    # the open-loop firehose outpaced the 8-wide rounds at least once
+    assert driver.pipelined_rounds > 0
+    monitor = driver.store.monitor
+    seen = [rifl for key in monitor.keys() for rifl in monitor.get_order(key)]
+    assert len(seen) == len(set(seen)) == 4 * COMMANDS_PER_CLIENT
