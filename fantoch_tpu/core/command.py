@@ -101,17 +101,25 @@ class Command:
                 return True
         return False
 
-    def execute(self, shard_id: ShardId, store: KVStore) -> Iterator["ExecutorResult"]:
-        """Execute this command's ops for `shard_id`, streaming per-key results.
+    def execute(self, shard_id: ShardId, store: KVStore) -> List["ExecutorResult"]:
+        """Execute this command's ops for `shard_id`, returning per-key results.
 
-        Reference: fantoch/src/command.rs:114-127.
+        Reference: fantoch/src/command.rs:114-127.  Returns a list (not a
+        generator): this is the serving hot path — one call per executed
+        command — and the dominant shape is a single key with a single op,
+        which skips the genexpr entirely.
         """
         from fantoch_tpu.executor.base import ExecutorResult
 
-        ops = self._shard_to_ops.get(shard_id, {})
-        for key, key_ops in ops.items():
-            results = tuple(store.execute(key, op, self._rifl) for op in key_ops)
-            yield ExecutorResult(self._rifl, key, results)
+        rifl = self._rifl
+        out = []
+        for key, key_ops in self._shard_to_ops.get(shard_id, {}).items():
+            if len(key_ops) == 1:
+                results = (store.execute(key, key_ops[0], rifl),)
+            else:
+                results = tuple(store.execute(key, op, rifl) for op in key_ops)
+            out.append(ExecutorResult(rifl, key, results))
+        return out
 
     def __eq__(self, other: object) -> bool:
         return (

@@ -66,27 +66,55 @@ from fantoch_tpu.utils import key_hash, logger
 Address = Tuple[str, int]
 
 
+_BUCKET_CACHE_MAX = 1 << 18  # bound the string->bucket memo (~25 MB)
+
+
 def _buckets(
-    cmd: Command, shard_id: ShardId, key_buckets: int, shard_count: int = 1
+    cmd: Command,
+    shard_id: ShardId,
+    key_buckets: int,
+    shard_count: int = 1,
+    cache: Optional[Dict] = None,
 ) -> List[int]:
     """Distinct key buckets for one command — the single definition shared
     by the driver's row builder and the session-boundary validator, so the
     two can never drift (colliding keys dedup, which only coarsens
     conflicts).
 
+    ``cache`` memoizes the per-key FNV hash->bucket map (workloads repeat
+    keys heavily — the hot-key half of the north-star workload is ONE
+    key); it is cleared wholesale past ``_BUCKET_CACHE_MAX`` entries so a
+    long-running server's key churn cannot grow it unboundedly.
+
     Sharded (shard_count > 1): buckets span EVERY shard the command
     touches, and bucket ``b`` encodes its owner as ``b % shard_count``
     (the sharded-key-axis contract of mesh_step.protocol_step); the
     ``shard_id`` argument is ignored — the unified mesh orders the whole
     command."""
+    if cache is not None and len(cache) > _BUCKET_CACHE_MAX:
+        cache.clear()
     if shard_count == 1:
-        return sorted({key_hash(k) % key_buckets for k in cmd.keys(shard_id)})
+        if cache is None:
+            return sorted({key_hash(k) % key_buckets for k in cmd.keys(shard_id)})
+        bs = set()
+        for k in cmd.keys(shard_id):
+            b = cache.get(k)
+            if b is None:
+                cache[k] = b = key_hash(k) % key_buckets
+            bs.add(b)
+        return sorted(bs)
     per_shard = key_buckets // shard_count
-    return sorted({
-        sid + shard_count * (key_hash(k) % per_shard)
-        for sid in cmd.shards()
-        for k in cmd.keys(sid)
-    })
+    bs = set()
+    for sid in cmd.shards():
+        for k in cmd.keys(sid):
+            ck = (sid, k)
+            b = None if cache is None else cache.get(ck)
+            if b is None:
+                b = sid + shard_count * (key_hash(k) % per_shard)
+                if cache is not None:
+                    cache[ck] = b
+            bs.add(b)
+    return sorted(bs)
 
 
 def _bucket_row(
@@ -95,10 +123,11 @@ def _bucket_row(
     key_buckets: int,
     key_width: int,
     shard_count: int = 1,
+    cache: Optional[Dict] = None,
 ):
     """Key-bucket row for one command (device key-row contract: a row must
     not repeat a bucket)."""
-    buckets = _buckets(cmd, shard_id, key_buckets, shard_count)
+    buckets = _buckets(cmd, shard_id, key_buckets, shard_count, cache)
     assert 1 <= len(buckets) <= key_width, (
         f"command touches {len(buckets)} key buckets but the device state "
         f"was initialized with key_width={key_width}"
@@ -140,6 +169,7 @@ class _DriverCore:
         # commands in flight: registered at step entry, dropped at execution
         self._cmds: Dict[int, Tuple[Dot, Command]] = {}
         self._requeue: List[Tuple[Dot, Command]] = []
+        self._bucket_cache: Dict = {}  # key -> bucket memo (see _buckets)
         self._seq_base = 0  # device seq column = dot.sequence - seq_base
         self.seq_epochs = 0  # window advances (observability)
         self.store = KVStore(monitor_execution_order)
@@ -379,7 +409,7 @@ class DeviceDriver(_DriverCore):
     def _bucket_row(self, cmd: Command) -> List[int]:
         return _bucket_row(
             cmd, self.shard_id, self.key_buckets, self.key_width,
-            self.shard_count,
+            self.shard_count, self._bucket_cache,
         )
 
     # gid space is int32 and the key clock holds raw gids; when the space
@@ -718,7 +748,10 @@ class NewtDeviceDriver(_DriverCore):
         src = np.zeros(b, dtype=np.int32)
         seq = np.zeros(b, dtype=np.int32)
         for i, (dot, cmd) in enumerate(batch):
-            buckets = _bucket_row(cmd, self.shard_id, self.key_buckets, self.key_width)
+            buckets = _bucket_row(
+                cmd, self.shard_id, self.key_buckets, self.key_width,
+                cache=self._bucket_cache,
+            )
             key[i, : len(buckets)] = buckets
             src[i] = dot.source
             seq[i] = self._device_seq(dot)
@@ -846,7 +879,8 @@ class CaesarDeviceDriver(_DriverCore):
         seq = np.zeros(b, dtype=np.int32)
         for i, (dot, cmd) in enumerate(batch):
             buckets = _bucket_row(
-                cmd, self.shard_id, self.key_buckets, self.key_width
+                cmd, self.shard_id, self.key_buckets, self.key_width,
+                cache=self._bucket_cache,
             )
             key[i, : len(buckets)] = buckets
             src[i] = dot.source
@@ -1172,7 +1206,8 @@ class _DeviceClientSession:
                 "device server"
             )
         buckets = _buckets(
-            cmd, driver.shard_id, driver.key_buckets, driver.shard_count
+            cmd, driver.shard_id, driver.key_buckets, driver.shard_count,
+            driver._bucket_cache,
         )
         if not buckets:
             return "command touches no keys"
