@@ -41,6 +41,7 @@ def run_tool(module, args, timeout=120):
     return out.stdout
 
 
+@pytest.mark.slow
 def test_cli_cluster_end_to_end(tmp_path):
     n = 3
     peer_ports = {pid: free_port() for pid in (1, 2, 3)}
@@ -119,6 +120,7 @@ def test_cli_cluster_end_to_end(tmp_path):
     assert replayed["results"] == 20  # 20 commands x 1 key
 
 
+@pytest.mark.slow
 def test_cli_device_step_sharded(tmp_path):
     """Partial replication from the shell: one --device-step
     --shard-count 2 server, the stock client with both shards pointed at

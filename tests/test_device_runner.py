@@ -865,7 +865,6 @@ def test_runtime_pipeline_engages_on_backlog():
     is enqueued before the driver task first runs, so the queue is
     non-empty at every early batch fill and step_pipelined must engage
     (no dependence on client arrival timing)."""
-    from fantoch_tpu.core.kvs import KVOp as _KVOp
     from fantoch_tpu.run.device_runner import DeviceRuntime
     from fantoch_tpu.run.harness import free_port
 

@@ -72,6 +72,7 @@ def test_mc_epaxos_two_conflicting_commands():
     assert result.terminals > 0
 
 
+@pytest.mark.slow
 def test_mc_atlas_two_conflicting_commands():
     from fantoch_tpu.protocol.graph_protocol import Atlas
 
@@ -137,6 +138,7 @@ def test_mc_caesar_two_conflicting_commands():
     assert result.terminals > 0
 
 
+@pytest.mark.slow
 def test_mc_newt_with_quiescent_timers():
     # Newt's executor needs detached-vote flushes (a periodic event) for
     # timestamp stability: quiescence-stage timer firings (to fixpoint)
@@ -196,6 +198,7 @@ def test_mc_newt_batched_table_path():
     assert result.terminals > 0
 
 
+@pytest.mark.slow
 def test_mc_caesar_batched_pred_executor():
     """Model-check Caesar over the BATCHED predecessor executor (the
     two-phase countdown kernel, ops/pred_resolve.py): every delivery

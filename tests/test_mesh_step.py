@@ -212,6 +212,7 @@ def test_protocol_step_multikey(mesh):
     assert state.frontier.tolist() == [2 * batch] * num_replicas
 
 
+@pytest.mark.slow
 def test_multikey_pending_commits_after_quorum_recovers(mesh):
     """Degraded-quorum liveness on the MULTI-key path: MISSING deps route
     through resolve_general's iterative branch inside shard_map; carried
@@ -357,6 +358,7 @@ def test_newt_clocks_continue_across_rounds(mesh):
     assert c2.min() > c1.max()
 
 
+@pytest.mark.slow
 def test_newt_degraded_quorum_carries_pending(mesh):
     """With fewer live replicas than the write quorum, slow-path commands
     cannot commit; they carry in the pending buffer and commit + execute
@@ -523,6 +525,7 @@ def test_newt_multikey_round(mesh):
     assert c2[e2].max() > r1_max
 
 
+@pytest.mark.slow
 def test_newt_multikey_holdback_preserves_per_key_order(mesh):
     """Regression (r4 review): a multi-key command stable on key A but
     blocked by key B must hold back higher-clocked commands on A, or A's
@@ -636,6 +639,7 @@ def test_sharded_step_cross_shard_dependencies(mesh):
     assert (kc[0:3, 4] >= 0).all() and (kc[3:6, 5] >= 0).all()
 
 
+@pytest.mark.slow
 def test_sharded_step_degraded_shard_blocks_multi_shard(mesh):
     """A dead majority in ONE shard blocks that shard's slow-path
     commands AND any multi-shard command touching it, while the healthy
@@ -780,6 +784,7 @@ def test_caesar_step_degraded_wait_and_recovery(mesh):
     assert clock3[0] != clock3[1]
 
 
+@pytest.mark.slow
 def test_caesar_wait_gate_transitive_holdback(mesh):
     """A committed multi-key row held behind an uncommitted lower-clock
     conflict on one bucket must transitively hold back higher-clock rows

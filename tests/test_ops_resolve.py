@@ -569,6 +569,7 @@ def test_general_fast_path_matches_iterative():
     assert per_key(fast.order) == per_key(it_order)
 
 
+@pytest.mark.slow
 def test_general_random_vs_oracle():
     """random_adds-style graphs (mod.rs:934-1033) without 3+-cycles: every
     fully-resolvable graph matches the oracle; stuck vertices are allowed

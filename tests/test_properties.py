@@ -154,6 +154,7 @@ def functional_graphs(draw):
 
 @settings(max_examples=60 // 4 if _CI else 60, deadline=None)
 @given(functional_graphs())
+@pytest.mark.slow
 def test_keyed_resolver_matches_oracle_property(args):
     from test_ops_resolve import assert_keyed_matches_oracle
 

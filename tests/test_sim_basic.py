@@ -66,6 +66,7 @@ def test_runner_single_client_per_process():
     assert west2.mean() == 142.0
 
 
+@pytest.mark.slow
 def test_runner_multiple_clients_per_process():
     # the simulator assumes infinite CPU: latency must not depend on load
     one_w1, one_w2 = run_basic(f=1, clients_per_process=1)

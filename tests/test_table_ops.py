@@ -1,5 +1,6 @@
 """Device table ops vs the host SequentialKeyClocks / VotesTable oracles."""
 
+import pytest
 import random
 
 import jax.numpy as jnp
@@ -47,6 +48,7 @@ def test_batched_proposal_matches_oracle():
         assert new_prior.tolist() == want_prior, f"trial {trial}"
 
 
+@pytest.mark.slow
 def test_batched_proposal_large_clocks_many_keys():
     """Overflow regression: micros-scale priors across tens of thousands of
     keys must not corrupt the segmented scan."""
