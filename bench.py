@@ -597,6 +597,23 @@ def bench_table_path(batch: int = 100_000, keys: int = 4096, n: int = 3):
 
     time_executor_arrays()  # warm
     exec_arrays_ms = min(time_executor_arrays() for _ in range(3))
+
+    # ordering-only drain (the table twin of executor_order_*): stable rows
+    # emit as rifl columns, no KVStore / ExecutorResult work
+    def time_executor_order():
+        config = Config(n, 1, newt_detached_send_interval_ms=5,
+                        batched_table_executor=True)
+        ex = TableExecutor(1, shard, config)
+        ex.record_order_arrays = True
+        t0 = time.perf_counter()
+        ex.handle_batch_arrays(votes_arrays, clock_t)
+        ms = (time.perf_counter() - t0) * 1000.0
+        _, seq = ex.take_order_arrays()
+        assert len(seq) == batch, f"order-drained {len(seq)}/{batch}"
+        return ms
+
+    time_executor_order()  # warm
+    exec_order_ms = min(time_executor_order() for _ in range(3))
     return {
         "table_batch": batch,
         "table_proposal_ms": round(batched_ms, 1),
@@ -612,6 +629,10 @@ def bench_table_path(batch: int = 100_000, keys: int = 4096, n: int = 3):
         ),
         "table_cmds_per_s_arrays": int(
             batch / ((arrays_ms + exec_arrays_ms) / 1000.0)
+        ),
+        "table_executor_order_ms": round(exec_order_ms, 1),
+        "table_cmds_per_s_order": int(
+            batch / ((arrays_ms + exec_order_ms) / 1000.0)
         ),
     }
 

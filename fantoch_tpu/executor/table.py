@@ -223,8 +223,10 @@ class TableExecutor(Executor):
     scale, a numpy partition below it (identical semantics; kernel
     dispatch only pays off across many keys)."""
 
-    # touched-key count at which the device kernel beats host numpy
-    _KERNEL_THRESHOLD = 64
+    # frontier-matrix element count (keys x n) at which the device kernel
+    # beats host numpy: an order statistic over 3-5 columns is a few ns/row
+    # on host, so the dispatch only amortizes at millions of elements
+    _KERNEL_THRESHOLD = 1 << 20
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
         _, _, stability_threshold = config.newt_quorum_sizes()
@@ -235,6 +237,12 @@ class TableExecutor(Executor):
         self._batched = config.batched_table_executor
         self._n = config.n
         self._stability_threshold = stability_threshold
+        # opt-in array drain (the record_order_arrays move from the graph
+        # executor): stable rows emit as (rifl_src, rifl_seq) columns and
+        # skip KVStore execution + ExecutorResult materialization — for
+        # array-native consumers and ordering benches.  Off by default.
+        self.record_order_arrays = False
+        self._order_arrays: List[Tuple["np.ndarray", "np.ndarray"]] = []
 
     def handle_batch(self, infos, time) -> None:
         if not self._batched or self._execute_at_commit:
@@ -310,45 +318,69 @@ class TableExecutor(Executor):
                       batch.ops[i])],
                 )
             return
-        # row -> key table (strings dedup through the executor's map)
-        tables: Dict[Key, VotesTable] = {}
-        key_ids = np.empty(B, dtype=np.int64)
-        key_list: List[Key] = []
-        seen: Dict[Key, int] = {}
-        for i, key in enumerate(batch.keys):
-            idx = seen.get(key)
-            if idx is None:
-                idx = len(key_list)
-                seen[key] = idx
-                key_list.append(key)
-                tables[key] = self._table._table(key)
-            key_ids[i] = idx
+        # row -> key table (one C-level sort instead of a 100k-iteration
+        # Python dict loop; key_list order is the unique-sorted order)
+        uniq, key_ids = np.unique(
+            np.asarray(batch.keys, dtype=object), return_inverse=True
+        )
+        key_ids = key_ids.astype(np.int64, copy=False)
+        key_list: List[Key] = uniq.tolist()
+        tables: Dict[Key, VotesTable] = {
+            k: self._table._table(k) for k in key_list
+        }
 
-        # 1. votes: coalesce per (key, process) with one lexsort, then one
-        # add_range per coalesced run (segments ~= touched keys x voters,
-        # not commands)
-        vkey = key_ids[batch.vote_row]
-        vorder = np.lexsort((batch.vote_start, batch.vote_by, vkey))
-        vk = vkey[vorder]
-        vb = batch.vote_by[vorder]
-        vs = batch.vote_start[vorder]
-        ve = batch.vote_end[vorder]
-        i = 0
-        V = len(vorder)
-        while i < V:
-            k, by = int(vk[i]), int(vb[i])
-            events = tables[key_list[k]]._votes[by]
-            start, end = int(vs[i]), int(ve[i])
-            i += 1
-            while i < V and vk[i] == k and vb[i] == by:
-                nxt_s, nxt_e = int(vs[i]), int(ve[i])
-                if nxt_s <= end + 1:
-                    end = max(end, nxt_e)
-                else:
+        # 1. votes: coalesce per (key, process) entirely in numpy — sort by
+        # (key, by, start), compute the per-group running max end (groups
+        # separated with a large offset so one accumulate serves all), and
+        # cut merged runs where a start clears the running end by > 1.
+        # One add_range call per *merged run* (~ touched keys x voters),
+        # not per vote row.
+        V = len(batch.vote_row)
+        if V:
+            vkey = key_ids[batch.vote_row]
+            vorder = np.lexsort((batch.vote_start, batch.vote_by, vkey))
+            vk = vkey[vorder]
+            vb = batch.vote_by[vorder]
+            vs = batch.vote_start[vorder]
+            ve = batch.vote_end[vorder]
+            grp_change = np.r_[True, (vk[1:] != vk[:-1]) | (vb[1:] != vb[:-1])]
+            gid = np.cumsum(grp_change) - 1
+            base = np.int64(ve.min())
+            spread = np.int64(int(ve.max()) - int(base) + 2)
+            ngroups = int(gid[-1]) + 1
+            if ngroups * int(spread) < (1 << 62):
+                # rebase + per-group offset keeps one global accumulate
+                # from leaking a group's max end into the next group
+                off = gid * spread
+                run_end = np.maximum.accumulate((ve - base) + off) - off + base
+                prev_end = np.empty_like(run_end)
+                prev_end[0] = vs[0]  # dead: grp_change[0] forces a run
+                prev_end[1:] = run_end[:-1]
+                new_run = grp_change | (vs > prev_end + 1)
+                run_starts = np.flatnonzero(new_run)
+                m_key = vk[run_starts].tolist()
+                m_by = vb[run_starts].tolist()
+                m_start = vs[run_starts].tolist()
+                m_end = np.maximum.reduceat(ve, run_starts).tolist()
+                for k, by, start, end in zip(m_key, m_by, m_start, m_end):
+                    tables[key_list[k]]._votes[by].add_range(start, end)
+            else:
+                # pathological clock spread: per-row host merge
+                i = 0
+                while i < V:
+                    k, by = int(vk[i]), int(vb[i])
+                    events = tables[key_list[k]]._votes[by]
+                    start, end = int(vs[i]), int(ve[i])
+                    i += 1
+                    while i < V and vk[i] == k and vb[i] == by:
+                        nxt_s, nxt_e = int(vs[i]), int(ve[i])
+                        if nxt_s <= end + 1:
+                            end = max(end, nxt_e)
+                        else:
+                            events.add_range(start, end)
+                            start, end = nxt_s, nxt_e
+                        i += 1
                     events.add_range(start, end)
-                    start, end = nxt_s, nxt_e
-                i += 1
-            events.add_range(start, end)
 
         # 2. stability over all touched keys in one pass
         frontiers = np.array(
@@ -400,14 +432,20 @@ class TableExecutor(Executor):
                 continue
             cut = int(np.searchsorted(batch.clock[rows], stable_k, side="right"))
             if cut:
-                self._execute(
-                    key,
-                    [
-                        (Rifl(int(batch.rifl_src[i]), int(batch.rifl_seq[i])),
-                         batch.ops[i])
-                        for i in rows[:cut].tolist()
-                    ],
-                )
+                if self.record_order_arrays:
+                    sel = rows[:cut]
+                    self._order_arrays.append(
+                        (batch.rifl_src[sel], batch.rifl_seq[sel])
+                    )
+                else:
+                    self._execute(
+                        key,
+                        [
+                            (Rifl(int(batch.rifl_src[i]), int(batch.rifl_seq[i])),
+                             batch.ops[i])
+                            for i in rows[:cut].tolist()
+                        ],
+                    )
             for i in rows[cut:].tolist():
                 table.add_op(
                     Dot(int(batch.dot_src[i]), int(batch.dot_seq[i])),
@@ -416,12 +454,12 @@ class TableExecutor(Executor):
                     batch.ops[i],
                 )
 
-    def _stable_clocks(self, frontiers) -> "np.ndarray":
+    def _stable_clocks(self, frontiers, force_kernel: bool = False) -> "np.ndarray":
         import numpy as np
 
         k, n = frontiers.shape
         col = n - self._stability_threshold
-        if k >= self._KERNEL_THRESHOLD:
+        if force_kernel or k * n >= self._KERNEL_THRESHOLD:
             base = int(frontiers.min())
             rebased = frontiers - base  # order statistic is shift-invariant
             if int(rebased.max()) < (1 << 31):
@@ -453,9 +491,40 @@ class TableExecutor(Executor):
             raise AssertionError(f"unknown table execution info {info}")
 
     def _execute(self, key: Key, to_execute: List[Tuple[Rifl, Tuple[KVOp, ...]]]) -> None:
+        if self.record_order_arrays:
+            import numpy as np
+
+            m = len(to_execute)
+            src = np.fromiter((r.source for r, _ in to_execute), np.int64, m)
+            seq = np.fromiter((r.sequence for r, _ in to_execute), np.int64, m)
+            self._order_arrays.append((src, seq))
+            return
+        store_execute = self._store.execute
+        append = self._to_clients.append
         for rifl, ops in to_execute:
-            results = tuple(self._store.execute(key, op, rifl) for op in ops)
-            self._to_clients.append(ExecutorResult(rifl, key, results))
+            if len(ops) == 1:
+                results = (store_execute(key, ops[0], rifl),)
+            else:
+                results = tuple(store_execute(key, op, rifl) for op in ops)
+            append(ExecutorResult(rifl, key, results))
+
+    def take_order_arrays(self) -> Tuple["np.ndarray", "np.ndarray"]:
+        """Concatenated (rifl_src, rifl_seq) execution-order columns since
+        the last take; requires ``record_order_arrays`` (same contract as
+        BatchedDependencyGraph.take_order_arrays — ordering only, no
+        KVStore side effects)."""
+        assert self.record_order_arrays
+        import numpy as np
+
+        if not self._order_arrays:
+            return np.empty(0, np.int64), np.empty(0, np.int64)
+        chunks, self._order_arrays = self._order_arrays, []
+        if len(chunks) == 1:
+            return chunks[0]
+        return (
+            np.concatenate([c[0] for c in chunks]),
+            np.concatenate([c[1] for c in chunks]),
+        )
 
     def to_clients(self) -> Optional[ExecutorResult]:
         return self._to_clients.popleft() if self._to_clients else None

@@ -241,22 +241,86 @@ def test_handle_batch_arrays_oracle_equivalence():
         assert mon_a.get_order(key) == mon_b.get_order(key)
 
 
+def test_handle_batch_arrays_order_drain():
+    """record_order_arrays: the (rifl_src, rifl_seq) column drain yields
+    the object drain's exact emit order — across buffered tails flushing
+    in a later round — with no ExecutorResult objects and no KVStore
+    side effects."""
+    from fantoch_tpu.core import Dot, RunTime
+    from fantoch_tpu.executor.table import (
+        TableExecutor,
+        TableVotes,
+        TableVotesArrays,
+    )
+    from fantoch_tpu.protocol.common.table_clocks import VoteRange
+
+    n = 3
+    time = RunTime()
+    cfg = lambda: Config(n, 1, batched_table_executor=True)  # noqa: E731
+    ex_obj = TableExecutor(1, SHARD, cfg())
+    ex_ord = TableExecutor(1, SHARD, cfg())
+    ex_ord.record_order_arrays = True
+
+    def make(rows, votes_spec):
+        """rows: [(key, clock, seq)]; votes_spec: [(row, by, start, end)]"""
+        B = len(rows)
+        return TableVotesArrays(
+            keys=[r[0] for r in rows],
+            dot_src=np.full(B, 1, dtype=np.int64),
+            dot_seq=np.array([r[2] for r in rows], dtype=np.int64),
+            clock=np.array([r[1] for r in rows], dtype=np.int64),
+            rifl_src=np.full(B, 1, dtype=np.int64),
+            rifl_seq=np.array([r[2] for r in rows], dtype=np.int64),
+            ops=[(KVOp.put(f"v{r[2]}"),) for r in rows],
+            vote_row=np.array([v[0] for v in votes_spec], dtype=np.int64),
+            vote_by=np.array([v[1] for v in votes_spec], dtype=np.int64),
+            vote_start=np.array([v[2] for v in votes_spec], dtype=np.int64),
+            vote_end=np.array([v[3] for v in votes_spec], dtype=np.int64),
+        )
+
+    # round 1: key a stabilizes (3 full voters), key b misses one -> tail
+    rows1 = [("a", 1, 1), ("b", 1, 2), ("a", 2, 3)]
+    votes1 = [(i, p, 1, c) for i, (_, c, _) in enumerate(rows1)
+              for p in ((1, 2, 3) if i != 1 else (1,))]
+    # round 2: key b's remaining voters arrive -> buffered tail flushes
+    rows2 = [("b", 2, 4)]
+    votes2 = [(0, p, 1, 2) for p in (1, 2, 3)]
+
+    for arrays in (make(rows1, votes1), make(rows2, votes2)):
+        ex_obj.handle_batch_arrays(arrays, time)
+        ex_ord.handle_batch_arrays(arrays, time)
+    obj_order = []
+    while (r := ex_obj.to_clients()) is not None:
+        obj_order.append(r.rifl.sequence)
+    src, seq = ex_ord.take_order_arrays()
+    assert (src == 1).all()
+    assert seq.tolist() == obj_order
+    assert ex_ord.to_clients() is None  # no object mirror accumulates
+    # a second take returns empty
+    src2, seq2 = ex_ord.take_order_arrays()
+    assert len(src2) == 0 and len(seq2) == 0
+
+
 def test_stable_clocks_kernel_vs_partition():
     """The device stable_clocks kernel and the numpy partition agree over
-    a wide random frontier matrix (both sides of the executor's
-    _KERNEL_THRESHOLD switch)."""
+    a wide random frontier matrix.  force_kernel pins the kernel side (the
+    work-based _KERNEL_THRESHOLD would otherwise route these sizes to the
+    host partition); the 2^40-scale matrix exercises the rebase-overflow
+    fallback inside _stable_clocks."""
     from fantoch_tpu.executor.table import TableExecutor
 
     config = Config(5, 1, newt_detached_send_interval_ms=5,
                     batched_table_executor=True)
     ex = TableExecutor(1, SHARD, config)
     rng = np.random.default_rng(2)
-    frontiers = rng.integers(0, 1 << 40, size=(128, 5))  # > threshold
     col = 5 - ex._stability_threshold
-    expected = np.sort(frontiers, axis=1)[:, col]
-    assert (ex._stable_clocks(frontiers) == expected).all()
-    small = frontiers[:8]
-    assert (ex._stable_clocks(small) == expected[:8]).all()
+    small_vals = rng.integers(0, 1 << 20, size=(128, 5))
+    expected = np.sort(small_vals, axis=1)[:, col]
+    assert (ex._stable_clocks(small_vals, force_kernel=True) == expected).all()
+    assert (ex._stable_clocks(small_vals) == expected).all()
+    wide = rng.integers(0, 1 << 40, size=(128, 5))  # rebase > int32: fallback
+    expected_w = np.sort(wide, axis=1)[:, col]
+    assert (ex._stable_clocks(wide, force_kernel=True) == expected_w).all()
 
 
 @pytest.mark.parametrize("n,f", [(3, 1), (5, 2)])
