@@ -630,6 +630,7 @@ class BatchedDependencyGraph(DependencyGraph):
         src32 = src.astype(np.int32)
         seq32 = (seq - seq.min()).astype(np.int32) if batch else src32
 
+        import jax
         import jax.numpy as jnp
 
         if functional and bool((key >= 0).all()):
@@ -662,14 +663,18 @@ class BatchedDependencyGraph(DependencyGraph):
                 jnp.asarray(pq),
                 return_structure=want_structure,
             )
-            order = np.asarray(res.order)
+            # one blocking transfer for all result fields (async copies
+            # issued per leaf, then one wait) — per-field np.asarray would
+            # pay a device round trip each on a remote-dispatch rig
+            res = jax.device_get(res)
+            order = res.order
             n_res = int(res.n_resolved)
             emitted = order[:n_res]
             emitted = emitted[emitted < batch]  # drop resolved pad rows
             n_res = len(emitted)
             stuck_rows = None
             if want_structure and n_res:
-                leaders = np.asarray(res.leader)[emitted]
+                leaders = res.leader[emitted]
                 sizes = np.diff(
                     np.concatenate(
                         [[0], np.nonzero(np.diff(leaders))[0] + 1, [n_res]]
@@ -683,12 +688,11 @@ class BatchedDependencyGraph(DependencyGraph):
             # (VERDICT r3 weak #3); structure metrics are skipped at this
             # size, matching the keyed path's gating
             res = resolve_general_staged(dep_rows, src32, seq32)
-            order = np.asarray(res.order)
-            resolved = np.asarray(res.resolved)
-            emitted = order[resolved[order]]
+            # staged results are host numpy already (see its return note)
+            order = res.order
+            emitted = order[res.resolved[order]]
             n_res = len(emitted)
-            stuck = np.asarray(res.stuck)
-            stuck_rows = np.nonzero(stuck)[0] if stuck.any() else None
+            stuck_rows = np.nonzero(res.stuck)[0] if res.stuck.any() else None
         else:
             padded_b = _pad_pow2(batch)
             padded_w = _pad_pow2(max(dep_rows.shape[1], 1))
@@ -699,15 +703,15 @@ class BatchedDependencyGraph(DependencyGraph):
             ps[:batch] = src32
             pq[:batch] = seq32
             res = resolve_general(jnp.asarray(mat), jnp.asarray(ps), jnp.asarray(pq))
-            order = np.asarray(res.order)
-            resolved = np.asarray(res.resolved)
+            res = jax.device_get(res)  # all fields in one blocking transfer
+            order = res.order
             order = order[order < batch]
-            emitted = order[resolved[order]]
+            emitted = order[res.resolved[order]]
             n_res = len(emitted)
-            stuck = np.asarray(res.stuck)[:batch]
+            stuck = res.stuck[:batch]
             stuck_rows = np.nonzero(stuck)[0] if stuck.any() else None
             if n_res:
-                leaders = np.asarray(res.leader)[emitted]
+                leaders = res.leader[emitted]
                 sizes = np.diff(
                     np.concatenate(
                         [[0], np.nonzero(np.diff(leaders))[0] + 1, [n_res]]

@@ -450,6 +450,8 @@ def resolve_keyed_auto(
 
 
 class GeneralResolution(NamedTuple):
+    # jax.Array from the jitted resolvers; host np.ndarray from the
+    # host-orchestrated resolve_general_staged (both index identically)
     order: jax.Array  # int32[B]
     resolved: jax.Array  # bool[B]
     rank: jax.Array  # int32[B]
@@ -623,7 +625,11 @@ def resolve_general_staged(
             jnp.asarray(final), jnp.asarray(rank_local),
             run_to_fixpoint=size <= min_size,
         )
-        tgt, floor, miss, final, rank_local = (np.asarray(a) for a in j_out[:5])
+        # one blocking transfer for the stage's whole output (device_get
+        # issues async copies for every leaf before blocking) — per-array
+        # np.asarray would pay one device round trip *each*, which on a
+        # remote-tunnel rig multiplies the stage cost by ~5
+        tgt, floor, miss, final, rank_local = jax.device_get(j_out[:5])
         tgt, floor, miss, final, rank_local = (
             tgt[: len(orig)], floor[: len(orig)], miss[: len(orig)],
             final[: len(orig)], rank_local[: len(orig)],
@@ -679,12 +685,15 @@ def resolve_general_staged(
             np.where(out_final, out_rank, _UNRESOLVED_RANK),
         )
     ).astype(np.int32)
+    # host numpy, deliberately: this variant is host-orchestrated and its
+    # consumers read the results on host — bouncing them through the device
+    # would cost an upload plus a fetch round trip per field
     return GeneralResolution(
-        jnp.asarray(order),
-        jnp.asarray(out_final),
-        jnp.asarray(np.where(out_final, out_rank, _UNRESOLVED_RANK)),
-        jnp.asarray(idx32),
-        jnp.asarray(stuck_np),
+        order,
+        out_final,
+        np.where(out_final, out_rank, _UNRESOLVED_RANK),
+        idx32,
+        stuck_np,
     )
 
 
