@@ -150,6 +150,7 @@ class BatchedKeyClocks:
         Semantics: identical to running ``proposal`` sequentially —
         same-key commands get consecutive clocks in batch order
         (fantoch_ps/src/protocol/common/table/votes.rs:133 ranges)."""
+        import jax
         import jax.numpy as jnp
 
         from fantoch_tpu.ops.table_ops import batched_clock_proposal
@@ -172,12 +173,15 @@ class BatchedKeyClocks:
         pm = np.zeros(bcap, dtype=np.int32)
         pk[:batch] = key_idx
         pm[:batch] = mins.astype(np.int32)
-        clock, vote_start, new_prior = batched_clock_proposal(
+        out = batched_clock_proposal(
             jnp.asarray(prior.astype(np.int32)), jnp.asarray(pk), jnp.asarray(pm)
         )
-        clock = np.asarray(clock)[:batch].astype(np.int64)
-        vote_start = np.asarray(vote_start)[:batch].astype(np.int64)
-        new_prior = np.asarray(new_prior).astype(np.int64)
+        # one blocking transfer for all three outputs (per-array np.asarray
+        # would pay a device round trip each on a remote-dispatch rig)
+        clock, vote_start, new_prior = jax.device_get(out)
+        clock = clock[:batch].astype(np.int64)
+        vote_start = vote_start[:batch].astype(np.int64)
+        new_prior = new_prior.astype(np.int64)
         self._clocks[: self._count] = new_prior[: self._count]
         return clock, vote_start
 

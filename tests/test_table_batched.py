@@ -301,6 +301,84 @@ def test_handle_batch_arrays_order_drain():
     assert len(src2) == 0 and len(seq2) == 0
 
 
+def test_vote_coalescing_differential_fuzz():
+    """The vectorized per-group interval merge in handle_batch_arrays must
+    leave every (key, process) RangeEventSet identical to feeding the same
+    votes through the per-info object path — random overlapping/adjacent/
+    disjoint ranges in random order, plus a 2^61-spread round that trips
+    the overflow guard into the scalar fallback branch."""
+    from fantoch_tpu.core import Dot, RunTime
+    from fantoch_tpu.executor.table import (
+        TableExecutor,
+        TableVotes,
+        TableVotesArrays,
+    )
+    from fantoch_tpu.protocol.common.table_clocks import VoteRange
+
+    n = 3
+    time = RunTime()
+    rng = random.Random(17)
+
+    def round_pair(n_rows, span, base=1):
+        """Same random votes as object infos and as arrays; rows carry
+        huge clocks so nothing stabilizes and only vote state changes."""
+        nonlocal next_seq
+        keys, infos = [], []
+        vr_row, vr_by, vr_s, vr_e = [], [], [], []
+        rows = []
+        for i in range(n_rows):
+            key = f"k{rng.randrange(3)}"
+            keys.append(key)
+            votes = []
+            for _ in range(rng.randrange(1, 5)):
+                by = rng.randrange(1, n + 1)
+                s = base + rng.randrange(span)
+                e = s + rng.randrange(span // 4 + 1)
+                votes.append(VoteRange(by, s, e))
+                vr_row.append(i); vr_by.append(by); vr_s.append(s); vr_e.append(e)
+            clock = 1 << 40  # far above any frontier: never stable
+            seq = next_seq
+            next_seq += 1
+            rows.append((key, clock, seq))
+            infos.append(TableVotes(Dot(1, seq), clock, Rifl(1, seq), key,
+                                    (KVOp.put(""),), votes))
+        B = len(rows)
+        arrays = TableVotesArrays(
+            keys=keys,
+            dot_src=np.full(B, 1, dtype=np.int64),
+            dot_seq=np.array([r[2] for r in rows], dtype=np.int64),
+            clock=np.array([r[1] for r in rows], dtype=np.int64),
+            rifl_src=np.full(B, 1, dtype=np.int64),
+            rifl_seq=np.array([r[2] for r in rows], dtype=np.int64),
+            ops=[(KVOp.put(""),)] * B,
+            vote_row=np.array(vr_row, dtype=np.int64),
+            vote_by=np.array(vr_by, dtype=np.int64),
+            vote_start=np.array(vr_s, dtype=np.int64),
+            vote_end=np.array(vr_e, dtype=np.int64),
+        )
+        return infos, arrays
+
+    for trial in range(20):
+        next_seq = 1
+        cfg = Config(n, 1, batched_table_executor=True)
+        ex_arr = TableExecutor(1, SHARD, cfg)
+        ex_obj = TableExecutor(1, SHARD, cfg)
+        spans = [50, 50, 1 << 61]  # last round forces the fallback branch
+        for span in spans:
+            infos, arrays = round_pair(rng.randrange(2, 25), span)
+            ex_arr.handle_batch_arrays(arrays, time)
+            ex_obj.handle_batch(infos, time)
+            tables_a = ex_arr._table._tables
+            tables_b = ex_obj._table._tables
+            assert set(tables_a) == set(tables_b)
+            for key, ta in tables_a.items():
+                tb = tables_b[key]
+                for pid in ta._votes:
+                    assert ta._votes[pid]._ranges == tb._votes[pid]._ranges, (
+                        f"trial {trial} span {span} key {key} process {pid}"
+                    )
+
+
 def test_stable_clocks_kernel_vs_partition():
     """The device stable_clocks kernel and the numpy partition agree over
     a wide random frontier matrix.  force_kernel pins the kernel side (the
