@@ -792,6 +792,45 @@ def _probe_backend() -> bool:
     return False
 
 
+_TPU_RECORD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LATEST.json"
+)
+
+
+def _save_tpu_record(line: str) -> None:
+    """Persist a successful TPU measurement (committed artifact) so later
+    CPU-fallback records can carry the chip's last verified numbers with
+    provenance — the tunnel to the chip flaps for hours at a time and a
+    fallback-only record would otherwise erase the TPU story."""
+    try:
+        rec = json.loads(line)
+        if rec.get("platform") != "tpu":
+            return
+        rec["recorded_utc"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
+        with open(_TPU_RECORD_PATH, "w") as f:
+            json.dump(rec, f)
+            f.write("\n")
+    except Exception as exc:  # noqa: BLE001 — bookkeeping must not fail the bench
+        print(f"# could not save TPU record: {exc!r}", file=sys.stderr)
+
+
+def _attach_last_tpu(line: str) -> str:
+    """Embed the last verified TPU record (if any) into a CPU-fallback
+    record, clearly labeled: `value` stays the CPU measurement."""
+    try:
+        rec = json.loads(line)
+        if rec.get("platform") == "tpu" or not os.path.exists(_TPU_RECORD_PATH):
+            return line
+        with open(_TPU_RECORD_PATH) as f:
+            rec["last_tpu_record"] = json.load(f)
+        return json.dumps(rec)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# could not attach TPU record: {exc!r}", file=sys.stderr)
+        return line
+
+
 def main() -> None:
     mode = os.environ.get(_CHILD_ENV)
     if mode:
@@ -803,13 +842,14 @@ def main() -> None:
     if not want_cpu and _probe_backend():
         line = _run_child("tpu", CHILD_TIMEOUT_S)
         if line is not None:
+            _save_tpu_record(line)
             print(line)
             return
         print("# tpu measurement failed; falling back to CPU", file=sys.stderr)
 
     line = _run_child("cpu", CHILD_TIMEOUT_S)
     if line is not None:
-        print(line)
+        print(_attach_last_tpu(line))
         return
     print(json.dumps({
         "metric": METRIC,
