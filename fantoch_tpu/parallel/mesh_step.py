@@ -644,6 +644,7 @@ def newt_protocol_step(
     f: int = 1,
     tiny_quorums: bool = False,
     live_replicas: int | None = None,
+    shard_count: int = 1,
 ) -> Tuple[NewtMeshState, NewtStepOutput]:
     """One batched Newt round: timestamp proposal, max aggregation over
     the fast quorum, count-of-max fast path, Synod accept for misses, and
@@ -663,6 +664,19 @@ def newt_protocol_step(
     sequential within-round clocks are a refinement; across rounds the
     committed clock still strictly dominates every key it touched).  A
     command executes when its clock is stable on EVERY key it touches.
+
+    ``shard_count`` (partial replication, mirroring the sharded epaxos
+    round above and the reference's MShardCommit clock aggregation —
+    fantoch_ps/src/protocol/partial.rs + newt.rs mcollect_actions): the
+    replica rows factor into ``shard_count`` shards of
+    ``R / shard_count`` each; key bucket ``b`` belongs to shard
+    ``b % shard_count``; quorums (fast count-of-max, Synod acks) and the
+    stability order statistic are per shard *per key slot*; a
+    multi-shard command's commit clock is the max over its slots'
+    shard-local commit clocks and it executes only when that clock is
+    stable on every key it touches (each key judged by its own shard's
+    frontiers).  A replica's key-clock/frontier learn only its own
+    shard's buckets.
     """
     num_replicas, key_buckets = state.key_clock.shape
     if key.ndim == 1:
@@ -673,8 +687,12 @@ def newt_protocol_step(
     )
     pend_cap = state.pend_key.shape[0]
     work = pend_cap + batch
+    assert num_replicas % shard_count == 0, (
+        "replica rows must factor into shard_count equal shards"
+    )
+    per_shard = num_replicas // shard_count
     fast_quorum, write_quorum, stability_threshold = newt_quorum_sizes(
-        num_replicas, f, tiny_quorums
+        per_shard, f, tiny_quorums
     )
     if live_replicas is None:
         live_replicas = num_replicas
@@ -710,57 +728,97 @@ def newt_protocol_step(
         key_full = jnp.where(propose_slot, key_cat, key_buckets + slot_iota)
         safe_key = jnp.minimum(key_full, key_buckets - 1)  # [W, KW]
 
-        # per-replica-block per-slot proposals over the flattened slots;
-        # the row's proposal is the max over its real slots
+        # shard geometry: bucket b belongs to shard b % shard_count; a
+        # replica row r is member (r % per_shard) of shard (r // per_shard)
+        row = (
+            jax.lax.axis_index(REPLICA_AXIS) * replica_blocks
+            + jnp.arange(replica_blocks, dtype=jnp.int32)
+        )
+        slot_shard = jnp.where(real_slot, key_cat % shard_count, 0)  # [W, KW]
+        row_shard = (row // per_shard)[:, None, None]  # [r_blk, 1, 1]
+        own_slot = row_shard == slot_shard[None]  # [r_blk, W, KW]
+
+        # per-replica-block per-slot proposals over the flattened slots
+        # (only the owning shard's replicas read their key clock; other
+        # replicas' lanes compute masked-out garbage)
         prior_rows = jnp.where(
-            propose_slot[None], key_clock[:, safe_key], 0
+            propose_slot[None] & own_slot, key_clock[:, safe_key], 0
         )  # [r_blk, W, KW]
         slot_prop = _segmented_proposal(
             prior_rows.reshape(replica_blocks, work * key_width),
             key_full.reshape(work * key_width),
             work * key_width,
         ).reshape(replica_blocks, work, key_width)
-        proposal = jnp.where(
-            propose_slot[None], slot_prop, int_min
-        ).max(axis=-1)  # [r_blk, W]
-        proposal = jnp.where(propose[None, :], proposal, 0)
 
-        # MCollectAck max-aggregation over the fast quorum (the first
-        # fast_quorum global replica rows)
-        row = (
-            jax.lax.axis_index(REPLICA_AXIS) * replica_blocks
-            + jnp.arange(replica_blocks, dtype=jnp.int32)
-        )
-        in_fq = (row < fast_quorum)[:, None]
-        fq_max = jax.lax.pmax(
-            jnp.where(in_fq, proposal, int_min).max(axis=0), REPLICA_AXIS
-        )  # [W]
-        # fast path iff the max clock was reported by >= f quorum members
-        # (newt.rs:527-546 via QuorumClocks max_count)
-        reports = jax.lax.psum(
-            (in_fq & (proposal == fq_max[None, :])).astype(jnp.int32).sum(axis=0),
+        # MCollectAck aggregation: a replica's proposal for a row is ONE
+        # clock per shard it owns — the max over the row's slots in that
+        # shard (the reference's proposal is per command, newt.rs:272-338)
+        # — aggregated over that shard's fast quorum (its first
+        # fast_quorum member rows).  Fast path iff EVERY touched shard's
+        # max was reported by >= f of its quorum members (newt.rs:527-546
+        # via QuorumClocks max_count; the multi-shard fast path needs
+        # every touched shard fast).  For shard_count == 1 this is
+        # exactly the row-level aggregation of the unsharded round, for
+        # every key width.
+        shard_ids = jnp.arange(shard_count, dtype=jnp.int32)
+        slot_onehot = (
+            propose_slot[:, :, None] & (slot_shard[:, :, None] == shard_ids)
+        )  # [W, KW, S]
+        touched = slot_onehot.any(axis=1)  # [W, S]
+        shard_prop = jnp.where(
+            slot_onehot[None], slot_prop[..., None], int_min
+        ).max(axis=2)  # [r_blk, W, S] — this replica's per-shard row clock
+        rep_shard = (row // per_shard)[:, None] == shard_ids[None]  # [r_blk, S]
+        in_fq_rs = (
+            ((row % per_shard) < fast_quorum)[:, None] & rep_shard
+        )[:, None, :]  # [r_blk, 1, S]
+        shard_fq_max = jax.lax.pmax(
+            jnp.where(in_fq_rs, shard_prop, int_min).max(axis=0), REPLICA_AXIS
+        )  # [W, S]
+        shard_reports = jax.lax.psum(
+            (in_fq_rs & (shard_prop == shard_fq_max[None]))
+            .astype(jnp.int32)
+            .sum(axis=0),
             REPLICA_AXIS,
+        )  # [W, S]
+        fast = (
+            jnp.where(touched, shard_reports >= f, True).all(axis=-1)
+            & propose
         )
-        fast = (reports >= f) & propose
+        # the commit clock: max over the touched shards' commit clocks
+        # (the MShardCommit max aggregation, partial.rs:37-142);
+        # propose rows always have >= 1 real slot, others read 0
+        fq_max = jnp.where(
+            propose,
+            jnp.where(touched, shard_fq_max, int_min).max(axis=-1),
+            0,
+        )  # [W]
 
-        # Synod ballot-0 accept round for fast-path misses (live replicas
-        # ack; commit at write_quorum = f + 1)
+        # Synod ballot-0 accept round for fast-path misses: every touched
+        # shard must reach write_quorum (f + 1) live acks
         live = (row < live_replicas)[:, None]
-        acks = jax.lax.psum(
-            (live & ~fast[None, :]).astype(jnp.int32).sum(axis=0), REPLICA_AXIS
-        )
-        newly_committed = (fast | (acks >= write_quorum)) & propose
+        shard_live_local = jnp.zeros((shard_count,), jnp.int32).at[
+            row // per_shard
+        ].add(live[:, 0].astype(jnp.int32))
+        shard_live = jax.lax.psum(shard_live_local, REPLICA_AXIS)  # [S]
+        slow_ok = jnp.where(
+            propose_slot, shard_live[slot_shard] >= write_quorum, True
+        ).all(axis=-1)
+        newly_committed = (fast | slow_ok) & propose
         committed = already_committed | newly_committed
         clock = jnp.where(
             newly_committed, fq_max, jnp.where(already_committed, prior_clock, -1)
         )
         slow_paths = (propose & ~fast).sum().astype(jnp.int32)
 
-        # vote/frontier update: live replicas chase every committed clock
-        # with (detached) votes on EVERY key the command touches —
-        # scatter-max into both tables over the key slots
+        # vote/frontier update: each slot's OWNING shard's live replicas
+        # chase every committed clock with (detached) votes — scatter-max
+        # into both tables over the key slots; other shards' replicas
+        # never learn foreign buckets
         upd = jnp.where(
-            live[..., None] & (committed[None, :, None] & real_slot[None]),
+            live[..., None]
+            & own_slot
+            & (committed[None, :, None] & real_slot[None]),
             clock[None, :, None],
             0,
         )  # [r_blk, W, KW]
@@ -780,14 +838,18 @@ def newt_protocol_step(
         )
 
         # stability: per-key (n - threshold)-th smallest frontier across
-        # ALL replicas (mod.rs:247-270) — gather the replica axis; a
-        # command executes once its clock is stable on ALL its keys
+        # the key's OWNING shard's replicas (mod.rs:247-270; n is the
+        # shard size under partial replication) — gather the replica
+        # axis, sort within each shard's contiguous row block, then each
+        # bucket reads its owner shard's order statistic
         full_frontier = jax.lax.all_gather(
             new_frontier, REPLICA_AXIS, tiled=True
         )  # [R, K]
-        stable_clock = jnp.sort(full_frontier, axis=0)[
-            num_replicas - stability_threshold
-        ]  # [K]
+        shard_stable = jnp.sort(
+            full_frontier.reshape(shard_count, per_shard, key_buckets), axis=1
+        )[:, per_shard - stability_threshold]  # [S, K]
+        bucket_ids = jnp.arange(key_buckets, dtype=jnp.int32)
+        stable_clock = shard_stable[bucket_ids % shard_count, bucket_ids]  # [K]
         slot_stable = jnp.where(
             real_slot, clock[:, None] <= stable_clock[real_key], True
         )
@@ -893,6 +955,7 @@ def jit_newt_step(
     f: int = 1,
     tiny_quorums: bool = False,
     live_replicas: int | None = None,
+    shard_count: int = 1,
 ):
     """jit-compiled Newt round with donated device-resident state."""
     import functools
@@ -904,6 +967,7 @@ def jit_newt_step(
             f=f,
             tiny_quorums=tiny_quorums,
             live_replicas=live_replicas,
+            shard_count=shard_count,
         ),
         donate_argnums=(0,),
     )

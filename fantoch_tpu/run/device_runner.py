@@ -184,6 +184,45 @@ class _DriverCore:
         """Commands registered but not yet executed (device pending)."""
         return len(self._cmds)
 
+    def _init_sharded_mesh(
+        self, mesh_step, num_replicas: int, shard_count: int,
+        key_buckets: int, pending_capacity: int, key_width: int, mesh,
+        init_state_fn,
+    ):
+        """Shared sharded-mesh setup (DeviceDriver + NewtDeviceDriver):
+        num_replicas is PER SHARD, the state holds shard_count *
+        num_replicas replica rows, bucket b % shard_count encodes the
+        owning shard."""
+        self.shard_count = shard_count
+        assert key_buckets % shard_count == 0, (
+            "key_buckets must split evenly across shards"
+        )
+        total_rows = shard_count * num_replicas
+        self._mesh = (
+            mesh
+            if mesh is not None
+            else mesh_step.make_mesh(num_replicas=total_rows)
+        )
+        self._state = init_state_fn(
+            self._mesh,
+            total_rows,
+            key_buckets=key_buckets,
+            pending_capacity=pending_capacity,
+            key_width=key_width,
+        )
+
+    def _execute_entry(self, cmd: Command) -> List[ExecutorResult]:
+        """Execute one ordered command against the KVStore.  Sharded mode:
+        the unified mesh owns every shard's keyspace, so each touched
+        shard's portion executes at the command's single execution point
+        (the partials the per-shard executors would emit)."""
+        if self.shard_count == 1:
+            return cmd.execute(self.shard_id, self.store)
+        results: List[ExecutorResult] = []
+        for sid in cmd.shards():
+            results.extend(cmd.execute(sid, self.store))
+        return results
+
     def take_requeue(self) -> List[Tuple[Dot, Command]]:
         """Commands dropped by a device pending-buffer overflow, to be fed
         into the next batch by the caller."""
@@ -284,7 +323,7 @@ class _DriverCore:
             if entry is None:
                 continue  # pad row
             _dot, cmd = entry
-            results.extend(cmd.execute(self.shard_id, self.store))
+            results.extend(self._execute_entry(cmd))
             self.executed += 1
 
         # after the pops, registry keys == this round's carried rows;
@@ -374,26 +413,9 @@ class DeviceDriver(_DriverCore):
 
         self._init_core(shard_id, batch_size, key_buckets, monitor_execution_order)
         self.key_width = key_width
-        # partial replication on one mesh: num_replicas is PER SHARD; the
-        # state holds shard_count * num_replicas replica rows and bucket
-        # b % shard_count encodes the owning shard (protocol_step's
-        # sharded-key-axis mode)
-        self.shard_count = shard_count
-        assert key_buckets % shard_count == 0, (
-            "key_buckets must split evenly across shards"
-        )
-        total_rows = shard_count * num_replicas
-        self._mesh = (
-            mesh
-            if mesh is not None
-            else mesh_step.make_mesh(num_replicas=total_rows)
-        )
-        self._state = mesh_step.init_state(
-            self._mesh,
-            total_rows,
-            key_buckets=key_buckets,
-            pending_capacity=pending_capacity,
-            key_width=key_width,
+        self._init_sharded_mesh(
+            mesh_step, num_replicas, shard_count, key_buckets,
+            pending_capacity, key_width, mesh, mesh_step.init_state,
         )
         self._step = mesh_step.jit_protocol_step(
             self._mesh, live_replicas=live_replicas, shard_count=shard_count
@@ -603,15 +625,7 @@ class DeviceDriver(_DriverCore):
             if entry is None:
                 continue  # padding row (registered by no one)
             _dot, cmd = entry
-            if self.shard_count == 1:
-                results.extend(cmd.execute(self.shard_id, self.store))
-            else:
-                # the unified mesh owns every shard's keyspace: execute
-                # each touched shard's portion at the command's single
-                # execution point (partials per key, as the per-shard
-                # executors would emit them)
-                for sid in cmd.shards():
-                    results.extend(cmd.execute(sid, self.store))
+            results.extend(self._execute_entry(cmd))
             self.executed += 1
             if fast[w]:
                 self.fast_paths += 1
@@ -666,6 +680,7 @@ class NewtDeviceDriver(_DriverCore):
         pending_capacity: int = 256,
         live_replicas: Optional[int] = None,
         shard_id: ShardId = 0,
+        shard_count: int = 1,
         monitor_execution_order: bool = False,
         mesh=None,
     ):
@@ -673,20 +688,13 @@ class NewtDeviceDriver(_DriverCore):
 
         self._init_core(shard_id, batch_size, key_buckets, monitor_execution_order)
         self.key_width = key_width
-        self._mesh = (
-            mesh
-            if mesh is not None
-            else mesh_step.make_mesh(num_replicas=num_replicas)
-        )
-        self._state = mesh_step.init_newt_state(
-            self._mesh,
-            num_replicas,
-            key_buckets=key_buckets,
-            pending_capacity=pending_capacity,
-            key_width=key_width,
+        self._init_sharded_mesh(
+            mesh_step, num_replicas, shard_count, key_buckets,
+            pending_capacity, key_width, mesh, mesh_step.init_newt_state,
         )
         self._step = mesh_step.jit_newt_step(
-            self._mesh, f=f, tiny_quorums=tiny_quorums, live_replicas=live_replicas
+            self._mesh, f=f, tiny_quorums=tiny_quorums,
+            live_replicas=live_replicas, shard_count=shard_count,
         )
         # host mirror of the device pending buffer's (src, seq) identity
         # columns (the step outputs index working rows = pending + batch;
@@ -750,7 +758,7 @@ class NewtDeviceDriver(_DriverCore):
         for i, (dot, cmd) in enumerate(batch):
             buckets = _bucket_row(
                 cmd, self.shard_id, self.key_buckets, self.key_width,
-                cache=self._bucket_cache,
+                self.shard_count, cache=self._bucket_cache,
             )
             key[i, : len(buckets)] = buckets
             src[i] = dot.source
@@ -880,7 +888,7 @@ class CaesarDeviceDriver(_DriverCore):
         for i, (dot, cmd) in enumerate(batch):
             buckets = _bucket_row(
                 cmd, self.shard_id, self.key_buckets, self.key_width,
-                cache=self._bucket_cache,
+                self.shard_count, cache=self._bucket_cache,
             )
             key[i, : len(buckets)] = buckets
             src[i] = dot.source
@@ -1301,14 +1309,14 @@ class DeviceRuntime:
         self.config = config
         self.process_id = process_id
         self.client_addr = client_addr
-        if protocol in ("newt", "fpaxos", "caesar") and config.shard_count != 1:
-            # the sharded key axis is built on the dep-commit round
-            # (epaxos/atlas/basic all serve through it); the
-            # timestamp/leader classes serve full replication only (their
-            # host/object runners cover partial replication)
+        if protocol in ("fpaxos", "caesar") and config.shard_count != 1:
+            # the leader-based slot round and the Caesar round serve full
+            # replication only (their host/object runners cover partial
+            # replication); the dep-commit and Newt timestamp rounds both
+            # serve a sharded key axis
             raise ValueError(
-                f"device-step sharding serves the dep-commit round; "
-                f"{protocol} serving is single-shard"
+                f"device-step sharding serves the dep-commit and newt "
+                f"rounds; {protocol} serving is single-shard"
             )
         if protocol == "newt":
             self.driver = NewtDeviceDriver(
@@ -1320,6 +1328,7 @@ class DeviceRuntime:
                 key_width=key_width,
                 pending_capacity=pending_capacity,
                 live_replicas=live_replicas,
+                shard_count=config.shard_count,
                 monitor_execution_order=monitor_execution_order,
                 mesh=mesh,
             )

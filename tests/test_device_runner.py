@@ -366,6 +366,86 @@ def test_device_runtime_sharded_tcp_cluster():
     assert runtime.failure is None
 
 
+def test_sharded_newt_driver_cross_shard_chain():
+    """shard_count=2 on the Newt device driver: a multi-shard command's
+    timestamp orders it after its per-shard predecessors and before later
+    commands on BOTH shards (the MShardCommit max-clock aggregation on
+    one mesh)."""
+    from fantoch_tpu.run.device_runner import NewtDeviceDriver
+    from fantoch_tpu.utils import key_hash
+
+    d = NewtDeviceDriver(
+        3, shard_count=2, batch_size=16, key_buckets=64, key_width=2,
+        monitor_execution_order=True,
+    )
+    key0 = next(f"a{i}" for i in range(100) if key_hash(f"a{i}") % 2 == 0)
+    key1 = next(f"b{i}" for i in range(100) if key_hash(f"b{i}") % 2 == 1)
+
+    def single(seq, key, value, shard):
+        return (
+            Dot(1, seq),
+            Command.from_single(Rifl(1, seq), shard, key, KVOp.put(value)),
+        )
+
+    def multi(seq, v0, v1):
+        return (
+            Dot(1, seq),
+            Command(Rifl(1, seq), {
+                0: {key0: (KVOp.put(v0),)},
+                1: {key1: (KVOp.put(v1),)},
+            }),
+        )
+
+    batch = [
+        single(1, key0, "s0a", 0),
+        single(2, key1, "s1a", 1),
+        multi(3, "m0", "m1"),
+        single(4, key0, "s0b", 0),
+        single(5, key1, "s1b", 1),
+    ]
+    results = d.step(batch)
+    assert d.executed == 5 and d.in_flight == 0
+    by_key = {}
+    for r in results:
+        by_key.setdefault(r.key, []).append(r.op_results[0])
+    assert by_key[key0] == [None, "s0a", "m0"]
+    assert by_key[key1] == [None, "s1a", "m1"]
+    mon = d.store.monitor
+    assert mon.get_order(key0)[1] == Rifl(1, 3) == mon.get_order(key1)[1]
+
+
+def test_device_runtime_sharded_newt_tcp_cluster():
+    """A 2-shard Newt device-step server behind real TCP clients:
+    multi-shard commands commit at the max of their shards' clocks,
+    every client completes, and per-key execution order is
+    duplicate-free."""
+    config = Config(3, 1, shard_count=2)
+    workload = Workload(
+        shard_count=2,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=2,
+        commands_per_client=COMMANDS_PER_CLIENT,
+        payload_size=1,
+    )
+    runtime, clients = asyncio.run(
+        run_device_server(
+            config, workload, client_count=4, batch_size=32,
+            key_width=2, key_buckets=64, protocol="newt",
+        )
+    )
+    assert len(clients) == 4
+    for client in clients.values():
+        assert client.issued_commands == COMMANDS_PER_CLIENT
+    driver = runtime.driver
+    assert driver.executed == 4 * COMMANDS_PER_CLIENT
+    assert driver.in_flight == 0
+    monitor = driver.store.monitor
+    for key in monitor.keys():
+        order = monitor.get_order(key)
+        assert len(order) == len(set(order))
+    assert runtime.failure is None
+
+
 def _put(src, seq, key, value):
     return (Dot(src, seq), Command.from_single(Rifl(src, seq), 0, key, KVOp.put(value)))
 

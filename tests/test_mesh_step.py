@@ -696,6 +696,132 @@ def step_pad(step, state, key, seq0=0, batch=None):
     return step(state, full, src, seq)
 
 
+def test_sharded_newt_cross_shard_clocks(mesh):
+    """shard_count=2 on the Newt round (6 replica rows = 2 shards x 3):
+    per-key clocks advance per shard, a multi-shard command's commit
+    clock is the max over its shards' clocks (the MShardCommit
+    aggregation), per-key execution order is (clock, dot) on each
+    shard's bucket, and replicas never learn foreign buckets."""
+    m = mesh_step.make_mesh(num_replicas=6)
+    state = mesh_step.init_newt_state(
+        m, 6, key_buckets=64, pending_capacity=16, key_width=2
+    )
+    step = mesh_step.jit_newt_step(m, f=1, shard_count=2)
+    KP = mesh_step.KEY_PAD
+
+    # bucket 4 -> shard 0 (rows 0..2), bucket 5 -> shard 1 (rows 3..5)
+    key = jnp.asarray(
+        [[4, KP], [5, KP], [4, KP], [5, KP], [4, 5], [4, KP], [5, KP]]
+        + [[KP, KP]],
+        dtype=jnp.int32,
+    )
+    batch = key.shape[0]
+    src = jnp.ones((batch,), jnp.int32)
+    seq = jnp.arange(batch, dtype=jnp.int32)
+    state, out = step(state, key, src, seq)
+    executed = np.asarray(out.executed)
+    clock = np.asarray(out.clock)
+    pend_cap = state.pend_key.shape[0]
+    w = lambda i: pend_cap + i  # fresh state: working row of batch row i
+    real = [w(i) for i in range(7)]
+    assert executed[real].all(), "healthy sharded Newt round executes all"
+    assert np.asarray(out.fast_path)[real].all()
+    assert int(out.slow_paths) == 0
+
+    # per-key consecutive clocks in batch order; the multi-shard row's
+    # clock is the max of its two shard-local assignments
+    assert clock[w(0)] < clock[w(2)] < clock[w(4)] < clock[w(5)]  # bucket 4
+    assert clock[w(1)] < clock[w(3)] < clock[w(4)] < clock[w(6)]  # bucket 5
+    assert clock[w(4)] == max(clock[w(2)], clock[w(3)]) + 1
+
+    # ownership: shard-0 rows never learned bucket 5 and vice versa
+    kc = np.asarray(state.key_clock)
+    vf = np.asarray(state.vote_frontier)
+    assert (kc[0:3, 5] == 0).all() and (kc[3:6, 4] == 0).all()
+    assert (vf[0:3, 5] == 0).all() and (vf[3:6, 4] == 0).all()
+    assert (kc[0:3, 4] > 0).all() and (kc[3:6, 5] > 0).all()
+
+
+@pytest.mark.slow
+def test_sharded_newt_degraded_shard_blocks_stability(mesh):
+    """A dead majority in shard 1 leaves its commits unstable (the
+    per-shard frontier order statistic cannot advance), blocking its
+    rows AND the multi-shard row, while shard 0 executes; recovery
+    drains the carried rows in per-key clock order."""
+    m = mesh_step.make_mesh(num_replicas=6)
+    state = mesh_step.init_newt_state(
+        m, 6, key_buckets=64, pending_capacity=16, key_width=2
+    )
+    KP = mesh_step.KEY_PAD
+    # rows 0..3 live = all of shard 0 + shard 1 member 0 only: shard 1's
+    # stability threshold (n - f = 2) cannot be met
+    degraded = mesh_step.jit_newt_step(m, f=1, shard_count=2, live_replicas=4)
+    key = jnp.asarray(
+        [[4, KP], [5, KP], [4, KP], [5, KP], [4, 5], [KP, KP], [KP, KP],
+         [KP, KP]],
+        dtype=jnp.int32,
+    )
+    batch = key.shape[0]
+    src = jnp.ones((batch,), jnp.int32)
+    state, out = degraded(state, key, src, jnp.arange(batch, dtype=jnp.int32))
+    executed = np.asarray(out.executed)
+    pend_cap = state.pend_key.shape[0]
+    w = lambda i: pend_cap + i
+    assert executed[[w(0), w(2)]].all(), "shard-0 rows execute"
+    assert not executed[[w(1), w(3), w(4)]].any(), (
+        "shard-1 and multi-shard rows must wait for shard-1 stability"
+    )
+    assert int(out.pending) == 3
+
+    # recovery: carried rows stabilize and drain
+    healthy = mesh_step.jit_newt_step(m, f=1, shard_count=2)
+    empty = jnp.full((batch, 2), KP, jnp.int32)
+    zeros = jnp.zeros((batch,), jnp.int32)
+    state, out2 = healthy(state, empty, zeros, zeros)
+    assert int(out2.pending) == 0
+    assert np.asarray(out2.executed).sum() == 3
+    # carried per-key order: bucket-5 rows drain in their committed
+    # (clock, dot) order
+    order2 = np.asarray(out2.order)
+    ex2 = np.asarray(out2.executed)
+    clocks2 = np.asarray(out2.clock)
+    drained = [int(clocks2[i]) for i in order2 if ex2[i]]
+    assert drained == sorted(drained)
+
+
+def test_newt_multikey_fast_path_is_row_level(mesh):
+    """Unsharded multi-key fast-path regression (review finding): the
+    count-of-max must aggregate at ROW level per shard, not per key slot.
+    n=5, f=2, KW=2: quorum members propose per-slot clocks (3,5), (5,3),
+    (1,1), (1,1) — each slot's max 5 is reported once, but the ROW max 5
+    is reported twice >= f, so the command must take the fast path at
+    clock 5 (newt.rs:527-546 counts reports of the single aggregated
+    commit clock)."""
+    m = mesh_step.make_mesh(num_replicas=5)
+    state = mesh_step.init_newt_state(
+        m, 5, key_buckets=8, pending_capacity=8, key_width=2
+    )
+    kc = np.array(state.key_clock)
+    kc[0, 0], kc[0, 1] = 2, 4  # replica 0: a=2, b=4 -> proposes (3, 5)
+    kc[1, 0], kc[1, 1] = 4, 2  # replica 1: a=4, b=2 -> proposes (5, 3)
+    state = state._replace(
+        key_clock=jax.device_put(jnp.asarray(kc), state.key_clock.sharding)
+    )
+    step = mesh_step.jit_newt_step(m, f=2)
+    KP = mesh_step.KEY_PAD
+    key = jnp.asarray([[0, 1]] + [[KP, KP]] * 7, dtype=jnp.int32)
+    src = jnp.ones((8,), jnp.int32)
+    seq = jnp.arange(8, dtype=jnp.int32)
+    state, out = step(state, key, src, seq)
+    w = state.pend_key.shape[0]  # working row of batch row 0
+    assert bool(np.asarray(out.fast_path)[w]), (
+        "row-level max reported >= f times must take the fast path"
+    )
+    assert int(np.asarray(out.clock)[w]) == 5
+    assert int(out.slow_paths) == 0
+    assert bool(np.asarray(out.executed)[w])
+
+
 # ---------------------------------------------------------------------------
 # Caesar on the mesh: the fourth consensus shape
 # ---------------------------------------------------------------------------

@@ -373,6 +373,9 @@ def test_vote_coalescing_differential_fuzz():
             assert set(tables_a) == set(tables_b)
             for key, ta in tables_a.items():
                 tb = tables_b[key]
+                assert set(ta._votes) == set(tb._votes), (
+                    f"trial {trial} span {span} key {key}: process sets differ"
+                )
                 for pid in ta._votes:
                     assert ta._votes[pid]._ranges == tb._votes[pid]._ranges, (
                         f"trial {trial} span {span} key {key} process {pid}"
