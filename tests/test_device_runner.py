@@ -414,6 +414,7 @@ def test_sharded_newt_driver_cross_shard_chain():
     assert mon.get_order(key0)[1] == Rifl(1, 3) == mon.get_order(key1)[1]
 
 
+@pytest.mark.slow
 def test_device_runtime_sharded_newt_tcp_cluster():
     """A 2-shard Newt device-step server behind real TCP clients:
     multi-shard commands commit at the max of their shards' clocks,

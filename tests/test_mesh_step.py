@@ -789,6 +789,7 @@ def test_sharded_newt_degraded_shard_blocks_stability(mesh):
     assert drained == sorted(drained)
 
 
+@pytest.mark.slow
 def test_newt_multikey_fast_path_is_row_level(mesh):
     """Unsharded multi-key fast-path regression (review finding): the
     count-of-max must aggregate at ROW level per shard, not per key slot.
