@@ -100,17 +100,16 @@ def build_workload(batch: int, conflict: float, clients: int = 4096, seed: int =
     return key, dep, dot_src, dot_seq
 
 
-def enable_compile_cache(jax_mod) -> None:
+def enable_compile_cache(jax_mod=None) -> None:
     """Persistent XLA compilation cache in-repo: first-ever compiles through
     the remote-compile tunnel run minutes; cached reloads run sub-second, so
-    the driver's end-of-round bench rides the cache warmed by dev runs."""
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-    try:
-        jax_mod.config.update("jax_compilation_cache_dir", cache_dir)
-        jax_mod.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax_mod.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception as exc:  # noqa: BLE001 — cache is an optimization only
-        print(f"# compile cache unavailable: {exc!r}", file=sys.stderr)
+    the driver's end-of-round bench rides the cache warmed by dev runs.
+    Delegates to the shared fantoch_tpu.hostenv helper (also used by
+    tests/conftest.py and the multichip dryrun); ``jax_mod`` is accepted
+    for caller compatibility and ignored."""
+    from fantoch_tpu.hostenv import enable_compile_cache as _enable
+
+    _enable()
 
 
 def child_main(mode: str) -> None:

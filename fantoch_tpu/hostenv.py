@@ -43,3 +43,27 @@ def force_cpu_platform(n_devices: int | None = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    """Persistent XLA compile cache — an optimization only; failures are
+    swallowed (the experimental jax.config flag names may change).
+
+    One shared helper for bench.py, tests/conftest.py and the dryrun:
+    first-ever compiles (remote-compile tunnel: minutes; the 8-device
+    virtual mesh: ~1 min/test-module) are cached in-repo and reload
+    sub-second.  Entries are keyed by program+topology+compiler version,
+    so a stale cache can only miss, never corrupt."""
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
