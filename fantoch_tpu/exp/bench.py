@@ -61,15 +61,16 @@ def run_experiment(
     sweep); its locally staged copies are removed in a ``finally`` here
     since stage() re-creates them on demand.
 
-    ``run_mode``: "release" (plain servers) or "cprofile" — the
-    RunMode::Flamegraph/Heaptrack analog (fantoch_exp/src/lib.rs:26-67):
-    every server runs under cProfile, its .prof artifact is pulled with
-    the results, and a cumulative-time top-30 text rendering lands next
-    to it (cProfile dumps in a ``finally``, so the SIGINT teardown still
-    produces the artifact)."""
+    ``run_mode``: "release" (plain servers), "cprofile" (CPU — the
+    RunMode::Flamegraph analog) or "memory" (tracemalloc — the
+    RunMode::Heaptrack analog); fantoch_exp/src/lib.rs:26-67.  Under a
+    profiling mode every server runs wrapped, its artifact is pulled with
+    the results (cProfile additionally gets a cumulative-time top-30 text
+    rendering); both profilers dump in a ``finally``, so the SIGINT
+    teardown still produces the artifact."""
     from fantoch_tpu.exp.testbed import HostsTestbed, LocalTestbed
 
-    assert run_mode in ("release", "cprofile"), run_mode
+    assert run_mode in ("release", "cprofile", "memory"), run_mode
     if testbed == "localhost":
         testbed = LocalTestbed()
     elif not isinstance(testbed, HostsTestbed):
@@ -151,7 +152,12 @@ def _run_experiment_testbed(
                         profile_artifact=(
                             f"{_RESULTS_REL}/profile_p{pid}.prof"
                             if run_mode == "cprofile"
+                            else f"{_RESULTS_REL}/memory_p{pid}.txt"
+                            if run_mode == "memory"
                             else None
+                        ),
+                        profile_kind=(
+                            "memory" if run_mode == "memory" else "cprofile"
                         ),
                         pidfile=f"{_RESULTS_REL}/server_p{pid}.pid",
                     ),
@@ -212,6 +218,8 @@ def _run_experiment_testbed(
     suffixes = ["metrics_p{pid}.gz", "execution_p{pid}.log"]
     if run_mode == "cprofile":
         suffixes.append("profile_p{pid}.prof")
+    elif run_mode == "memory":
+        suffixes.append("memory_p{pid}.txt")  # already-text heap report
     for pid, _shard in all_pids:
         for pattern in suffixes:
             rel = pattern.format(pid=pid)

@@ -1,0 +1,61 @@
+"""tracemalloc wrapper for experiment servers — the RunMode::Heaptrack
+analog (fantoch_exp/src/lib.rs:26-67: a memory profiler wraps the server
+binary and its artifact is pulled with the results).
+
+Usage (what the testbeds exec):
+
+    python -m fantoch_tpu.exp.memprof -o ARTIFACT -m MODULE [args...]
+
+Starts tracemalloc, runs MODULE as ``__main__`` and writes a text report
+(total current/peak traced bytes, top allocation sites by line, top
+tracebacks) to ARTIFACT in a ``finally`` — the SIGINT teardown the
+testbeds use to stop servers still produces the artifact, mirroring the
+cProfile mode's finally-dump behavior.
+"""
+
+from __future__ import annotations
+
+import runpy
+import sys
+import tracemalloc
+
+_FRAMES = 12  # traceback depth kept per allocation
+_TOP_LINES = 40
+_TOP_TRACES = 10
+
+
+def _write_report(artifact: str) -> None:
+    snapshot = tracemalloc.take_snapshot()
+    current, peak = tracemalloc.get_traced_memory()
+    with open(artifact, "w") as f:
+        f.write(
+            f"# tracemalloc: current={current} bytes, peak={peak} bytes "
+            f"({_FRAMES} frames/alloc)\n\n# top {_TOP_LINES} by line\n"
+        )
+        for stat in snapshot.statistics("lineno")[:_TOP_LINES]:
+            f.write(f"{stat}\n")
+        f.write(f"\n# top {_TOP_TRACES} by traceback\n")
+        for stat in snapshot.statistics("traceback")[:_TOP_TRACES]:
+            f.write(f"{stat.size / 1024:.1f} KiB in {stat.count} blocks\n")
+            for line in stat.traceback.format():
+                f.write(line + "\n")
+            f.write("\n")
+
+
+def main() -> None:
+    argv = sys.argv
+    if len(argv) < 5 or argv[1] != "-o" or argv[3] != "-m":
+        raise SystemExit(
+            "usage: python -m fantoch_tpu.exp.memprof -o ARTIFACT -m MODULE [args...]"
+        )
+    artifact, module = argv[2], argv[4]
+    sys.argv = [module, *argv[5:]]
+    tracemalloc.start(_FRAMES)
+    try:
+        runpy.run_module(module, run_name="__main__", alter_sys=True)
+    finally:
+        _write_report(artifact)
+
+
+if __name__ == "__main__":
+    main()

@@ -93,21 +93,28 @@ class LocalTestbed:
         pre_dirs: Optional[List[str]] = None,
         profile_artifact: Optional[str] = None,
         pidfile: Optional[str] = None,
+        profile_kind: str = "cprofile",
     ) -> subprocess.Popen:
-        """``profile_artifact``: workdir-relative .prof path — the server
-        runs under cProfile and writes its stats there on exit (the
-        RunMode::Flamegraph analog, fantoch_exp/src/lib.rs:26-67: a
-        profiler wraps the server binary and its artifact is pulled with
-        the results).  ``pidfile`` is unused locally (interrupt() signals
-        the child directly)."""
+        """``profile_artifact``: workdir-relative artifact path — the
+        server runs under a profiler that writes there on exit (the
+        RunMode::Flamegraph/Heaptrack analogs,
+        fantoch_exp/src/lib.rs:26-67: a profiler wraps the server binary
+        and its artifact is pulled with the results).  ``profile_kind``:
+        "cprofile" (CPU, .prof) or "memory" (tracemalloc text report via
+        fantoch_tpu.exp.memprof).  ``pidfile`` is unused locally
+        (interrupt() signals the child directly)."""
         assert self._workdir is not None, "prepare(exp_dir) first"
         env = cli_env()
         for d in pre_dirs or []:
             os.makedirs(os.path.join(self._workdir, d), exist_ok=True)
         cmd = [sys.executable, "-m", module, *args]
         if profile_artifact is not None:
+            wrapper = (
+                ["cProfile", "-o"] if profile_kind == "cprofile"
+                else ["fantoch_tpu.exp.memprof", "-o"]
+            )
             cmd = [
-                sys.executable, "-m", "cProfile", "-o", profile_artifact,
+                sys.executable, "-m", *wrapper, profile_artifact,
                 "-m", module, *args,
             ]
         return subprocess.Popen(
@@ -247,6 +254,7 @@ class HostsTestbed:
         pre_dirs: Optional[List[str]] = None,
         profile_artifact: Optional[str] = None,
         pidfile: Optional[str] = None,
+        profile_kind: str = "cprofile",
     ) -> str:
         """The command string a remote shell runs (identical in both
         transports — that's the point of the local mode)."""
@@ -254,8 +262,11 @@ class HostsTestbed:
         mkdirs = "".join(
             f"mkdir -p {shlex.quote(d)} && " for d in (pre_dirs or [])
         )
+        profile_mod = (
+            "cProfile" if profile_kind == "cprofile" else "fantoch_tpu.exp.memprof"
+        )
         profile = (
-            f"-m cProfile -o {shlex.quote(profile_artifact)} "
+            f"-m {profile_mod} -o {shlex.quote(profile_artifact)} "
             if profile_artifact is not None
             else ""
         )
@@ -291,9 +302,11 @@ class HostsTestbed:
         pre_dirs: Optional[List[str]] = None,
         profile_artifact: Optional[str] = None,
         pidfile: Optional[str] = None,
+        profile_kind: str = "cprofile",
     ) -> subprocess.Popen:
         command = self._remote_command(
-            index, module, args, pre_dirs, profile_artifact, pidfile
+            index, module, args, pre_dirs, profile_artifact, pidfile,
+            profile_kind,
         )
         if self.use_ssh:
             host = self.hosts[index % len(self.hosts)]

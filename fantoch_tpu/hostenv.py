@@ -65,5 +65,9 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:  # noqa: BLE001 — cache is an optimization only
-        pass
+    except Exception as exc:  # noqa: BLE001 — cache is an optimization only
+        # loud enough to diagnose "why did CI get slow" if a jax upgrade
+        # renames the flags; harmless otherwise
+        import sys
+
+        print(f"# compile cache unavailable: {exc!r}", file=sys.stderr)

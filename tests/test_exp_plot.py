@@ -49,6 +49,28 @@ def test_run_experiment_cprofile_mode(tmp_path):
 
 
 @pytest.mark.slow
+def test_run_experiment_memory_mode(tmp_path):
+    """run_mode='memory' (the RunMode::Heaptrack analog): every server
+    runs under the tracemalloc wrapper (fantoch_tpu.exp.memprof) and the
+    experiment pulls one heap-report text per process, written through
+    the SIGINT teardown path."""
+    cfg = ExperimentConfig(
+        "epaxos", 3, 1, commands_per_client=4, conflict_rate=50
+    )
+    out = str(tmp_path / "mem")
+    manifest = run_experiment(cfg, out, run_mode="memory")
+    assert manifest["run_mode"] == "memory"
+    exp_dir = os.path.join(out, cfg.name())
+    for pid in (1, 2, 3):
+        txt = os.path.join(exp_dir, f"memory_p{pid}.txt")
+        assert os.path.exists(txt), f"missing {txt}"
+        body = open(txt).read()
+        assert "tracemalloc" in body and "peak=" in body
+        assert "top" in body and "KiB" in body
+    assert manifest["outcome"]["commands"] == 4 * 3
+
+
+@pytest.mark.slow
 def test_run_sweep_throughput_latency_curve(tmp_path):
     # the reference's main experiment shape: one protocol at increasing
     # client counts -> a multi-point throughput-latency curve
