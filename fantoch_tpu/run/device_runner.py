@@ -26,13 +26,16 @@ The mesh models all replicas — on real TPU pods the replica axis spans
 mesh slices wired by ICI, which is exactly the deployment the reference
 reaches with one TCP mesh per geo-replica pair.
 
-Partial replication (``Config.shard_count > 1``, epaxos-class): ONE mesh
-carries every shard — shard s owns key buckets ``b % shard_count == s``
-and replica rows ``[s*n, (s+1)*n)``; quorums are per shard per key slot
-(mesh_step.protocol_step sharded mode).  Cross-shard dependencies
-resolve inside the shared working set — the mesh-native answer to the
+Partial replication (``Config.shard_count > 1``, epaxos-class and Newt):
+ONE mesh carries every shard — shard s owns key buckets
+``b % shard_count == s`` and replica rows ``[s*n, (s+1)*n)``; quorums
+are per shard per key slot (mesh_step.protocol_step /
+newt_protocol_step sharded modes).  Cross-shard dependencies resolve
+inside the shared working set — the mesh-native answer to the
 reference's cross-shard dep request RPCs
-(fantoch_ps/src/executor/graph/mod.rs:279-408).  The client plane keeps
+(fantoch_ps/src/executor/graph/mod.rs:279-408) — and a Newt multi-shard
+command commits at the max of its shards' clocks (the MShardCommit
+aggregation).  The client plane keeps
 the per-shard-server wire contract: clients connect once per shard
 (every shard maps to this server's address), Submit rides the target
 shard's connection, and each touched shard answers with its own
