@@ -152,9 +152,10 @@ def child_main(mode: str) -> None:
     # slope-timed device latency (see slope_timed): K back-to-back resolves
     # inside ONE dispatch, serialized by a real data dependence (order[0]
     # of resolve i perturbs the key batch of resolve i+1 by a runtime zero
-    # the compiler cannot fold).
-    @functools.partial(jax.jit, static_argnames=("k",))
-    def resolve_k(key, dep, src, seq, *, k):
+    # the compiler cannot fold).  One chain kernel serves both the 1M
+    # primary and the chip-only 4M scaling row (residual_size is static).
+    @functools.partial(jax.jit, static_argnames=("k", "residual_size"))
+    def resolve_chain(key, dep, src, seq, *, k, residual_size):
         carry = jnp.int32(0)
         for _ in range(k):
             r = resolve_functional_keyed(
@@ -162,7 +163,7 @@ def child_main(mode: str) -> None:
                 dep,
                 src,
                 seq,
-                residual_size=residual,
+                residual_size=residual_size,
                 return_structure=False,
             )
             carry = r.order[0]
@@ -170,7 +171,8 @@ def child_main(mode: str) -> None:
 
     K_LO, K_HI = 1, 5
     slope, lo_p50, hi_p50 = slope_timed(
-        lambda k: resolve_k(key, dep, src, seq, k=k), K_LO, K_HI, ITERS
+        lambda k: resolve_chain(key, dep, src, seq, k=k, residual_size=residual),
+        K_LO, K_HI, ITERS,
     )
     if slope is not None:
         p50 = slope
@@ -203,6 +205,41 @@ def child_main(mode: str) -> None:
     # past the parent's timeout, the parent still recovers this line from
     # the killed child's partial stdout (it takes the last valid line)
     print(json.dumps(record), flush=True)
+    def bench_scale_4m() -> dict:
+        """Chip-only scaling row (runs LAST: its fresh 4M-shape compile
+        must never cost the budget the executor/serving/pool rows need):
+        4x the north-star batch, correctness-checked before timing; the
+        ratio to the 1M number is reported only when both came from the
+        slope method (mixing a slope with a dispatch-laden single call
+        would make the ratio meaningless).  Local scope: the ~80 MB of
+        device buffers free on every exit path."""
+        b4 = 4 * BATCH
+        k4_np, d4_np, s4_np, q4_np = build_workload(b4, CONFLICT)
+        res4 = _residual_size_for(b4)
+        key4 = jax.device_put(jnp.asarray(k4_np))
+        dep4 = jax.device_put(jnp.asarray(d4_np))
+        src4 = jax.device_put(jnp.asarray(s4_np))
+        seq4 = jax.device_put(jnp.asarray(q4_np))
+        check = resolve_functional_keyed(
+            key4, dep4, src4, seq4, residual_size=res4, return_structure=False
+        )
+        assert int(check.n_resolved) == b4, (
+            f"4M workload resolved {int(check.n_resolved)}/{b4}"
+        )
+        assert not bool(check.overflow)
+        slope4, lo4, _hi4 = slope_timed(
+            lambda k: resolve_chain(key4, dep4, src4, seq4, k=k, residual_size=res4),
+            1, 3, 5,
+        )
+        out = {
+            "scale_batch": b4,
+            "scale_ms": round(slope4 if slope4 is not None else lo4, 3),
+            "scale_method": "slope 1->3" if slope4 is not None else "single-call",
+        }
+        if slope4 is not None and slope is not None:
+            out["scale_vs_1m"] = round(slope4 / p50, 2)
+        return out
+
     # secondary measurements must never cost us the primary one
     try:
         exec_ms, exec_cmds_per_s, order_ms = bench_integrated_executor()
@@ -242,6 +279,15 @@ def child_main(mode: str) -> None:
     except Exception as exc:  # noqa: BLE001
         print(f"# local-pool bench failed: {exc!r}", file=sys.stderr)
         record["pool_error"] = repr(exc)[:200]
+    # scaling row last and chip only: CPU sorts at 4M would eat the
+    # fallback child's whole budget, and a cold 4M compile must not
+    # crowd out the rows above on first run after a kernel change
+    if platform != "cpu":
+        try:
+            record.update(bench_scale_4m())
+        except Exception as exc:  # noqa: BLE001 — scaling row is optional
+            print(f"# 4M scaling bench failed: {exc!r}", file=sys.stderr)
+            record["scale_error"] = repr(exc)[:200]
 
     print(json.dumps(record), flush=True)
 

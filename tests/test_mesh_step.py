@@ -789,6 +789,33 @@ def test_sharded_newt_degraded_shard_blocks_stability(mesh):
     assert drained == sorted(drained)
 
 
+def test_newt_tiny_quorums_on_mesh(mesh):
+    """newt_tiny_quorums shrinks the fast quorum to f+1 (newt.rs:90-100):
+    a replica OUTSIDE the tiny quorum with a divergent key clock must not
+    influence the commit clock, while the regular quorum consults it."""
+    m = mesh_step.make_mesh(num_replicas=4)
+
+    def run(tiny):
+        state = mesh_step.init_newt_state(
+            m, 4, key_buckets=8, pending_capacity=8
+        )
+        kc = np.array(state.key_clock)
+        kc[2, 0] = 50  # replica 2: inside fq=3 (regular), outside fq=2 (tiny)
+        state = state._replace(
+            key_clock=jax.device_put(jnp.asarray(kc), state.key_clock.sharding)
+        )
+        step = mesh_step.jit_newt_step(m, f=1, tiny_quorums=tiny)
+        key = jnp.zeros((8,), jnp.int32).at[1:].set(mesh_step.KEY_PAD)
+        src = jnp.ones((8,), jnp.int32)
+        state, out = step(state, key, src, jnp.arange(8, dtype=jnp.int32))
+        w = state.pend_key.shape[0]
+        assert bool(np.asarray(out.executed)[w])
+        return int(np.asarray(out.clock)[w])
+
+    assert run(tiny=True) == 1  # rows 0,1 agree at clock 1
+    assert run(tiny=False) == 51  # row 2's stale view raises the max
+
+
 @pytest.mark.slow
 def test_newt_multikey_fast_path_is_row_level(mesh):
     """Unsharded multi-key fast-path regression (review finding): the

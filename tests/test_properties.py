@@ -181,3 +181,100 @@ def test_native_resolver_matches_oracle_property(args):
     expected, n_exec = oracle_per_key_order(3, args)
     assert len(order) == n_exec
     assert per_key == expected
+
+
+# --- sharded Newt mesh round properties ---
+
+_SHARDED_NEWT = {}
+
+
+def _sharded_newt_step():
+    """One jitted 2-shard Newt step + mesh, built once: hypothesis
+    examples reuse the compiled program (fixed shapes)."""
+    if not _SHARDED_NEWT:
+        from fantoch_tpu.parallel import mesh_step
+
+        m = mesh_step.make_mesh(num_replicas=6)
+        _SHARDED_NEWT["mesh_step"] = mesh_step
+        _SHARDED_NEWT["mesh"] = m
+        _SHARDED_NEWT["step"] = mesh_step.jit_newt_step(m, f=1, shard_count=2)
+    return _SHARDED_NEWT["mesh_step"], _SHARDED_NEWT["mesh"], _SHARDED_NEWT["step"]
+
+
+@settings(max_examples=25 // 5 if _CI else 25, deadline=None)
+@given(
+    st.lists(
+        st.one_of(
+            st.none(),  # pad row
+            st.integers(min_value=0, max_value=7),  # single bucket
+            st.tuples(
+                st.integers(min_value=0, max_value=7),
+                st.integers(min_value=0, max_value=7),
+            ).filter(lambda t: t[0] != t[1]),  # two distinct buckets
+        ),
+        min_size=8,
+        max_size=8,
+    )
+)
+@pytest.mark.slow
+def test_sharded_newt_round_properties(rows):
+    """Random single/multi-bucket batches through one healthy 2-shard
+    Newt round: every valid row fast-commits and executes, per-bucket
+    execution follows strictly increasing (clock, dot) sort ids — the
+    VotesTable contract; multi-key rows may tie on clock within a round
+    and break by dot (newt_protocol_step docstring) — and a shard's
+    replicas never learn the other shard's buckets."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh_step, _m, step = _sharded_newt_step()
+    KP = mesh_step.KEY_PAD
+    state = mesh_step.init_newt_state(
+        _SHARDED_NEWT["mesh"], 6, key_buckets=8, pending_capacity=8,
+        key_width=2,
+    )
+    key = np.full((8, 2), KP, np.int32)
+    for i, row in enumerate(rows):
+        if row is None:
+            continue
+        if isinstance(row, tuple):
+            key[i, 0], key[i, 1] = row
+        else:
+            key[i, 0] = row
+    state, out = step(
+        state, jnp.asarray(key), jnp.ones((8,), jnp.int32),
+        jnp.arange(8, dtype=jnp.int32),
+    )
+    pend_cap = state.pend_key.shape[0]
+    valid = [i for i, r in enumerate(rows) if r is not None]
+    executed = np.asarray(out.executed)
+    fast = np.asarray(out.fast_path)
+    clock = np.asarray(out.clock)
+    for i in valid:
+        assert executed[pend_cap + i] and fast[pend_cap + i], f"row {i}"
+
+    # per-bucket (clock, dot) sort ids strictly increase along the
+    # execution order (clock alone may tie for multi-key rows in one
+    # round; dot breaks the tie — the VotesTable SortId contract)
+    order = np.asarray(out.order)
+    last = {}
+    for w in order.tolist():
+        if not executed[w] or w < pend_cap:
+            continue
+        i = w - pend_cap
+        row = rows[i]
+        buckets = row if isinstance(row, tuple) else (row,)
+        sort_id = (int(clock[w]), i)  # dot = (1, seq=i): seq orders
+        for b in buckets:
+            assert last.get(b, (0, -1)) < sort_id, (
+                f"bucket {b}: {last.get(b)} !< {sort_id}"
+            )
+            last[b] = sort_id
+
+    # ownership: shard 0 = rows 0..2 owns even buckets, shard 1 odd
+    kc = np.asarray(state.key_clock)
+    vf = np.asarray(state.vote_frontier)
+    odd = np.arange(1, 8, 2)
+    even = np.arange(0, 8, 2)
+    assert (kc[0:3][:, odd] == 0).all() and (vf[0:3][:, odd] == 0).all()
+    assert (kc[3:6][:, even] == 0).all() and (vf[3:6][:, even] == 0).all()
