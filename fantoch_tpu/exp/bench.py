@@ -37,6 +37,12 @@ from fantoch_tpu.utils import logger
 # server artifacts land here relative to each process's workdir, then are
 # pulled into the experiment dir
 _RESULTS_REL = "testbed_results"
+# per-process profiler artifact filename by run mode — one definition for
+# the spawn wrapper and the result pull
+_PROFILE_ARTIFACTS = {
+    "cprofile": "profile_p{pid}.prof",
+    "memory": "memory_p{pid}.txt",
+}
 
 
 def _cli_env() -> Dict[str, str]:
@@ -150,10 +156,9 @@ def _run_experiment_testbed(
                         log,
                         pre_dirs=[_RESULTS_REL],
                         profile_artifact=(
-                            f"{_RESULTS_REL}/profile_p{pid}.prof"
-                            if run_mode == "cprofile"
-                            else f"{_RESULTS_REL}/memory_p{pid}.txt"
-                            if run_mode == "memory"
+                            f"{_RESULTS_REL}/"
+                            + _PROFILE_ARTIFACTS[run_mode].format(pid=pid)
+                            if run_mode in _PROFILE_ARTIFACTS
                             else None
                         ),
                         profile_kind=(
@@ -216,10 +221,8 @@ def _run_experiment_testbed(
     # pull per-process artifacts back from the machines that produced them
     pulled = []
     suffixes = ["metrics_p{pid}.gz", "execution_p{pid}.log"]
-    if run_mode == "cprofile":
-        suffixes.append("profile_p{pid}.prof")
-    elif run_mode == "memory":
-        suffixes.append("memory_p{pid}.txt")  # already-text heap report
+    if run_mode in _PROFILE_ARTIFACTS:
+        suffixes.append(_PROFILE_ARTIFACTS[run_mode])
     for pid, _shard in all_pids:
         for pattern in suffixes:
             rel = pattern.format(pid=pid)
