@@ -238,7 +238,9 @@ def _run_experiment_testbed(
         import pstats
 
         for pid, _shard in all_pids:
-            prof = os.path.join(exp_dir, f"profile_p{pid}.prof")
+            prof = os.path.join(
+                exp_dir, _PROFILE_ARTIFACTS["cprofile"].format(pid=pid)
+            )
             if not os.path.exists(prof):
                 continue
             txt = os.path.join(exp_dir, f"profile_p{pid}.txt")
