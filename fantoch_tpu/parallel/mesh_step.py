@@ -559,6 +559,12 @@ class NewtStepOutput(NamedTuple):
     stable_watermark: jax.Array  # int32[] — min stable clock over keys seen
     pending: jax.Array  # int32[]
     pend_dropped: jax.Array  # int32[]
+    # working-row dot identity (pending buffer + this round's batch):
+    # the drivers key their registries on these, so a drain never needs a
+    # host-side mirror of the device pending buffer — which is what lets
+    # a dispatched round be drained later (dispatch/drain pipelining)
+    work_src: jax.Array  # int32[W]
+    work_seq: jax.Array  # int32[W]
 
 
 def newt_quorum_sizes(
@@ -914,6 +920,7 @@ def newt_protocol_step(
             order, executed, committed, fast & valid, clock,
             slow_paths, watermark,
             jnp.minimum(pending, pend_cap), pend_dropped,
+            src_f, seq_f,
         )
 
     specs_in = (
@@ -928,6 +935,7 @@ def newt_protocol_step(
         P(), P(), P(), P(),  # pending buffer
         P(), P(), P(), P(), P(),  # order/executed/committed/fast/clock
         P(), P(), P(), P(),  # slow/watermark/pending/dropped
+        P(), P(),  # work identity columns
     )
     fn = shard_map(
         step, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False
@@ -936,6 +944,7 @@ def newt_protocol_step(
         kc, vf, pk, ps_, pq, pc,
         order, executed, committed, fast, clock,
         slow, watermark, pending, dropped,
+        work_src, work_seq,
     ) = fn(
         state.key_clock, state.vote_frontier,
         state.pend_key, state.pend_src, state.pend_seq, state.pend_clock,
@@ -946,6 +955,7 @@ def newt_protocol_step(
         NewtStepOutput(
             order, executed, committed, fast, clock,
             slow, watermark, pending, dropped,
+            work_src, work_seq,
         ),
     )
 
@@ -1217,6 +1227,9 @@ class CaesarStepOutput(NamedTuple):
     watermark: jax.Array  # int32[] — max executed clock this round
     pending: jax.Array  # int32[]
     pend_dropped: jax.Array  # int32[]
+    # working-row dot identity (see NewtStepOutput.work_src)
+    work_src: jax.Array  # int32[W]
+    work_seq: jax.Array  # int32[W]
 
 
 def init_caesar_state(
@@ -1474,6 +1487,7 @@ def caesar_protocol_step(
             order, executed, committed, fast, clock,
             slow_paths, watermark,
             jnp.minimum(pending, pend_cap), pend_dropped,
+            src_f, seq_f,
         )
 
     specs_in = (
@@ -1486,6 +1500,7 @@ def caesar_protocol_step(
         P(), P(), P(), P(),
         P(), P(), P(), P(), P(),
         P(), P(), P(), P(),
+        P(), P(),  # work identity columns
     )
     fn = shard_map(
         step, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False
@@ -1494,6 +1509,7 @@ def caesar_protocol_step(
         kc, pk, ps_, pq, pc,
         order, executed, committed, fast, clock,
         slow, watermark, pending, dropped,
+        work_src, work_seq,
     ) = fn(
         state.key_clock,
         state.pend_key, state.pend_src, state.pend_seq, state.pend_clock,
@@ -1504,6 +1520,7 @@ def caesar_protocol_step(
         CaesarStepOutput(
             order, executed, committed, fast, clock,
             slow, watermark, pending, dropped,
+            work_src, work_seq,
         ),
     )
 

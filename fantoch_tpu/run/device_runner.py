@@ -181,11 +181,85 @@ class _DriverCore:
         self.slow_paths = 0
         self.executed = 0
         self.stable_watermark = 0
+        # dispatch/drain pipelining (drivers implementing the
+        # dispatch()/drain() split get step/step_pipelined for free)
+        self._outstanding = None  # dispatched-but-undrained round token
+        self.pipelined_rounds = 0  # rounds whose dispatch overlapped a drain
+        # rounds dispatched and not yet entered drain — during a drain
+        # this counts OTHER in-flight rounds (unlike has_outstanding,
+        # which is False mid-flush even with round k+1 dispatched), so
+        # rebase paths can assert nothing is in flight
+        self._undrained = 0
+        self._pend_seq = None  # host (src, seq) pending mirror, if the
+        self._pend_src = None  # driver keeps one (Paxos; others derive
+        # working-row identity from the step outputs)
 
     @property
     def in_flight(self) -> int:
         """Commands registered but not yet executed (device pending)."""
         return len(self._cmds)
+
+    # --- dispatch/drain pipelining scaffold (shared by every driver
+    # that implements the dispatch()/drain() split) ---
+
+    @property
+    def has_outstanding(self) -> bool:
+        """A dispatched-but-undrained pipelined round exists."""
+        return self._outstanding is not None
+
+    def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
+        """One synchronous round: flush any pipelined round, dispatch,
+        drain."""
+        results = self.flush_pipeline()
+        tok = self._dispatch_tracked(batch)
+        results.extend(self._drain_tracked(tok))
+        return results
+
+    def step_pipelined(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
+        """Dispatch ``batch`` as round k+1, then drain round k (the
+        previously dispatched round) and return ITS results — one round
+        of delivery lag in exchange for overlapping device compute with
+        the host emit loop.  Call ``flush_pipeline`` to retire the final
+        round."""
+        if self._outstanding is not None and self._pipeline_flush_needed(batch):
+            # an epoch/window rebase would invalidate the in-flight
+            # round's identity or clock accounting — retire it first
+            # (rare: once per int32 window)
+            early = self.flush_pipeline()
+            self._outstanding = self._dispatch_tracked(batch)
+            return early
+        tok = self._dispatch_tracked(batch)
+        if self._outstanding is not None:
+            self.pipelined_rounds += 1
+        results = self.flush_pipeline()
+        self._outstanding = tok
+        return results
+
+    def flush_pipeline(self) -> List[ExecutorResult]:
+        """Drain the outstanding pipelined round, if any."""
+        if self._outstanding is None:
+            return []
+        tok, self._outstanding = self._outstanding, None
+        return self._drain_tracked(tok)
+
+    def _dispatch_tracked(self, batch):
+        tok = self.dispatch(batch)
+        self._undrained += 1
+        return tok
+
+    def _drain_tracked(self, tok):
+        self._undrained -= 1  # inside drain, _undrained = OTHER in-flight
+        return self.drain(tok)
+
+    def _pipeline_flush_needed(self, batch) -> bool:
+        """True when the upcoming dispatch may trigger a rebase that
+        must not happen with a round in flight.  Every driver's dot
+        drivers share the sequence-window trigger; drivers add their own
+        (gid epoch, clock window)."""
+        if not batch:
+            return False
+        top = max(dot.sequence for dot, _ in batch) - self._seq_base
+        return top >= self.SEQ_WINDOW_MAX
 
     def _init_sharded_mesh(
         self, mesh_step, num_replicas: int, shard_count: int,
@@ -213,6 +287,37 @@ class _DriverCore:
             pending_capacity=pending_capacity,
             key_width=key_width,
         )
+
+    def _dispatch_dot_keyed(self, batch: List[Tuple[Dot, Command]]):
+        """Shared dispatch body for the dot-keyed drivers (Newt/Caesar):
+        assemble the fixed-size key/src/seq columns, register commands
+        under packed (source, window sequence), and submit one device
+        round; returns the round token for ``drain``."""
+        import jax.numpy as jnp
+
+        from fantoch_tpu.parallel.mesh_step import KEY_PAD
+
+        assert len(batch) <= self.batch_size
+        self._ensure_seq_window(batch)
+        b = self.batch_size
+        key = np.full((b, self.key_width), KEY_PAD, dtype=np.int32)
+        src = np.zeros(b, dtype=np.int32)
+        seq = np.zeros(b, dtype=np.int32)
+        for i, (dot, cmd) in enumerate(batch):
+            buckets = _bucket_row(
+                cmd, self.shard_id, self.key_buckets, self.key_width,
+                self.shard_count, cache=self._bucket_cache,
+            )
+            key[i, : len(buckets)] = buckets
+            src[i] = dot.source
+            seq[i] = self._device_seq(dot)
+            self._cmds[self._packed(dot.source, seq[i])] = (dot, cmd)
+
+        self._state, out = self._step(
+            self._state, jnp.asarray(key), jnp.asarray(src), jnp.asarray(seq)
+        )
+        self.rounds += 1
+        return out
 
     def _execute_entry(self, cmd: Command) -> List[ExecutorResult]:
         """Execute one ordered command against the KVStore.  Sharded mode:
@@ -294,9 +399,10 @@ class _DriverCore:
         import jax.numpy as jnp
 
         self._rekey_registry_for_window()
-        self._pend_seq = (
-            self._pend_seq.astype(np.int64) - shift
-        ).astype(np.int32)
+        if self._pend_seq is not None:  # only Paxos keeps a host mirror
+            self._pend_seq = (
+                self._pend_seq.astype(np.int64) - shift
+            ).astype(np.int32)
         st = self._state
         pend_seq = np.asarray(st.pend_seq, dtype=np.int64) - shift
         self._state = st._replace(
@@ -305,18 +411,23 @@ class _DriverCore:
             )
         )
 
-    def _drain_and_mirror_carry(
-        self, out, work_src, work_seq, label: str, committed_noun: str
+    def _drain_and_carry(
+        self, out, label: str, committed_noun: str
     ) -> List[ExecutorResult]:
         """The dot-keyed drivers' shared tail (Newt/Caesar): execute the
-        round's executed rows in device order against the KVStore, then
-        mirror the device's committed-first pending carry into the host
-        (src, seq) columns.  Committed overflow cannot be re-proposed
-        (its timestamp already entered the replicas' tables) and fails
-        loudly; uncommitted overflow re-queues under the original dot."""
+        round's executed rows in device order against the KVStore, using
+        the step's own ``work_src``/``work_seq`` identity columns — the
+        device pending buffer carries its identity, so no host mirror
+        exists to drift (and a dispatched round can be drained later:
+        dispatch/drain pipelining).  Committed overflow cannot be
+        re-proposed (its timestamp already entered the replicas' tables)
+        and fails loudly; uncommitted overflow re-queues under the
+        original dot."""
         order = np.asarray(out.order)
         executed = np.asarray(out.executed)
         committed = np.asarray(out.committed)
+        work_src = np.asarray(out.work_src)
+        work_seq = np.asarray(out.work_seq)
         results: List[ExecutorResult] = []
         for w in order.tolist():
             if not executed[w]:
@@ -331,20 +442,15 @@ class _DriverCore:
 
         # after the pops, registry keys == this round's carried rows;
         # committed first in working order (both device carries sort
-        # committed rows ahead — carry_rank in the mesh steps)
-        pend_cap = len(self._pend_src)
+        # committed rows ahead — carry_rank in the mesh steps); rows
+        # beyond the device pending capacity were dropped there
         carried = [
             w
             for w in range(len(work_src))
             if self._packed(work_src[w], work_seq[w]) in self._cmds
         ]
         carried.sort(key=lambda w: (not committed[w], w))
-        kept, dropped = carried[:pend_cap], carried[pend_cap:]
-        self._pend_src = np.zeros(pend_cap, dtype=np.int32)
-        self._pend_seq = np.zeros(pend_cap, dtype=np.int32)
-        for slot, w in enumerate(kept):
-            self._pend_src[slot] = work_src[w]
-            self._pend_seq[slot] = work_seq[w]
+        dropped = carried[self._pend_cap:]
         requeued = 0
         for w in dropped:
             if committed[w]:
@@ -426,8 +532,6 @@ class DeviceDriver(_DriverCore):
         self._next_gid = 0  # host mirror of state.next_gid
         self._frontier_base = 0  # executed-count carried across gid epochs
         self.gid_epochs = 0
-        self._outstanding = None  # dispatched-but-undrained pipelined round
-        self.pipelined_rounds = 0  # rounds whose dispatch overlapped a drain
 
     # --- the serving round ---
 
@@ -506,59 +610,23 @@ class DeviceDriver(_DriverCore):
             )
         )
 
-    def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
-        """One device round over up to ``batch_size`` new commands (the
-        rest of the fixed batch is padding; excess raises).  Returns the
-        per-key results of every command *executed* this round — which
-        includes commands carried from previous degraded rounds.
+    # step/step_pipelined/flush_pipeline come from _DriverCore; one
+    # device round covers up to ``batch_size`` new commands (the rest of
+    # the fixed batch is padding; excess raises) and returns the per-key
+    # results of every command *executed* that round — including
+    # commands carried from previous degraded rounds.  Pipelined, the
+    # device round (or the remote-dispatch tunnel round trip) overlaps
+    # the host's result-emit loop — the two halves measured within ~1 ms
+    # of each other on CPU, so overlap ~halves the round (BENCH_DEV r5).
 
-        ``step`` = ``dispatch`` + ``drain`` back to back.  The pipelined
-        serving loop calls ``step_pipelined`` instead, which dispatches
-        round k+1 *before* draining round k so the device round (or the
-        remote-dispatch tunnel round trip) overlaps the host's
-        result-emit loop — the two halves measured within ~1 ms of each
-        other on CPU, so overlap ~halves the round (BENCH_DEV round 5).
-        """
-        # mixed use: fold any outstanding pipelined round's results in
-        # rather than stranding them
-        results = self.flush_pipeline()
-        tok = self.dispatch(batch)
-        results.extend(self.drain(tok))
-        return results
-
-    @property
-    def has_outstanding(self) -> bool:
-        """A dispatched-but-undrained pipelined round exists."""
-        return self._outstanding is not None
-
-    def step_pipelined(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
-        """Dispatch ``batch`` as round k+1, then drain round k (the
-        previously dispatched round) and return ITS results — one round
-        of delivery lag in exchange for overlapping device compute with
-        the host emit loop.  Call ``flush_pipeline`` to retire the final
-        round."""
-        if self._outstanding is not None and (
+    def _pipeline_flush_needed(self, batch) -> bool:
+        # a gid epoch reset rebases the registry and frontier base,
+        # which drain reads — retire the in-flight round first (rare:
+        # once per 2^31 gids)
+        return (
             self._next_gid + self.batch_size >= self.GID_RESET_THRESHOLD
-        ):
-            # a gid epoch reset rebases the registry and frontier base,
-            # which drain reads — retire the in-flight round first (rare:
-            # once per 2^31 gids)
-            early = self.flush_pipeline()
-            self._outstanding = self.dispatch(batch)
-            return early
-        tok = self.dispatch(batch)
-        if self._outstanding is not None:
-            self.pipelined_rounds += 1
-        results = self.flush_pipeline()
-        self._outstanding = tok
-        return results
-
-    def flush_pipeline(self) -> List[ExecutorResult]:
-        """Drain the outstanding pipelined round, if any."""
-        if self._outstanding is None:
-            return []
-        tok, self._outstanding = self._outstanding, None
-        return self.drain(tok)
+            or super()._pipeline_flush_needed(batch)
+        )
 
     def dispatch(self, batch: List[Tuple[Dot, Command]]):
         """Assemble + dispatch one device round (async — does not block
@@ -699,12 +767,9 @@ class NewtDeviceDriver(_DriverCore):
             self._mesh, f=f, tiny_quorums=tiny_quorums,
             live_replicas=live_replicas, shard_count=shard_count,
         )
-        # host mirror of the device pending buffer's (src, seq) identity
-        # columns (the step outputs index working rows = pending + batch;
-        # identities never need a device round-trip)
-        cap = pending_capacity
-        self._pend_src = np.zeros(cap, dtype=np.int32)
-        self._pend_seq = np.zeros(cap, dtype=np.int32)
+        # no host identity mirror: the step outputs carry the working
+        # rows' (src, seq) columns (NewtStepOutput.work_src/work_seq)
+        self._pend_cap = pending_capacity
         self._clock_floor = 0  # timestamps GC'd below this (host int)
         self._max_clock = 0  # highest committed device clock seen
         self.clock_epochs = 0
@@ -746,38 +811,31 @@ class NewtDeviceDriver(_DriverCore):
             floor, self.clock_epochs,
         )
 
-    def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
-        import jax
-        import jax.numpy as jnp
-
-        from fantoch_tpu.parallel.mesh_step import KEY_PAD
-
-        assert len(batch) <= self.batch_size
-        self._ensure_seq_window(batch)
-        b = self.batch_size
-        key = np.full((b, self.key_width), KEY_PAD, dtype=np.int32)
-        src = np.zeros(b, dtype=np.int32)
-        seq = np.zeros(b, dtype=np.int32)
-        for i, (dot, cmd) in enumerate(batch):
-            buckets = _bucket_row(
-                cmd, self.shard_id, self.key_buckets, self.key_width,
-                self.shard_count, cache=self._bucket_cache,
-            )
-            key[i, : len(buckets)] = buckets
-            src[i] = dot.source
-            seq[i] = self._device_seq(dot)
-            self._cmds[self._packed(dot.source, seq[i])] = (dot, cmd)
-
-        # this round's working-row identities: pending buffer first
-        work_src = np.concatenate([self._pend_src, src])
-        work_seq = np.concatenate([self._pend_seq, seq])
-
-        self._state, out = self._step(
-            self._state, jnp.asarray(key), jnp.asarray(src), jnp.asarray(seq)
+    def _pipeline_flush_needed(self, batch) -> bool:
+        # drain may advance the clock window only with nothing in
+        # flight (an in-flight round's clocks are in pre-shift units);
+        # per-bucket clocks grow by at most the working-set size per
+        # round, so a one-working-set margin guarantees the next drain
+        # stays under the threshold while a round is outstanding
+        work = self._pend_cap + self.batch_size
+        return (
+            self._max_clock + work >= self.CLOCK_RESET_THRESHOLD
+            or super()._pipeline_flush_needed(batch)
         )
+
+    def dispatch(self, batch: List[Tuple[Dot, Command]]):
+        """Assemble + dispatch one Newt round (async); returns the round
+        token for ``drain``."""
+        return self._dispatch_dot_keyed(batch)
+
+    def drain(self, out) -> List[ExecutorResult]:
+        """Fetch one round's outputs, advance watermark/clock-window
+        bookkeeping, and execute its stable commands in (clock, dot)
+        order."""
+        import jax
+
         # one pytree fetch, one device->host round trip (see DeviceDriver)
         out = jax.device_get(out)
-        self.rounds += 1
 
         device_wm = int(out.stable_watermark)
         # overflow trigger = the MAX committed clock (a hot key's clock
@@ -792,6 +850,10 @@ class NewtDeviceDriver(_DriverCore):
         if device_wm < 2**31 - 1:
             self.stable_watermark = self._clock_floor + device_wm
             if self._max_clock >= self.CLOCK_RESET_THRESHOLD:
+                assert self._undrained == 0, (
+                    "clock-window advance with a pipelined round in "
+                    "flight (_pipeline_flush_needed must prevent this)"
+                )
                 if device_wm > 0:
                     self._advance_clock_window(device_wm)
                     self._max_clock -= device_wm
@@ -811,9 +873,7 @@ class NewtDeviceDriver(_DriverCore):
         # longer set — counting at execution would undercount
         self.fast_paths += int(np.asarray(out.fast_path).sum())
 
-        return self._drain_and_mirror_carry(
-            out, work_src, work_seq, "newt", "unstable"
-        )
+        return self._drain_and_carry(out, "newt", "unstable")
 
 
 class CaesarDeviceDriver(_DriverCore):
@@ -826,11 +886,11 @@ class CaesarDeviceDriver(_DriverCore):
     (fantoch_ps/src/protocol/caesar.rs:216-451; execution =
     fantoch_ps/src/executor/pred/mod.rs:132-186).
 
-    Host mirror/carry contract is the Newt driver's: commands key on
-    packed (source, window sequence); the pending mirror tracks the
-    device's committed-first carry; committed overflow cannot be
-    re-proposed (a committed timestamp is final) and fails loudly,
-    uncommitted overflow re-queues under the original dot.
+    Carry contract is the Newt driver's: commands key on packed
+    (source, window sequence); working-row identity comes from the step
+    outputs (no host mirror); committed overflow cannot be re-proposed
+    (a committed timestamp is final) and fails loudly, uncommitted
+    overflow re-queues under the original dot.
     """
 
     # int32 timestamp headroom guard: Caesar has no per-key vote
@@ -872,41 +932,20 @@ class CaesarDeviceDriver(_DriverCore):
         self._step = mesh_step.jit_caesar_step(
             self._mesh, num_replicas=num_replicas, live_replicas=live_replicas
         )
-        cap = pending_capacity
-        self._pend_src = np.zeros(cap, dtype=np.int32)
-        self._pend_seq = np.zeros(cap, dtype=np.int32)
+        self._pend_cap = pending_capacity
 
-    def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
+    def dispatch(self, batch: List[Tuple[Dot, Command]]):
+        """Assemble + dispatch one Caesar round (async); returns the
+        round token for ``drain``."""
+        return self._dispatch_dot_keyed(batch)
+
+    def drain(self, out) -> List[ExecutorResult]:
+        """Fetch one round's outputs and execute its wait-cleared
+        commands in (clock, dot) order."""
         import jax
-        import jax.numpy as jnp
 
-        from fantoch_tpu.parallel.mesh_step import KEY_PAD
-
-        assert len(batch) <= self.batch_size
-        self._ensure_seq_window(batch)
-        b = self.batch_size
-        key = np.full((b, self.key_width), KEY_PAD, dtype=np.int32)
-        src = np.zeros(b, dtype=np.int32)
-        seq = np.zeros(b, dtype=np.int32)
-        for i, (dot, cmd) in enumerate(batch):
-            buckets = _bucket_row(
-                cmd, self.shard_id, self.key_buckets, self.key_width,
-                self.shard_count, cache=self._bucket_cache,
-            )
-            key[i, : len(buckets)] = buckets
-            src[i] = dot.source
-            seq[i] = self._device_seq(dot)
-            self._cmds[self._packed(dot.source, seq[i])] = (dot, cmd)
-
-        work_src = np.concatenate([self._pend_src, src])
-        work_seq = np.concatenate([self._pend_seq, seq])
-
-        self._state, out = self._step(
-            self._state, jnp.asarray(key), jnp.asarray(src), jnp.asarray(seq)
-        )
         # one pytree fetch, one device->host round trip (see DeviceDriver)
         out = jax.device_get(out)
-        self.rounds += 1
 
         wm = int(out.watermark)
         if wm >= self.CLOCK_GUARD:
@@ -917,9 +956,7 @@ class CaesarDeviceDriver(_DriverCore):
         self.slow_paths += int(out.slow_paths)
         self.fast_paths += int(np.asarray(out.fast_path).sum())
 
-        return self._drain_and_mirror_carry(
-            out, work_src, work_seq, "caesar", "blocked"
-        )
+        return self._drain_and_carry(out, "caesar", "blocked")
 
 
 class ProtocolError(Exception):
@@ -1378,7 +1415,9 @@ class DeviceRuntime:
             # BENCH_DEV round 5), so auto-enable only off-CPU
             device0 = np.asarray(self.driver._mesh.devices).flat[0]
             pipeline = getattr(device0, "platform", "cpu") != "cpu"
-        supported = hasattr(self.driver, "step_pipelined")
+        # the scaffold's step_pipelined needs the driver's dispatch/drain
+        # split (the Paxos driver serves with a monolithic step)
+        supported = hasattr(self.driver, "dispatch")
         self.pipeline = bool(pipeline) and supported
         if explicit and not supported:
             logger.warning(

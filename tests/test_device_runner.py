@@ -1028,6 +1028,80 @@ def test_driver_pipelined_equivalence():
         )
 
 
+@pytest.mark.parametrize("protocol", ["newt", "caesar"])
+def test_dot_driver_pipelined_equivalence(protocol):
+    """The Newt/Caesar drivers gain the dispatch/drain split: pipelined
+    rounds lag by one call and, with a final flush, reproduce the sync
+    driver's execution exactly — results, per-key monitor order, and
+    tallies (identity comes from the step outputs, so no host mirror can
+    drift while a round is in flight)."""
+    from fantoch_tpu.run.device_runner import (
+        CaesarDeviceDriver,
+        NewtDeviceDriver,
+    )
+
+    cls = NewtDeviceDriver if protocol == "newt" else CaesarDeviceDriver
+    mk = lambda: cls(3, batch_size=16, key_buckets=64,  # noqa: E731
+                     monitor_execution_order=True)
+
+    def batches():
+        out, seq = [], 0
+        for _r in range(5):
+            batch = []
+            for j in range(4):
+                seq += 1
+                key = "hot" if (seq % 2) else f"priv{j}"
+                batch.append(_put(1, seq, key, f"v{seq}"))
+            out.append(batch)
+        return out
+
+    d_sync, d_pipe = mk(), mk()
+    sync_rounds = [d_sync.step(b) for b in batches()]
+    pipe_rounds = [d_pipe.step_pipelined(b) for b in batches()]
+    assert pipe_rounds[0] == []  # one round of delivery lag
+    pipe_rounds.append(d_pipe.flush_pipeline())
+    assert not d_pipe.has_outstanding
+    assert d_pipe.pipelined_rounds == 4
+
+    def flat(rounds):
+        return [(r.rifl, r.key, tuple(r.op_results)) for rr in rounds for r in rr]
+
+    assert flat(pipe_rounds) == flat(sync_rounds)
+    assert flat(pipe_rounds[1:2]) == flat(sync_rounds[0:1])
+    assert d_pipe.executed == d_sync.executed == 20
+    assert d_pipe.in_flight == 0
+    for key in d_sync.store.monitor.keys():
+        assert (
+            d_pipe.store.monitor.get_order(key)
+            == d_sync.store.monitor.get_order(key)
+        )
+
+
+def test_newt_pipelined_clock_threshold_flushes_outstanding():
+    """A Newt clock-window advance must never run with a round in
+    flight: when the max committed clock nears the reset threshold,
+    step_pipelined retires the outstanding round first (and the drain
+    asserts the invariant)."""
+    from fantoch_tpu.run.device_runner import NewtDeviceDriver
+
+    d = NewtDeviceDriver(3, batch_size=8, key_buckets=16,
+                         pending_capacity=8,
+                         monitor_execution_order=True)
+    # force the flush condition without 2^31 rounds of work
+    d._max_clock = NewtDeviceDriver.CLOCK_RESET_THRESHOLD - 1
+    r1 = d.step_pipelined([_put(1, 1, "k", "a")])
+    assert r1 == [] and d.has_outstanding
+    # threshold trips: the next pipelined call must flush first
+    assert d._pipeline_flush_needed([_put(1, 2, "k", "b")])
+    r2 = d.step_pipelined([_put(1, 2, "k", "b")])
+    # the early flush returned round 1's results; round 2 is in flight
+    assert [r.op_results[0] for r in r2] == [None]
+    assert d.has_outstanding
+    r3 = d.flush_pipeline()
+    assert [r.op_results[0] for r in r3] == ["a"]
+    assert d.in_flight == 0
+
+
 def test_pipelined_gid_reset_flushes_outstanding():
     """The gid epoch reset rebases the registry that drain reads, so
     step_pipelined must retire the outstanding round *before* resetting
@@ -1049,10 +1123,12 @@ def test_pipelined_gid_reset_flushes_outstanding():
     assert len(order) == len(set(order)) == 2
 
 
-def test_device_runtime_pipelined_tcp_serving():
+@pytest.mark.parametrize("protocol", ["epaxos", "newt"])
+def test_device_runtime_pipelined_tcp_serving(protocol):
     """Saturated serving engages the pipelined loop (batch_size smaller
     than the standing queue) and still answers every client with per-key
-    order agreement — the TCP twin of the equivalence test."""
+    order agreement — the TCP twin of the equivalence test; the Newt
+    driver serves through the same dispatch/drain scaffold."""
     config = Config(3, 1, shard_count=1)
     workload = Workload(
         shard_count=1,
@@ -1068,6 +1144,7 @@ def test_device_runtime_pipelined_tcp_serving():
             client_count=4,
             batch_size=8,
             open_loop_interval_ms=1,
+            protocol=protocol,
             pipeline=True,  # auto would disable it on the CPU test backend
         )
     )

@@ -28,7 +28,7 @@ def test_hosts_testbed_experiment(tmp_path):
     )
     try:
         manifest = run_experiment(config, str(tmp_path), testbed=testbed,
-                                  client_timeout_s=180)
+                                  client_timeout_s=420)  # generous: full-suite runs contend on one core
     finally:
         testbed.cleanup()
     assert manifest["outcome"]["commands"] == 15
