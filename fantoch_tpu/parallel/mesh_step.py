@@ -1012,6 +1012,12 @@ class PaxosStepOutput(NamedTuple):
     slot: jax.Array  # int32[W] (-1 = pad row)
     pending: jax.Array  # int32[]
     pend_dropped: jax.Array  # int32[]
+    # this round's exec frontier and working-row dot identity (see
+    # NewtStepOutput.work_src) — the driver reads the round's own
+    # frontier even when a later round has already been dispatched
+    exec_frontier: jax.Array  # int32[]
+    work_src: jax.Array  # int32[W]
+    work_seq: jax.Array  # int32[W]
 
 
 def init_paxos_state(
@@ -1142,19 +1148,21 @@ def paxos_protocol_step(
             order, executed, committed, slot,
             jnp.minimum(pending, pend_cap),
             dropped,
+            src_f, seq_f,
         )
 
     specs_in = (
         P(), P(), P(), P(), P(),
         P(BATCH_AXIS), P(BATCH_AXIS), P(BATCH_AXIS),
     )
-    specs_out = (P(),) * 11
+    specs_out = (P(),) * 13
     fn = shard_map(
         step, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False
     )
     (
         next_slot, frontier, ps_, px, pq,
         order, executed, committed, slot, pending, dropped,
+        work_src, work_seq,
     ) = fn(
         state.next_slot, state.exec_frontier,
         state.pend_slot, state.pend_src, state.pend_seq,
@@ -1162,7 +1170,10 @@ def paxos_protocol_step(
     )
     return (
         PaxosMeshState(next_slot, frontier, ps_, px, pq),
-        PaxosStepOutput(order, executed, committed, slot, pending, dropped),
+        PaxosStepOutput(
+            order, executed, committed, slot, pending, dropped,
+            frontier, work_src, work_seq,
+        ),
     )
 
 

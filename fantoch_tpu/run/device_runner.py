@@ -190,9 +190,6 @@ class _DriverCore:
         # which is False mid-flush even with round k+1 dispatched), so
         # rebase paths can assert nothing is in flight
         self._undrained = 0
-        self._pend_seq = None  # host (src, seq) pending mirror, if the
-        self._pend_src = None  # driver keeps one (Paxos; others derive
-        # working-row identity from the step outputs)
 
     @property
     def in_flight(self) -> int:
@@ -319,6 +316,42 @@ class _DriverCore:
         self.rounds += 1
         return out
 
+    def _execute_ordered(
+        self, order, executed, work_src, work_seq
+    ) -> List[ExecutorResult]:
+        """Pop and execute the round's executed rows in device order
+        (shared by every drain; pad rows are registered by no one and
+        skip)."""
+        results: List[ExecutorResult] = []
+        for w in order.tolist():
+            if not executed[w]:
+                continue
+            entry = self._cmds.pop(
+                self._packed(work_src[w], work_seq[w]), None
+            )
+            if entry is None:
+                continue  # pad row
+            results.extend(self._execute_entry(entry[1]))
+            self.executed += 1
+        return results
+
+    def _requeue_rows(self, rows, work_src, work_seq, label: str) -> None:
+        """Re-queue overflow-dropped working rows under their original
+        dots (shared drain tail)."""
+        requeued = 0
+        for w in rows:
+            entry = self._cmds.pop(
+                self._packed(work_src[w], work_seq[w]), None
+            )
+            if entry is not None:
+                requeued += 1
+                self._requeue.append(entry)
+        if requeued:
+            logger.warning(
+                "%s device pending overflow: re-queueing %d commands",
+                label, requeued,
+            )
+
     def _execute_entry(self, cmd: Command) -> List[ExecutorResult]:
         """Execute one ordered command against the KVStore.  Sharded mode:
         the unified mesh owns every shard's keyspace, so each touched
@@ -389,20 +422,15 @@ class _DriverCore:
 
     def _on_seq_window_advanced(self, shift: int) -> None:
         """Rebase driver-held sequence state after a window advance: the
-        dot-keyed registry, the host (src, seq) pending mirror, and the
-        device-resident pend_seq column — the Newt/Paxos shape.  (Dead
-        mirror/device slots are masked by their key/slot columns and
-        match no registry key, so the blind shift is safe.)  DeviceDriver
-        overrides: its registry keys on gids and its device pend is
-        masked by pend_gid."""
+        dot-keyed registry and the device-resident pend_seq column — the
+        dot-keyed drivers' shape.  (Dead device slots are masked by
+        their key/slot columns and match no registry key, so the blind
+        shift is safe.)  DeviceDriver overrides: its registry keys on
+        gids and its device pend is masked by pend_gid."""
         import jax
         import jax.numpy as jnp
 
         self._rekey_registry_for_window()
-        if self._pend_seq is not None:  # only Paxos keeps a host mirror
-            self._pend_seq = (
-                self._pend_seq.astype(np.int64) - shift
-            ).astype(np.int32)
         st = self._state
         pend_seq = np.asarray(st.pend_seq, dtype=np.int64) - shift
         self._state = st._replace(
@@ -428,17 +456,7 @@ class _DriverCore:
         committed = np.asarray(out.committed)
         work_src = np.asarray(out.work_src)
         work_seq = np.asarray(out.work_seq)
-        results: List[ExecutorResult] = []
-        for w in order.tolist():
-            if not executed[w]:
-                continue
-            packed = self._packed(work_src[w], work_seq[w])
-            entry = self._cmds.pop(packed, None)
-            if entry is None:
-                continue  # pad row
-            _dot, cmd = entry
-            results.extend(self._execute_entry(cmd))
-            self.executed += 1
+        results = self._execute_ordered(order, executed, work_src, work_seq)
 
         # after the pops, registry keys == this round's carried rows;
         # committed first in working order (both device carries sort
@@ -451,25 +469,14 @@ class _DriverCore:
         ]
         carried.sort(key=lambda w: (not committed[w], w))
         dropped = carried[self._pend_cap:]
-        requeued = 0
-        for w in dropped:
-            if committed[w]:
-                raise RuntimeError(
-                    f"{label} device pending buffer overflowed with "
-                    f"committed-but-{committed_noun} commands: raise "
-                    "pending_capacity (a committed timestamp cannot be "
-                    "re-proposed)"
-                )
-            packed = self._packed(work_src[w], work_seq[w])
-            entry = self._cmds.pop(packed, None)
-            if entry is not None:
-                requeued += 1
-                self._requeue.append(entry)
-        if requeued:
-            logger.warning(
-                "%s device pending overflow: re-queueing %d commands",
-                label, requeued,
+        if any(committed[w] for w in dropped):
+            raise RuntimeError(
+                f"{label} device pending buffer overflowed with "
+                f"committed-but-{committed_noun} commands: raise "
+                "pending_capacity (a committed timestamp cannot be "
+                "re-proposed)"
             )
+        self._requeue_rows(dropped, work_src, work_seq, label)
         return results
 
     def _rekey_registry_for_window(self) -> None:
@@ -975,9 +982,10 @@ class PaxosDeviceDriver(_DriverCore):
 
     Commands need no key rows (the slot log totally orders them), so
     ``key_width`` is None: the session validator accepts any width.  The
-    registry keys on packed (source, sequence); the host mirrors the
-    device's slot-ordered pending carry to track identities across
-    degraded rounds.
+    registry keys on packed (source, sequence); working-row identity and
+    the round's exec frontier come from the step outputs (no host
+    mirror), so the driver serves through the shared dispatch/drain
+    pipelining scaffold like the other three.
     """
 
     key_width = None  # slot order needs no key rows: any command width
@@ -1012,13 +1020,9 @@ class PaxosDeviceDriver(_DriverCore):
             num_replicas=num_replicas,
             live_replicas=live_replicas,
         )
-        # host mirror of the device pending buffer's identity columns
-        # (valid = slot >= 0, matching PaxosMeshState.pend_slot);
+        # no host identity mirror (PaxosStepOutput.work_src/work_seq);
         # fast_paths stays 0 — leader-based: every commit is the one path
-        cap = pending_capacity
-        self._pend_slot = np.full(cap, -1, dtype=np.int64)
-        self._pend_src = np.zeros(cap, dtype=np.int32)
-        self._pend_seq = np.zeros(cap, dtype=np.int32)
+        self._pend_cap = pending_capacity
         self._slot_base = 0  # slots below base + exec_frontier executed
         self._next_slot = 0  # host mirror of state.next_slot
         self.slot_epochs = 0
@@ -1056,9 +1060,6 @@ class PaxosDeviceDriver(_DriverCore):
                 jnp.asarray(pend_slot.astype(np.int32)), st.pend_slot.sharding
             ),
         )
-        self._pend_slot = np.where(
-            self._pend_slot >= 0, self._pend_slot - delta, -1
-        )
         self._next_slot -= delta
         self._slot_base += delta
         self.slot_epochs += 1
@@ -1067,12 +1068,25 @@ class PaxosDeviceDriver(_DriverCore):
             delta, self.slot_epochs,
         )
 
-    def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
-        import jax
+    def _pipeline_flush_needed(self, batch) -> bool:
+        # a slot-epoch reset replaces next_slot/frontier/pending state
+        # that an in-flight round's outputs reference pre-rebase
+        return (
+            self._next_slot + self.batch_size >= self.SLOT_RESET_THRESHOLD
+            or super()._pipeline_flush_needed(batch)
+        )
+
+    def dispatch(self, batch: List[Tuple[Dot, Command]]):
+        """Assemble + dispatch one slot round (async); the token carries
+        the batch length for drain's slot-counter accounting."""
         import jax.numpy as jnp
 
         assert len(batch) <= self.batch_size
         if self._next_slot + self.batch_size >= self.SLOT_RESET_THRESHOLD:
+            assert self._undrained == 0, (
+                "slot epoch reset with a round in flight "
+                "(_pipeline_flush_needed must prevent this)"
+            )
             self._slot_epoch_reset()
             if self._next_slot + self.batch_size >= 2**31 - 1:
                 raise RuntimeError(
@@ -1090,74 +1104,51 @@ class PaxosDeviceDriver(_DriverCore):
             seq[i] = self._device_seq(dot)
             self._cmds[self._packed(dot.source, seq[i])] = (dot, cmd)
 
-        # this round's working-row identities: pending buffer first
-        work_valid = np.concatenate([self._pend_slot >= 0, valid])
-        work_src = np.concatenate([self._pend_src, src])
-        work_seq = np.concatenate([self._pend_seq, seq])
-
         self._state, out = self._step(
             self._state, jnp.asarray(valid), jnp.asarray(src), jnp.asarray(seq)
         )
-        # one pytree fetch, one device->host round trip (see DeviceDriver);
-        # the exec_frontier scalar rides the same fetch — a separate
-        # blocking read would cost a second full tunnel round trip
-        out, exec_frontier = jax.device_get((out, self._state.exec_frontier))
         self.rounds += 1
+        return (out, len(batch))
+
+    def drain(self, tok) -> List[ExecutorResult]:
+        """Fetch one round's outputs and execute its contiguous slot
+        prefix against the KVStore."""
+        import jax
+
+        out, n_batch = tok
+        # one pytree fetch, one device->host round trip (see DeviceDriver);
+        # the round's own exec_frontier rides in the output, so a later
+        # dispatched round cannot leak its frontier into this one
+        out = jax.device_get(out)
 
         order = np.asarray(out.order)
         executed = np.asarray(out.executed)
         slot = np.asarray(out.slot)
+        work_src = np.asarray(out.work_src)
+        work_seq = np.asarray(out.work_seq)
         # device slot counter: + new valid rows, - rolled-back overflow
-        self._next_slot += len(batch) - int(out.pend_dropped)
-        self.stable_watermark = self._slot_base + int(exec_frontier)
+        self._next_slot += n_batch - int(out.pend_dropped)
+        self.stable_watermark = self._slot_base + int(out.exec_frontier)
         # every commit in the leader class takes the same (slow) path: one
         # accept round — mirror the tally convention of the object runner
         self.slow_paths += int(executed.sum())
 
-        results: List[ExecutorResult] = []
-        for w in order.tolist():
-            if not executed[w]:
-                continue
-            packed = self._packed(work_src[w], work_seq[w])
-            entry = self._cmds.pop(packed, None)
-            if entry is None:
-                continue  # pad row
-            _dot, cmd = entry
-            results.extend(cmd.execute(self.shard_id, self.store))
-            self.executed += 1
+        results = self._execute_ordered(order, executed, work_src, work_seq)
 
-        # mirror the device's pending carry: unexecuted valid rows in SLOT
-        # order, lowest pend_cap kept.  Overflow rows are the HIGHEST slots
-        # and the device rolled its slot counter back over them (the log
-        # stays dense), so re-queueing them under the same dot is safe: no
-        # acceptor holds durable state for a rolled-back slot.
-        pend_cap = len(self._pend_slot)
+        # the device keeps the LOWEST pend_cap unexecuted slots (the log
+        # stays dense); overflow rows are the highest slots and the
+        # device rolled its slot counter back over them, so re-queueing
+        # them under the same dot is safe: no acceptor holds durable
+        # state for a rolled-back slot.
         carried = [
             w
             for w in range(len(work_src))
-            if work_valid[w] and not executed[w]
+            if slot[w] >= 0
+            and not executed[w]
+            and self._packed(work_src[w], work_seq[w]) in self._cmds
         ]
         carried.sort(key=lambda w: int(slot[w]))
-        kept, dropped = carried[:pend_cap], carried[pend_cap:]
-        self._pend_slot = np.full(pend_cap, -1, dtype=np.int64)
-        self._pend_src = np.zeros(pend_cap, dtype=np.int32)
-        self._pend_seq = np.zeros(pend_cap, dtype=np.int32)
-        for i, w in enumerate(kept):
-            self._pend_slot[i] = slot[w]
-            self._pend_src[i] = work_src[w]
-            self._pend_seq[i] = work_seq[w]
-        requeued = 0
-        for w in dropped:
-            packed = self._packed(work_src[w], work_seq[w])
-            entry = self._cmds.pop(packed, None)
-            if entry is not None:
-                requeued += 1
-                self._requeue.append(entry)
-        if requeued:
-            logger.warning(
-                "paxos device pending overflow: re-queueing %d commands",
-                requeued,
-            )
+        self._requeue_rows(carried[self._pend_cap:], work_src, work_seq, "paxos")
         return results
 
 
@@ -1415,15 +1406,9 @@ class DeviceRuntime:
             # BENCH_DEV round 5), so auto-enable only off-CPU
             device0 = np.asarray(self.driver._mesh.devices).flat[0]
             pipeline = getattr(device0, "platform", "cpu") != "cpu"
-        # the scaffold's step_pipelined needs the driver's dispatch/drain
-        # split (the Paxos driver serves with a monolithic step)
-        supported = hasattr(self.driver, "dispatch")
-        self.pipeline = bool(pipeline) and supported
-        if explicit and not supported:
-            logger.warning(
-                "pipeline requested but the %s driver has no dispatch/"
-                "drain split; serving synchronously", protocol,
-            )
+        # every driver implements the dispatch/drain split, so the
+        # scaffold's step_pipelined is always available
+        self.pipeline = bool(pipeline)
         self.dot_gen = AtomicIdGen(process_id)
         self.metrics_file = metrics_file
         self.metrics_interval_ms = metrics_interval_ms

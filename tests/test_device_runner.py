@@ -1028,19 +1028,21 @@ def test_driver_pipelined_equivalence():
         )
 
 
-@pytest.mark.parametrize("protocol", ["newt", "caesar"])
+@pytest.mark.parametrize("protocol", ["newt", "caesar", "fpaxos"])
 def test_dot_driver_pipelined_equivalence(protocol):
-    """The Newt/Caesar drivers gain the dispatch/drain split: pipelined
-    rounds lag by one call and, with a final flush, reproduce the sync
-    driver's execution exactly — results, per-key monitor order, and
-    tallies (identity comes from the step outputs, so no host mirror can
-    drift while a round is in flight)."""
+    """The Newt/Caesar/Paxos drivers gain the dispatch/drain split:
+    pipelined rounds lag by one call and, with a final flush, reproduce
+    the sync driver's execution exactly — results, per-key monitor
+    order, and tallies (identity comes from the step outputs, so no host
+    mirror can drift while a round is in flight)."""
     from fantoch_tpu.run.device_runner import (
         CaesarDeviceDriver,
         NewtDeviceDriver,
+        PaxosDeviceDriver,
     )
 
-    cls = NewtDeviceDriver if protocol == "newt" else CaesarDeviceDriver
+    cls = {"newt": NewtDeviceDriver, "caesar": CaesarDeviceDriver,
+           "fpaxos": PaxosDeviceDriver}[protocol]
     mk = lambda: cls(3, batch_size=16, key_buckets=64,  # noqa: E731
                      monitor_execution_order=True)
 
@@ -1123,7 +1125,7 @@ def test_pipelined_gid_reset_flushes_outstanding():
     assert len(order) == len(set(order)) == 2
 
 
-@pytest.mark.parametrize("protocol", ["epaxos", "newt"])
+@pytest.mark.parametrize("protocol", ["epaxos", "newt", "fpaxos"])
 def test_device_runtime_pipelined_tcp_serving(protocol):
     """Saturated serving engages the pipelined loop (batch_size smaller
     than the standing queue) and still answers every client with per-key
