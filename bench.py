@@ -713,8 +713,8 @@ def bench_device_serving(
         for i in range(total)
     ]
 
-    def measure(batch_size: int):
-        driver = DeviceDriver(n, batch_size=batch_size, key_buckets=8192)
+    def measure(batch_size: int, driver_cls=DeviceDriver):
+        driver = driver_cls(n, batch_size=batch_size, key_buckets=8192)
         driver.step(cmds[:batch_size])  # compile + warm
         t0 = time.perf_counter()
         served = 0
@@ -750,6 +750,20 @@ def bench_device_serving(
         "serving_pipelined_round_ms": pipe_ms,
         "serving_pipelined_cmds_per_s": pipe_cps,
     }
+    # the second protocol family's serving round (NewtDeviceDriver —
+    # timestamp proposal + stability instead of dep-graph resolution),
+    # one batch size: the families' round costs should track each other.
+    # Guarded: a Newt compile failure must not discard the DeviceDriver
+    # rows already measured above.
+    try:
+        from fantoch_tpu.run.device_runner import NewtDeviceDriver
+
+        newt_ms, newt_cps = measure(batch, NewtDeviceDriver)
+        out["serving_newt_round_ms"] = newt_ms
+        out["serving_newt_cmds_per_s"] = newt_cps
+    except Exception as exc:  # noqa: BLE001
+        print(f"# newt serving bench failed: {exc!r}", file=sys.stderr)
+        out["serving_newt_error"] = repr(exc)[:200]
     for other in (1024, 16384):
         if total < 2 * other:
             continue  # needs >= one steady-state round past the warm one
