@@ -40,6 +40,9 @@ def _run_point(params: dict) -> str:
     else:
         regions = sorted(planet.regions())[: params["n"]]
     assert len(regions) == params["n"], "one region per process"
+    assert 1 <= params["leader"] <= params["n"], (
+        f"--leader {params['leader']} out of range: process ids are 1..{params['n']}"
+    )
 
     config = Config(
         n=params["n"],
@@ -50,6 +53,10 @@ def _run_point(params: dict) -> str:
         # newt_config! macro always sets it, fantoch_ps/src/protocol/
         # mod.rs:65); harmless for the other protocols
         newt_detached_send_interval_ms=100,
+        # leader-based protocols need one (the reference's config! macro
+        # sets leader = 1 for fpaxos sims, fantoch_ps/src/protocol/
+        # mod.rs:698-716); ignored by the leaderless protocols
+        leader=params["leader"],
     )
     workload = Workload(
         shard_count=1,
@@ -109,6 +116,8 @@ def main(argv=None) -> None:
     parser.add_argument("--regions", default=None,
                         help="comma list of region names (default: first n)")
     parser.add_argument("--newt-tiny-quorums", action="store_true")
+    parser.add_argument("--leader", type=int, default=1,
+                        help="initial leader process id (leader-based protocols)")
     parser.add_argument("--seed", type=int, default=None)
     parser.add_argument("--parallel", type=int, default=1,
                         help="worker processes for the sweep (rayon analog)")
@@ -126,6 +135,7 @@ def main(argv=None) -> None:
             "dataset": args.dataset,
             "regions": args.regions.split(",") if args.regions else None,
             "tiny_quorums": args.newt_tiny_quorums,
+            "leader": args.leader,
             "seed": args.seed,
         }
         for clients in [int(c) for c in args.clients.split(",")]

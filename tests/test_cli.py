@@ -233,6 +233,23 @@ def test_cli_simulation_sweep():
             assert stats["mean_ms"] >= 0
 
 
+def test_cli_simulation_leader_based():
+    """Regression: the sim CLI must serve the leader-based protocol too
+    (it crashed without a leader in the Config; the reference's sim
+    configs always set leader = 1 for fpaxos)."""
+    out = run_tool(
+        "fantoch_tpu.bin.simulation",
+        [
+            "--protocol", "fpaxos", "-n", "3", "-f", "1",
+            "--clients", "1", "--commands-per-client", "5",
+        ],
+        timeout=240,
+    )
+    (line,) = [json.loads(l) for l in out.strip().splitlines() if l.startswith("{")]
+    assert line["protocol"] == "fpaxos"
+    assert all(s["issued"] == 5 for s in line["latency"].values())
+
+
 @pytest.mark.slow
 def test_cli_simulation_sweep_parallel_matches_sequential():
     # --parallel fans points over spawn workers (the rayon analog);
