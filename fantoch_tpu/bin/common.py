@@ -14,12 +14,29 @@ import os
 from typing import Dict, Optional, Tuple
 
 
-def force_platform_from_env() -> None:
-    """FANTOCH_PLATFORM=cpu forces the CPU backend before jax loads."""
+def force_platform_from_env(touches_default_backend: bool = True) -> None:
+    """FANTOCH_PLATFORM=cpu forces the CPU backend before jax loads.
+
+    ``touches_default_backend=False`` for entrypoints that always force
+    CPU themselves later (the simulation sweep's workers): no breadcrumb,
+    it would warn about a backend the run never touches."""
     if os.environ.get("FANTOCH_PLATFORM") == "cpu":
         from fantoch_tpu.hostenv import force_cpu_platform
 
         force_cpu_platform()
+    elif touches_default_backend:
+        import sys
+
+        # backend init on the default (TPU) platform can block
+        # indefinitely when the chip tunnel is down (hostenv.py
+        # postmortem) — leave a breadcrumb so a silent hang is
+        # diagnosable and escapable
+        print(
+            "# jax backend initializes on first use (default platform); "
+            "if this hangs, the TPU tunnel is unreachable — set "
+            "FANTOCH_PLATFORM=cpu to force the CPU backend",
+            file=sys.stderr,
+        )
 
 
 def protocol_by_name(name: str):

@@ -40,9 +40,6 @@ def _run_point(params: dict) -> str:
     else:
         regions = sorted(planet.regions())[: params["n"]]
     assert len(regions) == params["n"], "one region per process"
-    assert 1 <= params["leader"] <= params["n"], (
-        f"--leader {params['leader']} out of range: process ids are 1..{params['n']}"
-    )
 
     config = Config(
         n=params["n"],
@@ -100,7 +97,7 @@ def _run_point(params: dict) -> str:
 def main(argv=None) -> None:
     from fantoch_tpu.bin.common import force_platform_from_env
 
-    force_platform_from_env()
+    force_platform_from_env(touches_default_backend=False)
     parser = argparse.ArgumentParser(
         prog="fantoch_tpu.bin.simulation", description=__doc__
     )
@@ -122,6 +119,11 @@ def main(argv=None) -> None:
     parser.add_argument("--parallel", type=int, default=1,
                         help="worker processes for the sweep (rayon analog)")
     args = parser.parse_args(argv)
+    if not 1 <= args.leader <= args.processes:
+        parser.error(
+            f"--leader {args.leader} out of range: process ids are "
+            f"1..{args.processes}"
+        )
 
     points = [
         {
