@@ -233,6 +233,27 @@ def test_cli_simulation_sweep():
             assert stats["mean_ms"] >= 0
 
 
+@pytest.mark.slow
+def test_cli_exp_driver(tmp_path):
+    """The experiment-harness CLI (fantoch_exp bin/main analog): a
+    2-point client sweep through real localhost clusters, one manifest
+    line per point.  (ResultsDB indexing of sweep output is covered by
+    test_run_sweep_throughput_latency_curve.)"""
+    out = run_tool(
+        "fantoch_tpu.bin.exp",
+        [
+            "--protocol", "epaxos", "-n", "3", "-f", "1",
+            "--clients-sweep", "1,2", "--commands-per-client", "4",
+            "--output-dir", str(tmp_path / "exp"),
+        ],
+        timeout=420,
+    )
+    lines = [json.loads(l) for l in out.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 2
+    assert lines[0]["outcome"]["commands"] == 3 * 4
+    assert lines[1]["outcome"]["commands"] == 3 * 2 * 4
+
+
 def test_cli_simulation_leader_based():
     """Regression: the sim CLI must serve the leader-based protocol too
     (it crashed without a leader in the Config; the reference's sim
