@@ -228,3 +228,78 @@ def test_histogram():
     h2.increment(7)
     h.merge(h2)
     assert h.count == 6
+
+
+def test_zipf_key_gen_distribution():
+    """ZipfKeyGen (key_gen.rs:15,102-108): keys are ranks 1..keys_per_shard
+    x shard_count, low ranks dominate, and a higher coefficient skews
+    harder toward rank 1."""
+    import random as _random
+
+    from fantoch_tpu.client.key_gen import KeyGenState, ZipfKeyGen
+
+    def top1_share(coefficient, samples=20_000):
+        state = KeyGenState(
+            ZipfKeyGen(coefficient=coefficient, keys_per_shard=100),
+            shard_count=1, client_id=7, rng=_random.Random(3),
+        )
+        counts = {}
+        for _ in range(samples):
+            k = state.gen_cmd_key()
+            assert 1 <= int(k) <= 100
+            counts[k] = counts.get(k, 0) + 1
+        assert counts.get("1", 0) > counts.get("50", 0) > 0
+        return counts["1"] / samples
+
+    assert top1_share(2.0) > top1_share(1.0) > top1_share(0.5)
+
+
+def test_conflict_rate_boundaries_deterministic():
+    """conflict_rate 0/100 are deterministic (key_gen.rs:111-117)."""
+    import random as _random
+
+    from fantoch_tpu.client.key_gen import (
+        CONFLICT_COLOR,
+        ConflictRateKeyGen,
+        KeyGenState,
+    )
+
+    always = KeyGenState(ConflictRateKeyGen(100), 1, 5, rng=_random.Random(1))
+    never = KeyGenState(ConflictRateKeyGen(0), 1, 5, rng=_random.Random(1))
+    for _ in range(50):
+        assert always.gen_cmd_key() == CONFLICT_COLOR
+        assert never.gen_cmd_key() == "5"
+
+
+def test_zipf_workload_generates_multikey_commands():
+    """A zipf workload generates distinct-key commands whose target shard
+    is the first key's shard (workload.rs:136-177, 203)."""
+    import random as _random
+
+    from fantoch_tpu.client.key_gen import ZipfKeyGen
+    from fantoch_tpu.client.workload import Workload
+    from fantoch_tpu.core.ids import RiflGen
+
+    w = Workload(
+        shard_count=2,
+        key_gen=ZipfKeyGen(coefficient=1.0, keys_per_shard=50),
+        keys_per_command=2,
+        commands_per_client=20,
+        payload_size=4,
+    )
+    rifl_gen = RiflGen(9)
+    state = w.initial_key_gen_state(9, rng=_random.Random(11))
+    shards_seen = set()
+    while True:
+        out = w.next_cmd(rifl_gen, state)
+        if out is None:
+            break
+        shard, cmd = out
+        keys = sorted(k for s in cmd.shards() for k in cmd.keys(s))
+        assert len(keys) == 2 and keys[0] != keys[1]
+        # ops dicts preserve insertion order: the first inserted shard IS
+        # the first generated key's shard (the routing target)
+        assert shard == next(iter(cmd.shards()))
+        shards_seen.add(shard)
+    assert w.finished()
+    assert shards_seen == {0, 1}  # deterministic with Random(11)

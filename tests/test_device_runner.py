@@ -139,6 +139,32 @@ def test_device_runtime_multi_key_tcp():
     assert runtime.driver.in_flight == 0
 
 
+def test_device_runtime_zipf_workload_tcp():
+    """The zipf key generator end to end over TCP (the reference's other
+    key-gen family; conflict-rate covers the rest of the suite)."""
+    from fantoch_tpu.client.key_gen import ZipfKeyGen
+
+    config = Config(3, 1, shard_count=1)
+    workload = Workload(
+        shard_count=1,
+        key_gen=ZipfKeyGen(coefficient=1.0, keys_per_shard=64),
+        keys_per_command=1,
+        commands_per_client=COMMANDS_PER_CLIENT,
+        payload_size=1,
+    )
+    runtime, clients = asyncio.run(
+        run_device_server(config, workload, client_count=3, batch_size=16)
+    )
+    for client in clients.values():
+        assert client.issued_commands == COMMANDS_PER_CLIENT
+    driver = runtime.driver
+    assert driver.executed == 3 * COMMANDS_PER_CLIENT
+    assert driver.in_flight == 0
+    monitor = driver.store.monitor
+    # zipf keys are numeric ranks within keys_per_shard
+    assert all(1 <= int(k) <= 64 for k in monitor.keys())
+
+
 def test_newt_driver_hot_key_chain():
     """The Newt device driver orders a hot key by (clock, dot) and the
     key clock carries across rounds (second protocol family served)."""
