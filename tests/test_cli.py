@@ -254,6 +254,32 @@ def test_cli_exp_driver(tmp_path):
     assert lines[1]["outcome"]["commands"] == 3 * 2 * 4
 
 
+def test_cli_sequencer_bench():
+    """The key-clock sequencer microbenchmark CLI (sequencer_bench.rs
+    analog): both the host and device implementations report commands/s."""
+    out = run_tool(
+        "fantoch_tpu.bin.sequencer_bench",
+        ["--keys", "16", "--batch", "2000", "--iters", "1"],
+        timeout=240,
+    )
+    line = json.loads(out.strip().splitlines()[-1])
+    assert line["device_cmds_per_s"] > 0 and line["host_cmds_per_s"] > 0
+    assert line["keys"] == 16 and line["batch"] == 2000
+
+
+def test_cli_ordering_pool():
+    """The multi-process ordering pool CLI (the pool.rs scaling probe):
+    reports aggregate commands/s and the host's core count."""
+    out = run_tool(
+        "fantoch_tpu.bin.ordering_pool",
+        ["--commands", "5000", "--workers", "2"],
+        timeout=240,
+    )
+    line = json.loads(out.strip().splitlines()[-1])
+    assert line["commands"] == 5000 and line["workers"] == 2
+    assert line["cmds_per_s"] > 0 and line["cpus"] >= 1
+
+
 def test_cli_simulation_leader_based():
     """Regression: the sim CLI must serve the leader-based protocol too
     (it crashed without a leader in the Config; the reference's sim
