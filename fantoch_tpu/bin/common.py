@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import argparse
 import os
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 
 def force_platform_from_env(touches_default_backend: bool = True) -> None:

@@ -15,7 +15,7 @@ plus a bounded exception buffer — see fantoch_tpu/ops/frontier.py.
 
 from __future__ import annotations
 
-from typing import Dict, Generic, Hashable, Iterable, Iterator, List, Optional, Set, Tuple, TypeVar
+from typing import Dict, Generic, Hashable, Iterable, Iterator, Optional, Set, Tuple, TypeVar
 
 A = TypeVar("A", bound=Hashable)
 

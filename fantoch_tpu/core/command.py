@@ -7,8 +7,7 @@ results aggregate per-key op results until all keys have reported.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Dict, Iterator, Optional, Set, Tuple, TYPE_CHECKING
+from typing import Dict, Iterator, Tuple, TYPE_CHECKING
 
 from fantoch_tpu.core.ids import Rifl, ShardId
 from fantoch_tpu.core.kvs import KVOp, KVOpResult, Key, KVStore

@@ -13,7 +13,7 @@ from __future__ import annotations
 from abc import ABC, abstractmethod
 from dataclasses import dataclass
 from enum import Enum
-from typing import Generic, Iterator, List, NamedTuple, Optional, Tuple, TypeVar
+from typing import Generic, Iterator, NamedTuple, Optional, Tuple, TypeVar
 
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import ProcessId, Rifl, ShardId

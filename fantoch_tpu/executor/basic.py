@@ -7,7 +7,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import ProcessId, Rifl, ShardId

@@ -19,7 +19,7 @@ the host Tarjan oracle's stuck-residue walks.
 
 from __future__ import annotations
 
-from typing import Iterable, List, Tuple
+from typing import Iterable, List
 
 import numpy as np
 

@@ -7,7 +7,7 @@ throughput-latency curves and metrics tables, rendered with matplotlib
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 

@@ -12,12 +12,11 @@ fast/slow/stable metrics.
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from enum import Enum
 from typing import (
     Any,
     Dict,
-    FrozenSet,
     Generic,
     Iterator,
     List,

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Optional, Set
 
 from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.config import Config

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass
-from typing import Any, Deque, Dict, Optional, Set, Tuple
+from typing import Deque, Dict, Optional, Set, Tuple
 
 from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.config import Config

@@ -17,7 +17,7 @@ machine and the simulator tests.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Iterable, Iterator, List, Tuple
+from typing import Dict, Iterator, List, Tuple
 
 from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.ids import ProcessId, ShardId

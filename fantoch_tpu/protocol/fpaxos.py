@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass
-from typing import Any, Deque, Optional
+from typing import Deque, Optional
 
 from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.config import Config

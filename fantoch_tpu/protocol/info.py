@@ -11,7 +11,7 @@ distinction lives at the batching layer instead).
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Generic, Iterable, List, Tuple, TypeVar
+from typing import Callable, Dict, Generic, List, Tuple, TypeVar
 
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import Dot, ProcessId, ShardId

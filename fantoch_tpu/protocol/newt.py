@@ -32,7 +32,7 @@ shards — the data2 channel of partial.rs).
 from __future__ import annotations
 
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Deque, Dict, Optional, Set, Tuple
 
 from fantoch_tpu.core.command import Command
@@ -52,8 +52,6 @@ from fantoch_tpu.protocol.commit_gc import (
     CommitGCMixin,
     GarbageCollectionEvent,
     MCommitDot,
-    MGarbageCollection,
-    MStable,
 )
 from fantoch_tpu.protocol.common.synod import (
     MAccept,

@@ -12,10 +12,10 @@ from __future__ import annotations
 
 import asyncio
 from dataclasses import dataclass
-from typing import Any, List, Optional
+from typing import Any, List
 
 from fantoch_tpu.core.command import Command, CommandResult
-from fantoch_tpu.core.ids import ClientId, Dot, ProcessId, ShardId
+from fantoch_tpu.core.ids import ClientId, ProcessId, ShardId
 from fantoch_tpu.run.routing import WorkerIndex, resolve_index
 from fantoch_tpu.utils import logger
 

@@ -35,7 +35,7 @@ import random
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from fantoch_tpu.core.config import Config
-from fantoch_tpu.core.ids import AtomicIdGen, ClientId, ProcessId, Rifl, ShardId
+from fantoch_tpu.core.ids import AtomicIdGen, ClientId, ProcessId, ShardId
 from fantoch_tpu.core.timing import RunTime
 from fantoch_tpu.executor.aggregate import AggregatePending
 from fantoch_tpu.executor.base import ExecutorResult
