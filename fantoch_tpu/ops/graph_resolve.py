@@ -604,6 +604,11 @@ def resolve_general_staged(
     except RuntimeError:  # no cpu backend registered: keep the default
         _stage_dev = None
 
+    def _stage_ctx():
+        if _stage_dev is not None:
+            return jax.default_device(_stage_dev)
+        return contextlib.nullcontext()
+
     deps = np.asarray(deps, dtype=np.int32)
     batch, width = deps.shape
     idx32 = np.arange(batch, dtype=np.int32)
@@ -637,12 +642,7 @@ def resolve_general_staged(
             miss = np.concatenate([miss, np.zeros(pad, bool)])
             final = np.concatenate([final, np.ones(pad, bool)])  # inert
             rank_local = np.concatenate([rank_local, np.zeros(pad, np.int32)])
-        ctx = (
-            jax.default_device(_stage_dev)
-            if _stage_dev is not None
-            else contextlib.nullcontext()
-        )
-        with ctx:
+        with _stage_ctx():
             j_out = _peel_stage(
                 jnp.asarray(tgt), jnp.asarray(floor), jnp.asarray(miss),
                 jnp.asarray(final), jnp.asarray(rank_local),
