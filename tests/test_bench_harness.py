@@ -1,0 +1,100 @@
+"""Unit tests for bench.py's measurement harness.
+
+The benchmark is a driver-run artifact generator, so its *robustness*
+machinery is product behavior: the median-of-rounds slope fit and the
+TPU-record persistence gate both exist because one jitter-swamped
+two-point fit published a 0.129 ms primary where three same-day runs of
+the identical build said 2.3-3.0 ms (BENCH_DEV.md, session part 4).
+These tests pin that machinery without touching a device: the clock is
+scripted via monkeypatched ``time.perf_counter``.
+"""
+
+import json
+
+import bench
+
+
+def _scripted_clock(monkeypatch, durations_ms):
+    """perf_counter returns cumulative times so consecutive (t0, t1)
+    pairs measure exactly the scripted durations, in order."""
+    ticks = [0.0]
+    for d in durations_ms:
+        ticks.append(ticks[-1])  # t0 of the next measurement
+        ticks.append(ticks[-2] + d / 1000.0)  # t1 = t0 + duration
+    it = iter(ticks[1:])
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: next(it))
+
+
+def test_slope_timed_median_of_rounds(monkeypatch):
+    # rounds=3, iters=1: measurement order is lo,hi, lo,hi, lo,hi after
+    # two untimed warm calls.  One wild hi outlier must not drag the
+    # slope: per-round slopes are (2.0, 42.0, 2.0) ms/step -> median 2.0.
+    durations = [100.0, 108.0, 100.0, 268.0, 100.0, 108.0]
+    _scripted_clock(monkeypatch, durations)
+    slope, lo, hi = bench.slope_timed(lambda k: 0.0, 1, 5, iters=1, rounds=3)
+    assert slope is not None
+    assert abs(slope - 2.0) < 1e-9
+    assert abs(lo - 100.0) < 1e-9
+    assert abs(hi - 108.0) < 1e-9
+
+
+def test_slope_timed_noise_negative_returns_none(monkeypatch):
+    # hi consistently BELOW lo (pure jitter): the fit must refuse to
+    # fabricate a near-zero latency and signal failure instead
+    durations = [100.0, 99.0, 100.0, 98.0, 100.0, 99.5]
+    _scripted_clock(monkeypatch, durations)
+    slope, lo, hi = bench.slope_timed(lambda k: 0.0, 1, 5, iters=1, rounds=3)
+    assert slope is None
+    assert lo > hi
+
+
+def test_tpu_record_gate(tmp_path, monkeypatch):
+    path = tmp_path / "BENCH_TPU_LATEST.json"
+    monkeypatch.setattr(bench, "_TPU_RECORD_PATH", str(path))
+
+    # non-tpu records never persist
+    bench._save_tpu_record(json.dumps({"platform": "cpu", "value": 1.0}))
+    assert not path.exists()
+
+    # a chip record without its scale cross-check does not persist: the
+    # 4M row is the primary slope's independent witness
+    bench._save_tpu_record(json.dumps({"platform": "tpu", "value": 0.129}))
+    assert not path.exists()
+
+    # a wildly-off ratio (the observed 88.1 incident) does not persist
+    bench._save_tpu_record(
+        json.dumps({"platform": "tpu", "value": 0.129, "scale_vs_1m": 88.1})
+    )
+    assert not path.exists()
+
+    # a self-consistent record persists and gets a UTC stamp
+    bench._save_tpu_record(
+        json.dumps({"platform": "tpu", "value": 2.977, "scale_vs_1m": 3.42})
+    )
+    assert path.exists()
+    rec = json.loads(path.read_text())
+    assert rec["value"] == 2.977
+    assert "recorded_utc" in rec
+
+    # ... and a later gated record must NOT overwrite it
+    bench._save_tpu_record(
+        json.dumps({"platform": "tpu", "value": 0.2, "scale_vs_1m": 50.0})
+    )
+    assert json.loads(path.read_text())["value"] == 2.977
+
+
+def test_attach_last_tpu_embeds_without_touching_value(tmp_path, monkeypatch):
+    path = tmp_path / "BENCH_TPU_LATEST.json"
+    monkeypatch.setattr(bench, "_TPU_RECORD_PATH", str(path))
+    bench._save_tpu_record(
+        json.dumps({"platform": "tpu", "value": 2.977, "scale_vs_1m": 3.42})
+    )
+
+    cpu_line = json.dumps({"platform": "cpu", "value": 396.8})
+    out = json.loads(bench._attach_last_tpu(cpu_line))
+    assert out["value"] == 396.8  # the CPU measurement stays the value
+    assert out["last_tpu_record"]["value"] == 2.977
+
+    # a tpu record passes through untouched (no self-embedding)
+    tpu_line = json.dumps({"platform": "tpu", "value": 2.9})
+    assert json.loads(bench._attach_last_tpu(tpu_line)) == json.loads(tpu_line)
