@@ -26,25 +26,39 @@ class Command:
         self._rifl = rifl
         self._shard_to_ops = shard_to_ops
         # read_only inference (fantoch/src/command.rs:28-36): a command is
-        # read-only iff every op on every key is a read.
-        all_ops = [
-            op
-            for ops in shard_to_ops.values()
-            for key_ops in ops.values()
-            for op in key_ops
-        ]
-        self._read_only = all(op.is_read for op in all_ops)
+        # read-only iff every op on every key is a read.  One pass over the
+        # ops — this constructor sits on the client submit path, so no
+        # intermediate list / multiple scans.
+        reads = 0
+        writes = 0
+        total = 0
+        for ops in shard_to_ops.values():
+            total += len(ops)
+            for key_ops in ops.values():
+                for op in key_ops:
+                    if op.is_read:
+                        reads += 1
+                    else:
+                        writes += 1
+        self._read_only = writes == 0
         # reference invariant (fantoch/src/command.rs:32-41): either all ops
         # are reads or none are — mixed commands break read-only fast paths
-        if not self._read_only:
-            assert not any(
-                op.is_read for op in all_ops
-            ), "non-read-only commands cannot contain Get operations"
-        self._total_key_count = sum(len(ops) for ops in shard_to_ops.values())
+        assert reads == 0 or writes == 0, (
+            "non-read-only commands cannot contain Get operations"
+        )
+        self._total_key_count = total
 
     @staticmethod
     def from_single(rifl: Rifl, shard_id: ShardId, key: Key, op: KVOp) -> "Command":
-        return Command(rifl, {shard_id: {key: (op,)}})
+        # the dominant wire shape (one shard, one key, one op): the general
+        # scan above degenerates to constants, so skip it — single-op
+        # commands cannot violate the mixed-ops invariant
+        cmd = Command.__new__(Command)
+        cmd._rifl = rifl
+        cmd._shard_to_ops = {shard_id: {key: (op,)}}
+        cmd._read_only = op.is_read
+        cmd._total_key_count = 1
+        return cmd
 
     @staticmethod
     def from_keys(rifl: Rifl, shard_id: ShardId, key_ops: Dict[Key, Tuple[KVOp, ...]]) -> "Command":
