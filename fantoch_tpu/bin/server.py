@@ -34,8 +34,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--protocol", required=True,
                         help="basic|epaxos|atlas|newt|caesar|fpaxos; with "
                         "--device-step the protocol round runs as one device "
-                        "program: 'newt' serves the timestamp-consensus round, "
-                        "anything else the EPaxos-style dep-commit round")
+                        "program: 'newt' the timestamp-consensus round, "
+                        "'caesar' the timestamp+predecessors round, 'fpaxos' "
+                        "the leader-based slot round, anything else the "
+                        "EPaxos-style dep-commit round")
     parser.add_argument("--id", type=int, default=None,
                         help="process id (required without --device-step)")
     parser.add_argument("--shard-id", type=int, default=0)
