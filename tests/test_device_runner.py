@@ -1151,7 +1151,7 @@ def test_pipelined_gid_reset_flushes_outstanding():
     assert len(order) == len(set(order)) == 2
 
 
-@pytest.mark.parametrize("protocol", ["epaxos", "newt", "fpaxos"])
+@pytest.mark.parametrize("protocol", ["epaxos", "newt", "caesar", "fpaxos"])
 def test_device_runtime_pipelined_tcp_serving(protocol):
     """Saturated serving engages the pipelined loop (batch_size smaller
     than the standing queue) and still answers every client with per-key
