@@ -1,0 +1,122 @@
+"""Multi-host (replica, batch) meshes: DCN for quorums, ICI for batches.
+
+The reference scales across machines with one NCCL/MPI-style TCP link per
+replica pair (fantoch/src/run/mod.rs:105-445 — every process connects to
+every peer); collectives do not exist, so topology never matters. Here the
+device plane IS collective (parallel/mesh_step.py), so on a multi-host
+TPU deployment the mesh layout decides which interconnect each collective
+rides:
+
+* the **replica axis carries the quorum fan-ins** — masked ``pmax/pmin``
+  agreement, ``psum`` accept counts, GC stability ``pmin`` — all small
+  frontier-shaped reductions that model WAN consensus rounds in the first
+  place.  They are latency-bound and tiny, exactly what DCN (between
+  hosts) is acceptable for; replicas are also distinct failure domains,
+  which only makes sense across hosts.
+* the **batch axis carries the bandwidth** — the per-shard sorts, gathers
+  and scatters over the command batch.  Those want ICI, i.e. must stay
+  within one host's chips.
+
+``make_multihost_mesh`` therefore maps processes (hosts) to the replica
+axis and each host's local chips to the batch axis.  ``make_mesh``
+(mesh_step.py) keeps its single-host behavior; this module is additive
+and degrades to it when only one process is present, so everything
+dryrun/CI runs today is unchanged.
+
+Bootstrap: on real multi-host slices call :func:`distributed_init` (a
+thin, idempotent gate around ``jax.distributed.initialize``) on every
+host before building the mesh — the standard jax multi-controller
+recipe.  Every driver in run/device_runner.py accepts ``mesh=`` and every
+``init_*_state``/``jit_*_step`` in mesh_step.py takes the mesh it is
+given, so a multi-host mesh drops into the existing serving stack
+unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from fantoch_tpu.parallel.mesh_step import (
+    BATCH_AXIS,
+    REPLICA_AXIS,
+    Mesh,
+    make_mesh,
+)
+
+_DISTRIBUTED_INITIALIZED = False
+
+
+def distributed_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Idempotently initialize jax's multi-controller runtime.
+
+    Returns True when ``jax.distributed.initialize`` ran (or had already
+    run via this gate), False when single-process operation was detected
+    (no coordinator and no cluster env) and nothing was done — callers can
+    use the same code path on laptops, CI and pods.
+    """
+    global _DISTRIBUTED_INITIALIZED
+    if _DISTRIBUTED_INITIALIZED:
+        return True
+    import os
+
+    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
+        # no explicit coordinator and no cluster environment: single host
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _DISTRIBUTED_INITIALIZED = True
+    return True
+
+
+def group_by_process(devices: Sequence) -> list:
+    """Group a device list by ``process_index``, each group sorted by
+    device id, groups ordered by process index.  Raises on ragged
+    topologies (hosts with different chip counts) — a mesh needs a
+    rectangle, and a ragged slice means the deployment is broken."""
+    by_proc: dict = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    groups = [
+        sorted(by_proc[p], key=lambda d: d.id) for p in sorted(by_proc)
+    ]
+    sizes = {len(g) for g in groups}
+    if len(sizes) > 1:
+        raise ValueError(
+            f"ragged multi-host topology: per-host chip counts {sorted(sizes)}"
+        )
+    return groups
+
+
+def make_multihost_mesh(num_replicas: Optional[int] = None) -> Mesh:
+    """(replica, batch) mesh with hosts on the replica axis.
+
+    Single-process: defers to ``make_mesh`` (identical behavior, so CI /
+    dryrun / the virtual-device suite are unaffected).  Multi-process:
+    process p's chips form row p — the replica axis crosses hosts (DCN,
+    quorum fan-ins), the batch axis stays on-host (ICI, batch sorts).
+    When ``num_replicas`` is given it must be a multiple of the host
+    count, mirroring ``make_mesh``'s divisibility contract
+    (init_state shards whole replica blocks per row).
+    """
+    import numpy as np
+
+    devices = jax.devices()
+    groups = group_by_process(devices)
+    if len(groups) == 1:
+        return make_mesh(num_replicas=num_replicas)
+    if num_replicas is not None and num_replicas % len(groups) != 0:
+        raise ValueError(
+            f"num_replicas={num_replicas} must be a multiple of the host "
+            f"count {len(groups)} (whole replica blocks per mesh row)"
+        )
+    dev_array = np.array(groups)  # (hosts, chips_per_host)
+    return Mesh(dev_array, (REPLICA_AXIS, BATCH_AXIS))
