@@ -64,6 +64,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--device-pending", type=int, default=256,
                         help="device pending-buffer capacity")
     parser.add_argument(
+        "--multihost", action="store_true",
+        help="build the device mesh topology-aware for multi-host slices "
+        "(parallel/multihost.py): hosts on the replica axis (quorum "
+        "fan-ins ride DCN), each host's chips on the batch axis (sorts "
+        "ride ICI); bootstraps jax.distributed when a coordinator is "
+        "configured, degrades to the single-host mesh otherwise")
+    parser.add_argument(
         "--addresses",
         default=None,
         help="comma list of pid=host:port[:delay_ms] for every peer this "
@@ -102,6 +109,15 @@ async def serve_device_step(args: argparse.Namespace) -> None:
     protocol_by_name(args.protocol)  # validate the label even when unused
     config = config_from_args(args)
     process_id = args.id if args.id is not None else 1
+    mesh = None
+    if args.multihost:
+        from fantoch_tpu.parallel.multihost import (
+            distributed_init,
+            make_multihost_mesh,
+        )
+
+        distributed_init()
+        mesh = make_multihost_mesh(num_replicas=config.n)
     runtime = DeviceRuntime(
         config,
         (args.ip, args.client_port),
@@ -116,6 +132,7 @@ async def serve_device_step(args: argparse.Namespace) -> None:
         metrics_interval_ms=args.metrics_interval,
         pipeline=None if args.device_pipeline == "auto"
         else args.device_pipeline == "on",
+        mesh=mesh,
     )
     await runtime.start()
     print(

@@ -169,13 +169,17 @@ def test_cli_device_step_sharded(tmp_path):
 
 def test_cli_device_step_server(tmp_path):
     """The TPU serving path from the shell: one --device-step server, the
-    stock client binary against it (same wire protocol)."""
+    stock client binary against it (same wire protocol).  --multihost
+    exercises the topology-aware mesh builder's CLI wiring; on this
+    single-process backend it degrades to the stock mesh by contract
+    (tests/test_multihost.py pins both layouts)."""
     port = free_port()
     server = subprocess.Popen(
         [
             sys.executable, "-m", "fantoch_tpu.bin.server",
             "--protocol", "epaxos",
             "--device-step",
+            "--multihost",
             "--client-port", str(port),
             "--device-batch", "32",
             "-n", "3", "-f", "1",
