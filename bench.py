@@ -386,30 +386,44 @@ def bench_local_pool(total: int = 1 << 19, conflict: float = 0.5):
     from fantoch_tpu.run.local_pool import OrderingPool
 
     out = {"pool_total": total, "pool_cpus": mp.cpu_count()}
-    # two disjoint dot ranges: chunk A warms each worker's compile/native
-    # load, chunk B is the measured run (re-adding the same dots would
-    # violate the committed-once invariant)
+    # disjoint dot ranges: chunk A warms each worker's compile/native
+    # load, chunks B and C are measured runs (re-adding the same dots
+    # would violate the committed-once invariant).  Each arm takes the
+    # better of the two measured chunks: one measured run per arm once
+    # recorded pool_scaling_4w = 2.92 on a ONE-core host — the 1w arm had
+    # absorbed a burst of unrelated host activity, and a single sample
+    # can't tell that from real scaling.
     key_a, dep_a, src_a, seq_a = build_workload(total, conflict, seed=21)
-    key_b, dep_b, src_b, seq_b = build_workload(total, conflict, seed=22)
+    measured = [
+        build_workload(total, conflict, seed=22),
+        build_workload(total, conflict, seed=23),
+    ]
     thr = {}
     for workers in (1, 4):
         shards_a = OrderingPool.shard_columns(
             key_a, src_a.astype(np.int64), seq_a.astype(np.int64) + 1,
             dep_a.astype(np.int64), workers,
         )
-        shards_b = OrderingPool.shard_columns(
-            key_b, src_b.astype(np.int64),
-            seq_b.astype(np.int64) + 1 + total,
-            dep_b.astype(np.int64), workers,
-        )
+        shard_runs = [
+            OrderingPool.shard_columns(
+                key_m, src_m.astype(np.int64),
+                seq_m.astype(np.int64) + 1 + (i + 1) * total,
+                dep_m.astype(np.int64), workers,
+            )
+            for i, (key_m, dep_m, src_m, seq_m) in enumerate(measured)
+        ]
+        all_shards = shards_a + [s for run in shard_runs for s in run]
         with OrderingPool(workers) as pool:
-            pool.prepare(max(len(s[0]) for s in shards_a + shards_b))
+            pool.prepare(max(len(s[0]) for s in all_shards))
             pool.run_shards(shards_a)  # warm
-            t0 = time.perf_counter()
-            orders = pool.run_shards(shards_b)
-            dt = time.perf_counter() - t0
-        executed = sum(len(src) for src, _ in orders)
-        assert executed == total, f"pool ordered {executed}/{total}"
+            dt = None
+            for shards_m in shard_runs:
+                t0 = time.perf_counter()
+                orders = pool.run_shards(shards_m)
+                run_dt = time.perf_counter() - t0
+                executed = sum(len(src) for src, _ in orders)
+                assert executed == total, f"pool ordered {executed}/{total}"
+                dt = run_dt if dt is None else min(dt, run_dt)
         thr[workers] = total / dt
         out[f"pool_ms_{workers}w"] = round(dt * 1000.0, 1)
         out[f"pool_cmds_per_s_{workers}w"] = int(thr[workers])
