@@ -392,12 +392,13 @@ def test_device_runtime_sharded_tcp_cluster():
     assert runtime.failure is None
 
 
-def test_device_runtime_sharded_pipelined_tcp_cluster():
+@pytest.mark.parametrize("protocol", ["epaxos", "newt"])
+def test_device_runtime_sharded_pipelined_tcp_cluster(protocol):
     """Sharded serving through the pipelined dispatch/drain loop: the
-    pipelining scaffold lives in the shared driver core, so the sharded
-    epaxos-class driver must serve saturated multi-shard traffic with
-    cross-shard dependencies intact — the missing cell of the
-    (sharded x pipelined) matrix."""
+    pipelining scaffold lives in the shared driver core, so both sharded
+    drivers (dep-commit and Newt timestamp) must serve saturated
+    multi-shard traffic with cross-shard dependencies intact — the
+    missing cells of the (sharded x pipelined) matrix."""
     config = Config(3, 1, shard_count=2)
     workload = Workload(
         shard_count=2,
@@ -411,6 +412,7 @@ def test_device_runtime_sharded_pipelined_tcp_cluster():
             config, workload, client_count=4, batch_size=8,
             key_width=2, key_buckets=64,
             open_loop_interval_ms=1,
+            protocol=protocol,
             pipeline=True,  # auto would disable it on the CPU test backend
         )
     )
