@@ -49,6 +49,11 @@ def main(argv=None) -> None:
                         default="conflict_rate")
     parser.add_argument("--zipf-coefficient", type=float, default=1.0)
     parser.add_argument("--batched-graph-executor", action="store_true")
+    parser.add_argument("--device-step", action="store_true",
+                        help="run the experiment against one --device-step "
+                        "server (the TPU serving path) instead of an "
+                        "n-process TCP mesh")
+    parser.add_argument("--device-batch", type=int, default=256)
     parser.add_argument("--run-mode",
                         choices=["release", "cprofile", "memory"],
                         default="release")
@@ -74,6 +79,8 @@ def main(argv=None) -> None:
         zipf_coefficient=args.zipf_coefficient,
         keys_per_command=args.keys_per_command,
         batched_graph_executor=args.batched_graph_executor,
+        device_step=args.device_step,
+        device_batch=args.device_batch,
     )
     testbed = "localhost"
     if args.hosts:

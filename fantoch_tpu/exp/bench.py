@@ -110,10 +110,19 @@ def _run_experiment_testbed(
     testbed.stage()
     testbed.prepare(exp_dir)
 
-    shard_ids = {s: list(process_ids(s, config.n)) for s in range(config.shard_count)}
-    all_pids = [(pid, s) for s, ids in shard_ids.items() for pid in ids]
-    offset_of = {pid: pid - shard_ids[s][0] for pid, s in all_pids}
-    host_of = {pid: i for i, (pid, _s) in enumerate(all_pids)}
+    if config.device_step:
+        # TPU serving path: ONE server hosts the whole (replica x batch)
+        # mesh — no peer processes, no peer mesh; clients open one
+        # connection per shard, all to the same address
+        shard_ids = {0: [1]}
+        all_pids = [(1, 0)]
+        offset_of = {1: 0}
+        host_of = {1: 0}
+    else:
+        shard_ids = {s: list(process_ids(s, config.n)) for s in range(config.shard_count)}
+        all_pids = [(pid, s) for s, ids in shard_ids.items() for pid in ids]
+        offset_of = {pid: pid - shard_ids[s][0] for pid, s in all_pids}
+        host_of = {pid: i for i, (pid, _s) in enumerate(all_pids)}
 
     servers = []
     logs = []
@@ -122,28 +131,33 @@ def _run_experiment_testbed(
     monitor.start()
     try:
         for pid, shard in all_pids:
-            ids = shard_ids[shard]
-            offset = offset_of[pid]
-            peers = [p for p in ids if p != pid]
-            sorted_entries = [f"{pid}:{shard}"] + [f"{p}:{shard}" for p in peers]
-            for other, other_ids in shard_ids.items():
-                if other != shard:
-                    closest = other_ids[offset]
-                    peers.append(closest)
-                    sorted_entries.append(f"{closest}:{other}")
-            addresses = ",".join(
-                f"{p}={testbed.addr(host_of[p])}:{testbed.peer_port(p)}"
-                for p in peers
-            )
-            args = config.server_args(
-                pid,
-                shard,
-                testbed.peer_port(pid),
-                testbed.client_port(pid),
-                addresses,
-                ",".join(sorted_entries),
-                observe_dir=_RESULTS_REL,  # workdir-relative; pulled below
-            )
+            if config.device_step:
+                args = config.device_server_args(
+                    testbed.client_port(pid), observe_dir=_RESULTS_REL
+                )
+            else:
+                ids = shard_ids[shard]
+                offset = offset_of[pid]
+                peers = [p for p in ids if p != pid]
+                sorted_entries = [f"{pid}:{shard}"] + [f"{p}:{shard}" for p in peers]
+                for other, other_ids in shard_ids.items():
+                    if other != shard:
+                        closest = other_ids[offset]
+                        peers.append(closest)
+                        sorted_entries.append(f"{closest}:{other}")
+                addresses = ",".join(
+                    f"{p}={testbed.addr(host_of[p])}:{testbed.peer_port(p)}"
+                    for p in peers
+                )
+                args = config.server_args(
+                    pid,
+                    shard,
+                    testbed.peer_port(pid),
+                    testbed.client_port(pid),
+                    addresses,
+                    ",".join(sorted_entries),
+                    observe_dir=_RESULTS_REL,  # workdir-relative; pulled below
+                )
             log = open(os.path.join(exp_dir, f"server_p{pid}.log"), "w")
             logs.append(log)
             servers.append(
@@ -170,11 +184,17 @@ def _run_experiment_testbed(
             )
 
         # clients run on the driver machine against the offset-0 process of
-        # every shard
-        client_addresses = ",".join(
-            f"{s}={testbed.addr(host_of[ids[0]])}:{testbed.client_port(ids[0])}"
-            for s, ids in shard_ids.items()
-        )
+        # every shard (device-step: every shard lives on the one server)
+        if config.device_step:
+            one = f"{testbed.addr(0)}:{testbed.client_port(1)}"
+            client_addresses = ",".join(
+                f"{s}={one}" for s in range(config.shard_count)
+            )
+        else:
+            client_addresses = ",".join(
+                f"{s}={testbed.addr(host_of[ids[0]])}:{testbed.client_port(ids[0])}"
+                for s, ids in shard_ids.items()
+            )
         n_clients = config.clients_per_process * config.n
         client = subprocess.run(
             [
@@ -220,7 +240,11 @@ def _run_experiment_testbed(
 
     # pull per-process artifacts back from the machines that produced them
     pulled = []
-    suffixes = ["metrics_p{pid}.gz", "execution_p{pid}.log"]
+    if config.device_step:
+        # the device server's tallies are JSON; there is no execution log
+        suffixes = ["metrics_p{pid}.json"]
+    else:
+        suffixes = ["metrics_p{pid}.gz", "execution_p{pid}.log"]
     if run_mode in _PROFILE_ARTIFACTS:
         suffixes.append(_PROFILE_ARTIFACTS[run_mode])
     for pid, _shard in all_pids:

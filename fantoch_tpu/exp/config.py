@@ -35,6 +35,15 @@ class ExperimentConfig:
     multiplexing: int = 1
     batched_graph_executor: bool = False
     gc_interval_ms: int = 50
+    # TPU serving path: one --device-step server (the whole protocol
+    # round as a device program) instead of an n-process TCP mesh; the
+    # same client binary, results pipeline and plots apply
+    device_step: bool = False
+    device_batch: int = 256
+    # None derives from keys_per_command — the device state must admit as
+    # many key buckets per command as the workload sends, or the server
+    # rejects the commands; an explicit value still overrides
+    device_key_width: Optional[int] = None
     extra_flags: Tuple[str, ...] = field(default_factory=tuple)
 
     def name(self) -> str:
@@ -44,8 +53,9 @@ class ExperimentConfig:
             if self.key_gen == "conflict_rate"
             else f"zipf{self.zipf_coefficient}"
         )
+        dev = "dev_" if self.device_step else ""
         return (
-            f"{self.protocol}_n{self.n}_f{self.f}_s{self.shard_count}_"
+            f"{dev}{self.protocol}_n{self.n}_f{self.f}_s{self.shard_count}_"
             f"{kg}_k{self.keys_per_command}_c{self.clients_per_process}"
         )
 
@@ -91,6 +101,32 @@ class ExperimentConfig:
                 "--metrics-file", f"{observe_dir}/metrics_p{process_id}.gz",
                 "--metrics-interval", "500",
                 "--execution-log", f"{observe_dir}/execution_p{process_id}.log",
+            ]
+        args += list(self.extra_flags)
+        return args
+
+    def device_server_args(
+        self, client_port: int, observe_dir: Optional[str] = None
+    ) -> List[str]:
+        """Flags for the single --device-step server (the TPU serving
+        path): no peer mesh, no worker pools — the round is one device
+        program; metrics are the serving JSON tallies."""
+        args = [
+            "--protocol", self.protocol,
+            "--device-step",
+            "--id", "1",
+            "--client-port", str(client_port),
+            "-n", str(self.n),
+            "-f", str(self.f),
+            "--shard-count", str(self.shard_count),
+            "--device-batch", str(self.device_batch),
+            "--device-key-width",
+            str(self.device_key_width or self.keys_per_command),
+        ]
+        if observe_dir:
+            args += [
+                "--metrics-file", f"{observe_dir}/metrics_p1.json",
+                "--metrics-interval", "500",
             ]
         args += list(self.extra_flags)
         return args

@@ -23,6 +23,9 @@ class ExperimentResult:
     outcome: Dict[str, Any]
     _client_data: Optional[Dict] = field(default=None, repr=False)
     _metrics: Optional[Dict[int, Any]] = field(default=None, repr=False)
+    _device_tallies: Optional[Dict[int, Dict[str, int]]] = field(
+        default=None, repr=False
+    )
 
     @property
     def name(self) -> str:
@@ -49,8 +52,25 @@ class ExperimentResult:
                 self._metrics[pid] = read_metrics_snapshot(path)
         return self._metrics
 
+    def device_tallies(self) -> Dict[int, Dict[str, int]]:
+        """pid -> device-serving JSON tallies (run/device_runner.py
+        ``--metrics-file``: rounds/executed/fast_paths/slow_paths/...).
+        Empty for object-runner experiments, whose metrics are the
+        gzip+pickle ProcessMetrics indexed by :meth:`process_metrics`."""
+        if self._device_tallies is None:
+            self._device_tallies = {}
+            for path in glob.glob(os.path.join(self.path, "metrics_p*.json")):
+                pid = int(os.path.basename(path)[len("metrics_p"):-len(".json")])
+                with open(path) as fh:
+                    self._device_tallies[pid] = json.load(fh)
+        return self._device_tallies
+
     def protocol_totals(self) -> Dict[str, int]:
-        """Summed fast/slow/stable counters across processes."""
+        """Summed fast/slow/stable counters across processes.  Device
+        experiments contribute their fast_paths/slow_paths tallies;
+        ``stable`` stays 0 there (the device plane tracks a stability
+        *watermark*, not a per-command stable count — see
+        ``device_tallies`` for the raw record)."""
         from fantoch_tpu.protocol import ProtocolMetricsKind
 
         totals = {"fast_path": 0, "slow_path": 0, "stable": 0}
@@ -65,6 +85,9 @@ class ExperimentResult:
                 totals["stable"] += (
                     worker.get_aggregated(ProtocolMetricsKind.STABLE) or 0
                 )
+        for tallies in self.device_tallies().values():
+            totals["fast_path"] += tallies.get("fast_paths", 0)
+            totals["slow_path"] += tallies.get("slow_paths", 0)
         return totals
 
 

@@ -2,6 +2,7 @@
 fantoch_plot analogs): run real localhost experiments through the CLI
 binaries, index the results, render plots."""
 
+import json
 import os
 
 import pytest
@@ -46,6 +47,35 @@ def test_run_experiment_cprofile_mode(tmp_path):
         body = open(txt).read()
         assert "cumulative" in body and "function calls" in body
     assert manifest["outcome"]["commands"] == 4 * 3
+
+
+def test_run_experiment_device_step(tmp_path):
+    """The TPU serving path through the experiment layer: one
+    --device-step server instead of an n-process mesh, the stock client
+    binary, the serving JSON tallies pulled as the metrics artifact and
+    indexed by the plot layer.  keys_per_command=2 with no explicit
+    device_key_width pins the width derivation (an under-sized device
+    state would reject every 2-key command)."""
+    cfg = ExperimentConfig(
+        "epaxos", 3, 1, commands_per_client=4, conflict_rate=50,
+        keys_per_command=2, device_step=True, device_batch=32,
+    )
+    out = str(tmp_path / "dev")
+    manifest = run_experiment(cfg, out)
+    assert manifest["outcome"]["commands"] == 4 * 3
+    exp_dir = os.path.join(out, cfg.name())
+    assert cfg.name().startswith("dev_")
+    assert os.path.exists(os.path.join(exp_dir, "client_summary.json"))
+    metrics = os.path.join(exp_dir, "metrics_p1.json")
+    assert os.path.exists(metrics), "device tallies not pulled"
+    snap = json.load(open(metrics))
+    assert snap["executed"] >= 1 and snap["rounds"] >= 1
+    # the plot layer indexes the device tallies (fast/slow paths)
+    db = ResultsDB(out)
+    (res,) = db.results
+    assert res.device_tallies()[1]["executed"] == snap["executed"]
+    totals = res.protocol_totals()
+    assert totals["fast_path"] + totals["slow_path"] >= 1
 
 
 @pytest.mark.slow
