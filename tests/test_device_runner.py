@@ -165,6 +165,35 @@ def test_device_runtime_zipf_workload_tcp():
     assert all(1 <= int(k) <= 64 for k in monitor.keys())
 
 
+def test_device_runtime_read_mix_tcp():
+    """Mixed read/write workload through the device plane: the device
+    round orders read-only commands conservatively (by conflict key,
+    like writes — the _LatestRW read optimization is a host-KeyDeps
+    refinement, not a device-plane one), and gets execute against the
+    KVStore through the serving path without wedging any client."""
+    config = Config(3, 1, shard_count=1)
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(100),  # every command on the hot key
+        keys_per_command=1,
+        commands_per_client=COMMANDS_PER_CLIENT,
+        payload_size=4,
+        read_only_percentage=50,
+    )
+    runtime, clients = asyncio.run(
+        run_device_server(config, workload, client_count=3, batch_size=16)
+    )
+    for client in clients.values():
+        assert client.issued_commands == COMMANDS_PER_CLIENT
+    driver = runtime.driver
+    assert driver.executed == 3 * COMMANDS_PER_CLIENT
+    assert driver.in_flight == 0
+    monitor = driver.store.monitor
+    order = monitor.get_order("CONFLICT")  # the hot key (key_gen.py:18)
+    assert len(order) == len(set(order)) == 3 * COMMANDS_PER_CLIENT
+    assert runtime.failure is None
+
+
 def test_newt_driver_hot_key_chain():
     """The Newt device driver orders a hot key by (clock, dot) and the
     key clock carries across rounds (second protocol family served)."""
