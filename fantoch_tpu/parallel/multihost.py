@@ -65,7 +65,12 @@ def distributed_init(
         return True
     import os
 
-    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
+    # cluster hints jax.distributed.initialize can auto-detect from
+    # (explicit coordinator > jax's own env > SLURM > TPU pod metadata)
+    cluster_env = ("JAX_COORDINATOR_ADDRESS", "SLURM_JOB_ID", "TPU_WORKER_HOSTNAMES")
+    if coordinator_address is None and not any(
+        v in os.environ for v in cluster_env
+    ):
         # no explicit coordinator and no cluster environment: single host
         return False
     jax.distributed.initialize(
