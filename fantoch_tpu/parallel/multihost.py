@@ -44,6 +44,7 @@ from fantoch_tpu.parallel.mesh_step import (
     Mesh,
     make_mesh,
 )
+from fantoch_tpu.utils import logger
 
 _DISTRIBUTED_INITIALIZED = False
 
@@ -73,11 +74,24 @@ def distributed_init(
     ):
         # no explicit coordinator and no cluster environment: single host
         return False
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError) as exc:
+        if coordinator_address is not None:
+            raise  # the operator asked for a specific cluster: fail loudly
+        # a half-present cluster env (e.g. a single-chip rig that sets
+        # TPU_WORKER_HOSTNAMES) from which jax cannot derive a
+        # coordinator: fall back to single-host rather than killing the
+        # server over a hint
+        logger.warning(
+            "cluster env detected but jax.distributed could not "
+            "initialize (%r); continuing single-host", exc,
+        )
+        return False
     _DISTRIBUTED_INITIALIZED = True
     return True
 

@@ -85,6 +85,26 @@ def test_multihost_divisibility_contract(monkeypatch):
 def test_distributed_init_noop_without_cluster(monkeypatch):
     import fantoch_tpu.parallel.multihost as mh
 
-    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    for var in ("JAX_COORDINATOR_ADDRESS", "SLURM_JOB_ID", "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
     monkeypatch.setattr(mh, "_DISTRIBUTED_INITIALIZED", False)
     assert mh.distributed_init() is False
+
+
+def test_distributed_init_survives_half_present_cluster_env(monkeypatch):
+    """A rig that sets TPU_WORKER_HOSTNAMES without a derivable
+    coordinator (the single-chip axon host does exactly this) must fall
+    back to single-host, not kill the server over a hint."""
+    import fantoch_tpu.parallel.multihost as mh
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    monkeypatch.setattr(mh, "_DISTRIBUTED_INITIALIZED", False)
+
+    def boom(**_kw):
+        raise ValueError("coordinator_address should be defined.")
+
+    monkeypatch.setattr(mh.jax.distributed, "initialize", boom)
+    assert mh.distributed_init() is False
+    # an EXPLICIT coordinator still fails loudly
+    with pytest.raises(ValueError):
+        mh.distributed_init(coordinator_address="10.0.0.1:1234")
