@@ -119,6 +119,29 @@ def test_run_sweep_throughput_latency_curve(tmp_path):
 
 
 @pytest.mark.slow
+def test_run_sweep_device_step_curve(tmp_path):
+    """The device-plane throughput-latency curve: a client sweep where
+    every point serves through one --device-step server, indexed and
+    rendered by the same plot pipeline as the object-runner sweeps."""
+    from fantoch_tpu.exp import run_sweep
+
+    out = str(tmp_path / "devsweep")
+    base = ExperimentConfig(
+        "epaxos", 3, 1, commands_per_client=6, conflict_rate=50,
+        device_step=True, device_batch=32,
+    )
+    manifests = run_sweep(base, out, clients_sweep=[1, 2])
+    assert [m["config"]["clients_per_process"] for m in manifests] == [1, 2]
+    assert all(m["name"].startswith("dev_") for m in manifests)
+    db = ResultsDB(out)
+    assert len(db) == 2
+    for res in db.results:
+        assert res.device_tallies()[1]["executed"] >= 1
+    path = plots.throughput_latency(db.results, str(tmp_path / "curve.png"))
+    assert os.path.getsize(path) > 1000
+
+
+@pytest.mark.slow
 def test_run_experiments_db_and_plots(tmp_path):
     out = str(tmp_path / "results")
     configs = [
