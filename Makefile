@@ -1,7 +1,7 @@
 # Test/bench entry points (the reference pins quality with Makefile:3-7 —
 # fmt + clippy + `cargo test` under a quickcheck budget; here the suite +
 # dryrun + bench are the equivalent gates).
-.PHONY: test test-fast dryrun bench
+.PHONY: test test-fast test-chaos dryrun bench
 
 test:
 	python -m pytest tests/ -x -q
@@ -10,6 +10,11 @@ test:
 # max_examples both scale down under CI=true)
 test-fast:
 	CI=true python -m pytest tests/ -x -q -m "not slow"
+
+# the full fault-injection matrix (crash x loss x protocol, including the
+# `slow`-marked sweep rows tier-1 skips)
+test-chaos:
+	python -m pytest tests/test_faults.py -x -q -m chaos
 
 dryrun:
 	python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"

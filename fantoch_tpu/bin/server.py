@@ -117,7 +117,13 @@ async def serve_device_step(args: argparse.Namespace) -> None:
         )
 
         distributed_init()
-        mesh = make_multihost_mesh(num_replicas=config.n)
+        # the mesh is sized by TOTAL replica rows: the sharded device state
+        # holds n rows per shard in shard-major order (_init_sharded_mesh),
+        # so validating against config.n alone would under-count the mesh
+        mesh = make_multihost_mesh(
+            num_replicas=config.n * config.shard_count,
+            shard_count=config.shard_count,
+        )
     runtime = DeviceRuntime(
         config,
         (args.ip, args.client_port),

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import random
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from fantoch_tpu.client.data import ClientData
 from fantoch_tpu.client.pending import Pending
@@ -48,6 +48,11 @@ class Client:
 
     def shard_process(self, shard_id: ShardId) -> ProcessId:
         return self._processes[shard_id]
+
+    def targets(self) -> Set[ProcessId]:
+        """Every process this client submits to (one per shard) — the sim's
+        nemesis abandons clients whose target crashed."""
+        return set(self._processes.values())
 
     def next_cmd(self, time: SysTime) -> Optional[Tuple[ShardId, Command]]:
         nxt = self._workload.next_cmd(self._rifl_gen, self._key_gen_state)

@@ -38,6 +38,11 @@ class Config:
     executor_cleanup_interval_ms: Optional[int] = 5
     # interval at which executors check for stuck commands (liveness watchdog)
     executor_monitor_pending_interval_ms: Optional[int] = None
+    # bounded wait: a command pending on *missing* (never-committed)
+    # dependencies past this threshold raises a typed StalledExecutionError
+    # from the watchdog instead of hanging — the crash-tolerance contract
+    # for deps owned by dead replicas (None keeps the log-only behavior)
+    executor_pending_fail_ms: Optional[int] = None
     # record per-key execution order for agreement checks in tests
     executor_monitor_execution_order: bool = False
     # order committed commands with the batched device resolver

@@ -1,0 +1,87 @@
+"""Typed fault-tolerance errors shared by the sim, run, and executor layers.
+
+The reference's failure handling is all-or-nothing (a lost connection or a
+stuck command panics the process); growing toward the paper's actual claim
+— liveness with up to ``f`` crashed replicas — needs failures that are
+*classified*: a peer loss above quorum degrades, below quorum fails with a
+typed error, and a command stuck past its bounded wait surfaces what it is
+waiting on instead of hanging the driver.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+
+class FaultToleranceError(Exception):
+    """Base class for every typed fault-tolerance failure."""
+
+
+class PeerLostError(FaultToleranceError):
+    """A peer stayed unreachable past the reconnect budget."""
+
+    def __init__(self, peer_id: int, attempts: int, last: Optional[BaseException]):
+        self.peer_id = peer_id
+        self.attempts = attempts
+        self.last = last
+        super().__init__(
+            f"peer p{peer_id} unreachable after {attempts} reconnect "
+            f"attempts (last error: {last!r})"
+        )
+
+
+class QuorumLostError(FaultToleranceError):
+    """Too many same-shard peers are gone for the protocol to stay live.
+
+    Raised (surfaced through ``ProcessRuntime.failed``) when the number of
+    live same-shard processes drops below ``n - f`` — the point past which
+    no quorum can form and continuing would only hang clients.
+    """
+
+    def __init__(self, alive: int, needed: int, dead_peers: Iterable[int]):
+        self.alive = alive
+        self.needed = needed
+        self.dead_peers = sorted(dead_peers)
+        super().__init__(
+            f"quorum lost: {alive} live processes < {needed} required "
+            f"(dead peers: {self.dead_peers})"
+        )
+
+
+class StalledExecutionError(FaultToleranceError):
+    """A committed command waited past the bounded-wait threshold on
+    dependencies that never commit (e.g. dots owned by a crashed replica).
+
+    ``missing`` maps each stuck dot to the dependency dots it is blocked
+    on — the executor surfaces *what* it is waiting for instead of
+    silently hanging the ordering engine.
+    """
+
+    def __init__(self, process_id: int, missing: Dict, waited_ms: int):
+        self.process_id = process_id
+        self.missing = missing
+        self.waited_ms = waited_ms
+        detail = ", ".join(
+            f"{dot} <- missing {sorted(map(str, deps))}"
+            for dot, deps in sorted(missing.items(), key=lambda kv: str(kv[0]))
+        )
+        super().__init__(
+            f"p{process_id}: execution stalled > {waited_ms}ms on "
+            f"dependencies that never commit: {detail}"
+        )
+
+
+class SimStalledError(FaultToleranceError):
+    """The simulation passed its virtual-time bound with clients still
+    waiting — the whole-system analog of :class:`StalledExecutionError`
+    (e.g. every quorum of an in-flight command crashed)."""
+
+    def __init__(self, time_ms: int, bound_ms: int, waiting_clients: Iterable[int]):
+        self.time_ms = time_ms
+        self.bound_ms = bound_ms
+        self.waiting_clients = sorted(waiting_clients)
+        super().__init__(
+            f"simulation stalled: virtual time {time_ms}ms exceeded the "
+            f"{bound_ms}ms bound with clients {self.waiting_clients} still "
+            "waiting for results"
+        )

@@ -398,12 +398,37 @@ class BatchedDependencyGraph(DependencyGraph):
         src, seq, _key, tms, deps = self._backlog.columns()
         from fantoch_tpu.executor.graph.indexes import MONITOR_PENDING_THRESHOLD_MS
 
-        old = (float(time.millis()) - tms) >= MONITOR_PENDING_THRESHOLD_MS
-        if not old.any():
+        pending_for = float(time.millis()) - tms
+        old = pending_for >= MONITOR_PENDING_THRESHOLD_MS
+        # the bounded-wait mask has its own (possibly lower) threshold —
+        # it must not loosen the lost-execution check, which stays on
+        # `old`, nor be floored by it (see deps_graph.monitor_pending)
+        fail_ms = self._config.executor_pending_fail_ms
+        ripe = pending_for >= fail_ms if fail_ms is not None else None
+        if not old.any() and (ripe is None or not ripe.any()):
             return
         dep_rows = self._map_deps(src, seq, deps)
         batch = len(src)
         blocked = (dep_rows == MISSING).any(axis=1)
+        # bounded wait (Config.executor_pending_fail_ms): a row blocked on
+        # a missing dependency past the fail bound raises a typed error —
+        # a dot whose coordinator crashed before broadcasting commit never
+        # commits, and silently waiting on it is a deadlock
+        if ripe is not None:
+            stalled = blocked & ripe
+            if stalled.any():
+                missing_map = {}
+                for i in np.nonzero(stalled)[0][:8]:
+                    missing_map[Dot(int(src[i]), int(seq[i]))] = {
+                        Dot(int(d) >> 32, int(d) & 0xFFFFFFFF)
+                        for d, r in zip(deps[i], dep_rows[i])
+                        if r == MISSING and d >= 0
+                    }
+                from fantoch_tpu.errors import StalledExecutionError
+
+                raise StalledExecutionError(
+                    self._process_id, missing_map, int(pending_for[stalled].max())
+                )
         # forward-propagate blockedness to dependents, vectorized with an
         # early exit the moment every old row is covered (the common case:
         # one or two passes; the full fixpoint only runs on the panic path)

@@ -122,8 +122,19 @@ class DependencyGraph:
 
     def monitor_pending(self, time: SysTime) -> None:
         if self.executor_index == 0:
+            fail_ms = self._config.executor_pending_fail_ms
+            # a fail bound below the log threshold must still be honored:
+            # the scan's early-skip would otherwise silently floor it
+            threshold = (
+                MONITOR_PENDING_THRESHOLD_MS
+                if fail_ms is None
+                else min(MONITOR_PENDING_THRESHOLD_MS, fail_ms)
+            )
             self._vertex_index.monitor_pending(
-                self._executed_clock, MONITOR_PENDING_THRESHOLD_MS, time
+                self._executed_clock,
+                threshold,
+                time,
+                fail_missing_after_ms=fail_ms,
             )
 
     def handle_executed(self, dots: Set[Dot], _time: SysTime) -> None:

@@ -50,11 +50,19 @@ class VertexIndex:
         executed_clock: AEClock,
         threshold_ms: int,
         time: SysTime,
+        fail_missing_after_ms: Optional[int] = None,
     ) -> None:
         """Log long-pending commands; panic on pending-with-no-missing-deps
-        (index.rs:53-103)."""
+        (index.rs:53-103).  With ``fail_missing_after_ms`` set, a command
+        whose *missing* dependencies stay uncommitted past that bound
+        raises a typed StalledExecutionError — the bounded-wait contract
+        for dependencies owned by crashed replicas (a dot whose
+        coordinator died before broadcasting commit never commits, and
+        without this the executor waits on it forever)."""
         now = time.millis()
         stuck_without_missing: Set[Dot] = set()
+        stalled_missing: dict = {}
+        stalled_for = 0
         for vertex in self._index.values():
             pending_for = now - vertex.start_time_ms
             if pending_for < threshold_ms:
@@ -71,11 +79,21 @@ class VertexIndex:
             )
             if not missing:
                 stuck_without_missing.add(vertex.dot)
+            elif (
+                fail_missing_after_ms is not None
+                and pending_for >= fail_missing_after_ms
+            ):
+                stalled_missing[vertex.dot] = missing
+                stalled_for = max(stalled_for, pending_for)
         if stuck_without_missing:
             raise AssertionError(
                 f"p{self._process_id}: commands pending without missing "
                 f"dependencies: {stuck_without_missing}"
             )
+        if stalled_missing:
+            from fantoch_tpu.errors import StalledExecutionError
+
+            raise StalledExecutionError(self._process_id, stalled_missing, stalled_for)
 
     def _missing_dependencies(
         self, vertex: Vertex, executed_clock: AEClock, visited: Set[Dot]

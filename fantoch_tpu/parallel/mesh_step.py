@@ -56,7 +56,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-shard_map = jax.shard_map
+# jax >= 0.5 exposes shard_map at top level; 0.4.x keeps it experimental
+# and spells the replication-check kwarg check_rep instead of check_vma
+shard_map = getattr(jax, "shard_map", None)
+if shard_map is None:
+    from jax.experimental.shard_map import shard_map as _shard_map_experimental
+
+    def shard_map(f, *, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map_experimental(f, **kwargs)
 
 from fantoch_tpu.ops.graph_resolve import (
     MISSING,
@@ -125,6 +134,20 @@ def quorum_sizes(num_replicas: int) -> Tuple[int, int]:
     from fantoch_tpu.core.config import Config
 
     return Config(num_replicas, 0).epaxos_quorum_sizes()
+
+
+def shard_of_row(row: int, num_replicas_total: int, shard_count: int) -> int:
+    """Owning shard of a replica row — the row-order contract tests pin.
+
+    Replica rows are **shard-major**: shard ``s`` owns the contiguous
+    block ``[s*n, (s+1)*n)`` of the ``num_replicas_total = n * shard_count``
+    rows (protocol_step computes ``row // per_shard`` on-device; this is
+    the host-side mirror).  Host placement that wants a shard's quorum
+    fan-in on ICI must therefore map whole *blocks* — not strided rows —
+    onto one host (parallel/multihost.py validates exactly that).
+    """
+    assert num_replicas_total % shard_count == 0
+    return row // (num_replicas_total // shard_count)
 
 
 def make_mesh(

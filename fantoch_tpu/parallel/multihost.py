@@ -49,10 +49,17 @@ from fantoch_tpu.utils import logger
 _DISTRIBUTED_INITIALIZED = False
 
 
+# auto-detected clusters get a short barrier timeout: a CI runner that
+# merely *carries* SLURM env vars (no actual peers) must fail fast and
+# fall back to single-host instead of blocking on jax's ~300 s default
+AUTO_DETECT_INIT_TIMEOUT_S = 30
+
+
 def distributed_init(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    initialization_timeout_s: Optional[int] = None,
 ) -> bool:
     """Idempotently initialize jax's multi-controller runtime.
 
@@ -60,6 +67,13 @@ def distributed_init(
     run via this gate), False when single-process operation was detected
     (no coordinator and no cluster env) and nothing was done — callers can
     use the same code path on laptops, CI and pods.
+
+    Timeouts: with an explicit ``coordinator_address`` the operator named
+    a real cluster, so jax's long default barrier (~300 s, slow pod
+    boots) stands unless ``initialization_timeout_s`` overrides it.  On
+    the auto-detect path (cluster env vars only) the barrier is capped at
+    ``AUTO_DETECT_INIT_TIMEOUT_S`` so a stray SLURM_JOB_ID on a
+    peer-less runner degrades to single-host in seconds, not minutes.
     """
     global _DISTRIBUTED_INITIALIZED
     if _DISTRIBUTED_INITIALIZED:
@@ -74,11 +88,17 @@ def distributed_init(
     ):
         # no explicit coordinator and no cluster environment: single host
         return False
+    kwargs = {}
+    if initialization_timeout_s is not None:
+        kwargs["initialization_timeout"] = initialization_timeout_s
+    elif coordinator_address is None:
+        kwargs["initialization_timeout"] = AUTO_DETECT_INIT_TIMEOUT_S
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            **kwargs,
         )
     except (ValueError, RuntimeError) as exc:
         if coordinator_address is not None:
@@ -115,16 +135,28 @@ def group_by_process(devices: Sequence) -> list:
     return groups
 
 
-def make_multihost_mesh(num_replicas: Optional[int] = None) -> Mesh:
+def make_multihost_mesh(
+    num_replicas: Optional[int] = None, shard_count: int = 1
+) -> Mesh:
     """(replica, batch) mesh with hosts on the replica axis.
 
     Single-process: defers to ``make_mesh`` (identical behavior, so CI /
     dryrun / the virtual-device suite are unaffected).  Multi-process:
     process p's chips form row p — the replica axis crosses hosts (DCN,
     quorum fan-ins), the batch axis stays on-host (ICI, batch sorts).
-    When ``num_replicas`` is given it must be a multiple of the host
-    count, mirroring ``make_mesh``'s divisibility contract
-    (init_state shards whole replica blocks per row).
+
+    ``num_replicas`` is the mesh's **total replica-axis row count**.  In
+    sharded mode the device state holds ``n * shard_count`` rows in
+    shard-major order (mesh_step.shard_of_row: shard s owns rows
+    ``[s*n, (s+1)*n)``) — callers must size the mesh against that total,
+    NOT the per-shard ``n`` (run/device_runner.py ``_init_sharded_mesh``
+    builds ``shard_count * num_replicas`` rows).  When given it must be a
+    multiple of the host count, mirroring ``make_mesh``'s divisibility
+    contract (init_state shards whole replica blocks per row), and with
+    ``shard_count > 1`` each host row should additionally hold whole
+    shard blocks, or a shard's quorum fan-in straddles hosts and rides
+    DCN instead of ICI (warned, not fatal: it is a performance contract,
+    not a correctness one).
     """
     import numpy as np
 
@@ -132,10 +164,25 @@ def make_multihost_mesh(num_replicas: Optional[int] = None) -> Mesh:
     groups = group_by_process(devices)
     if len(groups) == 1:
         return make_mesh(num_replicas=num_replicas)
-    if num_replicas is not None and num_replicas % len(groups) != 0:
-        raise ValueError(
-            f"num_replicas={num_replicas} must be a multiple of the host "
-            f"count {len(groups)} (whole replica blocks per mesh row)"
-        )
+    hosts = len(groups)
+    if num_replicas is not None:
+        if num_replicas % hosts != 0:
+            raise ValueError(
+                f"num_replicas={num_replicas} (total replica rows, i.e. "
+                f"n * shard_count) must be a multiple of the host count "
+                f"{hosts} (whole replica blocks per mesh row)"
+            )
+        if shard_count > 1:
+            rows_per_host = num_replicas // hosts
+            per_shard = num_replicas // shard_count
+            if rows_per_host % per_shard != 0:
+                logger.warning(
+                    "multihost mesh: %d rows/host does not hold whole "
+                    "shard blocks of %d rows (shard-major order, "
+                    "mesh_step.shard_of_row) — sharded quorum fan-ins "
+                    "will cross hosts on DCN instead of staying on ICI",
+                    rows_per_host,
+                    per_shard,
+                )
     dev_array = np.array(groups)  # (hosts, chips_per_host)
     return Mesh(dev_array, (REPLICA_AXIS, BATCH_AXIS))

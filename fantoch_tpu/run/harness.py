@@ -57,6 +57,8 @@ async def run_localhost_cluster(
     peer_delays: Optional[Dict[ProcessId, Dict[ProcessId, int]]] = None,
     ping_sort: bool = False,
     observe_dir: Optional[str] = None,
+    runtime_kwargs: Optional[dict] = None,
+    chaos=None,
 ) -> Tuple[Dict[ProcessId, ProcessRuntime], Dict[ClientId, Client]]:
     """Boot n*shard_count processes + clients, run the workload to
     completion, keep the cluster alive `extra_run_time_ms` (for GC rounds),
@@ -118,6 +120,7 @@ async def run_localhost_cluster(
             execution_log=(
                 f"{observe_dir}/execution_p{pid}.log" if observe_dir else None
             ),
+            **(runtime_kwargs or {}),
         )
 
     await asyncio.gather(*(runtime.start() for runtime in runtimes.values()))
@@ -131,7 +134,12 @@ async def run_localhost_cluster(
         next_client += clients_per_process
         client_groups.append((group, pid))
 
-    results = await asyncio.gather(
+    # optional chaos driver runs alongside the clients (e.g. severing peer
+    # links mid-run to exercise the reconnect path)
+    chaos_task = (
+        asyncio.ensure_future(chaos(runtimes)) if chaos is not None else None
+    )
+    client_task = asyncio.gather(
         *(
             run_clients(
                 group,
@@ -145,10 +153,39 @@ async def run_localhost_cluster(
             for group, pid in client_groups
         )
     )
+    # a runtime failure (e.g. a typed QuorumLostError) must surface loudly
+    # instead of hanging the clients forever
+    failure_tasks = {
+        asyncio.ensure_future(runtime.failed.wait()): pid
+        for pid, runtime in runtimes.items()
+    }
+    try:
+        done, _pending = await asyncio.wait(
+            {client_task, *failure_tasks}, return_when=asyncio.FIRST_COMPLETED
+        )
+        if client_task not in done:
+            failed = next(t for t in done if t in failure_tasks)
+            pid = failure_tasks[failed]
+            client_task.cancel()
+            raise AssertionError(
+                f"runtime p{pid} failed mid-run: {runtimes[pid].failure!r}"
+            )
+        results = client_task.result()
+        if chaos_task is not None:
+            await chaos_task
+    finally:
+        for task in failure_tasks:
+            task.cancel()
+        # on any failure path the chaos driver must not outlive the run
+        # (it would keep poking runtimes that are being stopped)
+        if chaos_task is not None and not chaos_task.done():
+            chaos_task.cancel()
 
     await asyncio.sleep(extra_run_time_ms / 1000)
-    for runtime in runtimes.values():
-        await runtime.stop()
+    # stop concurrently: a sequential shutdown leaves the last runtimes
+    # watching already-stopped peers, and their failure detectors would
+    # (correctly, but uselessly) report the shutdown as peer loss
+    await asyncio.gather(*(runtime.stop() for runtime in runtimes.values()))
 
     clients: Dict[ClientId, Client] = {}
     for group in results:

@@ -1,0 +1,124 @@
+"""Crash-tolerant peer links: sequence/ack reliability + reconnect policy.
+
+The reference opens each peer connection once at boot
+(fantoch/src/run/task/process.rs:71-111) and treats any later connection
+loss as fatal — acceptable on a supervised testbed, not for the ROADMAP's
+production-scale target.  This module carries the state that lets the
+runner (run/process_runner.py) survive mid-run connection loss:
+
+* every peer link numbers its data frames; the receiver acks periodically
+  and dedups by sequence, so after a reconnect the sender can resend its
+  unacked window without double-delivering — TCP-like reliability that
+  *survives* the TCP connection, which the protocols' quasi-reliable
+  channel assumption actually requires;
+* :class:`ReconnectPolicy` is the exponential-backoff-with-full-jitter
+  schedule used both by mid-run reconnects and the initial boot dial;
+* :class:`LinkState` owns one link's sender-side window, and
+  :class:`PeerLinks` the per-peer bundle (``multiplexing`` links with the
+  reference's random-writer pick, process.rs:680-696).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Deque, List, Optional, Tuple
+
+# link-frame kinds (rw.py link framing)
+KIND_DATA = 0
+KIND_ACK = 1
+
+# receiver acks every this many data frames (plus once per reconnect), so
+# the sender's unacked window stays bounded without per-frame ack traffic
+ACK_EVERY = 64
+
+
+@dataclass(frozen=True)
+class ReconnectPolicy:
+    """Exponential backoff with full jitter, bounded attempts.
+
+    ``delays`` yields the sleep before each attempt; once exhausted the
+    peer is declared lost (PeerLostError -> quorum check).
+    """
+
+    attempts: int = 8
+    base_s: float = 0.05
+    factor: float = 2.0
+    cap_s: float = 1.0
+    jitter: float = 1.0  # fraction of the backoff drawn uniformly
+
+    def delays(self, rng: Optional[random.Random] = None):
+        rng = rng or random
+        delay = self.base_s
+        for _ in range(self.attempts):
+            yield delay * (1.0 - self.jitter) + rng.uniform(0, delay * self.jitter)
+            delay = min(delay * self.factor, self.cap_s)
+
+
+class LinkState:
+    """Sender-side state of one reliable link to a peer."""
+
+    __slots__ = (
+        "peer_id",
+        "addr",
+        "index",
+        "rw",
+        "queue",
+        "unacked",
+        "seq",
+        "resend",
+        "dead",
+    )
+
+    def __init__(self, peer_id: int, addr: Tuple[str, int], index: int, rw: Any):
+        self.peer_id = peer_id
+        self.addr = addr
+        self.index = index
+        self.rw = rw
+        # the queue the writer task drains (set by the runner; with a
+        # delay line this is the line's sink, not the enqueue side)
+        self.queue: Optional[asyncio.Queue] = None
+        # (seq, frame) sent but not yet acked: the resend window
+        self.unacked: Deque[Tuple[int, bytes]] = deque()
+        self.seq = 0
+        # set right after a reconnect: the writer replays unacked first
+        self.resend = False
+        self.dead = False
+
+    def next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    def ack(self, seq: int) -> None:
+        while self.unacked and self.unacked[0][0] <= seq:
+            self.unacked.popleft()
+
+
+class PeerLinks:
+    """The ``multiplexing`` reliable links to one peer; each send picks a
+    random link (process.rs:71-97 + :680-696 send_to_one_writer), so
+    same-peer messages may ride different links and arrive reordered —
+    adversity the buffered-commit paths are built for.  Once the peer is
+    declared lost, frames are dropped instead of queueing unboundedly."""
+
+    __slots__ = ("queues", "links", "dead")
+
+    def __init__(self) -> None:
+        self.queues: List[asyncio.Queue] = []
+        self.links: List[LinkState] = []
+        self.dead = False
+
+    def put_nowait(self, frame: Any) -> None:
+        if self.dead:
+            return
+        if len(self.queues) == 1:
+            self.queues[0].put_nowait(frame)
+        else:
+            random.choice(self.queues).put_nowait(frame)
+
+    def mark_dead(self) -> None:
+        self.dead = True
+        for link in self.links:
+            link.dead = True

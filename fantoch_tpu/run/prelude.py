@@ -60,8 +60,14 @@ class WarnQueue(asyncio.Queue):
 
 @dataclass
 class ProcessHi:
+    """Peer-link handshake.  ``link`` identifies which of the sender's
+    ``multiplexing`` links this connection carries: the receiver keys its
+    dedup state on (process_id, link) so a reconnected link resumes where
+    its predecessor stopped (run/links.py)."""
+
     process_id: ProcessId
     shard_id: ShardId
+    link: int = 0
 
 
 @dataclass

@@ -31,15 +31,23 @@ parallelism axis on TPU is the batched device step, not threads.
 from __future__ import annotations
 
 import asyncio
-import random
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import AtomicIdGen, ClientId, ProcessId, ShardId
 from fantoch_tpu.core.timing import RunTime
+from fantoch_tpu.errors import PeerLostError, QuorumLostError
 from fantoch_tpu.executor.aggregate import AggregatePending
 from fantoch_tpu.executor.base import ExecutorResult
 from fantoch_tpu.protocol.base import Protocol, ToForward, ToSend
+from fantoch_tpu.run.links import (
+    ACK_EVERY,
+    KIND_ACK,
+    KIND_DATA,
+    LinkState,
+    PeerLinks,
+    ReconnectPolicy,
+)
 from fantoch_tpu.run.prelude import (
     ClientHi,
     ClientHiAck,
@@ -55,7 +63,7 @@ from fantoch_tpu.run.prelude import (
     WarnQueue,
 )
 from fantoch_tpu.run.routing import worker_dot_index_shift
-from fantoch_tpu.run.rw import Rw, connect_with_retry, serialize
+from fantoch_tpu.run.rw import Rw, connect_with_retry, deserialize, serialize
 from fantoch_tpu.utils import key_hash, logger
 
 Address = Tuple[str, int]
@@ -84,25 +92,6 @@ def executor_index(info: Any, size: int) -> Optional[int]:
     if isinstance(key, str):
         return key_hash(key) % size
     return 0
-
-
-class _PeerLinks:
-    """The ``multiplexing`` TCP connections to one peer: each send picks a
-    random link (process.rs:71-97 connect loop + :680-696
-    send_to_one_writer), so messages to the same peer may ride different
-    connections and arrive reordered — the adversity the reference's
-    buffered-commit paths are built for."""
-
-    __slots__ = ("queues",)
-
-    def __init__(self) -> None:
-        self.queues: List[asyncio.Queue] = []
-
-    def put_nowait(self, frame: Any) -> None:
-        if len(self.queues) == 1:
-            self.queues[0].put_nowait(frame)
-        else:
-            random.choice(self.queues).put_nowait(frame)
 
 
 class _StampingQueue(WarnQueue):
@@ -144,48 +133,64 @@ class _ClientSession:
         while True:
             await self._flush_needed.wait()
             self._flush_needed.clear()
-            await self.rw.flush()
+            try:
+                await self.rw.flush()
+            except (ConnectionError, OSError):
+                return  # session torn down by run()'s recv seeing EOF
 
     async def run(self) -> None:
         hi = await self.rw.recv()
+        if hi is None:
+            return  # client vanished before the handshake
         assert isinstance(hi, ClientHi)
         self.client_ids = hi.client_ids
         for client_id in self.client_ids:
             self.runtime.client_sessions[client_id] = self
-        # ack AFTER registration: the client holds submissions until every
-        # shard acks, so a partial can never arrive before its session is
-        # routable (the ClientHi-vs-execution race)
-        await self.rw.send(ClientHiAck())
-        flusher = self.runtime.spawn(self._flush_loop())
-        while True:
-            msg = await self.rw.recv()
-            if msg is None:
-                break
-            if isinstance(msg, Register):
-                # non-target shard of a multi-shard command: start result
-                # aggregation for our part, but do not submit (the target
-                # shard's MForwardSubmit drives our protocol instance)
-                self.pending.wait_for(msg.cmd)
-                self._emit(self.pending.drain_early(msg.cmd.rifl))
-                continue
-            assert isinstance(msg, Submit)
-            cmd = msg.cmd
-            self.pending.wait_for(cmd)
-            self._emit(self.pending.drain_early(cmd.rifl))
-            dot = (
-                self.runtime.dot_gen.next_id()
-                if self.runtime.protocol_cls.leaderless()
-                else None
+        flusher = None
+        try:
+            # ack AFTER registration: the client holds submissions until
+            # every shard acks, so a partial can never arrive before its
+            # session is routable (the ClientHi-vs-execution race)
+            await self.rw.send(ClientHiAck())
+            flusher = self.runtime.spawn(self._flush_loop())
+            while True:
+                msg = await self.rw.recv()
+                if msg is None:
+                    break
+                if isinstance(msg, Register):
+                    # non-target shard of a multi-shard command: start
+                    # result aggregation for our part, but do not submit
+                    # (the target shard's MForwardSubmit drives our
+                    # protocol instance)
+                    self.pending.wait_for(msg.cmd)
+                    self._emit(self.pending.drain_early(msg.cmd.rifl))
+                    continue
+                assert isinstance(msg, Submit)
+                cmd = msg.cmd
+                self.pending.wait_for(cmd)
+                self._emit(self.pending.drain_early(cmd.rifl))
+                dot = (
+                    self.runtime.dot_gen.next_id()
+                    if self.runtime.protocol_cls.leaderless()
+                    else None
+                )
+                index = (
+                    worker_dot_index_shift(dot)
+                    if dot is not None
+                    else (0, 0)  # leader-based: submit handled by any worker
+                )
+                self.runtime.workers.forward(index, ("submit", dot, cmd))
+        except (ConnectionError, OSError) as exc:
+            # a lost client is the client's problem, not the cluster's:
+            # unregister and keep serving everyone else
+            logger.warning(
+                "client session %s lost mid-run: %r", self.client_ids, exc
             )
-            index = (
-                worker_dot_index_shift(dot)
-                if dot is not None
-                else (0, 0)  # leader-based: submit handled by any worker
-            )
-            self.runtime.workers.forward(index, ("submit", dot, cmd))
-        flusher.cancel()
-        for client_id in self.client_ids:
-            self.runtime.client_sessions.pop(client_id, None)
+        finally:
+            if flusher is not None:
+                flusher.cancel()
+            for client_id in self.client_ids:
+                self.runtime.client_sessions.pop(client_id, None)
 
 
 class ProcessRuntime:
@@ -208,6 +213,10 @@ class ProcessRuntime:
         metrics_interval_ms: int = 5000,
         execution_log: Optional[str] = None,
         tracer_show_interval_ms: Optional[int] = None,
+        reconnect_policy: Optional[ReconnectPolicy] = None,
+        send_timeout_s: float = 30.0,
+        heartbeat_interval_s: Optional[float] = 1.0,
+        heartbeat_misses: int = 8,
     ):
         self.protocol_cls = protocol_cls
         self.config = config
@@ -252,7 +261,23 @@ class ProcessRuntime:
         self.client_sessions: Dict[ClientId, _ClientSession] = {}
         assert multiplexing >= 1
         self.multiplexing = multiplexing
-        self._peer_writers: Dict[ProcessId, _PeerLinks] = {}
+        self._peer_writers: Dict[ProcessId, PeerLinks] = {}
+        # crash tolerance (run/links.py): reconnect schedule, per-send
+        # timeout, heartbeat failure detector, quorum-aware degradation
+        self.reconnect_policy = reconnect_policy or ReconnectPolicy()
+        self.send_timeout_s = send_timeout_s
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.heartbeat_misses = heartbeat_misses
+        self.dead_peers: Set[ProcessId] = set()
+        # failure detector state: last loop-time any frame arrived from a
+        # peer (readers update it; the heartbeat task judges silence)
+        self._last_heard: Dict[ProcessId, float] = {}
+        self._shard_of: Dict[ProcessId, ShardId] = dict(sorted_processes)
+        # receiver-side dedup state, keyed (peer, link) so it survives
+        # reconnects of the underlying TCP connection
+        self._link_recv_seq: Dict[Tuple[ProcessId, int], int] = {}
+        # live peer-connection rws -> peer id, for the chaos hook
+        self._chaos_rws: Dict[Rw, ProcessId] = {}
         # per-connection artificial delay in ms (delay.rs:6-39): outbound
         # frames to these peers pass through a FIFO delay line
         self.peer_delays = peer_delays or {}
@@ -272,6 +297,12 @@ class ProcessRuntime:
         self._tasks: Set[asyncio.Task] = set()
         self._servers: List[asyncio.base_events.Server] = []
         self._connected = asyncio.Event()
+        # set during stop()/_teardown(): reconnect loops and the failure
+        # detector must stand down — a peer vanishing because the operator
+        # is shutting the cluster down is not a fault (and a cancellation
+        # surfacing as wait_for's TimeoutError inside the writer must not
+        # resurrect the task into a reconnect loop)
+        self._stopping = False
         # first task failure; .failed is awaited by harnesses so a crashed
         # worker tears the cluster down loudly instead of stalling it
         self.failure: Optional[BaseException] = None
@@ -290,18 +321,26 @@ class ProcessRuntime:
         # (the reference logs and exits the task, process.rs:320-325); make
         # failures loud: record the exception and actively tear down.
         # (Raising here would only reach the loop exception handler.)
+        # Connection-level failures are NOT fatal anymore: writer tasks
+        # reconnect with backoff and surface PeerLostError through the
+        # quorum check (_declare_peer_lost) instead of escaping here.
         self._tasks.discard(task)
         if task.cancelled():
             return
         exc = task.exception()
         if exc is not None:
             logger.error("runner task crashed: %r", exc)
-            if self.failure is None:
-                self.failure = exc
-                self.failed.set()
-            self._teardown()
+            self._fail(exc)
+
+    def _fail(self, exc: BaseException) -> None:
+        """Record the first fatal failure and tear the runtime down."""
+        if self.failure is None:
+            self.failure = exc
+            self.failed.set()
+        self._teardown()
 
     def _teardown(self) -> None:
+        self._stopping = True
         for task in list(self._tasks):
             task.cancel()
         for server in self._servers:
@@ -313,16 +352,20 @@ class ProcessRuntime:
         client_server = await asyncio.start_server(self._on_client, *self.client_addr)
         self._servers = [peer_server, client_server]
 
-        # connect to every peer — `multiplexing` connections each, retrying
-        # while they boot (process.rs:71-111).  The links object is only
-        # registered once its first connection is up: the reader task's
-        # wait-guard keys on _peer_writers membership, and an empty links
-        # would crash its random pick
+        # connect to every peer — `multiplexing` reliable links each,
+        # retrying while they boot (process.rs:71-111).  The links object
+        # is only registered once its first connection is up: the reader
+        # task's wait-guard keys on _peer_writers membership, and an empty
+        # links would crash its random pick
         for peer_id, addr in self.peers.items():
-            links = _PeerLinks()
-            for _ in range(self.multiplexing):
+            links = PeerLinks()
+            for index in range(self.multiplexing):
                 rw = await connect_with_retry(addr)
-                await rw.send(ProcessHi(self.process.id, self.process.shard_id))
+                await rw.send(
+                    ProcessHi(self.process.id, self.process.shard_id, index)
+                )
+                link = LinkState(peer_id, addr, index, rw)
+                self._chaos_rws[rw] = peer_id
                 delay_ms = self.peer_delays.get(peer_id)
                 if delay_ms:
                     # FIFO delay line between the enqueue side and the
@@ -335,11 +378,14 @@ class ProcessRuntime:
                     )
                     delayed: asyncio.Queue = WarnQueue(f"writer->p{peer_id}")
                     self.spawn(self._delay_task(queue, delayed, delay_ms))
-                    self.spawn(self._writer_task(rw, delayed))
+                    link.queue = delayed
                 else:
                     queue = WarnQueue(f"writer->p{peer_id}")
-                    self.spawn(self._writer_task(rw, queue))
+                    link.queue = queue
+                self.spawn(self._peer_writer_task(link))
+                self.spawn(self._ack_reader_task(link, rw))
                 links.queues.append(queue)
+                links.links.append(link)
                 self._peer_writers[peer_id] = links
 
         if self.ping_sort:
@@ -361,6 +407,8 @@ class ProcessRuntime:
         cleanup = self.config.executor_cleanup_interval_ms
         if cleanup is not None and self.config.shard_count > 1:
             self.spawn(self._executor_cleanup_task(cleanup))
+        if self.heartbeat_interval_s is not None and self.peers:
+            self.spawn(self._heartbeat_task())
         if self.metrics_file is not None:
             self.spawn(self._metrics_logger_task())
         if self.execution_logger is not None:
@@ -376,9 +424,21 @@ class ProcessRuntime:
         self._connected.set()
 
     async def stop(self) -> None:
+        self._stopping = True
         tasks = list(self._tasks)
         self._teardown()
-        await asyncio.gather(*tasks, return_exceptions=True)
+        # bounded re-cancel: asyncio.wait_for can swallow a cancellation
+        # (inner future completes in the cancel's tick), leaving a task
+        # parked with no cancel pending — re-cancel instead of hanging
+        for _round in range(3):
+            if not tasks:
+                break
+            _done, pending = await asyncio.wait(tasks, timeout=5)
+            if not pending:
+                break
+            for task in pending:
+                task.cancel()
+            tasks = list(pending)
         if self.execution_logger is not None:
             self.execution_logger.close()
         if self.metrics_file is not None:
@@ -390,8 +450,15 @@ class ProcessRuntime:
     async def _on_peer(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         rw = Rw(reader, writer)
         hi = await rw.recv()
+        if hi is None:
+            return  # dialer gave up (e.g. crashed mid-handshake)
         assert isinstance(hi, ProcessHi), f"unexpected handshake {hi}"
-        self.spawn(self._reader_task(hi.process_id, hi.shard_id, rw))
+        self._chaos_rws[rw] = hi.process_id
+        self.spawn(
+            self._reader_task(
+                hi.process_id, hi.shard_id, rw, (hi.process_id, getattr(hi, "link", 0))
+            )
+        )
 
     async def _on_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         await self._connected.wait()
@@ -400,14 +467,64 @@ class ProcessRuntime:
 
     # --- tasks ---
 
-    async def _reader_task(self, from_: ProcessId, from_shard: ShardId, rw: Rw) -> None:
+    async def _reader_task(
+        self,
+        from_: ProcessId,
+        from_shard: ShardId,
+        rw: Rw,
+        dedup_key: Tuple[ProcessId, int],
+    ) -> None:
         """Route peer messages to workers by message index, and peer
         executor infos (cross-shard dependency traffic) to the executor
-        pool (process.rs:292-326)."""
+        pool (process.rs:292-326).
+
+        Frames arrive sequence-numbered (run/links.py): after a sender
+        reconnect it resends its unacked window, so frames at or below the
+        last seen sequence are dropped here (exactly-once delivery across
+        connection loss); the ack written back — immediately on connect,
+        then every ACK_EVERY frames — trims the sender's window."""
+        last_seq = self._link_recv_seq.setdefault(dedup_key, 0)
+        try:
+            await self._reader_loop(from_, from_shard, rw, dedup_key, last_seq)
+        finally:
+            # drop the chaos-hook registration with the connection, or a
+            # flapping link accumulates one dead Rw per reconnect
+            self._chaos_rws.pop(rw, None)
+
+    async def _reader_loop(
+        self,
+        from_: ProcessId,
+        from_shard: ShardId,
+        rw: Rw,
+        dedup_key: Tuple[ProcessId, int],
+        last_seq: int,
+    ) -> None:
+        try:
+            rw.write_link_frame(KIND_ACK, last_seq, b"")
+            await rw.flush()
+        except (ConnectionError, OSError):
+            return
+        received = 0
+        loop = asyncio.get_running_loop()
         while True:
-            msg = await rw.recv()
-            if msg is None:
+            frame = await rw.recv_link_frame()
+            if frame is None:
                 return
+            self._last_heard[from_] = loop.time()
+            kind, seq, payload = frame
+            if kind != KIND_DATA:
+                continue
+            if seq <= self._link_recv_seq[dedup_key]:
+                continue  # duplicate from a reconnect resend
+            self._link_recv_seq[dedup_key] = seq
+            received += 1
+            if received % ACK_EVERY == 0:
+                try:
+                    rw.write_link_frame(KIND_ACK, seq, b"")
+                    await rw.flush()
+                except (ConnectionError, OSError):
+                    return
+            msg = deserialize(payload)
             if isinstance(msg, PingReq):
                 # our outbound writer to this peer may still be connecting
                 # (pings fly during start); wait for it rather than crash
@@ -464,7 +581,9 @@ class ProcessRuntime:
         ]
         return [(self.process.id, self.process.shard_id)] + ordered + others
 
-    async def _ping_peer(self, peer_id: ProcessId, samples: int = 3) -> float:
+    async def _ping_peer(
+        self, peer_id: ProcessId, samples: int = 3, timeout: float = 10.0
+    ) -> float:
         """Median RTT to a peer over the live connection, ms."""
         loop = asyncio.get_running_loop()
         times = []
@@ -475,23 +594,205 @@ class ProcessRuntime:
             self._ping_waiters[nonce] = fut
             t0 = loop.time()
             self._peer_writers[peer_id].put_nowait(serialize(PingReq(nonce)))
-            await asyncio.wait_for(fut, timeout=10.0)
+            try:
+                await asyncio.wait_for(fut, timeout=timeout)
+            finally:
+                self._ping_waiters.pop(nonce, None)
             times.append((loop.time() - t0) * 1000)
         times.sort()
         return times[len(times) // 2]
 
-    async def _writer_task(self, rw: Rw, queue: asyncio.Queue) -> None:
-        """Drains pre-serialized frames (serialization happens at enqueue
-        time: a message may also be self-delivered, and the local handler
-        can mutate it in place before this task would run)."""
+    async def _peer_writer_task(self, link: LinkState) -> None:
+        """Drains pre-serialized frames onto one reliable peer link
+        (serialization happens at enqueue time: a message may also be
+        self-delivered, and the local handler can mutate it in place
+        before this task would run).
+
+        Crash tolerance: every data frame is sequence-numbered and kept in
+        the link's unacked window until the peer acks it; a send error or
+        per-send timeout triggers reconnect-with-backoff-and-jitter, after
+        which the window is resent (the peer's reader dedups by seq).
+        When the reconnect budget is exhausted the peer goes through the
+        quorum check instead of tearing the whole process down."""
+        queue = link.queue
+        # the _stopping check also reaps a cancellation that wait_for
+        # swallowed (inner future completed in the same tick the cancel
+        # landed — asyncio returns the result and loses the cancel); the
+        # task must still exit promptly or stop()'s gather hangs on it
+        while not link.dead and not self._stopping:
+            rw = link.rw
+            try:
+                if link.resend:
+                    for seq, frame in link.unacked:
+                        rw.write_link_frame(KIND_DATA, seq, frame)
+                    link.resend = False
+                    await asyncio.wait_for(rw.flush(), self.send_timeout_s)
+                    continue
+                frame = await queue.get()
+                rw.write_link_frame(KIND_DATA, link.next_seq(), frame)
+                link.unacked.append((link.seq, frame))
+                # batch whatever accumulated while writing (flush
+                # coalescing, process.rs:329-385)
+                while not queue.empty():
+                    frame = queue.get_nowait()
+                    rw.write_link_frame(KIND_DATA, link.next_seq(), frame)
+                    link.unacked.append((link.seq, frame))
+                await asyncio.wait_for(rw.flush(), self.send_timeout_s)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                # NB: a cancellation hitting inside wait_for can surface
+                # as TimeoutError (the classic asyncio footgun) — the
+                # _stopping check keeps a shutdown from resurrecting this
+                # task into a reconnect loop that outlives stop()
+                if link.dead or self._stopping:
+                    return
+                try:
+                    await self._reconnect_link(link)
+                except PeerLostError as exc:
+                    self._declare_peer_lost(link.peer_id, exc)
+                    return
+
+    async def _ack_reader_task(self, link: LinkState, rw: Rw) -> None:
+        """Reads ack frames the peer's reader writes back on our outbound
+        connection, trimming the link's resend window.  Ends silently on
+        EOF — the writer owns reconnects (one per connection incarnation;
+        a reconnect spawns a fresh one on the new rw)."""
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                frame = await rw.recv_link_frame()
+                if frame is None:
+                    return
+                self._last_heard[link.peer_id] = loop.time()
+                kind, seq, _payload = frame
+                if kind == KIND_ACK:
+                    link.ack(seq)
+        finally:
+            # dead connection: only the live rw should stay registered for
+            # the chaos hook (the writer re-registers on reconnect)
+            if rw is not link.rw:
+                self._chaos_rws.pop(rw, None)
+
+    async def _reconnect_link(self, link: LinkState) -> None:
+        """Re-dial one peer link with exponential backoff + full jitter;
+        raises PeerLostError once the policy's attempts are exhausted."""
+        link.rw.abort()
+        last: Optional[BaseException] = None
+        attempts = 0
+        for delay in self.reconnect_policy.delays():
+            if link.dead or self._stopping:
+                raise PeerLostError(link.peer_id, attempts, last)
+            attempts += 1
+            await asyncio.sleep(delay)
+            try:
+                rw = await asyncio.wait_for(
+                    connect_with_retry(link.addr, attempts=1),
+                    self.send_timeout_s,
+                )
+                await rw.send(
+                    ProcessHi(self.process.id, self.process.shard_id, link.index)
+                )
+            except (ConnectionError, OSError, asyncio.TimeoutError) as exc:
+                last = exc
+                continue
+            self._chaos_rws.pop(link.rw, None)
+            self._chaos_rws[rw] = link.peer_id
+            link.rw = rw
+            link.resend = True
+            self.spawn(self._ack_reader_task(link, rw))
+            logger.warning(
+                "p%s: reconnected link %d to p%s after %d attempt(s), "
+                "resending %d unacked frame(s)",
+                self.process.id,
+                link.index,
+                link.peer_id,
+                attempts,
+                len(link.unacked),
+            )
+            return
+        raise PeerLostError(link.peer_id, attempts, last)
+
+    async def _heartbeat_task(self) -> None:
+        """Peer failure detector: every interval, ping each peer (so even
+        an idle link generates traffic whose replies refresh
+        ``_last_heard``), and declare a peer lost only after
+        ``heartbeat_misses`` intervals of *total silence* — no frame of
+        any kind heard from it.  Judging silence rather than ping RTTs
+        keeps a congested-but-alive cluster (many processes sharing one
+        cooperative loop or core) from false-positive amputations; a
+        wedged or unreachable peer still trips the quorum check
+        (ping.rs:13-78 machinery, promoted from boot-time sort to a
+        liveness monitor)."""
+        loop = asyncio.get_running_loop()
+        silence_window = self.heartbeat_interval_s * self.heartbeat_misses
+        for peer_id in self.peers:
+            self._last_heard.setdefault(peer_id, loop.time())
         while True:
-            frame = await queue.get()
-            rw.write_frame(frame)
-            # batch whatever accumulated while writing (flush coalescing,
-            # process.rs:329-385)
-            while not queue.empty():
-                rw.write_frame(queue.get_nowait())
-            await rw.flush()
+            await asyncio.sleep(self.heartbeat_interval_s)
+            if self._stopping:
+                return
+            for peer_id in self.peers:
+                if peer_id in self.dead_peers:
+                    continue
+                # fire-and-forget probe: any reply (or any other frame)
+                # refreshes _last_heard via the reader
+                self._ping_nonce += 1
+                self._peer_writers[peer_id].put_nowait(
+                    serialize(PingReq(self._ping_nonce))
+                )
+                silent_for = loop.time() - self._last_heard[peer_id]
+                if silent_for > silence_window:
+                    self._declare_peer_lost(
+                        peer_id,
+                        PeerLostError(
+                            peer_id,
+                            self.heartbeat_misses,
+                            TimeoutError(f"silent for {silent_for:.1f}s"),
+                        ),
+                    )
+
+    def _declare_peer_lost(self, peer_id: ProcessId, cause: BaseException) -> None:
+        """Graceful degradation: a lost peer stops the cluster only when
+        the survivors can no longer form a quorum (alive < n - f); above
+        that the runtime keeps serving and drops frames to the dead peer."""
+        if peer_id in self.dead_peers or self._stopping:
+            return
+        self.dead_peers.add(peer_id)
+        links = self._peer_writers.get(peer_id)
+        if links is not None:
+            links.mark_dead()
+        my_shard = self.process.shard_id
+        same_shard = [
+            pid for pid in self.peers if self._shard_of.get(pid) == my_shard
+        ]
+        alive = 1 + sum(1 for pid in same_shard if pid not in self.dead_peers)
+        needed = self.config.n - self.config.f
+        if alive < needed:
+            self._fail(QuorumLostError(alive, needed, self.dead_peers))
+        else:
+            logger.warning(
+                "p%s: peer p%s lost (%r); degrading gracefully with "
+                "%d/%d same-shard processes alive (quorum needs %d)",
+                self.process.id,
+                peer_id,
+                cause,
+                alive,
+                self.config.n,
+                needed,
+            )
+
+    def inject_link_failure(self, peer_id: Optional[ProcessId] = None) -> int:
+        """Chaos hook for tests: hard-kill the live peer-link sockets (all
+        of them, or only those to/from ``peer_id``), simulating the
+        network dropping connections while every process stays up.
+        Returns the number of aborted connections."""
+        count = 0
+        for rw, rw_peer in list(self._chaos_rws.items()):
+            if peer_id is not None and rw_peer != peer_id:
+                continue
+            rw.abort()
+            self._chaos_rws.pop(rw, None)
+            count += 1
+        return count
 
     async def _worker_task(self, position: int) -> None:
         queue = self.workers.queue(position)

@@ -17,10 +17,18 @@ import struct
 from typing import Any, Optional
 
 _LEN = struct.Struct(">I")
+# link frames (peer connections after the handshake): u8 kind + u64 seq
+# header inside the length-delimited frame; see run/links.py for the
+# reliability protocol built on top
+_LINK = struct.Struct(">BQ")
 
 
 def serialize(value: Any) -> bytes:
     return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize(payload: bytes) -> Any:
+    return pickle.loads(payload)
 
 
 async def connect_with_retry(
@@ -80,8 +88,33 @@ class Rw:
         self.write(value)
         await self.flush()
 
+    # --- link framing (peer connections; run/links.py reliability) ---
+
+    def write_link_frame(self, kind: int, seq: int, payload: bytes) -> None:
+        """Queue one sequence-numbered frame without flushing."""
+        header = _LINK.pack(kind, seq)
+        self._writer.write(_LEN.pack(len(header) + len(payload)) + header + payload)
+
+    async def recv_link_frame(self) -> Optional[tuple]:
+        """Read one (kind, seq, payload) link frame; None on EOF/reset."""
+        try:
+            header = await self._reader.readexactly(_LEN.size)
+            (length,) = _LEN.unpack(header)
+            body = await self._reader.readexactly(length)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            return None
+        kind, seq = _LINK.unpack_from(body)
+        return kind, seq, body[_LINK.size :]
+
     async def flush(self) -> None:
         await self._writer.drain()
 
     def close(self) -> None:
         self._writer.close()
+
+    def abort(self) -> None:
+        """Hard-kill the underlying transport (chaos hook: simulates the
+        network dropping the connection while both processes stay up)."""
+        transport = self._writer.transport
+        if transport is not None:
+            transport.abort()

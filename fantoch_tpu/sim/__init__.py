@@ -1,3 +1,4 @@
+from fantoch_tpu.sim.faults import FaultPlan, Nemesis
 from fantoch_tpu.sim.runner import Runner
 from fantoch_tpu.sim.schedule import Schedule
 from fantoch_tpu.sim.simulation import Simulation

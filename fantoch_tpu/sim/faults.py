@@ -1,0 +1,345 @@
+"""Deterministic fault-injection plane for the discrete-event simulator.
+
+The protocols fantoch reproduces (EPaxos, Atlas, Newt, Caesar) claim
+liveness and linearizability with up to ``f`` crashed replicas over a
+lossy, partitionable network — yet the simulator delivers every message
+exactly once over fixed planet latencies.  This module closes that gap in
+the spirit of the reference's stateright exploration (``fantoch_mc``): a
+:class:`FaultPlan` describes *what* goes wrong and *when* (virtual time),
+a :class:`Nemesis` executes the plan with a dedicated seeded RNG, and the
+runner (sim/runner.py) consults it at message send/delivery time.  Same
+plan + same seed => byte-identical fault trace and committed-command
+trace, so every chaos test is replayable.
+
+Fault model (see README "Fault model" for the contract):
+
+* **Link faults** — per-(src, dst) message drop, duplication, and extra
+  delay inside a virtual-time window.  Drops default to
+  ``retransmit=True``: the underlying channel is lossy but the connection
+  layer retries with exponential backoff + jitter, exactly the TCP
+  semantics the protocols assume (quasi-reliable links between correct
+  processes).  The geometric retry sequence is collapsed into one
+  deterministic delivery delay at send time, so retransmission costs no
+  extra heap traffic.  ``retransmit=False`` models true message loss
+  (protocol liveness is then *not* guaranteed — pair it with the bounded
+  wait below).
+* **Partitions** — symmetric cuts between process groups from
+  ``start_ms`` until ``heal_ms``; crossing messages are deferred until
+  just after heal (connection-retry semantics) or dropped forever when
+  the partition never heals.
+* **Crash** — a process stops at ``at_ms``: inbound messages are dropped,
+  its periodic events stop, and clients attached to it are abandoned
+  (the runner stops waiting for them).
+* **Pause** — a transient freeze ``[at_ms, until_ms)``: inbound traffic
+  and periodic events are deferred and replayed at resume, modelling a
+  stop-the-world (GC pause, VM migration) rather than a crash.
+* **Bounded wait** — ``max_sim_time_ms`` turns a stalled run (e.g. every
+  member of an in-flight command's quorum crashed and recovery is not
+  implemented) into a typed :class:`~fantoch_tpu.errors.SimStalledError`
+  instead of an infinite loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+from fantoch_tpu.errors import SimStalledError  # noqa: F401  (re-export)
+
+# endpoint keys as used by sim/runner.py: ("process", pid) | ("client", cid)
+EndpointKey = Tuple[str, int]
+
+
+@dataclass(frozen=True)
+class LinkFault:
+    """Lossy-link behavior for messages src -> dst inside a time window.
+
+    ``src``/``dst`` of None match any endpoint (including clients); an
+    integer matches that *process* id.  ``msg_types`` optionally restricts
+    the fault to payload class names (e.g. ``("MCommit",)``) — the
+    targeted-drop primitive chaos tests use to strand dependencies.
+    """
+
+    src: Optional[int] = None
+    dst: Optional[int] = None
+    drop: float = 0.0
+    duplicate: float = 0.0
+    extra_delay_ms: int = 0
+    from_ms: int = 0
+    until_ms: Optional[int] = None
+    retransmit: bool = True
+    msg_types: Optional[Tuple[str, ...]] = None
+
+    def matches(self, now: int, src: Optional[int], dst: Optional[int], msg: Any) -> bool:
+        if now < self.from_ms:
+            return False
+        if self.until_ms is not None and now >= self.until_ms:
+            return False
+        if self.src is not None and self.src != src:
+            return False
+        if self.dst is not None and self.dst != dst:
+            return False
+        if self.msg_types is not None and type(msg).__name__ not in self.msg_types:
+            return False
+        return True
+
+
+@dataclass(frozen=True)
+class Partition:
+    """Symmetric cut between process groups during [start_ms, heal_ms).
+
+    Processes in different groups cannot exchange messages while the
+    partition is active; ``heal_ms=None`` never heals.  Processes in no
+    group are unaffected (reachable from everyone).
+    """
+
+    groups: Tuple[Tuple[int, ...], ...]
+    start_ms: int
+    heal_ms: Optional[int] = None
+
+    def active(self, now: int) -> bool:
+        return now >= self.start_ms and (self.heal_ms is None or now < self.heal_ms)
+
+    def separates(self, a: int, b: int) -> bool:
+        ga = gb = None
+        for index, group in enumerate(self.groups):
+            if a in group:
+                ga = index
+            if b in group:
+                gb = index
+        return ga is not None and gb is not None and ga != gb
+
+
+@dataclass(frozen=True)
+class Crash:
+    process_id: int
+    at_ms: int
+
+
+@dataclass(frozen=True)
+class Pause:
+    process_id: int
+    at_ms: int
+    until_ms: int
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Declarative, immutable fault schedule (builder-style constructors).
+
+    The plan owns the determinism contract: every random decision the
+    nemesis makes is drawn from ``random.Random(seed)`` in simulation
+    order, so two runs of the same (plan, workload, sim seed) produce
+    byte-identical traces.
+    """
+
+    seed: int = 0
+    link_faults: Tuple[LinkFault, ...] = ()
+    partitions: Tuple[Partition, ...] = ()
+    crashes: Tuple[Crash, ...] = ()
+    pauses: Tuple[Pause, ...] = ()
+    # base RTO for the collapsed retransmission sequence
+    retransmit_base_ms: int = 25
+    # bounded wait: virtual-time budget before a stalled run raises
+    max_sim_time_ms: Optional[int] = None
+
+    # --- builders ---
+
+    def with_link_fault(self, **kwargs) -> "FaultPlan":
+        return dataclasses.replace(
+            self, link_faults=self.link_faults + (LinkFault(**kwargs),)
+        )
+
+    def with_loss(self, drop: float, **kwargs) -> "FaultPlan":
+        """Uniform loss on every link (retransmitted by default)."""
+        return self.with_link_fault(drop=drop, **kwargs)
+
+    def with_crash(self, process_id: int, at_ms: int) -> "FaultPlan":
+        return dataclasses.replace(
+            self, crashes=self.crashes + (Crash(process_id, at_ms),)
+        )
+
+    def with_pause(self, process_id: int, at_ms: int, until_ms: int) -> "FaultPlan":
+        assert until_ms > at_ms
+        return dataclasses.replace(
+            self, pauses=self.pauses + (Pause(process_id, at_ms, until_ms),)
+        )
+
+    def with_partition(
+        self, groups, start_ms: int, heal_ms: Optional[int] = None
+    ) -> "FaultPlan":
+        part = Partition(tuple(tuple(g) for g in groups), start_ms, heal_ms)
+        return dataclasses.replace(self, partitions=self.partitions + (part,))
+
+    def crashed_ids(self) -> Tuple[int, ...]:
+        return tuple(sorted({c.process_id for c in self.crashes}))
+
+
+@dataclass
+class NemesisMark:
+    """Trace/bookkeeping marker the runner schedules at plan timestamps
+    (crash / pause / resume / partition / heal) so state transitions are
+    visible in the event trace and crash-time client accounting runs at
+    the right virtual instant."""
+
+    kind: str
+    detail: str
+    process_id: Optional[int] = None
+
+
+# delivery verdicts for Nemesis.on_deliver
+DELIVER = "deliver"
+DROP = "drop"
+DEFER = "defer"
+
+_MAX_RETRANSMITS = 64
+
+
+class Nemesis:
+    """Executes a :class:`FaultPlan` over the simulator's message flow."""
+
+    def __init__(self, plan: FaultPlan):
+        import random
+
+        self.plan = plan
+        self.rng = random.Random(plan.seed)
+        self.trace: List[Tuple[int, str, str]] = []
+        self._crash_at = {c.process_id: c.at_ms for c in plan.crashes}
+
+    # --- trace ---
+
+    def record(self, now: int, kind: str, detail: str) -> None:
+        self.trace.append((now, kind, detail))
+
+    def trace_lines(self) -> List[str]:
+        return [f"t={t}ms {kind} {detail}" for t, kind, detail in self.trace]
+
+    def trace_digest(self) -> str:
+        digest = hashlib.sha256()
+        for line in self.trace_lines():
+            digest.update(line.encode())
+            digest.update(b"\n")
+        return digest.hexdigest()
+
+    # --- fault state (pure functions of virtual time) ---
+
+    def is_dead(self, process_id: int, now: int) -> bool:
+        at = self._crash_at.get(process_id)
+        return at is not None and now >= at
+
+    def paused_until(self, process_id: int, now: int) -> Optional[int]:
+        for pause in self.plan.pauses:
+            if pause.process_id == process_id and pause.at_ms <= now < pause.until_ms:
+                return pause.until_ms
+        return None
+
+    def marks(self) -> List[Tuple[int, NemesisMark]]:
+        """(at_ms, mark) pairs the runner schedules up front."""
+        out: List[Tuple[int, NemesisMark]] = []
+        for crash in self.plan.crashes:
+            out.append(
+                (crash.at_ms, NemesisMark("crash", f"p{crash.process_id}", crash.process_id))
+            )
+        for pause in self.plan.pauses:
+            out.append((pause.at_ms, NemesisMark("pause", f"p{pause.process_id}")))
+            out.append((pause.until_ms, NemesisMark("resume", f"p{pause.process_id}")))
+        for part in self.plan.partitions:
+            groups = "|".join(",".join(map(str, g)) for g in part.groups)
+            out.append((part.start_ms, NemesisMark("partition", groups)))
+            if part.heal_ms is not None:
+                out.append((part.heal_ms, NemesisMark("heal", groups)))
+        return out
+
+    # --- send path ---
+
+    @staticmethod
+    def _pid(key: EndpointKey) -> Optional[int]:
+        kind, id_ = key
+        return id_ if kind == "process" else None
+
+    def on_send(
+        self,
+        now: int,
+        from_key: EndpointKey,
+        to_key: EndpointKey,
+        base_delay_ms: int,
+        msg: Any,
+    ) -> List[int]:
+        """Delivery delays for one message: ``[]`` = dropped forever,
+        one entry = normal (possibly retransmission-delayed) delivery,
+        two entries = delivered + duplicated."""
+        src, dst = self._pid(from_key), self._pid(to_key)
+        label = f"{from_key[0]}{from_key[1]}->{to_key[0]}{to_key[1]} {type(msg).__name__}"
+        if dst is not None and self.is_dead(dst, now):
+            self.record(now, "drop-dead", label)
+            return []
+        delay = base_delay_ms
+        if src is not None and dst is not None:
+            for part in self.plan.partitions:
+                if part.active(now) and part.separates(src, dst):
+                    if part.heal_ms is None:
+                        self.record(now, "drop-partition", label)
+                        return []
+                    # connection-level retry: delivered just after heal
+                    delay = (
+                        (part.heal_ms - now)
+                        + base_delay_ms
+                        + self.rng.randint(1, self.plan.retransmit_base_ms)
+                    )
+                    self.record(now, "defer-partition", f"{label} +{delay}ms")
+                    break
+        fault = next(
+            (f for f in self.plan.link_faults if f.matches(now, src, dst, msg)), None
+        )
+        if fault is None:
+            return [delay]
+        if fault.drop and self.rng.random() < fault.drop:
+            if not fault.retransmit:
+                self.record(now, "drop", label)
+                return []
+            # collapse the geometric retry sequence (exponential backoff,
+            # full jitter, capped) into one deterministic extra delay
+            rto = self.plan.retransmit_base_ms
+            extra = 0
+            attempts = 1
+            while attempts < _MAX_RETRANSMITS:
+                extra += rto + self.rng.randint(0, rto)
+                rto = min(rto * 2, 8 * self.plan.retransmit_base_ms)
+                attempts += 1
+                if self.rng.random() >= fault.drop:
+                    break
+            delay += extra
+            self.record(now, "retransmit", f"{label} x{attempts} +{extra}ms")
+        if fault.extra_delay_ms:
+            jitter = self.rng.randint(0, fault.extra_delay_ms)
+            delay += jitter
+            if jitter:
+                self.record(now, "delay", f"{label} +{jitter}ms")
+        delays = [delay]
+        # duplication only applies between processes: client channels carry
+        # submit/result frames the client layer does not dedup (the run
+        # layer's seq-numbered peer links are the real-world analog)
+        if (
+            fault.duplicate
+            and src is not None
+            and dst is not None
+            and self.rng.random() < fault.duplicate
+        ):
+            dup = delay + self.rng.randint(1, max(1, self.plan.retransmit_base_ms))
+            delays.append(dup)
+            self.record(now, "duplicate", f"{label} +{dup}ms")
+        return delays
+
+    # --- delivery path ---
+
+    def on_deliver(self, now: int, process_id: int) -> Tuple[str, Optional[int]]:
+        """Verdict for an action about to be handled by ``process_id``:
+        (DELIVER, None) | (DROP, None) | (DEFER, resume_at_ms)."""
+        if self.is_dead(process_id, now):
+            return DROP, None
+        until = self.paused_until(process_id, now)
+        if until is not None:
+            return DEFER, until
+        return DELIVER, None

@@ -22,8 +22,10 @@ from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import ClientId, ProcessId, ShardId, process_ids
 from fantoch_tpu.core.metrics import Histogram, Metrics
 from fantoch_tpu.core.planet import Planet, Region
+from fantoch_tpu.errors import SimStalledError
 from fantoch_tpu.executor.monitor import ExecutionOrderMonitor
 from fantoch_tpu.protocol.base import Protocol, ToForward, ToSend
+from fantoch_tpu.sim.faults import DEFER, DELIVER, DROP, FaultPlan, Nemesis, NemesisMark
 from fantoch_tpu.sim.schedule import Schedule
 from fantoch_tpu.sim.simulation import Simulation
 from fantoch_tpu.utils import closest_process_per_shard, sort_processes_by_distance
@@ -63,6 +65,17 @@ class PeriodicExecutedNotification:
     delay_ms: int
 
 
+@dataclass
+class PeriodicExecutorWatchdog:
+    """Bounded-wait liveness check: under a fault plan, every executor's
+    ``monitor_pending`` runs on this tick so a command stuck on
+    dependencies from a dead replica surfaces a typed error instead of
+    hanging the run (Config.executor_pending_fail_ms)."""
+
+    process_id: ProcessId
+    delay_ms: int
+
+
 class Runner:
     def __init__(
         self,
@@ -74,6 +87,7 @@ class Runner:
         process_regions: List[Region],
         client_regions: List[Region],
         seed: Optional[int] = None,
+        fault_plan: Optional[FaultPlan] = None,
     ):
         assert len(process_regions) == config.n, "one region per process"
         assert config.gc_interval_ms is not None, "sim requires gc running"
@@ -85,6 +99,9 @@ class Runner:
         self._rng = random.Random(seed)
         self._make_distances_symmetric = False
         self._reorder_messages = False
+        self._nemesis: Optional[Nemesis] = (
+            Nemesis(fault_plan) if fault_plan is not None else None
+        )
 
         # a single shard in simulation
         shard_id = 0
@@ -125,6 +142,9 @@ class Runner:
                 self._simulation.register_client(client)
                 self._client_to_region[client_id] = region
         self._client_count = client_id
+        # clients still owed results; crashes remove the ones attached to
+        # dead processes so the loop does not wait for them forever
+        self._active_clients = set(self._client_to_region)
 
         # schedule periodic events
         for process_id, event, delay in periodic_events:
@@ -136,6 +156,20 @@ class Runner:
                 self._simulation.time, delay, PeriodicExecutedNotification(process_id, delay)
             )
 
+        # fault plan: schedule state-transition marks at their virtual
+        # timestamps, plus the executor bounded-wait watchdog
+        if self._nemesis is not None:
+            for at_ms, mark in self._nemesis.marks():
+                self._schedule.schedule(self._simulation.time, at_ms, mark)
+            watchdog = config.executor_monitor_pending_interval_ms
+            if watchdog is not None:
+                for pid in self._process_to_region:
+                    self._schedule.schedule(
+                        self._simulation.time,
+                        watchdog,
+                        PeriodicExecutorWatchdog(pid, watchdog),
+                    )
+
     # --- adversity knobs (runner.rs:192-198) ---
 
     def make_distances_symmetric(self) -> None:
@@ -143,6 +177,10 @@ class Runner:
 
     def reorder_messages(self) -> None:
         self._reorder_messages = True
+
+    @property
+    def nemesis(self) -> Optional[Nemesis]:
+        return self._nemesis
 
     # --- main loop ---
 
@@ -165,36 +203,109 @@ class Runner:
         )
 
     def _simulation_loop(self, extra_sim_time_ms: Optional[int]) -> None:
-        clients_done = 0
         extra_phase = False
         final_time = 0
         while True:
             action = self._schedule.next_action(self._simulation.time)
-            assert action is not None, "there should be a next action (periodics always run)"
+            if action is None:
+                # only reachable under a fault plan: without one periodics
+                # reschedule forever.  An empty schedule means the nemesis
+                # dropped every remaining event (e.g. all processes
+                # crashed) — clean exit if nobody is owed a result
+                assert self._nemesis is not None, (
+                    "there should be a next action (periodics always run)"
+                )
+                if not self._active_clients:
+                    return
+                now = self._simulation.time.millis()
+                raise SimStalledError(now, now, self._active_clients)
+            now = self._simulation.time.millis()
+            if self._nemesis is not None:
+                bound = self._nemesis.plan.max_sim_time_ms
+                if bound is not None and now > bound and self._active_clients:
+                    raise SimStalledError(now, bound, self._active_clients)
+                action = self._apply_faults(action, now)
+                if action is None:
+                    continue
             if isinstance(action, PeriodicProcessEvent):
                 self._handle_periodic_process_event(action)
             elif isinstance(action, PeriodicExecutedNotification):
                 self._handle_periodic_executed_notification(action)
+            elif isinstance(action, PeriodicExecutorWatchdog):
+                self._handle_executor_watchdog(action)
             elif isinstance(action, SubmitToProc):
                 self._handle_submit_to_proc(action.process_id, action.cmd)
             elif isinstance(action, SendToProc):
                 self._handle_send_to_proc(action.from_, action.from_shard_id, action.to, action.msg)
             elif isinstance(action, SendToClient):
+                if action.client_id not in self._active_clients:
+                    continue  # abandoned (attached to a crashed process)
                 submit = self._simulation.forward_to_client(action.cmd_result)
                 if submit is not None:
                     process_id, cmd = submit
                     self._schedule_submit(("client", action.client_id), process_id, cmd)
                 else:
-                    clients_done += 1
-                    if clients_done == self._client_count:
-                        if extra_sim_time_ms is None:
-                            return
-                        extra_phase = True
-                        final_time = self._simulation.time.millis() + extra_sim_time_ms
+                    self._active_clients.discard(action.client_id)
             else:
                 raise AssertionError(f"unknown action {action}")
+            if not extra_phase and not self._active_clients:
+                if extra_sim_time_ms is None:
+                    return
+                extra_phase = True
+                final_time = self._simulation.time.millis() + extra_sim_time_ms
             if extra_phase and self._simulation.time.millis() > final_time:
                 return
+
+    # --- fault plane (sim/faults.py) ---
+
+    def _apply_faults(self, action: Any, now: int):
+        """Nemesis delivery-time verdict for one popped action; returns the
+        action to handle, or None when it was dropped, deferred, or was a
+        nemesis bookkeeping mark."""
+        if isinstance(action, NemesisMark):
+            self._handle_nemesis_mark(action, now)
+            return None
+        process_id = None
+        periodic = False
+        if isinstance(
+            action,
+            (PeriodicProcessEvent, PeriodicExecutedNotification, PeriodicExecutorWatchdog),
+        ):
+            process_id, periodic = action.process_id, True
+        elif isinstance(action, SubmitToProc):
+            process_id = action.process_id
+        elif isinstance(action, SendToProc):
+            process_id = action.to
+        if process_id is None:
+            return action
+        verdict, resume_ms = self._nemesis.on_deliver(now, process_id)
+        if verdict == DELIVER:
+            return action
+        if verdict == DROP:
+            # dead process: periodic events stop for good (never
+            # rescheduled); in-flight messages evaporate
+            if not periodic:
+                self._nemesis.record(now, "drop-dead", f"{type(action).__name__}->p{process_id}")
+            return None
+        assert verdict == DEFER and resume_ms is not None
+        self._schedule.schedule(self._simulation.time, resume_ms - now, action)
+        return None
+
+    def _handle_nemesis_mark(self, mark: NemesisMark, now: int) -> None:
+        self._nemesis.record(now, mark.kind, mark.detail)
+        if mark.kind == "crash" and mark.process_id is not None:
+            # abandon clients attached to the dead process: their commands
+            # can no longer complete, so the loop must not wait for them
+            doomed = {
+                client_id
+                for client_id in self._active_clients
+                if mark.process_id in self._simulation.get_client(client_id).targets()
+            }
+            if doomed:
+                self._active_clients -= doomed
+                self._nemesis.record(
+                    now, "clients-abandoned", ",".join(map(str, sorted(doomed)))
+                )
 
     # --- handlers ---
 
@@ -210,6 +321,14 @@ class Runner:
         if executed is not None:
             process.handle_executed(executed, self._simulation.time)
             self._send_to_processes_and_executors(ev.process_id)
+        self._schedule.schedule(self._simulation.time, ev.delay_ms, ev)
+
+    def _handle_executor_watchdog(self, ev: PeriodicExecutorWatchdog) -> None:
+        """Bounded-wait check: raises a typed StalledExecutionError (via
+        Config.executor_pending_fail_ms) when a committed command has been
+        waiting on never-committing dependencies past the bound."""
+        _, executor, _ = self._simulation.get_process(ev.process_id)
+        executor.monitor_pending(self._simulation.time)
         self._schedule.schedule(self._simulation.time, ev.delay_ms, ev)
 
     def _handle_submit_to_proc(self, process_id: ProcessId, cmd: Command) -> None:
@@ -291,7 +410,17 @@ class Runner:
         distance = self._distance(self._region_of(from_key), self._region_of(to_key))
         if self._reorder_messages:
             distance = int(distance * self._rng.uniform(0.0, 10.0))
-        self._schedule.schedule(self._simulation.time, distance, action)
+        if self._nemesis is None:
+            self._schedule.schedule(self._simulation.time, distance, action)
+            return
+        now = self._simulation.time.millis()
+        msg = getattr(action, "msg", None) or getattr(action, "cmd", None) or action
+        delays = self._nemesis.on_send(now, from_key, to_key, distance, msg)
+        for index, delay in enumerate(delays):
+            # a duplicated delivery gets its own deep copy: receivers may
+            # mutate payloads in place (same reason ToSend fans out copies)
+            copy_ = action if index == 0 else copy.deepcopy(action)
+            self._schedule.schedule(self._simulation.time, delay, copy_)
 
     def _region_of(self, key) -> Region:
         kind, id_ = key

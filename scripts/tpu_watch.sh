@@ -8,10 +8,15 @@ cd "$(dirname "$0")/.."
 for i in $(seq 1 100); do
   if env -u JAX_PLATFORMS timeout 90 python -u -c "import jax; print(jax.devices()[0].platform)" >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) tunnel up — running bench" >> tpu_watch.log
-    # outer budget > probe retries + TPU child (1500s) + CPU fallback
-    # child (1500s), so a hung TPU child can't starve the fallback
+    # outer budget > probe retries + TPU child + CPU fallback child, so a
+    # hung TPU child can't starve the fallback.  Derived from the same
+    # env var bench.py reads (child timeout, default 1500s): a raised
+    # FANTOCH_BENCH_TIMEOUT_S used to overflow the old hardcoded 3400
+    # and silently truncate the CPU fallback.
+    child_timeout="${FANTOCH_BENCH_TIMEOUT_S:-1500}"
+    outer_budget=$((2 * child_timeout + 400))
     before=$(stat -c %Y BENCH_TPU_LATEST.json 2>/dev/null || echo 0)
-    out=$(env -u JAX_PLATFORMS timeout 3400 python -u bench.py 2>>tpu_watch.log)
+    out=$(env -u JAX_PLATFORMS timeout "$outer_budget" python -u bench.py 2>>tpu_watch.log)
     rc=$?
     echo "$out" >> tpu_watch.log
     echo "$(date -u +%H:%M:%S) bench rc=$rc" >> tpu_watch.log

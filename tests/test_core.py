@@ -133,6 +133,26 @@ def test_command_read_only():
     assert not rw.read_only
 
 
+def test_command_from_single_matches_general_constructor():
+    """from_single's __new__ fast path must stay equivalent to __init__
+    (every derived field included), so adding a Command field without
+    updating the fast path is caught here instead of drifting silently."""
+    for op in (KVOp.put("v"), KVOp.get(), KVOp.delete()):
+        rifl = Rifl(3, 7)
+        fast = Command.from_single(rifl, 2, "key", op)
+        general = Command(rifl, {2: {"key": (op,)}})
+        assert fast == general
+        assert fast.read_only == general.read_only
+        assert fast.total_key_count == general.total_key_count
+        assert fast.shard_count == general.shard_count
+        assert list(fast.iter_ops(2)) == list(general.iter_ops(2))
+    # the fast path must cover every slot __init__ fills — a new slot
+    # would show up here as an AttributeError on the fast-path object
+    fast = Command.from_single(Rifl(1, 1), 0, "k", KVOp.get())
+    for slot in Command.__slots__:
+        assert getattr(fast, slot) == getattr(Command(Rifl(1, 1), {0: {"k": (KVOp.get(),)}}), slot)
+
+
 def test_command_result_aggregation():
     rifl = Rifl(9, 1)
     res = CommandResult(rifl, 2)

@@ -10,8 +10,20 @@ AggregatePending to the sessions.
 
 import asyncio
 
+import jax
 import numpy as np
 import pytest
+
+# jaxlib 0.4.x CPU segfaults *flakily* while tracing the device drivers'
+# scan bodies (C-stack overflow in _scan tracing) — a crash mid-suite
+# aborts the whole pytest run, so on that pin this module is skipped
+# outright rather than allowed to take the suite down with it
+if tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5):
+    pytest.skip(
+        "jax<0.5: device-driver scan tracing segfaults flakily on this "
+        "jaxlib; run the device suite on the jax>=0.5 pin",
+        allow_module_level=True,
+    )
 
 from fantoch_tpu.client import ConflictRateKeyGen, Workload
 from fantoch_tpu.core import Command, Config, Dot, KVOp, Rifl

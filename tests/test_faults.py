@@ -1,0 +1,503 @@
+"""Fault-tolerance matrix: the paper's actual claim, finally under test.
+
+The leaderless protocols fantoch reproduces stay live and linearizable
+with up to ``f`` crashed replicas over a lossy network.  These tests drive
+the deterministic nemesis (fantoch_tpu/sim/faults.py) and the
+crash-tolerant run layer (fantoch_tpu/run/links.py + process_runner.py)
+through that claim:
+
+* **Determinism** — same FaultPlan seed twice => byte-identical fault
+  trace and committed/executed-command trace.
+* **Liveness under crash + loss** — crash replicas mid-run under >= 10%
+  message loss (retransmitted: lossy network, quasi-reliable channels —
+  exactly what the protocols assume of TCP); surviving clients' commands
+  all commit and execute with write-order agreement across surviving
+  replicas.
+* **Bounded wait** — where liveness is *not* achievable (an isolated
+  coordinator's dots stranded in survivors' dependency sets; a crashed
+  fast-quorum member with no recovery protocol — recovery is explicitly
+  NotImplemented in protocol/graph_protocol.py), the run surfaces a typed
+  error (StalledExecutionError / SimStalledError) instead of hanging.
+* **Run layer** — severing live TCP connections mid-run triggers
+  reconnect-with-backoff + seq/ack resend and the workload completes;
+  losing peers past quorum surfaces a typed QuorumLostError.
+
+Topology note: fast quorums are fixed per command at submit time
+(BaseProcess.discover), so a *crashed quorum member* stalls in-flight
+commands forever absent recovery.  The crash-liveness rows therefore use
+a planet where the crashed replicas are the farthest from everyone —
+outside every survivor's fast quorum — which is precisely the deployment
+argument the papers make (quorums of nearby replicas tolerate the loss
+of distant ones).  Quorum-member failure is covered by the pause rows
+(transient outage, must heal) and the bounded-wait rows (permanent, must
+fail loudly), not silently skipped.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from fantoch_tpu.client import ConflictRateKeyGen, Workload
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.core.planet import Region
+from fantoch_tpu.errors import (
+    QuorumLostError,
+    SimStalledError,
+    StalledExecutionError,
+)
+from fantoch_tpu.protocol import Atlas, Basic, EPaxos, Newt
+from fantoch_tpu.sim import Runner
+from fantoch_tpu.sim.faults import FaultPlan
+
+from harness import check_monitors
+
+pytestmark = pytest.mark.chaos
+
+# CI-shrunk load, like tests/harness.py
+COMMANDS_PER_CLIENT = 5 if os.environ.get("CI") else 10
+CLIENTS_PER_PROCESS = 2
+
+
+def edge_planet(n, far=1):
+    """n regions where the last ``far`` are 200ms from everyone and the
+    rest are ~10ms apart: the far replicas land outside every core
+    replica's fast quorum (distance-sorted, BaseProcess.discover)."""
+    regions = [Region(f"r{i}") for i in range(n)]
+    latencies = {}
+    for i, a in enumerate(regions):
+        latencies[a] = {}
+        for j, b in enumerate(regions):
+            if i == j:
+                d = 0
+            elif i >= n - far or j >= n - far:
+                d = 200
+            else:
+                d = 10 + abs(i - j)
+            latencies[a][b] = d
+    return regions, Planet.from_latencies(latencies)
+
+
+def chaos_sim(
+    protocol_cls,
+    config: Config,
+    plan: FaultPlan,
+    far: int = 1,
+    clients_on_far: bool = False,
+    commands_per_client: int = COMMANDS_PER_CLIENT,
+    conflict_rate: int = 50,
+    keys_per_command: int = 2,
+    seed: int = 0,
+    extra_sim_time_ms: int = 2000,
+):
+    """Run one nemesis scenario; returns (runner, metrics, monitors)."""
+    n = config.n
+    regions, planet = edge_planet(n, far)
+    config = config.with_(
+        executor_monitor_execution_order=True,
+        gc_interval_ms=100,
+        executor_executed_notification_interval_ms=100,
+        shard_count=1,
+    )
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(conflict_rate),
+        keys_per_command=keys_per_command,
+        commands_per_client=commands_per_client,
+        payload_size=1,
+    )
+    client_regions = regions if clients_on_far else regions[: n - far]
+    runner = Runner(
+        protocol_cls,
+        planet,
+        config,
+        workload,
+        CLIENTS_PER_PROCESS,
+        process_regions=regions,
+        client_regions=list(client_regions),
+        seed=seed,
+        fault_plan=plan,
+    )
+    metrics, monitors, _latencies = runner.run(extra_sim_time_ms=extra_sim_time_ms)
+    return runner, metrics, monitors
+
+
+def assert_survivors_done_and_agree(runner, monitors, crashed_ids):
+    """Liveness + safety: every client not attached to a crashed replica
+    finished its whole workload, and all surviving replicas executed
+    conflicting writes in the same order."""
+    crashed = set(crashed_ids)
+    for client_id, client in runner._simulation.clients():
+        if client.targets() & crashed:
+            continue  # abandoned with its crashed replica
+        assert client.issued_commands == COMMANDS_PER_CLIENT, (
+            f"surviving client {client_id} finished only "
+            f"{client.issued_commands}/{COMMANDS_PER_CLIENT} commands"
+        )
+    check_monitors({pid: m for pid, m in monitors.items() if pid not in crashed})
+
+
+def crash_loss_plan(n, loss, seed=7, crash_at_ms=150, crashed=1):
+    plan = FaultPlan(seed=seed, max_sim_time_ms=300_000).with_loss(loss)
+    for k in range(crashed):
+        plan = plan.with_crash(n - k, at_ms=crash_at_ms)
+    return plan
+
+
+# --- determinism: same seed => byte-identical traces ---
+
+
+def _determinism_traces():
+    plan = (
+        FaultPlan(seed=11, max_sim_time_ms=300_000)
+        .with_loss(0.2)
+        .with_link_fault(duplicate=0.3, msg_types=("MCollect", "MCommit"))
+        .with_link_fault(extra_delay_ms=40)
+        .with_crash(5, at_ms=200)
+        .with_partition([(1,), (2, 3)], start_ms=100, heal_ms=400)
+    )
+    runner, metrics, monitors = chaos_sim(EPaxos, Config(5, 1), plan)
+    committed = {
+        pid: (sorted(str(k) for k in m.keys()), repr(m)) for pid, m in monitors.items()
+    }
+    return runner.nemesis.trace_lines(), runner.nemesis.trace_digest(), committed
+
+
+def test_fault_plan_determinism():
+    """Same FaultPlan seed twice over the same sim => identical fault
+    trace (every drop/retransmit/duplicate decision) AND identical
+    committed/executed order on every process."""
+    trace_a, digest_a, committed_a = _determinism_traces()
+    trace_b, digest_b, committed_b = _determinism_traces()
+    assert trace_a == trace_b
+    assert digest_a == digest_b
+    assert committed_a == committed_b
+    assert trace_a, "the plan must actually have injected faults"
+
+
+# --- liveness: crash f mid-run under message loss ---
+
+
+def test_crash_epaxos_5_under_loss():
+    runner, _metrics, monitors = chaos_sim(
+        EPaxos, Config(5, 1), crash_loss_plan(5, loss=0.15)
+    )
+    assert_survivors_done_and_agree(runner, monitors, crashed_ids=[5])
+    # the crash actually happened and bit: messages died on the dead link
+    kinds = {kind for _t, kind, _d in runner.nemesis.trace}
+    assert {"crash", "retransmit", "drop-dead"} <= kinds
+
+
+def test_crash_atlas_5_1_under_loss():
+    runner, _metrics, monitors = chaos_sim(
+        Atlas, Config(5, 1), crash_loss_plan(5, loss=0.15)
+    )
+    assert_survivors_done_and_agree(runner, monitors, crashed_ids=[5])
+
+
+def test_crash_newt_5_1_under_loss():
+    runner, _metrics, monitors = chaos_sim(
+        Newt,
+        Config(5, 1, newt_detached_send_interval_ms=100),
+        crash_loss_plan(5, loss=0.15),
+    )
+    assert_survivors_done_and_agree(runner, monitors, crashed_ids=[5])
+
+
+def test_atlas_5_2_two_replica_outage():
+    """Atlas f=2: two replicas fail mid-run — one crashes for good (the
+    far one, outside every fast quorum), one fast-quorum member pauses
+    and heals (with fq = n//2 + f = 4 of 5, every in-flight command needs
+    it; a *permanent* second crash requires the recovery protocol, which
+    is explicitly NotImplemented).  Everything must commit and agree."""
+    plan = (
+        FaultPlan(seed=5, max_sim_time_ms=600_000)
+        .with_loss(0.10)
+        .with_crash(5, at_ms=150)
+        .with_pause(4, at_ms=300, until_ms=1500)
+    )
+    runner, _metrics, monitors = chaos_sim(Atlas, Config(5, 2), plan)
+    assert_survivors_done_and_agree(runner, monitors, crashed_ids=[5])
+
+
+def test_crash_abandons_attached_clients():
+    """Clients attached to a crashed replica are abandoned (counted out of
+    the run) while everyone else's workload completes."""
+    plan = FaultPlan(seed=3, max_sim_time_ms=300_000).with_loss(0.1).with_crash(
+        3, at_ms=120
+    )
+    runner, _metrics, _monitors = chaos_sim(
+        Basic, Config(3, 1), plan, clients_on_far=True
+    )
+    abandoned = [
+        client_id
+        for client_id, client in runner._simulation.clients()
+        if 3 in client.targets()
+    ]
+    assert abandoned, "the far replica should have had attached clients"
+    for client_id, client in runner._simulation.clients():
+        if client_id in abandoned:
+            assert client.issued_commands < COMMANDS_PER_CLIENT
+        else:
+            assert client.issued_commands == COMMANDS_PER_CLIENT
+    assert any(kind == "clients-abandoned" for _t, kind, _d in runner.nemesis.trace)
+
+
+def test_partition_heal_epaxos():
+    """A symmetric partition that heals: crossing messages are deferred
+    (connection-retry semantics), nothing is lost, everything commits
+    once the cut heals — including the minority side's clients."""
+    plan = (
+        FaultPlan(seed=9, max_sim_time_ms=300_000)
+        .with_loss(0.05)
+        .with_partition([(1,), (2, 3)], start_ms=100, heal_ms=500)
+    )
+    runner, _metrics, monitors = chaos_sim(
+        EPaxos, Config(3, 1), plan, far=0, clients_on_far=True
+    )
+    assert_survivors_done_and_agree(runner, monitors, crashed_ids=[])
+    assert any(kind == "defer-partition" for _t, kind, _d in runner.nemesis.trace)
+
+
+# --- bounded wait: stalls surface typed errors, never hang ---
+
+
+def test_executor_stall_surfaces_typed_error():
+    """Permanently isolating a coordinator strands its in-flight dots in
+    the survivors' dependency sets: their graph executors must raise a
+    typed StalledExecutionError naming the missing dots (bounded wait),
+    not wait forever."""
+    config = Config(
+        5,
+        1,
+        executor_monitor_pending_interval_ms=500,
+        executor_pending_fail_ms=5_000,
+    )
+    plan = (
+        FaultPlan(seed=2, max_sim_time_ms=60_000)
+        .with_link_fault(src=5, drop=1.0, retransmit=False, from_ms=600)
+        .with_link_fault(dst=5, drop=1.0, retransmit=False, from_ms=600)
+    )
+    with pytest.raises((StalledExecutionError, SimStalledError)) as err:
+        chaos_sim(
+            EPaxos,
+            config,
+            plan,
+            clients_on_far=True,
+            conflict_rate=100,
+            keys_per_command=1,
+            commands_per_client=20,
+        )
+    if isinstance(err.value, StalledExecutionError):
+        # the missing dependencies are the isolated coordinator's dots
+        assert err.value.missing
+        assert all(
+            dep.source == 5 for deps in err.value.missing.values() for dep in deps
+        )
+
+
+def test_crashed_quorum_member_stall_is_bounded():
+    """Crashing a fast-quorum member stalls in-flight collects (recovery
+    is NotImplemented); the sim's virtual-time bound must convert the
+    hang into a typed SimStalledError listing the waiting clients."""
+    plan = FaultPlan(seed=1, max_sim_time_ms=20_000).with_crash(2, at_ms=100)
+    with pytest.raises(SimStalledError) as err:
+        chaos_sim(
+            EPaxos, Config(3, 1), plan, far=0, conflict_rate=100, keys_per_command=1
+        )
+    assert err.value.waiting_clients
+
+
+# --- the slow rows: crash x loss x protocol sweep ---
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("loss", [0.1, 0.3])
+@pytest.mark.parametrize(
+    "protocol_cls,config",
+    [
+        (EPaxos, Config(5, 1)),
+        (Atlas, Config(5, 1)),
+        (Atlas, Config(5, 1, batched_graph_executor=True)),
+        (Newt, Config(5, 1, newt_detached_send_interval_ms=100)),
+    ],
+    ids=["epaxos", "atlas", "atlas-batched", "newt"],
+)
+def test_crash_matrix(protocol_cls, config, loss):
+    runner, _metrics, monitors = chaos_sim(
+        protocol_cls, config, crash_loss_plan(5, loss=loss)
+    )
+    assert_survivors_done_and_agree(runner, monitors, crashed_ids=[5])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_determinism_across_seeds(seed):
+    """Different seeds explore different schedules; each is individually
+    reproducible."""
+    plan = (
+        FaultPlan(seed=seed, max_sim_time_ms=300_000)
+        .with_loss(0.25)
+        .with_crash(5, at_ms=100 + 50 * seed)
+    )
+    first = chaos_sim(EPaxos, Config(5, 1), plan)[0].nemesis.trace_digest()
+    second = chaos_sim(EPaxos, Config(5, 1), plan)[0].nemesis.trace_digest()
+    assert first == second
+
+
+# --- run layer: reconnect + quorum degradation over real TCP ---
+
+
+def test_run_reconnect_completes_workload():
+    """Severing every one of a peer's live TCP connections mid-run must
+    trigger reconnect-with-backoff + seq/ack resend, and the cluster
+    completes the whole workload with no runtime failure and no peer
+    declared dead."""
+    from fantoch_tpu.run.harness import run_localhost_cluster
+    from fantoch_tpu.run.links import ReconnectPolicy
+
+    commands = 20
+
+    async def chaos(runtimes):
+        await asyncio.sleep(0.3)
+        severed = runtimes[3].inject_link_failure()
+        assert severed > 0, "the chaos hook found no live sockets to sever"
+        for pid in (1, 2):
+            runtimes[pid].inject_link_failure(peer_id=3)
+
+    async def scenario():
+        config = Config(
+            n=3,
+            f=1,
+            executor_monitor_execution_order=True,
+            gc_interval_ms=50,
+            executor_executed_notification_interval_ms=50,
+        )
+        workload = Workload(
+            shard_count=1,
+            key_gen=ConflictRateKeyGen(50),
+            keys_per_command=2,
+            commands_per_client=commands,
+            payload_size=1,
+        )
+        return await run_localhost_cluster(
+            EPaxos,
+            config,
+            workload,
+            2,
+            open_loop_interval_ms=10,
+            extra_run_time_ms=500,
+            runtime_kwargs=dict(
+                reconnect_policy=ReconnectPolicy(attempts=10, base_s=0.02, cap_s=0.2),
+                heartbeat_interval_s=0.2,
+                heartbeat_misses=25,
+            ),
+            chaos=chaos,
+        )
+
+    runtimes, clients = asyncio.run(scenario())
+    for client in clients.values():
+        assert client.issued_commands == commands
+    for pid, runtime in runtimes.items():
+        assert runtime.failure is None, (pid, runtime.failure)
+        assert not runtime.dead_peers, (pid, runtime.dead_peers)
+
+
+def test_run_below_quorum_typed_failure():
+    """Killing peers past the quorum line must surface a clean, typed
+    QuorumLostError through ProcessRuntime.failed — never a hang."""
+    from fantoch_tpu.run.harness import free_port
+    from fantoch_tpu.run.links import ReconnectPolicy
+    from fantoch_tpu.run.process_runner import ProcessRuntime
+
+    async def scenario():
+        config = Config(n=3, f=1, gc_interval_ms=50)
+        peer_ports = {pid: free_port() for pid in (1, 2, 3)}
+        client_ports = {pid: free_port() for pid in (1, 2, 3)}
+        runtimes = {}
+        for pid in (1, 2, 3):
+            runtimes[pid] = ProcessRuntime(
+                EPaxos,
+                pid,
+                0,
+                config,
+                listen_addr=("127.0.0.1", peer_ports[pid]),
+                client_addr=("127.0.0.1", client_ports[pid]),
+                peers={
+                    p: ("127.0.0.1", peer_ports[p]) for p in (1, 2, 3) if p != pid
+                },
+                sorted_processes=[(pid, 0)]
+                + [(p, 0) for p in (1, 2, 3) if p != pid],
+                reconnect_policy=ReconnectPolicy(attempts=3, base_s=0.02, cap_s=0.1),
+                heartbeat_interval_s=0.1,
+                heartbeat_misses=5,
+            )
+        await asyncio.gather(*(r.start() for r in runtimes.values()))
+        await asyncio.sleep(0.3)
+        # kill two of three: the survivor is below quorum (alive 1 < n-f=2)
+        await runtimes[2].stop()
+        await runtimes[3].stop()
+        try:
+            await asyncio.wait_for(runtimes[1].failed.wait(), timeout=20)
+        finally:
+            failure = runtimes[1].failure
+            await runtimes[1].stop()
+        return failure
+
+    failure = asyncio.run(scenario())
+    assert isinstance(failure, QuorumLostError), failure
+    assert failure.alive == 1 and failure.needed == 2
+    assert failure.dead_peers == [2, 3]
+
+
+def test_run_degrades_gracefully_above_quorum():
+    """Losing one peer of three (f=1) is survivable: the runtime records
+    the dead peer, logs degradation, and does NOT fail."""
+    from fantoch_tpu.run.harness import free_port
+    from fantoch_tpu.run.links import ReconnectPolicy
+    from fantoch_tpu.run.process_runner import ProcessRuntime
+
+    async def scenario():
+        config = Config(n=3, f=1, gc_interval_ms=50)
+        peer_ports = {pid: free_port() for pid in (1, 2, 3)}
+        client_ports = {pid: free_port() for pid in (1, 2, 3)}
+        runtimes = {}
+        for pid in (1, 2, 3):
+            runtimes[pid] = ProcessRuntime(
+                EPaxos,
+                pid,
+                0,
+                config,
+                listen_addr=("127.0.0.1", peer_ports[pid]),
+                client_addr=("127.0.0.1", client_ports[pid]),
+                peers={
+                    p: ("127.0.0.1", peer_ports[p]) for p in (1, 2, 3) if p != pid
+                },
+                sorted_processes=[(pid, 0)]
+                + [(p, 0) for p in (1, 2, 3) if p != pid],
+                reconnect_policy=ReconnectPolicy(attempts=3, base_s=0.02, cap_s=0.1),
+                heartbeat_interval_s=0.1,
+                heartbeat_misses=5,
+            )
+        await asyncio.gather(*(r.start() for r in runtimes.values()))
+        await asyncio.sleep(0.3)
+        await runtimes[3].stop()
+        # wait until both survivors notice the dead peer
+        deadline = asyncio.get_running_loop().time() + 20
+        while asyncio.get_running_loop().time() < deadline:
+            if all(3 in runtimes[pid].dead_peers for pid in (1, 2)):
+                break
+            await asyncio.sleep(0.1)
+        state = {
+            pid: (runtimes[pid].failure, set(runtimes[pid].dead_peers))
+            for pid in (1, 2)
+        }
+        for pid in (1, 2):
+            await runtimes[pid].stop()
+        return state
+
+    state = asyncio.run(scenario())
+    for pid in (1, 2):
+        failure, dead = state[pid]
+        assert failure is None, f"p{pid} must degrade, not fail: {failure!r}"
+        assert dead == {3}

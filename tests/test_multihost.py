@@ -82,6 +82,79 @@ def test_multihost_divisibility_contract(monkeypatch):
         mh.make_multihost_mesh(num_replicas=4)  # 3 hosts
 
 
+def test_multihost_mesh_counts_total_rows_not_per_shard(monkeypatch):
+    """Sharded deployments size the mesh by n * shard_count rows
+    (shard-major, mesh_step.shard_of_row); validating against per-shard n
+    would accept meshes the device state cannot shard."""
+    import fantoch_tpu.parallel.multihost as mh
+
+    devs = [FakeDev(h * 2 + c, h) for h in range(3) for c in range(2)]
+    monkeypatch.setattr(mh.jax, "devices", lambda: devs)
+    monkeypatch.setattr(mh, "Mesh", lambda arr, axes: "mesh-sentinel")
+    # n=2 x 3 shards = 6 total rows over 3 hosts: whole shard blocks per
+    # host, accepted; per-shard n=2 alone would NOT divide by 3 hosts
+    assert mh.make_multihost_mesh(num_replicas=6, shard_count=3) == "mesh-sentinel"
+    with pytest.raises(ValueError, match="total replica rows"):
+        mh.make_multihost_mesh(num_replicas=2, shard_count=1)
+
+
+def test_multihost_mesh_warns_when_shard_blocks_straddle_hosts(monkeypatch, caplog):
+    """Shard-major blocks that don't align with host rows demote the
+    quorum fan-in to DCN — surfaced as a warning."""
+    import logging
+
+    import fantoch_tpu.parallel.multihost as mh
+
+    devs = [FakeDev(h * 2 + c, h) for h in range(4) for c in range(2)]
+    monkeypatch.setattr(mh.jax, "devices", lambda: devs)
+    monkeypatch.setattr(mh, "Mesh", lambda arr, axes: "mesh-sentinel")
+    with caplog.at_level(logging.WARNING, logger="fantoch_tpu"):
+        # 8 rows = 2 shards x n=4 over 4 hosts: 2 rows/host < 4-row blocks
+        mh.make_multihost_mesh(num_replicas=8, shard_count=2)
+    assert any("shard blocks" in r.message for r in caplog.records)
+
+
+def test_shard_of_row_is_shard_major():
+    """Pin the replica-row order the sharded device state uses: shard s
+    owns the contiguous block [s*n, (s+1)*n) (protocol_step's on-device
+    row // per_shard), NOT a replica-major interleave."""
+    from fantoch_tpu.parallel.mesh_step import shard_of_row
+
+    n, shards = 3, 2
+    total = n * shards
+    assert [shard_of_row(r, total, shards) for r in range(total)] == [0, 0, 0, 1, 1, 1]
+    # replica-major interleave would read [0, 1, 0, 1, 0, 1] — reject it
+    assert [shard_of_row(r, total, shards) for r in range(total)] != [0, 1, 0, 1, 0, 1]
+
+
+def test_distributed_init_auto_detect_times_out_fast(monkeypatch):
+    """A runner with SLURM env vars but no peers must hit the short
+    auto-detect barrier timeout, not jax's ~300 s default; an explicit
+    coordinator keeps the long default."""
+    import fantoch_tpu.parallel.multihost as mh
+
+    captured = {}
+
+    def fake_initialize(**kwargs):
+        captured.update(kwargs)
+
+    monkeypatch.setenv("SLURM_JOB_ID", "12345")
+    monkeypatch.setattr(mh, "_DISTRIBUTED_INITIALIZED", False)
+    monkeypatch.setattr(mh.jax.distributed, "initialize", fake_initialize)
+    assert mh.distributed_init() is True
+    assert captured["initialization_timeout"] == mh.AUTO_DETECT_INIT_TIMEOUT_S
+
+    captured.clear()
+    monkeypatch.setattr(mh, "_DISTRIBUTED_INITIALIZED", False)
+    assert mh.distributed_init(coordinator_address="10.0.0.1:1234") is True
+    assert "initialization_timeout" not in captured
+
+    captured.clear()
+    monkeypatch.setattr(mh, "_DISTRIBUTED_INITIALIZED", False)
+    assert mh.distributed_init(initialization_timeout_s=7) is True
+    assert captured["initialization_timeout"] == 7
+
+
 def test_distributed_init_noop_without_cluster(monkeypatch):
     import fantoch_tpu.parallel.multihost as mh
 

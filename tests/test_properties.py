@@ -24,6 +24,11 @@ _CI = bool(os.environ.get("CI"))
 
 import numpy as np
 import pytest
+
+# gate, don't error: containers without hypothesis skip the property
+# suite instead of failing collection (the reference's quickcheck dep is
+# likewise dev-only)
+hypothesis = pytest.importorskip("hypothesis")
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
