@@ -1,7 +1,7 @@
 # Test/bench entry points (the reference pins quality with Makefile:3-7 —
 # fmt + clippy + `cargo test` under a quickcheck budget; here the suite +
 # dryrun + bench are the equivalent gates).
-.PHONY: test test-fast test-chaos dryrun bench
+.PHONY: test test-fast test-chaos test-recovery dryrun bench
 
 test:
 	python -m pytest tests/ -x -q
@@ -15,6 +15,12 @@ test-fast:
 # `slow`-marked sweep rows tier-1 skips)
 test-chaos:
 	python -m pytest tests/test_faults.py -x -q -m chaos
+
+# the recovery slice: per-dot MPrepare/MPromise recovery, noop commits,
+# FPaxos leader failover (sim + TCP), and the crashed-coordinator model
+# checker rows
+test-recovery:
+	python -m pytest tests/ -x -q -m recovery
 
 dryrun:
 	python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"

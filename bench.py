@@ -897,6 +897,48 @@ def _probe_backend() -> bool:
 _TPU_RECORD_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LATEST.json"
 )
+# sidecar for chip runs that failed the scale_vs_1m self-consistency gate:
+# repeatedly-gated rounds are visible here (with reasons and timestamps)
+# instead of silently reusing a stale BENCH_TPU_LATEST.json (ADVICE r5)
+_TPU_GATED_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_GATED.json"
+)
+
+
+def _record_gated_candidate(rec: dict, reason: str) -> None:
+    """Append the gated measurement to the sidecar and count consecutive
+    gated rounds, so staleness of the persisted record is observable."""
+    entry = {
+        "gated_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "reason": reason,
+        "gated_candidate": rec,
+    }
+    try:
+        sidecar = {"consecutive_gated": 0, "entries": []}
+        if os.path.exists(_TPU_GATED_PATH):
+            with open(_TPU_GATED_PATH) as f:
+                sidecar = json.load(f)
+        sidecar["consecutive_gated"] = sidecar.get("consecutive_gated", 0) + 1
+        sidecar["entries"] = (sidecar.get("entries", []) + [entry])[-10:]
+        with open(_TPU_GATED_PATH, "w") as f:
+            json.dump(sidecar, f, indent=1)
+            f.write("\n")
+    except Exception as exc:  # noqa: BLE001 — bookkeeping must not fail the bench
+        print(f"# could not record gated candidate: {exc!r}", file=sys.stderr)
+
+
+def _clear_gated_streak() -> None:
+    """A persisted (un-gated) chip record resets the staleness counter."""
+    try:
+        if os.path.exists(_TPU_GATED_PATH):
+            with open(_TPU_GATED_PATH) as f:
+                sidecar = json.load(f)
+            sidecar["consecutive_gated"] = 0
+            with open(_TPU_GATED_PATH, "w") as f:
+                json.dump(sidecar, f, indent=1)
+                f.write("\n")
+    except Exception as exc:  # noqa: BLE001
+        print(f"# could not reset gated streak: {exc!r}", file=sys.stderr)
 
 
 def _save_tpu_record(line: str) -> None:
@@ -920,11 +962,12 @@ def _save_tpu_record(line: str) -> None:
         # the un-gated measurement.
         ratio = rec.get("scale_vs_1m")
         if ratio is None or not (1.0 <= ratio <= 16.0):
-            print(
-                f"# TPU record NOT persisted: scale_vs_1m={ratio} fails the "
-                "self-consistency gate [1, 16] (None = no cross-check ran)",
-                file=sys.stderr,
+            reason = (
+                f"scale_vs_1m={ratio} fails the self-consistency gate "
+                "[1, 16] (None = no cross-check ran)"
             )
+            print(f"# TPU record NOT persisted: {reason}", file=sys.stderr)
+            _record_gated_candidate(rec, reason)
             return
         rec["recorded_utc"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -932,6 +975,7 @@ def _save_tpu_record(line: str) -> None:
         with open(_TPU_RECORD_PATH, "w") as f:
             json.dump(rec, f)
             f.write("\n")
+        _clear_gated_streak()
     except Exception as exc:  # noqa: BLE001 — bookkeeping must not fail the bench
         print(f"# could not save TPU record: {exc!r}", file=sys.stderr)
 
@@ -945,6 +989,17 @@ def _attach_last_tpu(line: str) -> str:
             return line
         with open(_TPU_RECORD_PATH) as f:
             rec["last_tpu_record"] = json.load(f)
+        # staleness note: if chip runs since then kept failing the gate,
+        # say so instead of letting the stale record pass as fresh
+        if os.path.exists(_TPU_GATED_PATH):
+            with open(_TPU_GATED_PATH) as f:
+                streak = json.load(f).get("consecutive_gated", 0)
+            if streak:
+                rec["last_tpu_record"]["staleness_note"] = (
+                    f"{streak} chip run(s) since this record were gated by "
+                    "the scale_vs_1m self-consistency check; see "
+                    "BENCH_TPU_GATED.json"
+                )
         return json.dumps(rec)
     except Exception as exc:  # noqa: BLE001
         print(f"# could not attach TPU record: {exc!r}", file=sys.stderr)

@@ -96,6 +96,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "ProcessMetrics normally, JSON round/path tallies "
                         "under --device-step")
     parser.add_argument("--metrics-interval", type=int, default=5000, metavar="MS")
+    parser.add_argument(
+        "--heartbeat-interval", type=float, default=1.0, metavar="S",
+        help="peer failure-detector probe interval (seconds)")
+    parser.add_argument(
+        "--heartbeat-misses", type=int, default=8,
+        help="silent intervals before a peer is declared lost; raise on "
+             "contended machines (testbeds sharing one core) so CPU "
+             "starvation does not read as peer death")
     parser.add_argument("--execution-log", default=None)
     parser.add_argument("--tracer-show-interval", type=int, default=None, metavar="MS")
     parser.add_argument("--log-file", default=None)
@@ -213,6 +221,8 @@ async def serve(args: argparse.Namespace) -> None:
         metrics_interval_ms=args.metrics_interval,
         execution_log=args.execution_log,
         tracer_show_interval_ms=args.tracer_show_interval,
+        heartbeat_interval_s=args.heartbeat_interval,
+        heartbeat_misses=args.heartbeat_misses,
     )
     await runtime.start()
     print(f"p{args.id} ({args.protocol}) up on {args.ip}:{args.port}", flush=True)

@@ -43,6 +43,18 @@ class Config:
     # from the watchdog instead of hanging — the crash-tolerance contract
     # for deps owned by dead replicas (None keeps the log-only behavior)
     executor_pending_fail_ms: Optional[int] = None
+    # bounded wait before a process starts per-dot recovery consensus for a
+    # committed-overdue dot (MPrepare/MPromise over the embedded synod):
+    # the dot's owner retries first, ring successors stagger in afterwards.
+    # Pick it SMALLER than executor_pending_fail_ms so recovery races ahead
+    # of the executor watchdog (None disables recovery — the reference's
+    # todo!() behavior)
+    recovery_delay_ms: Optional[int] = None
+    # FPaxos leader failover: followers suspect a silent leader after this
+    # bound (ring successors stagger by distance) and run MultiSynod
+    # prepare/promise with accepted-slot carry-forward; the leader
+    # heartbeats at a quarter of it (None disables failover)
+    fpaxos_leader_timeout_ms: Optional[int] = None
     # record per-key execution order for agreement checks in tests
     executor_monitor_execution_order: bool = False
     # order committed commands with the batched device resolver

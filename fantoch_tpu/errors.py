@@ -57,17 +57,31 @@ class StalledExecutionError(FaultToleranceError):
     silently hanging the ordering engine.
     """
 
-    def __init__(self, process_id: int, missing: Dict, waited_ms: int):
+    def __init__(
+        self,
+        process_id: int,
+        missing: Dict,
+        waited_ms: int,
+        recovery_delay_ms: Optional[int] = None,
+    ):
         self.process_id = process_id
         self.missing = missing
         self.waited_ms = waited_ms
+        if recovery_delay_ms is None:
+            self.recovery_note = "recovery disabled (Config.recovery_delay_ms unset)"
+        else:
+            self.recovery_note = (
+                f"recovery was attempted every {recovery_delay_ms}ms but "
+                "could not commit these dots — likely no n-f promise "
+                "quorum among the survivors"
+            )
         detail = ", ".join(
             f"{dot} <- missing {sorted(map(str, deps))}"
             for dot, deps in sorted(missing.items(), key=lambda kv: str(kv[0]))
         )
         super().__init__(
             f"p{process_id}: execution stalled > {waited_ms}ms on "
-            f"dependencies that never commit: {detail}"
+            f"dependencies that never commit: {detail} [{self.recovery_note}]"
         )
 
 

@@ -66,8 +66,11 @@ class Executor(ABC, Generic[Info]):
     def cleanup(self, time: SysTime) -> None:
         """Periodic housekeeping (cross-shard request retries...)."""
 
-    def monitor_pending(self, time: SysTime) -> None:
-        """Liveness watchdog: check for stuck-but-satisfiable commands."""
+    def monitor_pending(self, time: SysTime):
+        """Liveness watchdog: check for stuck-but-satisfiable commands.
+        May return a set of missing dependency dots for the runner to feed
+        into the protocol's recovery plane (Protocol.nudge_recovery)."""
+        return None
 
     @abstractmethod
     def handle(self, info: Info, time: SysTime) -> None:

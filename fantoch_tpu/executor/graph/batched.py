@@ -382,7 +382,7 @@ class BatchedDependencyGraph(DependencyGraph):
         self._flush()
         return super().commands_to_execute()
 
-    def monitor_pending(self, time: SysTime) -> None:
+    def monitor_pending(self, time: SysTime):
         if not self._array_mode:
             return super().monitor_pending(time)
         self._flush(time)
@@ -394,7 +394,7 @@ class BatchedDependencyGraph(DependencyGraph):
         # dropped executed-notification) — panic naming the dots, exactly
         # like the reference's per-command pending monitor.
         if not self._backlog.count:
-            return
+            return None
         src, seq, _key, tms, deps = self._backlog.columns()
         from fantoch_tpu.executor.graph.indexes import MONITOR_PENDING_THRESHOLD_MS
 
@@ -406,10 +406,19 @@ class BatchedDependencyGraph(DependencyGraph):
         fail_ms = self._config.executor_pending_fail_ms
         ripe = pending_for >= fail_ms if fail_ms is not None else None
         if not old.any() and (ripe is None or not ripe.any()):
-            return
+            return None
         dep_rows = self._map_deps(src, seq, deps)
         batch = len(src)
         blocked = (dep_rows == MISSING).any(axis=1)
+        # missing dependency dots of old blocked rows: returned so the
+        # runner can nudge the protocol's recovery plane (deps_graph
+        # monitor_pending contract)
+        nudge = {
+            Dot(int(d) >> 32, int(d) & 0xFFFFFFFF)
+            for i in np.nonzero(blocked & old)[0]
+            for d, r in zip(deps[i], dep_rows[i])
+            if r == MISSING and d >= 0
+        }
         # bounded wait (Config.executor_pending_fail_ms): a row blocked on
         # a missing dependency past the fail bound raises a typed error —
         # a dot whose coordinator crashed before broadcasting commit never
@@ -427,7 +436,10 @@ class BatchedDependencyGraph(DependencyGraph):
                 from fantoch_tpu.errors import StalledExecutionError
 
                 raise StalledExecutionError(
-                    self._process_id, missing_map, int(pending_for[stalled].max())
+                    self._process_id,
+                    missing_map,
+                    int(pending_for[stalled].max()),
+                    self._config.recovery_delay_ms,
                 )
         # forward-propagate blockedness to dependents, vectorized with an
         # early exit the moment every old row is covered (the common case:
@@ -437,7 +449,7 @@ class BatchedDependencyGraph(DependencyGraph):
         while True:
             lost = old & ~blocked
             if not lost.any():
-                return
+                return nudge
             grown = blocked | np.where(valid, blocked[safe], False).any(axis=1)
             if (grown == blocked).all():
                 break
@@ -451,6 +463,7 @@ class BatchedDependencyGraph(DependencyGraph):
                 f"p{self._process_id}: {int(lost.sum())} commands pending "
                 f"without missing dependencies: {dots}"
             )
+        return nudge
 
     def _flush(self, time: Optional[SysTime] = None) -> None:
         if not self._array_mode or not self._dirty:

@@ -120,7 +120,7 @@ class DependencyGraph:
             for from_shard, dots in buffered.items():
                 self.process_requests(from_shard, dots, time)
 
-    def monitor_pending(self, time: SysTime) -> None:
+    def monitor_pending(self, time: SysTime):
         if self.executor_index == 0:
             fail_ms = self._config.executor_pending_fail_ms
             # a fail bound below the log threshold must still be honored:
@@ -130,12 +130,14 @@ class DependencyGraph:
                 if fail_ms is None
                 else min(MONITOR_PENDING_THRESHOLD_MS, fail_ms)
             )
-            self._vertex_index.monitor_pending(
+            return self._vertex_index.monitor_pending(
                 self._executed_clock,
                 threshold,
                 time,
                 fail_missing_after_ms=fail_ms,
+                recovery_delay_ms=self._config.recovery_delay_ms,
             )
+        return None
 
     def handle_executed(self, dots: Set[Dot], _time: SysTime) -> None:
         """Secondary executors absorb executed notifications from the main."""
@@ -176,6 +178,17 @@ class DependencyGraph:
         """
         for dot, cmd, deps in adds:
             self.handle_add(dot, cmd, deps, time)
+
+    def handle_noop(self, dot: Dot, time: SysTime) -> None:
+        """A recovered-noop commit: count the dot as executed and retry its
+        dependents — the RequestReplyExecuted path minus the network.  The
+        batched subclass inherits this unchanged: its ``_executed_clock``
+        aliases the device frontier and its ``_check_pending`` override
+        marks the backlog dirty for the next resolve."""
+        assert self.executor_index == 0
+        self._executed_clock.add(dot.source, dot.sequence)
+        self._added_to_executed_clock.add(dot)
+        self._check_pending([dot], time)
 
     def handle_request(self, from_shard: ShardId, dots: Set[Dot], time: SysTime) -> None:
         assert self.executor_index > 0

@@ -52,6 +52,15 @@ class GraphAddBatch:
 
 
 @dataclass
+class GraphNoop:
+    """A dot committed as a recovered noop (protocol/recovery.py): nothing
+    executes — the dot just counts as executed so dependents waiting on it
+    resolve (the same seam RequestReplyExecuted uses)."""
+
+    dot: Dot
+
+
+@dataclass
 class GraphRequest:
     from_shard: ShardId
     dots: Set[Dot]
@@ -110,8 +119,10 @@ class GraphExecutor(Executor):
             self.graph.cleanup(time)
             self._fetch_actions(time)
 
-    def monitor_pending(self, time: SysTime) -> None:
-        self.graph.monitor_pending(time)
+    def monitor_pending(self, time: SysTime):
+        """Liveness watchdog; returns the missing dependency dots (if any)
+        so the runner can nudge the protocol's recovery plane."""
+        return self.graph.monitor_pending(time)
 
     def handle_batch(self, infos, time: SysTime) -> None:
         """Group runs of GraphAdds into one batched graph add (a single
@@ -161,6 +172,11 @@ class GraphExecutor(Executor):
                     self.graph.handle_add(
                         Dot(int(info.dot_src[i]), int(info.dot_seq[i])), cmd, deps, time
                     )
+                self._fetch_actions(time)
+        elif isinstance(info, GraphNoop):
+            # execute-at-commit has no ordering state to resolve
+            if not self._config.execute_at_commit:
+                self.graph.handle_noop(info.dot, time)
                 self._fetch_actions(time)
         elif isinstance(info, GraphRequest):
             self.graph.handle_request(info.from_shard, info.dots, time)
@@ -219,6 +235,6 @@ class GraphExecutor(Executor):
 
     @staticmethod
     def executor_index_of(info: GraphExecutionInfo):
-        if isinstance(info, (GraphAdd, GraphAddBatch, GraphRequestReply)):
+        if isinstance(info, (GraphAdd, GraphAddBatch, GraphNoop, GraphRequestReply)):
             return (0, _MAIN_EXECUTOR_INDEX)
         return (0, _SECONDARY_EXECUTOR_INDEX)

@@ -51,18 +51,26 @@ class VertexIndex:
         threshold_ms: int,
         time: SysTime,
         fail_missing_after_ms: Optional[int] = None,
-    ) -> None:
+        recovery_delay_ms: Optional[int] = None,
+    ) -> Set[Dot]:
         """Log long-pending commands; panic on pending-with-no-missing-deps
         (index.rs:53-103).  With ``fail_missing_after_ms`` set, a command
         whose *missing* dependencies stay uncommitted past that bound
         raises a typed StalledExecutionError — the bounded-wait contract
         for dependencies owned by crashed replicas (a dot whose
         coordinator died before broadcasting commit never commits, and
-        without this the executor waits on it forever)."""
+        without this the executor waits on it forever).
+
+        Returns the union of missing dependency dots seen below the fail
+        bound: the runner feeds them to the protocol's recovery plane
+        (``Protocol.nudge_recovery``), which can commit a dot the executor
+        is starving on even when no live process ever got its payload (the
+        noop path)."""
         now = time.millis()
         stuck_without_missing: Set[Dot] = set()
         stalled_missing: dict = {}
         stalled_for = 0
+        all_missing: Set[Dot] = set()
         for vertex in self._index.values():
             pending_for = now - vertex.start_time_ms
             if pending_for < threshold_ms:
@@ -79,12 +87,14 @@ class VertexIndex:
             )
             if not missing:
                 stuck_without_missing.add(vertex.dot)
-            elif (
-                fail_missing_after_ms is not None
-                and pending_for >= fail_missing_after_ms
-            ):
-                stalled_missing[vertex.dot] = missing
-                stalled_for = max(stalled_for, pending_for)
+            else:
+                all_missing |= missing
+                if (
+                    fail_missing_after_ms is not None
+                    and pending_for >= fail_missing_after_ms
+                ):
+                    stalled_missing[vertex.dot] = missing
+                    stalled_for = max(stalled_for, pending_for)
         if stuck_without_missing:
             raise AssertionError(
                 f"p{self._process_id}: commands pending without missing "
@@ -93,7 +103,10 @@ class VertexIndex:
         if stalled_missing:
             from fantoch_tpu.errors import StalledExecutionError
 
-            raise StalledExecutionError(self._process_id, stalled_missing, stalled_for)
+            raise StalledExecutionError(
+                self._process_id, stalled_missing, stalled_for, recovery_delay_ms
+            )
+        return all_missing
 
     def _missing_dependencies(
         self, vertex: Vertex, executed_clock: AEClock, visited: Set[Dot]

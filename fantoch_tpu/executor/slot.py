@@ -29,17 +29,33 @@ class SlotExecutor(Executor):
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
         self._shard_id = shard_id
         self._execute_at_commit = config.execute_at_commit
+        # only leader failover legitimately re-chooses a slot (takeover
+        # carry-forward); without it a duplicate delivery is a protocol
+        # bug the original asserts must keep catching loudly
+        self._failover = config.fpaxos_leader_timeout_ms is not None
         self._store = KVStore(config.executor_monitor_execution_order)
         self._next_slot = 1
         self._to_execute: Dict[int, Command] = {}
         self._to_clients: Deque[ExecutorResult] = deque()
 
     def handle(self, info: SlotExecutionInfo, time) -> None:
-        assert info.slot >= self._next_slot, "slots execute exactly once"
         if self._execute_at_commit:
             self._execute(info.cmd)
             return
-        assert info.slot not in self._to_execute
+        if not self._failover:
+            assert info.slot >= self._next_slot, "slots execute exactly once"
+            assert info.slot not in self._to_execute
+        elif info.slot in self._to_execute:
+            # re-chosen via takeover carry-forward: exactly once — and the
+            # re-chosen value must be the same command (ballots guarantee
+            # it; a mismatch is a consensus safety violation)
+            assert self._to_execute[info.slot].rifl == info.cmd.rifl, (
+                f"slot {info.slot} re-chosen with a different command: "
+                f"{self._to_execute[info.slot].rifl} vs {info.cmd.rifl}"
+            )
+            return
+        elif info.slot < self._next_slot:
+            return  # already executed (same-value re-choice)
         self._to_execute[info.slot] = info.cmd
         while True:
             cmd = self._to_execute.pop(self._next_slot, None)

@@ -157,6 +157,10 @@ def _run_experiment_testbed(
                     addresses,
                     ",".join(sorted_entries),
                     observe_dir=_RESULTS_REL,  # workdir-relative; pulled below
+                    # local (non-ssh) testbeds co-locate every server on
+                    # this machine: forgive scheduler starvation in the
+                    # failure detector (real multi-host runs keep defaults)
+                    shared_machine=not getattr(testbed, "use_ssh", False),
                 )
             log = open(os.path.join(exp_dir, f"server_p{pid}.log"), "w")
             logs.append(log)

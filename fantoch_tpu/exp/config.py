@@ -73,6 +73,7 @@ class ExperimentConfig:
         addresses: str,
         sorted_processes: str,
         observe_dir: Optional[str] = None,
+        shared_machine: bool = False,
     ) -> List[str]:
         args = [
             "--protocol", self.protocol,
@@ -90,6 +91,15 @@ class ExperimentConfig:
             "--multiplexing", str(self.multiplexing),
             "--gc-interval", str(self.gc_interval_ms),
         ]
+        if shared_machine:
+            # a forgiving failure detector for servers sharing one machine
+            # (often one core, under a concurrently-running test suite),
+            # where >8s of scheduler starvation is normal — the default
+            # window would read it as peer death, trip the quorum check,
+            # and tear sessions down with commands outstanding (VERDICT
+            # r5's under-load flake).  Real multi-host runs keep the
+            # default detector so failover latency stays measurable
+            args += ["--heartbeat-interval", "2", "--heartbeat-misses", "60"]
         if self.batched_graph_executor:
             args.append("--batched-graph-executor")
         if self.protocol == "fpaxos":

@@ -176,6 +176,7 @@ class HostsTestbed:
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
         self._local_dirs: Dict[str, str] = {}  # per-host staged copy (local mode)
+        self._local_ports: Dict[int, int] = {}  # local mode: OS-probed ports
 
     def describe(self) -> Dict:
         return {"kind": "hosts", "hosts": self.hosts, "ssh": self.use_ssh}
@@ -199,10 +200,25 @@ class HostsTestbed:
         return host.split("@", 1)[-1]
 
     def peer_port(self, pid: int) -> int:
-        return self.base_port + pid
+        return self._derived_port(pid)
 
     def client_port(self, pid: int) -> int:
-        return self.base_port + 1000 + pid
+        return self._derived_port(1000 + pid)
+
+    def _derived_port(self, slot: int) -> int:
+        """Over ssh the ports must be predictable on the remote (base +
+        offset).  In local mode all servers share this machine, where
+        ``base + offset`` arithmetic can collide with any concurrently
+        bound socket (base_port usually comes from free_port(), i.e. the
+        ephemeral range a loaded test suite is actively allocating from) —
+        probe each port from the OS instead, memoized per slot."""
+        if self.use_ssh:
+            return self.base_port + slot
+        if slot not in self._local_ports:
+            from fantoch_tpu.run.harness import free_port
+
+            self._local_ports[slot] = free_port()
+        return self._local_ports[slot]
 
     # --- staging (baremetal.rs setup: clone/sync the tree per machine) ---
 

@@ -137,9 +137,20 @@ class CheckResult:
 
 
 class _State:
-    __slots__ = ("protocols", "executors", "network", "unsubmitted", "executed")
+    __slots__ = (
+        "protocols",
+        "executors",
+        "network",
+        "unsubmitted",
+        "executed",
+        "crashed",
+        "optional",
+    )
 
-    def __init__(self, protocols, executors, network, unsubmitted, executed):
+    def __init__(
+        self, protocols, executors, network, unsubmitted, executed,
+        crashed=(), optional=(),
+    ):
         self.protocols: Dict[ProcessId, Any] = protocols
         self.executors: Dict[ProcessId, Any] = executors
         # in-flight messages: (from_pid, to_pid, msg, fingerprint) — the
@@ -149,6 +160,12 @@ class _State:
         self.unsubmitted: List[Tuple[ProcessId, Command]] = unsubmitted
         # per-process executed (rifl) order, per key — the agreement object
         self.executed: Dict[ProcessId, Dict[str, List[Any]]] = executed
+        # crashed process ids (sorted tuple): they take no actions, their
+        # inbound messages evaporate — the nemesis crash, in MC form
+        self.crashed: Tuple[ProcessId, ...] = tuple(crashed)
+        # rifls submitted at a now-crashed coordinator: survivors must
+        # execute them everywhere or nowhere (recovery may noop them)
+        self.optional: Tuple[Any, ...] = tuple(optional)
 
 
 class ModelChecker:
@@ -165,11 +182,21 @@ class ModelChecker:
         submits: List[Tuple[ProcessId, Command]],
         max_states: int = 200_000,
         check_agreement: bool = True,
+        crashes: Optional[List[ProcessId]] = None,
     ):
         self._protocol_cls = protocol_cls
         self._config = config
         self._submits = submits
         self._max_states = max_states
+        # processes that MAY crash: exploration branches a crash action for
+        # each at every state (once per process), so every
+        # crash-interleaving is covered.  Crash semantics mirror the sim
+        # nemesis: in-flight messages to the dead process evaporate, it
+        # takes no further actions, and its not-yet-submitted commands are
+        # abandoned with it.  Pair with Config.recovery_delay_ms so the
+        # stabilization closure drives MPrepare/MPromise recovery of its
+        # in-flight dots.
+        self._crashes = list(crashes or [])
         # Basic is the reference's intentionally *inconsistent* protocol
         # (fantoch/src/protocol/basic.rs): per-key agreement is not among
         # its properties, so callers disable that invariant for it
@@ -213,7 +240,8 @@ class ModelChecker:
     def _enabled(self, st: _State) -> List[Tuple[str, Any]]:
         actions: List[Tuple[str, Any]] = []
         for i, (pid, cmd) in enumerate(st.unsubmitted):
-            actions.append(("submit", i))
+            if pid not in st.crashed:
+                actions.append(("submit", i))
         seen = set()
         for i, (src, dst, _msg, fp) in enumerate(st.network):
             # identical in-flight messages are interchangeable: exploring
@@ -222,6 +250,9 @@ class ModelChecker:
             if key not in seen:
                 seen.add(key)
                 actions.append(("deliver", i))
+        for pid in self._crashes:
+            if pid not in st.crashed:
+                actions.append(("crash", pid))
         return actions
 
     def _apply(self, st: _State, action: Tuple[str, Any]) -> Tuple[_State, str]:
@@ -245,7 +276,8 @@ class ModelChecker:
                     )
                 )
                 return _State(
-                    protocols, executors, network, list(st.unsubmitted), executed
+                    protocols, executors, network, list(st.unsubmitted), executed,
+                    st.crashed, st.optional,
                 )
             except Exception as exc:  # noqa: BLE001 — unpicklable: degrade
                 import warnings
@@ -265,6 +297,8 @@ class ModelChecker:
             copy.deepcopy(st.network),
             list(st.unsubmitted),
             copy.deepcopy(st.executed),
+            st.crashed,
+            st.optional,
         )
 
     def _apply_to(self, succ: _State, action: Tuple[str, Any]) -> str:
@@ -277,6 +311,21 @@ class ModelChecker:
             succ.protocols[pid].submit(None, cmd, self._time)
             self._drain(succ, pid)
             desc = f"submit {cmd.rifl} at p{pid}"
+        elif kind == "crash":
+            pid = i
+            # nemesis semantics: already-sent messages from the dead
+            # process stay deliverable; everything addressed to it
+            # evaporates; its unsubmitted commands are abandoned with it
+            succ.crashed = tuple(sorted({*succ.crashed, pid}))
+            succ.network = [e for e in succ.network if e[1] != pid]
+            submitted_here = {
+                cmd.rifl for p, cmd in self._submits if p == pid
+            } - {cmd.rifl for p, cmd in succ.unsubmitted if p == pid}
+            succ.optional = tuple(
+                sorted({*succ.optional, *submitted_here}, key=repr)
+            )
+            succ.unsubmitted = [e for e in succ.unsubmitted if e[0] != pid]
+            desc = f"crash p{pid}"
         elif kind == "events":
             pid = i
             proto = succ.protocols[pid]
@@ -319,6 +368,8 @@ class ModelChecker:
                     # pickle-round-trip state copy (alias-preserving) differ
                     # from per-field deepcopy (alias-severing)
                     for target in sorted(act.target):
+                        if target in st.crashed:
+                            continue  # dead endpoint: the message evaporates
                         msg = copy.deepcopy(act.msg)
                         if target == pid:
                             local.append(msg)
@@ -369,36 +420,47 @@ class ModelChecker:
         return None
 
     def _check_terminal(self, st: _State) -> Optional[Tuple[str, str]]:
-        """Nothing in flight: every process executed every command.
-        Returns (kind, detail) or None."""
-        expected: Dict[str, int] = {}
-        for _pid, cmd in self._submits:
+        """Nothing in flight: every surviving process executed every
+        mandatory command; commands whose coordinator crashed mid-run
+        (``st.optional``) execute everywhere or nowhere (recovery may have
+        nooped them).  Returns (kind, detail) or None."""
+        optional = set(st.optional)
+        survivors = [pid for pid in sorted(st.executed) if pid not in st.crashed]
+        # mandatory rifls per key: submitted commands whose coordinator
+        # survived (recovery guarantees their completion); never-submitted
+        # commands of a crashed coordinator are not in either set
+        mandatory: Dict[str, set] = {}
+        for pid, cmd in self._submits:
+            if pid in st.crashed or cmd.rifl in optional:
+                continue
             for key in cmd.keys(0):
-                expected[key] = expected.get(key, 0) + 1
-        for pid, by_key in st.executed.items():
-            for key, count in expected.items():
-                got = len(by_key.get(key, []))
-                if got != count:
+                mandatory.setdefault(key, set()).add(cmd.rifl)
+        for pid in survivors:
+            by_key = st.executed[pid]
+            for key, rifls in mandatory.items():
+                got = set(by_key.get(key, []))
+                if not rifls <= got:
                     return (
                         "incomplete",
-                        f"p{pid} executed {got}/{count} commands on key "
-                        f"{key!r} in a terminal state",
+                        f"p{pid} missed mandatory {sorted(rifls - got, key=repr)} "
+                        f"on key {key!r} in a terminal state",
                     )
-        if self._check_agreement_flag:
-            pids = sorted(st.executed)
-            first = st.executed[pids[0]]
-            for pid in pids[1:]:
+        if self._check_agreement_flag and survivors:
+            first = st.executed[survivors[0]]
+            for pid in survivors[1:]:
                 if st.executed[pid] != first:
                     return (
                         "divergent_terminal",
-                        f"terminal orders diverge: p{pids[0]}={first} "
+                        f"terminal orders diverge: p{survivors[0]}={first} "
                         f"p{pid}={st.executed[pid]}",
                     )
         # GC completeness (the reference's gc_at x commits == stable check,
         # fantoch_ps/src/protocol/mod.rs:1060-1075, as a structural
         # invariant): with GC configured, a stabilized terminal must have
-        # drained every per-dot info
-        if self._config.gc_interval_ms is not None:
+        # drained every per-dot info.  A crash legitimately halts GC (the
+        # dead process stops reporting its committed clock), so the
+        # invariant only applies to crash-free runs
+        if self._config.gc_interval_ms is not None and not st.crashed:
             for pid, proto in st.protocols.items():
                 infos = getattr(getattr(proto, "_cmds", None), "_infos", None)
                 if infos:
@@ -425,20 +487,32 @@ class ModelChecker:
         Returns ``(state, converged)``: ``converged`` is False when
         ``max_rounds`` elapsed without reaching a fingerprint fixpoint —
         terminal invariants checked on such a state may be spurious, so
-        callers must mark any violation found there as truncated."""
+        callers must mark any violation found there as truncated.
+
+        Crashed processes take no timer actions.  Stabilization runs on a
+        far-future clock so time-gated timers actually fire — in
+        particular the per-dot recovery scan (Config.recovery_delay_ms),
+        which is how a crashed coordinator's in-flight dots heal through
+        MPrepare/MPromise inside the closure."""
         succ = self._copy_state(st)
-        prev_fp = self._fingerprint(succ)
-        converged = False
-        for _ in range(max_rounds):
-            for pid in sorted(succ.protocols):
-                self._apply_to(succ, ("events", pid))
-            while succ.network:
-                self._apply_to(succ, ("deliver", 0))
-            fp = self._fingerprint(succ)
-            if fp == prev_fp:
-                converged = True
-                break
-            prev_fp = fp
+        outer_time = self._time
+        self._time = SimTime(1_000_000_000)
+        try:
+            prev_fp = self._fingerprint(succ)
+            converged = False
+            for _ in range(max_rounds):
+                for pid in sorted(succ.protocols):
+                    if pid not in succ.crashed:
+                        self._apply_to(succ, ("events", pid))
+                while succ.network:
+                    self._apply_to(succ, ("deliver", 0))
+                fp = self._fingerprint(succ)
+                if fp == prev_fp:
+                    converged = True
+                    break
+                prev_fp = fp
+        finally:
+            self._time = outer_time
         return succ, converged
 
     # --- exploration ---
@@ -452,6 +526,8 @@ class ModelChecker:
                 sorted((s, d, fp) for s, d, _m, fp in st.network),
                 st.unsubmitted,
                 sorted(st.executed.items()),
+                st.crashed,
+                st.optional,
             )
         )
 
@@ -478,9 +554,12 @@ class ModelChecker:
                 continue  # don't explore past a violated state
 
             actions = self._enabled(st)
-            if not actions:
-                # quiescence: stabilize deterministically (timers + FIFO
-                # drains to a fixpoint), then check the terminal invariants
+            if all(kind == "crash" for kind, _ in actions):
+                # quiescence: no submit/delivery left (a crash from a fully
+                # quiescent state is not explored — nothing is in flight, so
+                # it cannot change any surviving invariant): stabilize
+                # deterministically (timers + FIFO drains to a fixpoint),
+                # then check the terminal invariants
                 terminals += 1
                 stable, converged = self._stabilize(st)
                 if not converged:

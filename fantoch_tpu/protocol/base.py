@@ -124,6 +124,18 @@ class Protocol(ABC):
     def handle_executed(self, executed: Executed, time: SysTime) -> None:
         """Notification of executed dots (GC worker only); default no-op."""
 
+    def on_peer_down(self, peer_id: ProcessId, time: SysTime) -> None:
+        """Run-layer failure-detector notification (a peer stayed silent
+        past the heartbeat budget).  Default no-op; leader-based protocols
+        use it to trigger failover without waiting out their own
+        protocol-level timeout."""
+
+    def nudge_recovery(self, dots, time: SysTime) -> None:
+        """Executor-watchdog hint: these dots are missing dependencies of
+        committed commands.  Default no-op; recovery-capable protocols
+        start per-dot recovery consensus for them — including dots whose
+        payload never reached any live process (recovered as noops)."""
+
     @abstractmethod
     def to_processes(self) -> Optional[Action]: ...
 

@@ -23,6 +23,23 @@ Slot = int
 # MultiSynod messages (multi.rs:18-31); MChosen/MForwardSubmit are handled
 # by the protocol layer, the rest route between the agents
 @dataclass
+class MPrepare:
+    """Leader-election phase 1: a candidate's ballot (the reference's
+    todo!() at multi.rs:97-99, implemented here)."""
+
+    ballot: Ballot
+
+
+@dataclass
+class MPromise(Generic[V]):
+    """Phase-1 answer: the acceptor's whole accepted-slot map, so the new
+    leader can carry forward every value that may have been chosen."""
+
+    ballot: Ballot
+    accepted: Dict[Slot, Tuple[Ballot, V]]
+
+
+@dataclass
 class MSpawnCommander(Generic[V]):
     ballot: Ballot
     slot: Slot
@@ -99,8 +116,14 @@ class _Acceptor(Generic[V]):
         self.ballot: Ballot = initial_leader
         self.accepted: Dict[Slot, Tuple[Ballot, V]] = {}
 
-    # leader recovery (prepare/promise over accepted slots) is unimplemented,
-    # mirroring the reference's todo!() at multi.rs:97-99
+    def handle_prepare(self, ballot: Ballot) -> Optional[MPromise]:
+        """Leader-election phase 1 (the reference's todo!() at
+        multi.rs:97-99): join a higher ballot and promise the full
+        accepted-slot map for value carry-forward."""
+        if ballot <= self.ballot:
+            return None
+        self.ballot = ballot
+        return MPromise(ballot, dict(self.accepted))
 
     def handle_accept(self, ballot: Ballot, slot: Slot, value: V) -> Optional[MAccepted]:
         if ballot < self.ballot:
@@ -125,6 +148,13 @@ class MultiSynod(Generic[V]):
         self._leader = _Leader(process_id, initial_leader)
         self._acceptor: _Acceptor[V] = _Acceptor(initial_leader)
         self._commanders: Dict[Slot, _Commander[V]] = {}
+        # election state: the ballot we're campaigning on + its promises
+        self._campaign_ballot: Optional[Ballot] = None
+        self._promises: Dict[ProcessId, Dict[Slot, Tuple[Ballot, V]]] = {}
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader.is_leader
 
     def submit(self, value: V):
         """MSpawnCommander if we're the leader, else MForwardSubmit."""
@@ -134,7 +164,44 @@ class MultiSynod(Generic[V]):
         ballot, slot = allocated
         return MSpawnCommander(ballot, slot, value)
 
+    def new_prepare(self) -> MPrepare:
+        """Start (or restart) a leadership campaign: a fresh ballot owned
+        by this process, above anything the local acceptor has joined."""
+        round_ = self._acceptor.ballot // self.n
+        self._campaign_ballot = self._leader.process_id + self.n * (round_ + 1)
+        assert self._campaign_ballot > self._acceptor.ballot
+        self._promises = {}
+        self._leader.is_leader = False  # a superseded leader must re-win
+        return MPrepare(self._campaign_ballot)
+
+    def handle_promise(
+        self, from_: ProcessId, ballot: Ballot, accepted: Dict[Slot, Tuple[Ballot, V]]
+    ) -> Optional[Dict[Slot, V]]:
+        """Count campaign promises; with n - f of them, take over: adopt
+        the ballot, resume slot allocation above every slot seen, and
+        return the carry-forward map (slot -> highest-ballot accepted
+        value) the protocol must re-propose through fresh commanders."""
+        if ballot != self._campaign_ballot or self._leader.is_leader:
+            return None
+        self._promises[from_] = accepted
+        if len(self._promises) != self.n - self.f:
+            return None
+        carry: Dict[Slot, Tuple[Ballot, V]] = {}
+        for acc in self._promises.values():
+            for slot, (b, value) in acc.items():
+                if slot not in carry or b > carry[slot][0]:
+                    carry[slot] = (b, value)
+        self._leader.is_leader = True
+        self._leader.ballot = ballot
+        self._leader.last_slot = max(
+            self._leader.last_slot, max(carry, default=0)
+        )
+        self._promises = {}
+        return {slot: value for slot, (_b, value) in sorted(carry.items())}
+
     def handle(self, from_: ProcessId, msg):
+        if isinstance(msg, MPrepare):
+            return self._handle_prepare(msg.ballot)
         if isinstance(msg, MSpawnCommander):
             return self._handle_spawn_commander(msg.ballot, msg.slot, msg.value)
         if isinstance(msg, MAccept):
@@ -143,6 +210,14 @@ class MultiSynod(Generic[V]):
             return self._handle_maccepted(from_, msg.ballot, msg.slot)
         raise AssertionError(f"unexpected multi-synod message {msg}")
 
+    def _handle_prepare(self, ballot: Ballot) -> Optional[MPromise]:
+        out = self._acceptor.handle_prepare(ballot)
+        if out is not None and self._leader.is_leader and ballot > self._leader.ballot:
+            # superseded: stop allocating; live commanders die with their
+            # ballot (their accepts are rejected at the joined acceptors)
+            self._leader.is_leader = False
+        return out
+
     def gc(self, start: Slot, end: Slot) -> int:
         return self._acceptor.gc(start, end)
 
@@ -150,7 +225,11 @@ class MultiSynod(Generic[V]):
         self._acceptor.gc_single(slot)
 
     def _handle_spawn_commander(self, ballot: Ballot, slot: Slot, value: V) -> MAccept:
-        assert slot not in self._commanders, "one commander per slot"
+        prev = self._commanders.get(slot)
+        # one commander per slot in steady state; a takeover re-proposes a
+        # carried-forward slot at a strictly higher ballot, superseding any
+        # commander a dethroned leader left behind
+        assert prev is None or prev.ballot < ballot, "one commander per slot"
         self._commanders[slot] = _Commander(self.f, ballot, value)
         return MAccept(ballot, slot, value)
 

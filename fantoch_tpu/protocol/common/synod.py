@@ -77,6 +77,20 @@ class Synod(Generic[V]):
         """First-ballot shortcut for the original coordinator (single.rs:86-92)."""
         return self._proposer.skip_prepare(self._acceptor)
 
+    def can_skip_prepare(self) -> bool:
+        """The first-ballot shortcut is sound only while no prepare has
+        touched the acceptor: once a recovery proposer owns a higher
+        ballot, the original coordinator must go through prepare too."""
+        return self._acceptor.ballot == 0
+
+    def chosen(self) -> bool:
+        return self._chosen
+
+    def current_ballot(self) -> Ballot:
+        """The proposer's active ballot: <= n on the first-ballot shortcut,
+        > n once a recovery prepare ran (ballot = id + n * round)."""
+        return self._proposer._ballot
+
     def handle(self, from_: ProcessId, msg) -> Optional[SynodMessage]:
         if isinstance(msg, MChosen):
             self._chosen = True

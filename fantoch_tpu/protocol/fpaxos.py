@@ -7,17 +7,31 @@ they can run slot-sharded across workers); acceptors sit at a fixed worker.
 Chosen commands broadcast as MChosen and execute in slot order.  GC is
 slot-watermark based — no MStable round: the acceptor worker both tracks
 watermarks and holds the slots to collect (fpaxos.rs:419-447).
+
+Leader failover (beyond the reference, whose acceptor carries a todo!()
+for it at multi.rs:97-99): with ``Config.fpaxos_leader_timeout_ms`` set,
+the leader heartbeats every quarter-timeout and followers watch for
+silence — the ring successor suspects first (one timeout), the next one a
+timeout later, and so on, so elections are staggered and deterministic.
+A candidate runs MultiSynod prepare/promise over the accepted-slot maps of
+an n-f quorum, carries every possibly-chosen value forward through fresh
+commanders at its ballot, resumes allocation above every slot seen, and
+announces itself via the heartbeat.  Followers re-forward their pending
+(not-yet-chosen) submissions to the new leader, which dedups by rifl.
+The run layer's heartbeat failure detector feeds ``on_peer_down`` so a
+TCP cluster elects as soon as the detector fires rather than waiting out
+the protocol timeout.
 """
 
 from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass
-from typing import Deque, Optional
+from typing import Deque, Dict, Optional, Set
 
 from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.config import Config
-from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
+from fantoch_tpu.core.ids import Dot, ProcessId, Rifl, ShardId
 from fantoch_tpu.core.timing import SysTime
 from fantoch_tpu.executor.slot import SlotExecutionInfo, SlotExecutor
 from fantoch_tpu.protocol.base import (
@@ -33,6 +47,7 @@ from fantoch_tpu.protocol.common.multi_synod import (
     MAccepted as SynodMAccepted,
     MChosen as SynodMChosen,
     MForwardSubmit as SynodMForwardSubmit,
+    MPrepare as SynodMPrepare,
     MSpawnCommander as SynodMSpawnCommander,
     MultiSynod,
     SlotGCTrack,
@@ -88,8 +103,38 @@ class MGarbageCollection:
 
 
 @dataclass
+class MPrepare:
+    """Leader-election phase 1 (candidate ballot)."""
+
+    ballot: int
+
+
+@dataclass
+class MPromise:
+    """Phase-1 answer: the acceptor's accepted-slot map (slot -> (ballot,
+    cmd)) for value carry-forward."""
+
+    ballot: int
+    accepted: Dict[int, tuple]
+
+
+@dataclass
+class MLeaderHeartbeat:
+    """Periodic leadership announcement; also how a freshly-elected leader
+    tells followers where to (re-)forward."""
+
+    ballot: int
+
+
+@dataclass
 class GarbageCollectionEvent:
     pass
+
+
+@dataclass
+class LeaderCheckEvent:
+    """Periodic failover tick: the leader heartbeats, followers judge
+    silence (interval = fpaxos_leader_timeout_ms // 4)."""
 
 
 class FPaxos(Protocol):
@@ -103,17 +148,51 @@ class FPaxos(Protocol):
             "in a leader-based protocol, the initial leader should be defined"
         )
         self._leader = initial_leader
+        # ballot backing the current leadership belief (heartbeats carry
+        # it; higher ballot wins)
+        self._leader_ballot = initial_leader
         self._multi_synod: MultiSynod[Command] = MultiSynod(
             process_id, initial_leader, config.n, config.f
         )
         self._gc_track = SlotGCTrack(process_id, config.n)
         self._to_processes: Deque[Action] = deque()
         self._to_executors: Deque[SlotExecutionInfo] = deque()
+        # failover state
+        self._failover = config.fpaxos_leader_timeout_ms is not None
+        if self._failover:
+            # the acceptor must retain accepted slots until globally stable
+            # (the gc-track path); gc_single-at-choose would let a new
+            # leader resume allocation below a chosen slot it cannot see
+            assert config.gc_interval_ms is not None, (
+                "fpaxos_leader_timeout_ms requires gc_interval_ms: leader "
+                "failover carries values forward from acceptor state, which "
+                "must be retained until slots are globally stable"
+            )
+        # last virtual ms any message arrived from the current leader
+        self._leader_heard: Optional[int] = None
+        # submissions forwarded but not yet chosen: re-forwarded on leader
+        # change (Rifl -> Command); the leader dedups re-forwards below
+        self._pending_forwards: Dict[Rifl, Command] = {}
+        # rifls this process knows are allocated-or-chosen — the dedup set
+        # that keeps a re-forward from executing a command twice.  Bounded
+        # by the same stability horizon as the acceptor maps (pruning in
+        # _handle_mgc keeps only the un-stable tail)
+        self._seen_rifls: Set[Rifl] = set()
+        self._rifl_slot: Dict[Rifl, int] = {}
+        # chosen slots not yet stable (guards re-chosen duplicates at
+        # takeover); pruned by GC
+        self._chosen_slots: Set[int] = set()
+        # peers the run layer's failure detector declared dead
+        self._down: Set[ProcessId] = set()
 
     def periodic_events(self):
+        events = []
         if self.bp.config.gc_interval_ms is not None:
-            return [(GarbageCollectionEvent(), self.bp.config.gc_interval_ms)]
-        return []
+            events.append((GarbageCollectionEvent(), self.bp.config.gc_interval_ms))
+        if self._failover:
+            interval = max(1, self.bp.config.fpaxos_leader_timeout_ms // 4)
+            events.append((LeaderCheckEvent(), interval))
+        return events
 
     @property
     def id(self) -> ProcessId:
@@ -131,6 +210,8 @@ class FPaxos(Protocol):
         self._handle_submit(cmd)
 
     def handle(self, from_, from_shard_id, msg, time):
+        if self._failover and from_ == self._leader and from_ != self.id:
+            self._leader_heard = time.millis()
         if isinstance(msg, MForwardSubmit):
             self._handle_submit(msg.cmd)
         elif isinstance(msg, MSpawnCommander):
@@ -143,10 +224,19 @@ class FPaxos(Protocol):
             self._handle_mchosen(msg.slot, msg.cmd)
         elif isinstance(msg, MGarbageCollection):
             self._handle_mgc(from_, msg.committed)
+        elif isinstance(msg, MPrepare):
+            self._handle_mprepare(from_, msg.ballot)
+        elif isinstance(msg, MPromise):
+            self._handle_mpromise(from_, msg.ballot, msg.accepted, time)
+        elif isinstance(msg, MLeaderHeartbeat):
+            self._handle_leader_heartbeat(from_, msg.ballot, time)
         else:
             raise AssertionError(f"unknown message {msg}")
 
     def handle_event(self, event, time):
+        if isinstance(event, LeaderCheckEvent):
+            self._handle_leader_check(time)
+            return
         assert isinstance(event, GarbageCollectionEvent)
         self._to_processes.append(
             ToSend(self.bp.all_but_me(), MGarbageCollection(self._gc_track.committed()))
@@ -172,24 +262,46 @@ class FPaxos(Protocol):
     # --- handlers ---
 
     def _handle_submit(self, cmd: Command) -> None:
+        if self._multi_synod.is_leader and cmd.rifl in self._seen_rifls:
+            # a follower re-forwarded after failover but the command is
+            # already allocated (carried forward) or chosen: executing it
+            # twice would break linearizability — drop the duplicate
+            return
         out = self._multi_synod.submit(cmd)
         if isinstance(out, SynodMSpawnCommander):
             # we're the leader: spawn the commander via a self-forward so it
             # can land on a slot-sharded worker
+            if self._failover:
+                self._register_allocation(out.value.rifl, out.slot)
             self._to_processes.append(
                 ToForward(MSpawnCommander(out.ballot, out.slot, out.value))
             )
         elif isinstance(out, SynodMForwardSubmit):
+            if self._failover:
+                self._pending_forwards[cmd.rifl] = cmd
             self._to_processes.append(ToSend({self._leader}, MForwardSubmit(out.value)))
         else:
             raise AssertionError(f"can't handle {out} in submit")
+
+    def _register_allocation(self, rifl: Rifl, slot: int) -> None:
+        self._seen_rifls.add(rifl)
+        self._rifl_slot[rifl] = slot
 
     def _handle_mspawn_commander(self, from_, ballot, slot, cmd) -> None:
         assert from_ == self.id, "spawn commander messages come from self"
         out = self._multi_synod.handle(from_, SynodMSpawnCommander(ballot, slot, cmd))
         assert isinstance(out, SynodMAccept)
+        # steady state accepts go to the write quorum; a post-takeover
+        # leader (ballot > n: initial-leader ballots are process ids) or a
+        # known-dead quorum member means the quorum was sized for the
+        # failure-free path and may contain dead processes — broadcast
+        # then (still only f+1 accepts needed), without paying the n-fold
+        # amplification on every failure-free command
+        targets = self.bp.write_quorum()
+        if self._failover and (ballot > self.bp.config.n or self._down & targets):
+            targets = self.bp.all()
         self._to_processes.append(
-            ToSend(self.bp.write_quorum(), MAccept(out.ballot, out.slot, out.value))
+            ToSend(targets, MAccept(out.ballot, out.slot, out.value))
         )
 
     def _handle_maccept(self, from_, ballot, slot, cmd) -> None:
@@ -207,6 +319,12 @@ class FPaxos(Protocol):
         self._to_processes.append(ToSend(self.bp.all(), MChosen(out.slot, out.value)))
 
     def _handle_mchosen(self, slot: int, cmd: Command) -> None:
+        if self._failover:
+            if slot in self._chosen_slots:
+                return  # re-chosen via takeover carry-forward: exactly once
+            self._chosen_slots.add(slot)
+            self._seen_rifls.add(cmd.rifl)
+            self._pending_forwards.pop(cmd.rifl, None)
         self._to_executors.append(SlotExecutionInfo(slot, cmd))
         if self.bp.config.gc_interval_ms is not None:
             self._gc_track.commit(slot)
@@ -218,15 +336,116 @@ class FPaxos(Protocol):
         start, end = self._gc_track.stable()
         if start <= end:
             self.bp.stable(self._multi_synod.gc(start, end))
+            if self._failover:
+                # stable slots can never be re-proposed (no acceptor still
+                # holds them): prune the exactly-once bookkeeping
+                self._chosen_slots -= set(range(start, end + 1))
+                for rifl, slot in list(self._rifl_slot.items()):
+                    if slot <= end:
+                        self._rifl_slot.pop(rifl, None)
+                        self._seen_rifls.discard(rifl)
+
+    # --- leader failover ---
+
+    def _ring_distance(self, candidate: ProcessId) -> int:
+        return (candidate - self._leader) % self.bp.config.n
+
+    def _handle_leader_check(self, time: SysTime) -> None:
+        now = time.millis()
+        if self._leader == self.id:
+            if self._multi_synod.is_leader:
+                self._to_processes.append(
+                    ToSend(
+                        self.bp.all_but_me(), MLeaderHeartbeat(self._leader_ballot)
+                    )
+                )
+            return
+        if self._leader_heard is None:
+            self._leader_heard = now  # start the clock at the first tick
+            return
+        # staggered suspicion: the ring successor campaigns after one
+        # timeout, the next after two, ... — deterministic, collision-free
+        timeout = self.bp.config.fpaxos_leader_timeout_ms
+        wait = timeout * max(1, self._ring_distance(self.id))
+        if now - self._leader_heard >= wait:
+            self._leader_heard = now  # re-campaign only after another wait
+            self._start_election()
+
+    def _start_election(self) -> None:
+        prepare = self._multi_synod.new_prepare()
+        # broadcast (self included: our own acceptor's promise counts)
+        self._to_processes.append(ToSend(self.bp.all(), MPrepare(prepare.ballot)))
+
+    def _handle_mprepare(self, from_: ProcessId, ballot: int) -> None:
+        out = self._multi_synod.handle(from_, SynodMPrepare(ballot))
+        if out is not None:
+            self._to_processes.append(
+                ToSend({from_}, MPromise(out.ballot, out.accepted))
+            )
+
+    def _handle_mpromise(self, from_: ProcessId, ballot: int, accepted, time) -> None:
+        carry = self._multi_synod.handle_promise(from_, ballot, accepted)
+        if carry is None:
+            return
+        # won the election: adopt leadership, re-propose every
+        # possibly-chosen slot at our ballot, re-submit our own pending
+        # forwards, and announce
+        self._leader = self.id
+        self._leader_ballot = ballot
+        for slot, cmd in carry.items():
+            if slot in self._chosen_slots:
+                continue  # already decided and known here
+            self._register_allocation(cmd.rifl, slot)
+            self._pending_forwards.pop(cmd.rifl, None)
+            self._to_processes.append(ToForward(MSpawnCommander(ballot, slot, cmd)))
+        pending, self._pending_forwards = self._pending_forwards, {}
+        for cmd in pending.values():
+            self._handle_submit(cmd)
+        self._to_processes.append(
+            ToSend(self.bp.all_but_me(), MLeaderHeartbeat(ballot))
+        )
+
+    def _handle_leader_heartbeat(self, from_: ProcessId, ballot: int, time) -> None:
+        if ballot < self._leader_ballot:
+            return  # stale leader
+        changed = from_ != self._leader
+        self._leader = from_
+        self._leader_ballot = ballot
+        self._leader_heard = time.millis()
+        if changed and self._pending_forwards:
+            # our earlier forwards may have died with the old leader:
+            # re-forward everything not yet chosen (the leader dedups)
+            for cmd in self._pending_forwards.values():
+                self._to_processes.append(ToSend({from_}, MForwardSubmit(cmd)))
+
+    def on_peer_down(self, peer_id: ProcessId, time: SysTime) -> None:
+        """Run-layer failure-detector hook: elect immediately when the
+        dead peer is the leader and we are the first live ring successor
+        (the sim path relies on the staggered timeouts instead)."""
+        if not self._failover:
+            return
+        self._down.add(peer_id)
+        if peer_id != self._leader or self._leader == self.id:
+            return
+        candidates = sorted(
+            (pid for pid in self.bp.all() if pid != self._leader and pid not in self._down),
+            key=self._ring_distance,
+        )
+        if candidates and candidates[0] == self.id:
+            self._leader_heard = time.millis()
+            self._start_election()
 
     # --- worker routing (fpaxos.rs:416-465) ---
 
     @staticmethod
     def message_index(msg):
-        if isinstance(msg, MForwardSubmit):
+        if isinstance(msg, (MForwardSubmit, MPromise, MLeaderHeartbeat)):
+            # leadership state (election, pending re-forwards) lives with
+            # the submit path on the leader worker
             return worker_index_no_shift(LEADER_WORKER_INDEX)
-        if isinstance(msg, (MAccept, MChosen, MGarbageCollection)):
-            # the acceptor also learns chosen slots and runs gc tracking
+        if isinstance(msg, (MAccept, MChosen, MGarbageCollection, MPrepare)):
+            # the acceptor also learns chosen slots, runs gc tracking, and
+            # answers election prepares (its accepted map is the promise)
             return worker_index_no_shift(ACCEPTOR_WORKER_INDEX)
         if isinstance(msg, (MSpawnCommander, MAccepted)):
             return worker_index_shift(msg.slot)
@@ -234,4 +453,6 @@ class FPaxos(Protocol):
 
     @staticmethod
     def event_index(event):
+        if isinstance(event, LeaderCheckEvent):
+            return worker_index_no_shift(LEADER_WORKER_INDEX)
         return worker_index_no_shift(ACCEPTOR_WORKER_INDEX)

@@ -23,7 +23,12 @@ from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
 from fantoch_tpu.core.timing import SysTime
-from fantoch_tpu.executor.graph.executor import GraphAdd, GraphAddBatch, GraphExecutor
+from fantoch_tpu.executor.graph.executor import (
+    GraphAdd,
+    GraphAddBatch,
+    GraphExecutor,
+    GraphNoop,
+)
 from fantoch_tpu.protocol.base import (
     Action,
     BaseProcess,
@@ -46,6 +51,12 @@ from fantoch_tpu.protocol.common.synod import (
 )
 from fantoch_tpu.protocol.gc import GCTrack
 from fantoch_tpu.protocol.info import CommandsInfo
+from fantoch_tpu.protocol.recovery import (
+    MRecoveryPrepare,
+    MRecoveryPromise,
+    RecoveryEvent,
+    RecoveryMixin,
+)
 from fantoch_tpu.protocol.partial import (
     MForwardSubmit,
     MShardAggregatedCommit,
@@ -125,14 +136,20 @@ class MCollectAck:
 
 @dataclass
 class ConsensusValue:
-    """(is_noop, deps) — the value agreed on per dot (epaxos.rs:602-621)."""
+    """(is_noop, deps) — the value agreed on per dot (epaxos.rs:602-621).
+
+    ``bottom()`` (the synod's pre-ack initial value) is the *noop*: a
+    recovery promise carrying it means "this acceptor never acked the
+    MCollect", which is exactly what distinguishes a never-payloaded dot
+    (recovered as a committed noop) from a real report with empty deps.
+    """
 
     deps: Set[Dependency]
     is_noop: bool = False
 
     @staticmethod
     def bottom() -> "ConsensusValue":
-        return ConsensusValue(set())
+        return ConsensusValue(set(), is_noop=True)
 
 
 @dataclass
@@ -146,6 +163,9 @@ class MConsensus:
     dot: Dot
     ballot: int
     value: ConsensusValue
+    # payload piggyback on recovery rounds, so a recovered value can commit
+    # at processes the original MCollect broadcast never reached
+    cmd: Optional[Command] = None
 
 
 @dataclass
@@ -161,8 +181,27 @@ class Status:
     COMMIT = "commit"
 
 
-def _proposal_gen(_values):
-    raise NotImplementedError("recovery not implemented yet")
+def _recovery_proposal_gen(values):
+    """Recovery value selection over the ballot-0 reports of an n-f promise
+    quorum (protocol/recovery.py; the reference's todo!() at
+    epaxos.rs:627-629).  Reports are the deps fast-quorum members set when
+    acking the MCollect plus non-quorum holders' "late reports" (staged at
+    payload receipt so conflict edges survive losing the
+    quorum-intersection member); bottom (``is_noop``) marks acceptors that
+    never saw the payload.  No report anywhere -> the dot is recovered as
+    a committed noop; otherwise the union of reports — a free (therefore
+    safe) choice whenever no commit was decided before recovery began,
+    which protocol/recovery.py's safety note reduces to the
+    recovery_delay_ms-exceeds-delivery-delay assumption."""
+    deps: Set[Dependency] = set()
+    reported = False
+    for value in values.values():
+        if not value.is_noop:
+            reported = True
+            deps |= value.deps
+    if not reported:
+        return ConsensusValue(set(), is_noop=True)
+    return ConsensusValue(deps)
 
 
 def _graph_info_factory(pid, _sid, _cfg, _fq, _wq, *, n, f, quorum_deps_size):
@@ -180,13 +219,13 @@ class GraphCommandInfo:
         self.status = Status.START
         self.quorum: Set[ProcessId] = set()
         self.synod: Synod[ConsensusValue] = Synod(
-            process_id, n, f, _proposal_gen, ConsensusValue.bottom()
+            process_id, n, f, _recovery_proposal_gen, ConsensusValue.bottom()
         )
         self.cmd: Optional[Command] = None
         self.quorum_deps = QuorumDeps(quorum_deps_size)
 
 
-class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
+class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
     """Common skeleton; see module docstring for the specialization points."""
 
     Executor = GraphExecutor
@@ -245,9 +284,10 @@ class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
             _CommitBuffer(shard_id) if config.shard_count == 1 else None
         )
         self._init_partial()
+        self._init_recovery()
 
     def periodic_events(self):
-        return self.gc_periodic_events()
+        return list(self.gc_periodic_events()) + self.recovery_periodic_events()
 
     @property
     def id(self) -> ProcessId:
@@ -283,15 +323,20 @@ class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
         elif isinstance(msg, MCommit):
             self._handle_mcommit(from_, msg.dot, msg.value, time)
         elif isinstance(msg, MConsensus):
-            self._handle_mconsensus(from_, msg.dot, msg.ballot, msg.value)
+            self._handle_mconsensus(from_, msg.dot, msg.ballot, msg.value, msg.cmd, time)
         elif isinstance(msg, MConsensusAck):
             self._handle_mconsensusack(from_, msg.dot, msg.ballot)
+        elif self.handle_recovery_message(from_, msg, time):
+            pass
         elif self.handle_partial_message(from_, msg):
             pass
         elif not self.handle_gc_message(from_, msg):
             raise AssertionError(f"unknown message {msg}")
 
     def handle_event(self, event, time):
+        if isinstance(event, RecoveryEvent):
+            self.handle_recovery_event(time)
+            return
         assert isinstance(event, GarbageCollectionEvent)
         self.handle_gc_event()
 
@@ -331,15 +376,23 @@ class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
         info = self._cmds.get(dot)
         if info.status != Status.START:
             return
+        self._recovery_track(dot, time)
         if self.bp.process_id not in quorum:
             # not in the fast quorum: just store the payload; replay any
             # buffered commit now that we have it
             info.status = Status.PAYLOAD
             info.cmd = cmd
-            buffered = self._buffered_commits.pop(dot, None)
-            if buffered is not None:
-                buf_from, buf_value = buffered
-                self._handle_mcommit(buf_from, dot, buf_value, time)
+            if self._recovery_enabled():
+                # record the payload in the conflict index and stage a
+                # ballot-0 "late report": if this dot ever needs recovery,
+                # our promise then carries the conflict edges we know
+                # about.  Without it, two dots recovered from disjoint
+                # survivor sets can commit with no dependency edge between
+                # them — the quorum-intersection member that would have
+                # reported the edge being exactly the crashed one
+                deps = self.key_deps.add_cmd(dot, cmd, remote_deps)
+                info.synod.set_if_not_accepted(lambda: ConsensusValue(set(deps)))
+            self._replay_buffered_commit(dot, time)
             return
 
         message_from_self = from_ == self.bp.process_id
@@ -349,15 +402,29 @@ class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
         else:
             deps = self.key_deps.add_cmd(dot, cmd, remote_deps)
 
-        info.status = Status.COLLECT
-        info.quorum = set(quorum)
         info.cmd = cmd
         value = ConsensusValue(set(deps))
-        was_set = info.synod.set_if_not_accepted(lambda: value)
-        assert was_set, "consensus value should not have been accepted yet"
+        if not info.synod.set_if_not_accepted(lambda: value):
+            # a recovery prepare already owns a higher ballot for this dot:
+            # our promise forbids the ballot-0 ack, so keep the payload and
+            # let recovery drive the commit
+            info.status = Status.PAYLOAD
+            self._replay_buffered_commit(dot, time)
+            return
+        info.status = Status.COLLECT
+        info.quorum = set(quorum)
 
         if self.coordinator_self_ack() or not message_from_self:
             self._to_processes.append(ToSend({from_}, MCollectAck(dot, deps)))
+        # with recovery in play a commit can be decided without this
+        # member's ack and thus arrive before its MCollect — replay it
+        self._replay_buffered_commit(dot, time)
+
+    def _replay_buffered_commit(self, dot, time) -> None:
+        buffered = self._buffered_commits.pop(dot, None)
+        if buffered is not None:
+            buf_from, buf_value = buffered
+            self._handle_mcommit(buf_from, dot, buf_value, time)
 
     def _handle_mcollectack(self, from_, dot, deps) -> None:
         if not self.coordinator_self_ack():
@@ -370,6 +437,15 @@ class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
             return
         final_deps, fast_path = self.fast_path_condition(info)
         value = ConsensusValue(final_deps)
+        if not info.synod.can_skip_prepare():
+            # a recovery proposer owns a higher ballot: neither the
+            # unilateral fast-path commit nor the first-ballot shortcut is
+            # sound anymore — join recovery with a full prepare instead
+            prepare = info.synod.new_prepare()
+            self._to_processes.append(
+                ToSend(self.bp.all(), MRecoveryPrepare(dot, prepare.ballot))
+            )
+            return
         if fast_path:
             self.bp.fast_path()
             self._mcommit_actions(dot, value)
@@ -382,29 +458,41 @@ class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
 
     def _handle_mcommit(self, from_, dot, value, time) -> None:
         info = self._cmds.get(dot)
+        if info.status == Status.COMMIT:
+            return
+        if value.is_noop:
+            # recovered noop (the dot was never payloaded anywhere the
+            # promise quorum could see): nothing executes — the executor's
+            # noop seam just resolves any dependents waiting on the dot
+            self._to_executors.append(GraphNoop(dot))
+            self._commit_bookkeeping(info, from_, dot, value)
+            return
         if info.status == Status.START:
             # MCollect may arrive after MCommit (multiplexing): buffer
             self._buffered_commits[dot] = (from_, value)
             return
-        if info.status == Status.COMMIT:
-            return
-        assert not value.is_noop, "handling noops is not implemented yet"
         cmd = info.cmd
         assert cmd is not None, "there should be a command payload"
         if self._commit_buffer is not None:
             self._commit_buffer.append(dot, cmd, value.deps)
         else:
             self._to_executors.append(GraphAdd(dot, cmd, set(value.deps)))
+        self._commit_bookkeeping(info, from_, dot, value)
+
+    def _commit_bookkeeping(self, info, from_, dot, value) -> None:
         info.status = Status.COMMIT
         out = info.synod.handle(from_, MChosen(value))
         assert out is None
+        self._recovery_untrack(dot)
         if self._gc_running() and self._dot_in_my_shard(dot):
             self._to_processes.append(ToForward(MCommitDot(dot)))
         else:
             self._cmds.gc_single(dot)
 
-    def _handle_mconsensus(self, from_, dot, ballot, value) -> None:
+    def _handle_mconsensus(self, from_, dot, ballot, value, cmd=None, time=None) -> None:
         info = self._cmds.get(dot)
+        if cmd is not None and info.cmd is None:
+            self._adopt_recovered_payload(dot, info, cmd, time)
         out = info.synod.handle(from_, MAccept(ballot, value))
         if out is None:
             return  # ballot too low
@@ -436,6 +524,23 @@ class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
         if cmd is None or not self.partial_mcommit_actions(dot, cmd, set(value.deps)):
             self._to_processes.append(ToSend(self.bp.all(), MCommit(dot, value)))
 
+    # --- recovery hooks (protocol/recovery.py) ---
+
+    def _adopt_recovered_payload(self, dot, info, cmd, time) -> None:
+        info.cmd = cmd
+        if info.status == Status.START:
+            info.status = Status.PAYLOAD
+            self._replay_buffered_commit(dot, time)
+
+    def _recovery_consensus_msg(self, dot, ballot, value, cmd):
+        return MConsensus(dot, ballot, value, cmd)
+
+    def _recovery_chosen_reply(self, to, dot, info, value) -> None:
+        # same single-shard guard as the late-MConsensus reply: multi-shard
+        # commits must carry the cross-shard aggregate
+        if info.cmd is None or info.cmd.shard_count == 1:
+            self._to_processes.append(ToSend({to}, MCommit(dot, value)))
+
     # --- partial-replication adapters (deps union; atlas.rs:559-650) ---
 
     def _partial_initial_data(self):
@@ -465,6 +570,8 @@ class GraphProtocol(PartialCommitMixin, CommitGCMixin, Protocol):
                 MForwardSubmit,
                 MShardCommit,
                 MShardAggregatedCommit,
+                MRecoveryPrepare,
+                MRecoveryPromise,
             ),
         ):
             return worker_dot_index_shift(msg.dot)

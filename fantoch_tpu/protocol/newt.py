@@ -31,6 +31,8 @@ shards — the data2 channel of partial.rs).
 
 from __future__ import annotations
 
+import copy
+
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Deque, Dict, Optional, Set, Tuple
@@ -72,6 +74,12 @@ from fantoch_tpu.protocol.partial import (
     PartialCommitMixin,
 )
 from fantoch_tpu.protocol.info import CommandsInfo
+from fantoch_tpu.protocol.recovery import (
+    MRecoveryPrepare,
+    MRecoveryPromise,
+    RecoveryEvent,
+    RecoveryMixin,
+)
 from fantoch_tpu.run.routing import (
     worker_dot_index_shift,
     worker_index_no_shift,
@@ -102,6 +110,12 @@ class MCommit:
     dot: Dot
     clock: int
     votes: Votes
+    # True when the commit was decided by recovery consensus rather than
+    # the coordinator's aggregation: the carried votes then lack the fast
+    # quorum's consumed ranges, and each member re-broadcasts its held
+    # copy commit-coupled (see _handle_mcommit) so vote frontiers heal
+    # without ever overtaking the ops they stabilize
+    recovered: bool = False
 
 
 @dataclass
@@ -132,6 +146,9 @@ class MConsensus:
     dot: Dot
     ballot: int
     clock: int
+    # payload piggyback on recovery rounds, so a recovered clock can commit
+    # at processes the original MCollect broadcast never reached
+    cmd: Optional[Command] = None
 
 
 @dataclass
@@ -160,8 +177,16 @@ class Status:
     COMMIT = "commit"
 
 
-def _proposal_gen(_values):
-    raise NotImplementedError("recovery not implemented yet")
+def _recovery_proposal_gen(values):
+    """Recovery clock selection over the ballot-0 reports of an n-f promise
+    quorum (protocol/recovery.py; the reference's todo!() at
+    newt.rs:1110-1112).  Reports are the clocks fast-quorum members
+    proposed when acking the MCollect; 0 marks acceptors that never did.
+    All-zero -> the dot is recovered as a committed noop (clock 0, nothing
+    executes); otherwise the max reported clock — agreement alone is what
+    per-key order needs, and survivors' detached votes fill their own
+    frontiers up to any committed clock."""
+    return max(values.values(), default=0)
 
 
 def _newt_info_factory(pid, _sid, cfg, fq, _wq) -> "NewtInfo":
@@ -177,7 +202,7 @@ class NewtInfo:
     def __init__(self, process_id: ProcessId, n: int, f: int, fast_quorum_size: int):
         self.status = Status.START
         self.quorum: Set[ProcessId] = set()
-        self.synod: Synod[int] = Synod(process_id, n, f, _proposal_gen, 0)
+        self.synod: Synod[int] = Synod(process_id, n, f, _recovery_proposal_gen, 0)
         self.cmd: Optional[Command] = None
         # coordinator-side aggregation of fast-quorum votes
         self.votes = Votes()
@@ -194,7 +219,7 @@ CLOCK_BUMP_WORKER_INDEX = 1
 _MBUMP_BUFFER_CAP = 4096
 
 
-class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
+class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
     Executor = TableExecutor
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
@@ -228,8 +253,10 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
         # from "GC'd", and no later message would ever pop such an entry)
         self._buffered_mbumps: Dict[Dot, int] = {}
         self._init_partial()
+        self._init_recovery()
         # MCommit before MCollect (multiplexing reorders): buffer
-        self._buffered_mcommits: Dict[Dot, Tuple[ProcessId, int, Votes]] = {}
+        # (from, clock, merged votes, recovered)
+        self._buffered_mcommits: Dict[Dot, Tuple[ProcessId, int, Votes, bool]] = {}
         # highest committed clock: the floor for real-time clock bumps
         # (traceical clocks can run ahead of a simulated wall clock)
         self._max_commit_clock = 0
@@ -253,6 +280,7 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
             events.append(
                 (SendDetachedEvent(), self.bp.config.newt_detached_send_interval_ms)
             )
+        events.extend(self.recovery_periodic_events())
         return events
 
     @property
@@ -298,18 +326,20 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
         elif isinstance(msg, MCollectAck):
             self._handle_mcollectack(from_, msg.dot, msg.clock, msg.process_votes)
         elif isinstance(msg, MCommit):
-            self._handle_mcommit(from_, msg.dot, msg.clock, msg.votes)
+            self._handle_mcommit(from_, msg.dot, msg.clock, msg.votes, msg.recovered)
         elif isinstance(msg, MCommitClock):
             assert from_ == self.bp.process_id
             self._max_commit_clock = max(self._max_commit_clock, msg.clock)
         elif isinstance(msg, MDetached):
             self._handle_mdetached(msg.detached)
         elif isinstance(msg, MConsensus):
-            self._handle_mconsensus(from_, msg.dot, msg.ballot, msg.clock)
+            self._handle_mconsensus(from_, msg.dot, msg.ballot, msg.clock, msg.cmd, time)
         elif isinstance(msg, MConsensusAck):
             self._handle_mconsensusack(from_, msg.dot, msg.ballot)
         elif isinstance(msg, MBump):
             self._handle_mbump(msg.dot, msg.clock)
+        elif self.handle_recovery_message(from_, msg, time):
+            pass
         elif self.handle_partial_message(from_, msg):
             pass
         elif not self.handle_gc_message(from_, msg):
@@ -322,6 +352,8 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
             self._handle_event_clock_bump(time)
         elif isinstance(event, SendDetachedEvent):
             self._handle_event_send_detached()
+        elif isinstance(event, RecoveryEvent):
+            self.handle_recovery_event(time)
         else:
             raise AssertionError(f"unknown event {event}")
 
@@ -371,6 +403,7 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
         info = self._cmds.get(dot)
         if info.status != Status.START:
             return
+        self._recovery_track(dot, time)
 
         if self.bp.process_id not in quorum:
             # not in the fast quorum: store the payload only; pre-create the
@@ -382,17 +415,8 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
             buffered_bump = self._buffered_mbumps.pop(dot, None)
             if buffered_bump is not None:
                 self.key_clocks.detached(cmd, buffered_bump, self._detached)
-            buffered = self._buffered_mcommits.pop(dot, None)
-            if buffered is not None:
-                buf_from, buf_clock, buf_votes = buffered
-                self._handle_mcommit(buf_from, dot, buf_clock, buf_votes)
+            self._replay_buffered_mcommit(dot)
             return
-
-        # a fast-quorum member can never see MCommit before MCollect: the
-        # commit requires this member's own ack (or, under skip_fast_ack,
-        # is generated by this very handler), so buffering only ever happens
-        # on the not-in-quorum path above
-        assert dot not in self._buffered_mcommits
 
         message_from_self = from_ == self.bp.process_id
         if message_from_self:
@@ -401,11 +425,21 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
         else:
             clock, process_votes = self.key_clocks.proposal(cmd, remote_clock)
 
-        info.status = Status.COLLECT
         info.cmd = cmd
+        if not info.synod.set_if_not_accepted(lambda: clock):
+            # a recovery prepare already owns a higher ballot: our promise
+            # forbids the ballot-0 ack.  The proposal above consumed votes
+            # from our key clocks, though — hold them (plus any coordinator
+            # votes the MCollect carried) with the dot; the commit handler
+            # releases them commit-coupled so our vote frontier never gains
+            # a gap and never advances ahead of the dot's ops either
+            info.votes.merge(votes)
+            info.votes.merge(process_votes)
+            info.status = Status.PAYLOAD
+            self._replay_buffered_mcommit(dot)
+            return
+        info.status = Status.COLLECT
         info.quorum = set(quorum)
-        was_set = info.synod.set_if_not_accepted(lambda: clock)
-        assert was_set
 
         if not message_from_self and self._skip_fast_ack:
             # tiny-quorums shortcut (q=2): this quorum member holds both the
@@ -418,6 +452,14 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
             votes.merge(process_votes)
             self._mcommit_actions(info, dot, clock, votes)
         else:
+            if self._recovery_enabled():
+                # keep a copy of the votes we ship: if the coordinator dies
+                # with the ack in flight, these consumed ranges exist
+                # nowhere else and the resulting gap in our own vote
+                # frontier would stall timestamp stability forever — the
+                # commit handler re-flushes them through the detached
+                # channel (ranges dedup, so double delivery is harmless)
+                info.votes.merge(copy.deepcopy(process_votes))
             self._to_processes.append(
                 ToSend({from_}, MCollectAck(dot, clock, process_votes))
             )
@@ -434,6 +476,15 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
         buffered_bump = self._buffered_mbumps.pop(dot, None)
         if buffered_bump is not None:
             self.key_clocks.detached(cmd, buffered_bump, self._detached)
+        # with recovery in play a commit can be decided without this
+        # member's ack and thus arrive before its MCollect — replay it
+        self._replay_buffered_mcommit(dot)
+
+    def _replay_buffered_mcommit(self, dot: Dot) -> None:
+        buffered = self._buffered_mcommits.pop(dot, None)
+        if buffered is not None:
+            buf_from, buf_clock, buf_votes, buf_recovered = buffered
+            self._handle_mcommit(buf_from, dot, buf_clock, buf_votes, buf_recovered)
 
     def _handle_mcollectack(self, from_, dot, clock, remote_votes) -> None:
         info = self._cmds.get(dot)
@@ -452,6 +503,16 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
             self.key_clocks.detached(cmd, max_clock, self._detached)
 
         if not info.quorum_clocks.all():
+            return
+        if not info.synod.can_skip_prepare():
+            # a recovery proposer owns a higher ballot: neither the
+            # unilateral fast-path commit nor the first-ballot shortcut is
+            # sound anymore — join recovery with a full prepare; the
+            # aggregated votes stay in info.votes for the eventual commit
+            prepare = info.synod.new_prepare()
+            self._to_processes.append(
+                ToSend(self.bp.all(), MRecoveryPrepare(dot, prepare.ballot))
+            )
             return
         if max_count >= self.bp.config.f:
             self.bp.fast_path()
@@ -487,13 +548,39 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
             self._buffered_mbumps.pop(next(iter(self._buffered_mbumps)))
         self._buffered_mbumps[dot] = max(prev, clock)
 
-    def _mcommit_actions(self, info: NewtInfo, dot: Dot, clock: int, votes: Votes) -> None:
+    def _mcommit_actions(
+        self, info: NewtInfo, dot: Dot, clock: int, votes: Votes, recovered: bool = False
+    ) -> None:
         """Single-shard: broadcast MCommit.  Multi-shard: clock-max shard
         aggregation; the Votes stay here and rejoin the final MCommit
         (newt.rs:1063-1093)."""
         cmd = info.cmd
         if cmd is None or not self.partial_mcommit_actions(dot, cmd, clock, local=votes):
-            self._to_processes.append(ToSend(self.bp.all(), MCommit(dot, clock, votes)))
+            self._to_processes.append(
+                ToSend(self.bp.all(), MCommit(dot, clock, votes, recovered))
+            )
+
+    # --- recovery hooks (protocol/recovery.py) ---
+
+    def _adopt_recovered_payload(self, dot, info, cmd, time) -> None:
+        info.cmd = cmd
+        if info.status == Status.START:
+            info.status = Status.PAYLOAD
+            buffered_bump = self._buffered_mbumps.pop(dot, None)
+            if buffered_bump is not None:
+                self.key_clocks.detached(cmd, buffered_bump, self._detached)
+            self._replay_buffered_mcommit(dot)
+
+    def _recovery_consensus_msg(self, dot, ballot, value, cmd):
+        return MConsensus(dot, ballot, value, cmd)
+
+    def _recovery_chosen_reply(self, to, dot, info, value) -> None:
+        # same single-shard guard as the late-MConsensus reply; recovered
+        # so the receiver re-broadcasts any votes it still holds
+        if info.cmd is None or info.cmd.shard_count == 1:
+            self._to_processes.append(
+                ToSend({to}, MCommit(dot, value, info.votes, recovered=True))
+            )
 
     # --- partial-replication adapters (clock max; newt.rs:825-895) ---
 
@@ -506,16 +593,66 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
     def _partial_final_mcommit(self, dot: Dot, data, local):
         return MCommit(dot, data, local if local is not None else Votes())
 
-    def _handle_mcommit(self, from_, dot, clock, votes: Votes) -> None:
+    def _handle_mcommit(self, from_, dot, clock, votes: Votes, recovered=False) -> None:
         info = self._cmds.get(dot)
-        if info.status == Status.START:
-            self._buffered_mcommits[dot] = (from_, clock, votes)
-            return
         if info.status == Status.COMMIT:
+            # duplicate commit — typically a member re-broadcasting its
+            # held votes after a recovered commit: the ops are already in
+            # our table, so the ranges can join it directly
+            if not votes.is_empty():
+                for key, key_votes in votes:
+                    self._to_executors.append(TableDetachedVotes(key, key_votes))
+            return
+        if clock == 0:
+            # recovered noop (the dot never got a clock proposal anywhere
+            # the promise quorum could see): nothing executes and nothing
+            # stabilizes — settle the synod and stop recovery.  Votes held
+            # for a noop dot couple to no ops, so they flush as detached
+            info.status = Status.COMMIT
+            self._buffered_mbumps.pop(dot, None)
+            if not info.votes.is_empty():
+                held, info.votes = info.votes, Votes()
+                self._detached.merge(held)
+            out = info.synod.handle(from_, MChosen(clock))
+            assert out is None
+            self._recovery_untrack(dot)
+            if self._gc_running() and self._dot_in_my_shard(dot):
+                self._to_processes.append(ToForward(MCommitDot(dot)))
+            else:
+                self._cmds.gc_single(dot)
+            return
+        if info.status == Status.START:
+            buffered = self._buffered_mcommits.get(dot)
+            if buffered is not None:
+                # merge (not overwrite): a recovered commit and a member's
+                # held-vote re-broadcast may both arrive pre-payload
+                _bf, _bc, buf_votes, buf_rec = buffered
+                votes.merge(buf_votes)
+                recovered = recovered or buf_rec
+            self._buffered_mcommits[dot] = (from_, clock, votes, recovered)
             return
 
         cmd = info.cmd
         assert cmd is not None, "there should be a command payload"
+        if not info.votes.is_empty():
+            # votes this process consumed for the dot (shipped toward a
+            # possibly-dead coordinator, or held on the no-ack/interrupted
+            # paths).  They may exist nowhere else, and they must reach
+            # every table *with* the dot's ops — releasing them detached
+            # would let stability overtake the commit on slower replicas.
+            # So: join them to the local table add below, and when the
+            # commit was recovery-decided (its votes lack the quorum's
+            # consumed ranges) re-broadcast them commit-coupled; receivers
+            # fold them in post-ops via the duplicate-commit branch above
+            held, info.votes = info.votes, Votes()
+            if recovered:
+                self._to_processes.append(
+                    ToSend(
+                        self.bp.all_but_me(),
+                        MCommit(dot, clock, copy.deepcopy(held), recovered=True),
+                    )
+                )
+            votes.merge(held)
         for key, ops in cmd.iter_ops(self.bp.shard_id):
             key_votes = votes.remove(key)
             self._to_executors.append(
@@ -529,6 +666,7 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
         self._buffered_mbumps.pop(dot, None)
         out = info.synod.handle(from_, MChosen(clock))
         assert out is None
+        self._recovery_untrack(dot)
 
         if self.bp.config.newt_clock_bump_interval_ms is not None:
             # real-time mode: the clock-bump worker generates detached votes
@@ -546,25 +684,24 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
         for key, key_votes in detached:
             self._to_executors.append(TableDetachedVotes(key, key_votes))
 
-    def _handle_mconsensus(self, from_, dot, ballot, clock) -> None:
+    def _handle_mconsensus(self, from_, dot, ballot, clock, cmd=None, time=None) -> None:
         info = self._cmds.get(dot)
+        if cmd is not None and info.cmd is None:
+            self._adopt_recovered_payload(dot, info, cmd, time)
         out = info.synod.handle(from_, MAccept(ballot, clock))
         if out is None:
             return
         if isinstance(out, SynodMAccepted):
             self._to_processes.append(ToSend({from_}, MConsensusAck(dot, out.ballot)))
         elif isinstance(out, MChosen):
-            # already chosen: answer with a commit carrying our local votes.
-            # Multi-shard commands must not: the local clock lacks the
-            # cross-shard max, which only travels via MShardAggregatedCommit.
-            # Staying silent here is a liveness gap only under coordinator
-            # recovery (a new coordinator re-running consensus against
-            # already-chosen acceptors) — recovery is out of scope, as in
-            # the reference (newt.rs:1110-1112 panics todo!); in the
-            # no-recovery regime the sole MConsensus round precedes MChosen
+            # already chosen: answer with a commit carrying our local votes
+            # (a recovery proposer re-running consensus against
+            # already-chosen acceptors lands here).  Multi-shard commands
+            # must not: the local clock lacks the cross-shard max, which
+            # only travels via MShardAggregatedCommit
             if info.cmd is None or info.cmd.shard_count == 1:
                 self._to_processes.append(
-                    ToSend({from_}, MCommit(dot, out.value, info.votes))
+                    ToSend({from_}, MCommit(dot, out.value, info.votes, recovered=True))
                 )
         else:
             raise AssertionError(f"unexpected synod output {out}")
@@ -576,7 +713,11 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
             return
         assert isinstance(out, MChosen), f"unexpected synod output {out}"
         votes, info.votes = info.votes, Votes()
-        self._mcommit_actions(info, dot, out.value, votes)
+        # first-round slow-path ballots are process ids (<= n); anything
+        # above means this choice came from recovery prepare/promise and
+        # the commit must carry the recovered flag (vote re-broadcasts)
+        recovered = info.synod.current_ballot() > self.bp.config.n
+        self._mcommit_actions(info, dot, out.value, votes, recovered)
 
     # --- periodic events ---
 
@@ -611,6 +752,8 @@ class Newt(PartialCommitMixin, CommitGCMixin, Protocol):
                 MBump,
                 MShardCommit,
                 MShardAggregatedCommit,
+                MRecoveryPrepare,
+                MRecoveryPromise,
             ),
         ):
             return worker_dot_index_shift(msg.dot)

@@ -1,0 +1,240 @@
+"""Per-dot recovery consensus shared by the leaderless protocols.
+
+The reference leaves coordinator-crash recovery unimplemented (``todo!()``
+at fantoch_ps epaxos.rs:627-629 and newt.rs:1110-1112); this module goes
+beyond it: when a dot's commit is overdue (``Config.recovery_delay_ms``), a
+surviving process drives the dot's embedded :class:`Synod` through the
+full prepare/promise path that ``protocol/common/synod.py`` always carried
+but nothing called.
+
+Protocol flow (per overdue dot):
+
+1. **Trigger** — a periodic :class:`RecoveryEvent` scans the protocol's
+   pending-dot ledger.  The dot's owner (``dot.source``) retries first;
+   ring successors stagger in at ``recovery_delay_ms`` increments so a
+   dead owner's dots are picked up by exactly one process at a time
+   (deterministic: no randomness, so fault traces stay byte-identical).
+2. **Prepare** — ``synod.new_prepare()`` allocates a ballot above anything
+   seen (``id + n * round``) and broadcasts :class:`MRecoveryPrepare`.
+3. **Promise** — every acceptor answers with its ballot-0 value (the deps
+   or clock it reported when it acked the original MCollect; the
+   protocol's *bottom* when it never did) or its highest accepted value,
+   plus the command payload when it holds one — so a recovering value can
+   commit even at processes the original payload broadcast missed.  An
+   acceptor that already learned the decision short-circuits with a
+   commit reply instead.
+4. **Select** — with ``n - f`` promises the synod proposer picks the
+   highest-ballot accepted value; if nothing was ever accepted the
+   protocol's ``proposal_gen`` runs over the ballot-0 reports: the union
+   of reported deps / the max reported clock, or the protocol's *noop*
+   bottom for dots never payloaded anywhere visible (owner crashed before
+   its MCollect got out).
+5. **Phase 2** — the chosen value flows through the protocols' existing
+   MConsensus/MConsensusAck handlers (broadcast rather than
+   write-quorum-only, since quorum members may be the dead ones) and
+   commits through the normal MCommit path; noop commits resolve
+   dependents through the executor's noop seam without executing
+   anything.
+
+Safety note: ballots make concurrent recoveries and recovery-vs-slow-path
+races safe (classic synod).  The one residual window is recovery racing a
+*fast-path* commit that the (live or crashed) coordinator decided but that
+no promiser has seen: the recovered value can then differ from the
+decided one — the graph protocols' union includes non-quorum "late
+reports" (extra conflict edges a fast-path value never saw) and can at
+the same time miss deps/clock-maxima known only to reporters outside the
+promise quorum.  With the all-at-once fan-out both the simulator and the
+TCP writer perform, a decided commit either reaches every live process or
+none, so the window requires an in-flight commit surviving past the
+recovery trigger: ``recovery_delay_ms`` MUST exceed the maximum delivery
+delay, retransmit tails included — size the knob accordingly (and the
+model checker covers the message-driven interleavings exhaustively at
+small scope).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from fantoch_tpu.core.command import Command
+from fantoch_tpu.core.ids import Dot, ProcessId
+from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.protocol.base import ToSend
+from fantoch_tpu.protocol.common.synod import (
+    MAccept as SynodMAccept,
+    MChosen as SynodMChosen,
+    MPrepare as SynodMPrepare,
+    MPromise as SynodMPromise,
+)
+
+
+@dataclass
+class MRecoveryPrepare:
+    dot: Dot
+    ballot: int
+
+
+@dataclass
+class MRecoveryPromise:
+    dot: Dot
+    ballot: int
+    accepted: Tuple[int, Any]  # (accepted ballot, value)
+    cmd: Optional[Command]  # payload piggyback for processes that miss it
+
+
+@dataclass
+class RecoveryEvent:
+    """Periodic overdue-dot scan (interval = Config.recovery_delay_ms)."""
+
+
+class RecoveryMixin:
+    """Requires from the host protocol: ``self.bp`` (BaseProcess),
+    ``self._cmds`` (CommandsInfo over infos with ``.status``/``.synod``/
+    ``.cmd``), ``self._to_processes`` (deque), a ``Status`` class with
+    ``COMMIT``, and two hooks:
+
+    * ``_recovery_consensus_msg(dot, ballot, value, cmd)`` — the protocol's
+      MConsensus carrying a recovered value (and the payload piggyback);
+    * ``_recovery_chosen_reply(to, dot, info, value)`` — answer a prepare
+      for an already-decided dot with the protocol's commit message.
+    """
+
+    _STATUS_COMMIT = "commit"
+
+    def _init_recovery(self) -> None:
+        # dot -> virtual ms when it became pending (or last recovery try)
+        self._pending_since: Dict[Dot, int] = {}
+
+    def _recovery_enabled(self) -> bool:
+        cfg = self.bp.config
+        # single-shard only: the partial-replication commit aggregation has
+        # no recovery story yet (cross-shard MShardCommit state dies with
+        # the dot owner)
+        return cfg.recovery_delay_ms is not None and cfg.shard_count == 1
+
+    def recovery_periodic_events(self):
+        if self._recovery_enabled():
+            return [(RecoveryEvent(), self.bp.config.recovery_delay_ms)]
+        return []
+
+    def _recovery_track(self, dot: Dot, time: SysTime) -> None:
+        if self._recovery_enabled() and dot not in self._pending_since:
+            self._pending_since[dot] = time.millis()
+
+    def _recovery_untrack(self, dot: Dot) -> None:
+        if self._recovery_enabled():
+            self._pending_since.pop(dot, None)
+
+    # --- triggers ---
+
+    def nudge_recovery(self, dots, time: SysTime) -> None:
+        """Executor-watchdog hint (Protocol.nudge_recovery): track missing
+        dependency dots so the periodic scan recovers them — the only path
+        by which a dot payloaded at no live process (its owner crashed
+        before the broadcast got out) heals, as a committed noop."""
+        if not self._recovery_enabled():
+            return
+        for dot in sorted(dots):
+            self._recovery_track(dot, time)
+
+    def handle_recovery_event(self, time: SysTime) -> None:
+        if not self._recovery_enabled():
+            return
+        now = time.millis()
+        delay = self.bp.config.recovery_delay_ms
+        n = self.bp.config.n
+        me = self.bp.process_id
+        for dot in list(self._pending_since):
+            # get (not get_existing): a nudged dot may have no info yet —
+            # recovery then runs on the fresh bottom synod and, with no
+            # payload anywhere, commits it as a noop
+            info = self._cmds.get(dot)
+            if info.status == self._STATUS_COMMIT:
+                self._pending_since.pop(dot, None)
+                continue
+            # stagger: the owner retries after one delay, its ring
+            # successor after two, and so on — exactly one new proposer
+            # joins per interval while earlier ones retry
+            wait = delay * (1 + (me - dot.source) % n)
+            if now - self._pending_since[dot] < wait:
+                continue
+            # rebase the clock so, once joined, this proposer retries once
+            # per interval (next eligibility lands at now + delay)
+            self._pending_since[dot] = now - delay * ((me - dot.source) % n)
+            prepare = info.synod.new_prepare()
+            self._to_processes.append(
+                ToSend(self.bp.all(), MRecoveryPrepare(dot, prepare.ballot))
+            )
+
+    # --- wire handlers ---
+
+    def handle_recovery_message(self, from_: ProcessId, msg: Any, time: SysTime) -> bool:
+        """Dispatch a recovery message; returns False if ``msg`` is not
+        one."""
+        if isinstance(msg, MRecoveryPrepare):
+            self._handle_recovery_prepare(from_, msg.dot, msg.ballot)
+        elif isinstance(msg, MRecoveryPromise):
+            self._handle_recovery_promise(
+                from_, msg.dot, msg.ballot, msg.accepted, msg.cmd, time
+            )
+        else:
+            return False
+        return True
+
+    def _handle_recovery_prepare(self, from_: ProcessId, dot: Dot, ballot: int) -> None:
+        info = self._cmds.get(dot)
+        out = info.synod.handle(from_, SynodMPrepare(ballot))
+        if out is None:
+            return  # stale ballot
+        if isinstance(out, SynodMPromise):
+            self._to_processes.append(
+                ToSend(
+                    {from_},
+                    MRecoveryPromise(dot, out.ballot, out.accepted, info.cmd),
+                )
+            )
+        elif isinstance(out, SynodMChosen):
+            # already decided here: short-circuit the proposer with a commit
+            self._recovery_chosen_reply(from_, dot, info, out.value)
+        else:  # pragma: no cover
+            raise AssertionError(f"unexpected synod output {out}")
+
+    def _handle_recovery_promise(
+        self,
+        from_: ProcessId,
+        dot: Dot,
+        ballot: int,
+        accepted: Tuple[int, Any],
+        cmd: Optional[Command],
+        time: SysTime,
+    ) -> None:
+        info = self._cmds.get(dot)
+        if cmd is not None and info.cmd is None:
+            # adopt the piggybacked payload so a later commit can execute
+            # even if the original MCollect never reached us
+            self._adopt_recovered_payload(dot, info, cmd, time)
+        out = info.synod.handle(from_, SynodMPromise(ballot, accepted))
+        if out is None:
+            return  # not this ballot, or still below n - f promises
+        assert isinstance(out, SynodMAccept), f"unexpected synod output {out}"
+        # broadcast (not write-quorum-only): the write quorum was sized for
+        # the failure-free path and may contain the dead processes recovery
+        # is routing around; phase-2 still only needs f + 1 accepts
+        self._to_processes.append(
+            ToSend(
+                self.bp.all(),
+                self._recovery_consensus_msg(dot, out.ballot, out.value, info.cmd),
+            )
+        )
+
+    # --- hooks for the host protocol ---
+
+    def _adopt_recovered_payload(self, dot: Dot, info, cmd: Command, time: SysTime) -> None:
+        info.cmd = cmd
+
+    def _recovery_consensus_msg(self, dot: Dot, ballot: int, value, cmd):
+        raise NotImplementedError
+
+    def _recovery_chosen_reply(self, to: ProcessId, dot: Dot, info, value) -> None:
+        raise NotImplementedError

@@ -779,6 +779,10 @@ class ProcessRuntime:
                 self.config.n,
                 needed,
             )
+            # tell the protocol (worker 0 owns leadership state): FPaxos
+            # uses this to elect a new leader without waiting out its own
+            # protocol-level silence timeout
+            self.workers.forward_to(0, ("peer_down", peer_id))
 
     def inject_link_failure(self, peer_id: Optional[ProcessId] = None) -> int:
         """Chaos hook for tests: hard-kill the live peer-link sockets (all
@@ -821,6 +825,8 @@ class ProcessRuntime:
                 process.handle_event(item[1], self.time)
             elif kind == "executed":
                 process.handle_executed(item[1], self.time)
+            elif kind == "peer_down":
+                process.on_peer_down(item[1], self.time)
             else:
                 raise AssertionError(f"unknown worker item {item}")
             self._drain_protocol()

@@ -33,10 +33,14 @@ Fault model (see README "Fault model" for the contract):
 * **Pause** — a transient freeze ``[at_ms, until_ms)``: inbound traffic
   and periodic events are deferred and replayed at resume, modelling a
   stop-the-world (GC pause, VM migration) rather than a crash.
-* **Bounded wait** — ``max_sim_time_ms`` turns a stalled run (e.g. every
-  member of an in-flight command's quorum crashed and recovery is not
-  implemented) into a typed :class:`~fantoch_tpu.errors.SimStalledError`
-  instead of an infinite loop.
+* **Bounded wait** — ``max_sim_time_ms`` turns a stalled run (e.g. more
+  than ``f`` members of an in-flight command's quorum crashed, so even the
+  per-dot recovery consensus of ``protocol/recovery.py`` cannot gather an
+  n-f promise quorum) into a typed
+  :class:`~fantoch_tpu.errors.SimStalledError` instead of an infinite
+  loop.  With ``Config.recovery_delay_ms`` set and at most ``f`` crashes,
+  stalls *heal* instead: overdue dots go through prepare/promise recovery
+  and commit (possibly as noops).
 """
 
 from __future__ import annotations

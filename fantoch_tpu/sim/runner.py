@@ -326,9 +326,16 @@ class Runner:
     def _handle_executor_watchdog(self, ev: PeriodicExecutorWatchdog) -> None:
         """Bounded-wait check: raises a typed StalledExecutionError (via
         Config.executor_pending_fail_ms) when a committed command has been
-        waiting on never-committing dependencies past the bound."""
-        _, executor, _ = self._simulation.get_process(ev.process_id)
-        executor.monitor_pending(self._simulation.time)
+        waiting on never-committing dependencies past the bound.  Below the
+        bound, the missing dots feed the protocol's recovery plane
+        (Protocol.nudge_recovery): with Config.recovery_delay_ms set, a dot
+        the executor is starving on is recovered by consensus — as a noop
+        when its payload never reached any live process — instead of ever
+        reaching the typed error."""
+        process, executor, _ = self._simulation.get_process(ev.process_id)
+        missing = executor.monitor_pending(self._simulation.time)
+        if missing:
+            process.nudge_recovery(missing, self._simulation.time)
         self._schedule.schedule(self._simulation.time, ev.delay_ms, ev)
 
     def _handle_submit_to_proc(self, process_id: ProcessId, cmd: Command) -> None:

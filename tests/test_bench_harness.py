@@ -51,6 +51,8 @@ def test_slope_timed_noise_negative_returns_none(monkeypatch):
 def test_tpu_record_gate(tmp_path, monkeypatch):
     path = tmp_path / "BENCH_TPU_LATEST.json"
     monkeypatch.setattr(bench, "_TPU_RECORD_PATH", str(path))
+    # the gated-candidate sidecar must land in the sandbox too, not the repo
+    monkeypatch.setattr(bench, "_TPU_GATED_PATH", str(tmp_path / "BENCH_TPU_GATED.json"))
 
     # non-tpu records never persist
     bench._save_tpu_record(json.dumps({"platform": "cpu", "value": 1.0}))

@@ -2,35 +2,37 @@
 
 The leaderless protocols fantoch reproduces stay live and linearizable
 with up to ``f`` crashed replicas over a lossy network.  These tests drive
-the deterministic nemesis (fantoch_tpu/sim/faults.py) and the
-crash-tolerant run layer (fantoch_tpu/run/links.py + process_runner.py)
-through that claim:
+the deterministic nemesis (fantoch_tpu/sim/faults.py), the recovery plane
+(fantoch_tpu/protocol/recovery.py) and the crash-tolerant run layer
+(fantoch_tpu/run/links.py + process_runner.py) through that claim:
 
 * **Determinism** — same FaultPlan seed twice => byte-identical fault
-  trace and committed/executed-command trace.
+  trace and committed/executed-command trace (with or without recovery).
 * **Liveness under crash + loss** — crash replicas mid-run under >= 10%
   message loss (retransmitted: lossy network, quasi-reliable channels —
   exactly what the protocols assume of TCP); surviving clients' commands
   all commit and execute with write-order agreement across surviving
   replicas.
-* **Bounded wait** — where liveness is *not* achievable (an isolated
-  coordinator's dots stranded in survivors' dependency sets; a crashed
-  fast-quorum member with no recovery protocol — recovery is explicitly
-  NotImplemented in protocol/graph_protocol.py), the run surfaces a typed
-  error (StalledExecutionError / SimStalledError) instead of hanging.
+* **Recovery** (``recovery`` marker) — with ``Config.recovery_delay_ms``
+  set, crashing *fast-quorum members and coordinators of in-flight
+  commands* (the scenarios that used to assert a typed stall) heals:
+  overdue dots go through MPrepare/MPromise recovery, commit (as noops
+  when never payloaded), and every surviving client completes.  FPaxos
+  survives a leader crash via MultiSynod failover, in sim and over TCP.
+* **Bounded wait** — where liveness is *not* achievable (recovery
+  disabled, or more than f failures so no n-f promise quorum exists), the
+  run surfaces a typed error (StalledExecutionError / SimStalledError)
+  whose message says whether recovery ran and why it could not proceed.
 * **Run layer** — severing live TCP connections mid-run triggers
   reconnect-with-backoff + seq/ack resend and the workload completes;
   losing peers past quorum surfaces a typed QuorumLostError.
 
 Topology note: fast quorums are fixed per command at submit time
-(BaseProcess.discover), so a *crashed quorum member* stalls in-flight
-commands forever absent recovery.  The crash-liveness rows therefore use
-a planet where the crashed replicas are the farthest from everyone —
-outside every survivor's fast quorum — which is precisely the deployment
-argument the papers make (quorums of nearby replicas tolerate the loss
-of distant ones).  Quorum-member failure is covered by the pause rows
-(transient outage, must heal) and the bounded-wait rows (permanent, must
-fail loudly), not silently skipped.
+(BaseProcess.discover).  The no-recovery crash-liveness rows use a planet
+where the crashed replicas are the farthest from everyone — outside every
+survivor's fast quorum (the papers' deployment argument).  The recovery
+rows do the opposite: ``far=0`` puts every crashed replica inside live
+fast quorums, which stalled forever before PR 3.
 """
 
 import asyncio
@@ -46,7 +48,7 @@ from fantoch_tpu.errors import (
     SimStalledError,
     StalledExecutionError,
 )
-from fantoch_tpu.protocol import Atlas, Basic, EPaxos, Newt
+from fantoch_tpu.protocol import Atlas, Basic, EPaxos, FPaxos, Newt
 from fantoch_tpu.sim import Runner
 from fantoch_tpu.sim.faults import FaultPlan
 
@@ -263,10 +265,11 @@ def test_partition_heal_epaxos():
 
 
 def test_executor_stall_surfaces_typed_error():
-    """Permanently isolating a coordinator strands its in-flight dots in
-    the survivors' dependency sets: their graph executors must raise a
-    typed StalledExecutionError naming the missing dots (bounded wait),
-    not wait forever."""
+    """With recovery disabled, permanently isolating a coordinator strands
+    its in-flight dots in the survivors' dependency sets: their graph
+    executors must raise a typed StalledExecutionError naming the missing
+    dots (bounded wait), not wait forever — and the message must say
+    recovery was disabled."""
     config = Config(
         5,
         1,
@@ -294,18 +297,345 @@ def test_executor_stall_surfaces_typed_error():
         assert all(
             dep.source == 5 for deps in err.value.missing.values() for dep in deps
         )
+        assert "recovery disabled" in str(err.value)
 
 
-def test_crashed_quorum_member_stall_is_bounded():
-    """Crashing a fast-quorum member stalls in-flight collects (recovery
-    is NotImplemented); the sim's virtual-time bound must convert the
-    hang into a typed SimStalledError listing the waiting clients."""
+def test_crashed_quorum_member_stall_bounded_without_recovery():
+    """Without recovery_delay_ms, crashing a fast-quorum member stalls
+    in-flight collects forever; the sim's virtual-time bound must convert
+    the hang into a typed SimStalledError listing the waiting clients.
+    (The recovery rows below run the same scenario and assert completion
+    instead.)"""
     plan = FaultPlan(seed=1, max_sim_time_ms=20_000).with_crash(2, at_ms=100)
     with pytest.raises(SimStalledError) as err:
         chaos_sim(
             EPaxos, Config(3, 1), plan, far=0, conflict_rate=100, keys_per_command=1
         )
     assert err.value.waiting_clients
+
+
+# --- recovery: the same crashes now heal (protocol/recovery.py) ---
+
+recovery = pytest.mark.recovery
+
+RECOVERY_33 = Config(3, 1, recovery_delay_ms=1000)
+RECOVERY_PLAN_33 = FaultPlan(seed=1, max_sim_time_ms=120_000).with_crash(2, at_ms=100)
+
+
+@recovery
+@pytest.mark.parametrize(
+    "protocol_cls,config",
+    [
+        (EPaxos, RECOVERY_33),
+        (Atlas, RECOVERY_33),
+        (EPaxos, RECOVERY_33.with_(batched_graph_executor=True)),
+        (Newt, RECOVERY_33.with_(newt_detached_send_interval_ms=100)),
+    ],
+    ids=["epaxos", "atlas", "epaxos-batched", "newt"],
+)
+def test_recovery_quorum_member_crash_completes(protocol_cls, config):
+    """The exact scenario that used to assert SimStalledError: a crashed
+    fast-quorum member at n=3/f=1 (far=0: it sits in every live fast
+    quorum).  With recovery on, every surviving client completes and the
+    execution-order monitors agree."""
+    runner, _metrics, monitors = chaos_sim(
+        protocol_cls, config, RECOVERY_PLAN_33, far=0,
+        conflict_rate=100, keys_per_command=1,
+    )
+    assert_survivors_done_and_agree(runner, monitors, crashed_ids=[2])
+    # the slow/recovery path was actually exercised, not a lucky fast run
+    assert any(kind == "crash" for _t, kind, _d in runner.nemesis.trace)
+
+
+@recovery
+def test_recovery_epaxos_5_2_double_crash_under_loss():
+    """n=5 with two crashed processes (coordinators of in-flight commands
+    included) under 15% message loss: recovery heals everything the
+    survivors owe."""
+    plan = (
+        FaultPlan(seed=7, max_sim_time_ms=300_000)
+        .with_loss(0.15)
+        .with_crash(2, at_ms=150)
+        .with_crash(4, at_ms=250)
+    )
+    runner, _metrics, monitors = chaos_sim(
+        EPaxos, Config(5, 2, recovery_delay_ms=1500), plan, far=0
+    )
+    assert_survivors_done_and_agree(runner, monitors, crashed_ids=[2, 4])
+
+
+@recovery
+def test_recovery_determinism():
+    """Recovery decisions are deterministic: same plan + seed twice under
+    crash + loss + recovery => byte-identical fault traces."""
+    plan = (
+        FaultPlan(seed=3, max_sim_time_ms=120_000)
+        .with_loss(0.1)
+        .with_crash(2, at_ms=120)
+    )
+
+    def digest():
+        runner, _m, monitors = chaos_sim(
+            EPaxos, Config(3, 1, recovery_delay_ms=1000), plan, far=0
+        )
+        assert_survivors_done_and_agree(runner, monitors, crashed_ids=[2])
+        return runner.nemesis.trace_digest()
+
+    assert digest() == digest()
+
+
+@recovery
+def test_recovery_noop_payload_starved_dots():
+    """The noop path: p3's payload broadcasts are blackholed (true loss),
+    p3 acks other commands normally (its key-deps reference its own
+    stranded dots), then p3 crashes.  Survivors commit commands whose deps
+    name dots payloaded at NO live process; the executor watchdog nudges
+    the recovery plane and they heal as committed noops."""
+    from fantoch_tpu.core.planet import Region
+
+    regions = [Region("r0"), Region("r1"), Region("r2")]
+    lat = {
+        regions[0]: {regions[0]: 0, regions[1]: 20, regions[2]: 5},
+        regions[1]: {regions[0]: 20, regions[1]: 0, regions[2]: 20},
+        regions[2]: {regions[0]: 5, regions[1]: 20, regions[2]: 0},
+    }
+    planet = Planet.from_latencies(lat)
+    config = Config(
+        3,
+        1,
+        recovery_delay_ms=400,
+        executor_monitor_pending_interval_ms=200,
+        executor_pending_fail_ms=30_000,
+        executor_monitor_execution_order=True,
+        gc_interval_ms=100,
+        executor_executed_notification_interval_ms=100,
+    )
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(100),
+        keys_per_command=1,
+        commands_per_client=8,
+        payload_size=1,
+    )
+    plan = (
+        FaultPlan(seed=2, max_sim_time_ms=120_000)
+        .with_link_fault(src=3, drop=1.0, retransmit=False, msg_types=("MCollect",))
+        .with_crash(3, at_ms=300)
+    )
+    runner = Runner(
+        EPaxos, planet, config, workload, CLIENTS_PER_PROCESS,
+        process_regions=regions, client_regions=regions,
+        seed=0, fault_plan=plan,
+    )
+    _metrics, monitors, _lat = runner.run(extra_sim_time_ms=2000)
+    for _cid, client in runner._simulation.clients():
+        if 3 in client.targets():
+            continue
+        assert client.issued_commands == 8
+    check_monitors({pid: m for pid, m in monitors.items() if pid != 3})
+
+
+@recovery
+def test_recovery_below_quorum_is_still_bounded():
+    """More than f crashes (2 of n=3): recovery cannot gather an n-f
+    promise quorum, so the run must still fail with a *typed* error
+    rather than hang — the bounded-wait contract survives the recovery
+    plane."""
+    config = Config(
+        3,
+        1,
+        recovery_delay_ms=500,
+        executor_monitor_pending_interval_ms=300,
+        executor_pending_fail_ms=4_000,
+    )
+    plan = (
+        FaultPlan(seed=4, max_sim_time_ms=30_000)
+        .with_crash(2, at_ms=30)
+        .with_crash(3, at_ms=60)
+    )
+    with pytest.raises((StalledExecutionError, SimStalledError)) as err:
+        chaos_sim(
+            EPaxos,
+            config,
+            plan,
+            far=0,
+            conflict_rate=100,
+            keys_per_command=1,
+            commands_per_client=30,
+        )
+    if isinstance(err.value, StalledExecutionError):
+        assert "recovery was attempted" in str(err.value)
+
+
+@recovery
+def test_stall_error_names_recovery_attempt():
+    """The executor watchdog's StalledExecutionError must say whether
+    recovery ran: with recovery_delay_ms set, the message names the
+    attempt and the likely cause (no n-f promise quorum)."""
+    from fantoch_tpu.core import Command, KVOp, Rifl
+    from fantoch_tpu.core.ids import Dot
+    from fantoch_tpu.core.timing import SimTime
+    from fantoch_tpu.executor.graph.executor import GraphAdd, GraphExecutor
+    from fantoch_tpu.protocol.common.graph_deps import Dependency
+
+    config = Config(
+        3,
+        1,
+        recovery_delay_ms=100,
+        executor_pending_fail_ms=500,
+        executor_monitor_pending_interval_ms=100,
+    )
+    executor = GraphExecutor(1, 0, config)
+    executor.set_executor_index(0)
+    time = SimTime()
+    cmd = Command.from_keys(Rifl(1, 1), 0, {"A": (KVOp.put("v"),)})
+    missing_dep = Dependency(Dot(3, 1), frozenset({0}))
+    executor.handle(GraphAdd(Dot(1, 1), cmd, {missing_dep}), time)
+    time.set_millis(1_000)
+    with pytest.raises(StalledExecutionError) as err:
+        executor.monitor_pending(time)
+    assert "recovery was attempted every 100ms" in str(err.value)
+    # the same watchdog pass, below the fail bound, returns the missing
+    # dots so the runner can nudge the recovery plane
+    executor2 = GraphExecutor(1, 0, config.with_(executor_pending_fail_ms=10_000))
+    executor2.set_executor_index(0)
+    time2 = SimTime()
+    executor2.handle(GraphAdd(Dot(1, 1), cmd, {missing_dep}), time2)
+    time2.set_millis(2_000)
+    assert executor2.monitor_pending(time2) == {Dot(3, 1)}
+
+
+@recovery
+def test_recovery_fpaxos_sim_leader_failover():
+    """Crash the FPaxos leader mid-run: the ring successor elects itself
+    through MultiSynod prepare/promise, carries accepted slots forward,
+    and every surviving client completes."""
+    config = Config(3, 1, leader=1, fpaxos_leader_timeout_ms=400)
+    plan = FaultPlan(seed=5, max_sim_time_ms=120_000).with_crash(1, at_ms=150)
+    runner, _metrics, monitors = chaos_sim(FPaxos, config, plan, far=0)
+    assert_survivors_done_and_agree(runner, monitors, crashed_ids=[1])
+    for pid in (2, 3):
+        proto = runner._simulation.get_process(pid)[0]
+        assert proto._leader == 2, (pid, proto._leader)
+
+
+@recovery
+def test_recovery_fpaxos_tcp_leader_failover():
+    """Kill the FPaxos leader's runtime mid-run over real TCP: the
+    heartbeat failure detector triggers on_peer_down, p2 wins the
+    election, and both client pools complete against the survivors."""
+    from fantoch_tpu.run.client_runner import run_clients
+    from fantoch_tpu.run.harness import free_port
+    from fantoch_tpu.run.links import ReconnectPolicy
+    from fantoch_tpu.run.process_runner import ProcessRuntime
+
+    commands = 20
+
+    async def scenario():
+        config = Config(
+            n=3,
+            f=1,
+            leader=1,
+            fpaxos_leader_timeout_ms=2000,
+            executor_monitor_execution_order=True,
+            gc_interval_ms=50,
+            executor_executed_notification_interval_ms=50,
+        )
+        peer_ports = {pid: free_port() for pid in (1, 2, 3)}
+        client_ports = {pid: free_port() for pid in (1, 2, 3)}
+        runtimes = {}
+        for pid in (1, 2, 3):
+            runtimes[pid] = ProcessRuntime(
+                FPaxos,
+                pid,
+                0,
+                config,
+                listen_addr=("127.0.0.1", peer_ports[pid]),
+                client_addr=("127.0.0.1", client_ports[pid]),
+                peers={
+                    p: ("127.0.0.1", peer_ports[p]) for p in (1, 2, 3) if p != pid
+                },
+                sorted_processes=[(pid, 0)]
+                + [(p, 0) for p in (1, 2, 3) if p != pid],
+                reconnect_policy=ReconnectPolicy(attempts=3, base_s=0.02, cap_s=0.1),
+                heartbeat_interval_s=0.1,
+                heartbeat_misses=5,
+            )
+        await asyncio.gather(*(r.start() for r in runtimes.values()))
+        workload = Workload(
+            shard_count=1,
+            key_gen=ConflictRateKeyGen(50),
+            keys_per_command=2,
+            commands_per_client=commands,
+            payload_size=1,
+        )
+
+        async def chaos():
+            await asyncio.sleep(0.15)
+            await runtimes[1].stop()  # kill the leader
+
+        client_task = asyncio.gather(
+            run_clients(
+                [1, 2], {0: ("127.0.0.1", client_ports[2])}, workload,
+                open_loop_interval_ms=10,
+            ),
+            run_clients(
+                [3, 4], {0: ("127.0.0.1", client_ports[3])}, workload,
+                open_loop_interval_ms=10,
+            ),
+        )
+        chaos_task = asyncio.ensure_future(chaos())
+        results = await asyncio.wait_for(client_task, timeout=120)
+        await chaos_task
+        # the workload may have outrun the kill; the election itself is
+        # driven by the failure detector, so wait for it regardless
+        deadline = asyncio.get_running_loop().time() + 30
+        while asyncio.get_running_loop().time() < deadline:
+            if all(runtimes[pid].process._leader == 2 for pid in (2, 3)):
+                break
+            await asyncio.sleep(0.1)
+        leaders = {pid: runtimes[pid].process._leader for pid in (2, 3)}
+        failures = {pid: runtimes[pid].failure for pid in (2, 3)}
+        await asyncio.gather(*(runtimes[pid].stop() for pid in (2, 3)))
+        return results, leaders, failures
+
+    results, leaders, failures = asyncio.run(scenario())
+    for group in results:
+        for cid, client in group.items():
+            assert client.issued_commands == commands, (cid, client.issued_commands)
+    assert leaders == {2: 2, 3: 2}, leaders
+    assert failures == {2: None, 3: None}, failures
+
+
+@recovery
+@pytest.mark.slow
+@pytest.mark.parametrize("loss", [0.1, 0.3])
+@pytest.mark.parametrize(
+    "protocol_cls,config",
+    [
+        (EPaxos, Config(5, 2, recovery_delay_ms=1500)),
+        (Atlas, Config(5, 2, recovery_delay_ms=1500)),
+        (
+            Newt,
+            Config(
+                5, 2, recovery_delay_ms=1500, newt_detached_send_interval_ms=100
+            ),
+        ),
+    ],
+    ids=["epaxos", "atlas", "newt"],
+)
+def test_recovery_crash_matrix_5_2(protocol_cls, config, loss):
+    """Acceptance matrix: n=5/f=2, two crashed processes inside live fast
+    quorums, 10-30% message loss — all surviving clients complete with
+    order agreement."""
+    plan = (
+        FaultPlan(seed=13, max_sim_time_ms=600_000)
+        .with_loss(loss)
+        .with_crash(2, at_ms=150)
+        .with_crash(4, at_ms=250)
+    )
+    runner, _metrics, monitors = chaos_sim(protocol_cls, config, plan, far=0)
+    assert_survivors_done_and_agree(runner, monitors, crashed_ids=[2, 4])
 
 
 # --- the slow rows: crash x loss x protocol sweep ---

@@ -158,6 +158,47 @@ def test_mc_newt_with_quiescent_timers():
     assert result.terminals > 0
 
 
+@pytest.mark.recovery
+def test_mc_epaxos_crashed_coordinator_recovery():
+    """Exhaustively explore a coordinator crash at n=3/f=1: the crash of
+    p1 branches at every state (in-flight messages to it evaporate, its
+    unsubmitted commands are abandoned), and the stabilization closure
+    drives the survivors' MPrepare/MPromise recovery of its in-flight
+    dots.  Every interleaving must keep the consensus agreement invariant
+    (identical survivor orders, mandatory commands complete, crashed-
+    coordinator commands executed everywhere-or-nowhere)."""
+    from fantoch_tpu.protocol.graph_protocol import EPaxos
+
+    mc = ModelChecker(
+        EPaxos,
+        Config(3, 1, gc_interval_ms=100, recovery_delay_ms=50),
+        [(1, put(1, 1, "A")), (2, put(2, 1, "A"))],
+        max_states=500_000,
+        crashes=[1],
+    )
+    result = mc.run()
+    assert result.complete, "state space must be exhausted"
+    assert result.ok, result.violations[:1]
+    assert result.terminals > 0
+
+
+@pytest.mark.recovery
+@pytest.mark.slow
+def test_mc_atlas_crashed_coordinator_recovery():
+    from fantoch_tpu.protocol.graph_protocol import Atlas
+
+    mc = ModelChecker(
+        Atlas,
+        Config(3, 1, gc_interval_ms=100, recovery_delay_ms=50),
+        [(1, put(1, 1, "A")), (2, put(2, 1, "A"))],
+        max_states=500_000,
+        crashes=[1],
+    )
+    result = mc.run()
+    assert result.complete and result.ok, result.violations[:1]
+    assert result.terminals > 0
+
+
 @pytest.mark.skipif(
     not os.environ.get("FANTOCH_MC_SLOW"),
     reason="~8 min exhaustive run; set FANTOCH_MC_SLOW=1",
