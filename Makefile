@@ -1,7 +1,7 @@
 # Test/bench entry points (the reference pins quality with Makefile:3-7 —
 # fmt + clippy + `cargo test` under a quickcheck budget; here the suite +
 # dryrun + bench are the equivalent gates).
-.PHONY: test test-fast test-chaos test-recovery dryrun bench
+.PHONY: test test-fast test-chaos test-recovery dryrun bench bench-smoke
 
 test:
 	python -m pytest tests/ -x -q
@@ -27,3 +27,9 @@ dryrun:
 
 bench:
 	python bench.py
+
+# tiny CPU-sized bench rows (table + Newt serving), in-process: catches
+# import breaks and order-of-magnitude regressions in the bench seams
+# without a chip — the per-push CI slice runs this
+bench-smoke:
+	python bench.py --smoke

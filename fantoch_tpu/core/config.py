@@ -67,6 +67,19 @@ class Config:
     # vectorized stability pass per executor batch
     # (fantoch_tpu/ops/table_ops.py at the executor/table.py seam)
     batched_table_executor: bool = False
+    # device-resident votes-table plane: the TableExecutor keeps the
+    # (key_bucket x process) vote-frontier matrix on device across
+    # batches (donated buffers) and runs vote coalescing + frontier
+    # update + stability as ONE fused dispatch per batch
+    # (executor/table_plane.py over ops/table_ops.fused_votes_commit).
+    # Requires clocks below 2^31 (no real-time-micros clock bumps)
+    device_table_plane: bool = False
+    # frontier-matrix element count (keys x n) at which the TableExecutor
+    # host path routes stability to the device kernel instead of the
+    # numpy partition.  None = the built-in default (1 << 20), overridable
+    # via the FANTOCH_TABLE_KERNEL_THRESHOLD env var; an explicit value
+    # here beats both
+    table_kernel_threshold: Optional[int] = None
     # batch Caesar's predecessor executor: two-phase countdown resolution
     # as one device kernel per batch (fantoch_tpu/ops/pred_resolve.py at
     # the executor/pred.py seam)
@@ -98,6 +111,14 @@ class Config:
             raise ValueError("n must be positive")
         if self.f > self.n:
             raise ValueError(f"f = {self.f} must not exceed n = {self.n}")
+        if self.device_table_plane and self.newt_clock_bump_interval_ms is not None:
+            # real-time clock bumps vote wall-clock micros, which overflow
+            # the plane's 31-bit device-clock window (ops/table_ops.py)
+            raise ValueError(
+                "device_table_plane is incompatible with "
+                "newt_clock_bump_interval_ms (real-time micros clocks "
+                "exceed the 31-bit device window)"
+            )
 
     # --- quorum sizes (protocol facts; fantoch/src/config.rs:252-317) ---
 

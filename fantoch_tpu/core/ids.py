@@ -11,8 +11,7 @@ lightweight frozen dataclasses on the host control plane and as ``int32[2]``
 from __future__ import annotations
 
 import itertools
-from dataclasses import dataclass
-from typing import Iterator, Tuple
+from typing import Iterator, NamedTuple, Tuple
 
 # Process ids are small ints (reference uses u8); shard ids are ints (u64).
 ProcessId = int
@@ -20,13 +19,17 @@ ClientId = int
 ShardId = int
 
 
-@dataclass(frozen=True, order=True)
-class Dot:
+class Dot(NamedTuple):
     """Proposal identifier: (source process, per-source sequence).
 
     Reference: fantoch/src/id.rs:12 (``Dot = Id<ProcessId>``).  Ordering is
     lexicographic (source, sequence), matching the reference's derived Ord —
     this ordering is what makes SCC-internal execution order deterministic.
+
+    A NamedTuple, not a frozen dataclass: dots materialize per command on
+    every executor/protocol hot path and tuple construction is ~3x
+    cheaper than a frozen dataclass's two ``object.__setattr__`` calls;
+    ordering, equality, and hashing are field-lexicographic either way.
     """
 
     source: ProcessId
@@ -52,11 +55,11 @@ class Dot:
         return Dot(packed >> 48, packed & ((1 << 48) - 1))
 
 
-@dataclass(frozen=True, order=True)
-class Rifl:
+class Rifl(NamedTuple):
     """Request identifier: (client id, client-local sequence).
 
     Reference: fantoch/src/id.rs:16 (``Rifl = Id<ClientId>``).
+    NamedTuple for the same hot-path reason as :class:`Dot`.
     """
 
     source: ClientId

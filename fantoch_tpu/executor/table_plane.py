@@ -1,0 +1,208 @@
+"""Device-resident votes-table plane for the Newt/Tempo commit path.
+
+The host twin (executor/table.py) keeps one ``RangeEventSet`` per
+(key, process) and rebuilds + re-uploads the frontier matrix for every
+executor batch — ~68 ms of dispatch round-trip per 71 ms call on the
+remote-dispatch rig (BENCH_TPU_LATEST).  This plane applies the move that
+won the graph executor: the ``(key_bucket x process)`` frontier matrix
+lives ON DEVICE across batches (donated buffers,
+``ops/table_ops.fused_votes_commit``), and each batch is one fused
+dispatch doing vote-range coalescing (segment-max over sorted
+``(key, by)`` runs), frontier update, and stability.
+
+Exactness: a merged vote run that starts beyond a frontier gap cannot
+advance the watermark; the kernel marks it *residual* and this class
+buffers + re-feeds it with every later batch until the gap fills —
+after which the frontier equals what the RangeEventSets would hold
+(oracle-equivalence tested, tests/test_table_plane.py).
+
+Clock width: device clocks are int32.  The plane refuses clocks at or
+above ``2^31 - 1`` with a typed error instead of silently wrapping —
+real-time-micros clock bumps (``Config.newt_clock_bump_interval_ms``)
+are rejected at config time (core/config.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from fantoch_tpu.core.kvs import Key
+from fantoch_tpu.ops.table_ops import next_pow2 as _pow2
+
+_INT32_MAX = (1 << 31) - 1
+
+
+class ClockOverflowError(ValueError):
+    """A clock or vote endpoint exceeds the plane's 31-bit device window."""
+
+
+
+class DeviceTablePlane:
+    """Resident vote-frontier state + fused commit dispatch per batch.
+
+    ``commit_votes`` consumes vote columns (already bucketed) and returns
+    the post-batch stable clock of every registered bucket; the frontier
+    matrix never crosses the host boundary (donated in, donated out).
+    """
+
+    __slots__ = (
+        "n",
+        "threshold",
+        "_key_index",
+        "_keys",
+        "_cap",
+        "_frontier",
+        "_res_key",
+        "_res_by",
+        "_res_start",
+        "_res_end",
+        "dispatches",
+        "grows",
+    )
+
+    def __init__(self, n: int, stability_threshold: int, key_buckets: int = 1024):
+        assert stability_threshold <= n
+        self.n = n
+        self.threshold = stability_threshold
+        self._key_index: Dict[Key, int] = {}
+        self._keys: List[Key] = []
+        self._cap = _pow2(max(key_buckets, 2))
+        self._frontier = None  # lazy: created on first dispatch
+        empty = np.empty(0, dtype=np.int64)
+        self._res_key, self._res_by = empty, empty
+        self._res_start, self._res_end = empty, empty
+        self.dispatches = 0
+        self.grows = 0
+
+    # --- key registry (string keys -> stable device buckets) ---
+
+    def bucket(self, key: Key) -> int:
+        idx = self._key_index.get(key)
+        if idx is None:
+            idx = len(self._keys)
+            self._key_index[key] = idx
+            self._keys.append(key)
+            if idx >= self._cap:
+                self._grow()
+        return idx
+
+    @property
+    def key_count(self) -> int:
+        return len(self._keys)
+
+    def _grow(self) -> None:
+        """Double the bucket capacity; pads the resident frontier (one
+        host round-trip — rare, amortized by the pow2 schedule)."""
+        import jax
+        import jax.numpy as jnp
+
+        new_cap = self._cap * 2
+        if self._frontier is not None:
+            host = np.asarray(jax.device_get(self._frontier))
+            padded = np.zeros((new_cap, self.n), dtype=np.int32)
+            padded[: self._cap] = host
+            # jnp.array copies into an XLA-owned buffer: jnp.asarray
+            # would zero-copy alias ``padded``'s numpy memory on CPU, and
+            # fused_votes_commit donates this buffer (use-after-free)
+            self._frontier = jnp.array(padded)
+        self._cap = new_cap
+        self.grows += 1
+
+    # --- the fused commit dispatch ---
+
+    def commit_votes(
+        self,
+        vkey: np.ndarray,  # int64[V] bucket ids (from ``bucket``)
+        vby: np.ndarray,  # int64[V] process ids, 1-based (protocol ids)
+        vstart: np.ndarray,  # int64[V]
+        vend: np.ndarray,  # int64[V]
+    ) -> np.ndarray:
+        """Apply a batch of vote ranges; returns ``int64[key_count]``
+        stable clocks (post-batch) for every registered bucket.  Residual
+        (beyond-gap) runs are buffered internally and re-fed with the
+        next batch."""
+        import jax
+        import jax.numpy as jnp
+
+        from fantoch_tpu.ops.table_ops import fused_votes_commit
+
+        if len(vend) and int(np.max(vend)) >= _INT32_MAX:
+            raise ClockOverflowError(
+                "vote endpoint >= 2^31 - 1: the device table plane is "
+                "31-bit windowed (disable device_table_plane for "
+                "real-time-micros clocks)"
+            )
+        # prepend buffered residuals so gap-filling batches coalesce with
+        # the runs they unblock
+        vkey = np.concatenate([self._res_key, vkey])
+        vby = np.concatenate([self._res_by, vby])
+        vstart = np.concatenate([self._res_start, vstart])
+        vend = np.concatenate([self._res_end, vend])
+        V = len(vkey)
+
+        if self._frontier is None:
+            self._frontier = jax.device_put(
+                jnp.zeros((self._cap, self.n), dtype=jnp.int32)
+            )
+        if V == 0:
+            # nothing to apply: stability unchanged — read it off the
+            # resident state with the plain (non-donating) kernel
+            from fantoch_tpu.ops.table_ops import stable_clocks
+
+            stable = stable_clocks(self._frontier, threshold=self.threshold)
+            return np.asarray(jax.device_get(stable)).astype(np.int64)[
+                : self.key_count
+            ]
+
+        # pad the vote columns to pow2 so XLA compiles O(log) programs
+        vcap = _pow2(V)
+        pk = np.zeros(vcap, dtype=np.int32)
+        pb = np.zeros(vcap, dtype=np.int32)
+        ps = np.zeros(vcap, dtype=np.int32)
+        pe = np.zeros(vcap, dtype=np.int32)
+        pk[:V] = vkey
+        pb[:V] = vby - 1  # protocol process ids are 1-based; columns 0-based
+        ps[:V] = vstart
+        pe[:V] = vend
+        pvalid = np.zeros(vcap, dtype=bool)
+        pvalid[:V] = True
+
+        out = fused_votes_commit(
+            self._frontier,
+            jnp.asarray(pk),
+            jnp.asarray(pb),
+            jnp.asarray(ps),
+            jnp.asarray(pe),
+            jnp.asarray(pvalid),
+            threshold=self.threshold,
+        )
+        self._frontier = out[0]
+        # one blocking transfer for stability + the residual run columns
+        stable, run_key, run_by, run_start, run_end, residual = jax.device_get(
+            out[1:]
+        )
+        self.dispatches += 1
+        res = np.flatnonzero(residual)
+        self._res_key = run_key[res].astype(np.int64)
+        self._res_by = (run_by[res] + 1).astype(np.int64)  # back to 1-based
+        self._res_start = run_start[res].astype(np.int64)
+        self._res_end = run_end[res].astype(np.int64)
+        return stable.astype(np.int64)[: self.key_count]
+
+    # --- introspection (tests / debugging) ---
+
+    def frontiers(self) -> np.ndarray:
+        """Host copy of the live ``int64[key_count, n]`` frontier matrix
+        (a device round-trip; for tests and debugging only)."""
+        import jax
+
+        if self._frontier is None:
+            return np.zeros((self.key_count, self.n), dtype=np.int64)
+        host = np.asarray(jax.device_get(self._frontier)).astype(np.int64)
+        return host[: self.key_count]
+
+    @property
+    def residual_count(self) -> int:
+        return len(self._res_key)

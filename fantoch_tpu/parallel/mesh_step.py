@@ -630,12 +630,15 @@ def init_newt_state(
 def _segmented_proposal(prior_of_row, key_full, work):
     """Per-replica batched clock proposal over the working set: same-key
     rows receive consecutive clocks continuing from the replica's prior —
-    the tensorized ``SequentialKeyClocks::proposal`` over one round, the
-    same segmented max-scan as ops/table_ops.batched_clock_proposal.
+    the tensorized ``SequentialKeyClocks::proposal`` over one round,
+    built on the same segmented max-scan core as the device votes-table
+    plane (ops/table_ops.segmented_running_max).
 
     ``prior_of_row``: int32[r_blk, W] — the proposing replica's current
     clock for each row's key.  Returns proposals of the same shape.
     """
+    from fantoch_tpu.ops.table_ops import segmented_running_max
+
     widx = jnp.arange(work, dtype=jnp.int32)
     perm = jnp.argsort(key_full, stable=True).astype(jnp.int32)
     k_sorted = key_full[perm]
@@ -648,17 +651,8 @@ def _segmented_proposal(prior_of_row, key_full, work):
     )
     rank = widx - group_first
 
-    def seg_max(a, b):
-        a_seg, a_val = a
-        b_seg, b_val = b
-        return b_seg, jnp.where(a_seg == b_seg, jnp.maximum(a_val, b_val), b_val)
-
     base = prior_of_row[:, perm] + 1  # [r_blk, W] in sorted order
-    _, running = jax.lax.associative_scan(
-        seg_max,
-        (jnp.broadcast_to(seg_id, base.shape), base - rank),
-        axis=-1,
-    )
+    running = segmented_running_max(seg_id, base - rank, axis=-1)
     clock_sorted = rank + running
     return jnp.zeros_like(base).at[:, perm].set(clock_sorted)
 
@@ -996,6 +990,66 @@ def jit_newt_step(
     return jax.jit(
         functools.partial(
             newt_protocol_step,
+            mesh=mesh,
+            f=f,
+            tiny_quorums=tiny_quorums,
+            live_replicas=live_replicas,
+            shard_count=shard_count,
+        ),
+        donate_argnums=(0,),
+    )
+
+
+def newt_protocol_multi_step(
+    state: NewtMeshState,
+    keys: jax.Array,  # int32[S, B] or int32[S, B, KW] — S chained rounds
+    dot_srcs: jax.Array,  # int32[S, B]
+    dot_seqs: jax.Array,  # int32[S, B]
+    *,
+    mesh: Mesh,
+    f: int = 1,
+    tiny_quorums: bool = False,
+    live_replicas: int | None = None,
+    shard_count: int = 1,
+) -> Tuple[NewtMeshState, NewtStepOutput]:
+    """S chained Newt rounds in ONE dispatch via ``lax.scan`` — the
+    votes-table plane's in-dispatch chaining (ops/table_ops.
+    fused_table_rounds) applied to the mesh serving family: replica
+    state threads round-to-round on device and the host pays one
+    dispatch round-trip for the whole chain, which is what drops
+    ``serving_newt_round_ms`` on dispatch-dominated rigs.
+
+    Outputs are the per-round :class:`NewtStepOutput` arrays stacked on a
+    leading ``S`` axis; the caller drains all S rounds afterwards (the
+    dispatch/drain pipelining contract of ``work_src``/``work_seq``).
+    """
+
+    def body(carry, xs):
+        key, src, seq = xs
+        new_state, out = newt_protocol_step(
+            carry, key, src, seq,
+            mesh=mesh, f=f, tiny_quorums=tiny_quorums,
+            live_replicas=live_replicas, shard_count=shard_count,
+        )
+        return new_state, out
+
+    return jax.lax.scan(body, state, (keys, dot_srcs, dot_seqs))
+
+
+def jit_newt_multi_step(
+    mesh: Mesh,
+    f: int = 1,
+    tiny_quorums: bool = False,
+    live_replicas: int | None = None,
+    shard_count: int = 1,
+):
+    """jit-compiled multi-round Newt chain with donated state (one
+    compile per S shape; S rides the input's leading axis)."""
+    import functools
+
+    return jax.jit(
+        functools.partial(
+            newt_protocol_multi_step,
             mesh=mesh,
             f=f,
             tiny_quorums=tiny_quorums,

@@ -30,15 +30,34 @@ import numpy as np
 from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.ids import ProcessId, ShardId
 from fantoch_tpu.core.kvs import Key
+from fantoch_tpu.ops.table_ops import next_pow2 as _pow2
 from fantoch_tpu.protocol.common.table_clocks import VoteRange, Votes
 
 _INT32_MAX = (1 << 31) - 1
 
 
 class BatchedKeyClocks:
-    """SequentialKeyClocks semantics over a dense clock array."""
+    """SequentialKeyClocks semantics over a dense clock array.
 
-    __slots__ = ("process_id", "shard_id", "_key_index", "_keys", "_clocks", "_count")
+    The batched proposal path keeps the clock table DEVICE-RESIDENT
+    across batches (``ops/table_ops.resident_clock_proposal`` with a
+    donated prior): successive ``proposal_batch_arrays`` calls never
+    re-upload or re-download the table.  The host ``_clocks`` mirror goes
+    stale while the device copy leads; any scalar-path access
+    (``proposal``/``detached``/``detached_all``) re-materializes the host
+    view and drops the device table (rebuilt lazily on the next batch).
+    Live Newt interleaves scalar detached-bumps between submit batches,
+    so THERE the proposal path degrades to upload-per-batch (the pre-
+    resident behavior, never worse); uninterrupted residency is the
+    executor-plane / fused-chain / device-serving regime.  Holding it
+    across scalar bumps would need a device-side bump kernel that
+    returns the generated vote ranges (see BENCH_DEV round 6).
+    """
+
+    __slots__ = (
+        "process_id", "shard_id", "_key_index", "_keys", "_clocks", "_count",
+        "_dev_prior", "_dev_kcap", "_host_stale", "_host_max",
+    )
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId):
         self.process_id = process_id
@@ -47,6 +66,58 @@ class BatchedKeyClocks:
         self._keys: List[Key] = []
         self._clocks = np.zeros(64, dtype=np.int64)
         self._count = 0
+        self._dev_prior = None  # resident int32[kcap] clock table
+        self._dev_kcap = 0
+        self._host_stale = False
+        # upper bound on any clock in the table (host or device): the
+        # window guard must not read the device table, so the bound is
+        # maintained incrementally and tightened at materialize time
+        self._host_max = 0
+
+    def _materialize_host(self) -> None:
+        """Sync the host mirror from the resident device table and drop
+        the device copy (the caller is about to read or mutate host
+        state).  Buckets registered after the last batch hold 0 on both
+        sides; the device table's last slot is the pad bucket and is
+        never copied."""
+        if self._dev_prior is not None:
+            if self._host_stale:
+                import jax
+
+                dev = np.asarray(jax.device_get(self._dev_prior)).astype(np.int64)
+                # never copy the device table's LAST slot: it is the pad
+                # bucket, whose clock accumulates garbage from pad rows.
+                # A real key at that index can only have registered after
+                # the last dispatch (dispatch guarantees real indices
+                # <= len(dev) - 2), so its live clock is the host's 0
+                take = min(self._count, len(dev) - 1)
+                self._clocks[:take] = dev[:take]
+                self._host_stale = False
+                # tighten the incrementally-grown window bound to the
+                # actual table max (pad-bucket garbage is dropped here)
+                if self._count:
+                    self._host_max = int(self._clocks[: self._count].max())
+                else:
+                    self._host_max = 0
+            self._dev_prior = None
+            self._dev_kcap = 0
+
+    def __getstate__(self):
+        # device buffers don't pickle (sim snapshots / the model checker):
+        # materialize the host view and ship that
+        self._materialize_host()
+        return {
+            s: getattr(self, s)
+            for s in self.__slots__
+            if s not in ("_dev_prior",)
+        }
+
+    def __setstate__(self, state):
+        for k, v in state.items():
+            setattr(self, k, v)
+        self._dev_prior = None
+        self._dev_kcap = 0
+        self._host_stale = False
 
     # --- registry ---
 
@@ -70,18 +141,22 @@ class BatchedKeyClocks:
     # --- scalar SequentialKeyClocks interface ---
 
     def proposal(self, cmd: Command, min_clock: int) -> Tuple[int, Votes]:
+        self._materialize_host()
         clock = max(min_clock, self._cmd_clock(cmd) + 1)
         votes = Votes()
         self.detached(cmd, clock, votes)
         return clock, votes
 
     def detached(self, cmd: Command, up_to: int, votes: Votes) -> None:
+        self._materialize_host()
         for key in cmd.keys(self.shard_id):
             self._maybe_bump(key, up_to, votes)
 
     def detached_all(self, up_to: int, votes: Votes) -> None:
         # vectorized sweep over every registered key (the clock-bump event
         # touches the whole table, newt.rs:983-1006)
+        self._materialize_host()
+        self._host_max = max(self._host_max, up_to)
         count = self._count
         current = self._clocks[:count]
         behind = np.nonzero(current < up_to)[0]
@@ -108,6 +183,8 @@ class BatchedKeyClocks:
         if current < up_to:
             votes.add(key, VoteRange(self.process_id, current + 1, up_to))
             self._clocks[idx] = up_to
+            if up_to > self._host_max:
+                self._host_max = up_to
 
     # --- the batched proposal seam ---
 
@@ -149,41 +226,77 @@ class BatchedKeyClocks:
         (real-time micros; callers fall back to the sequential loop).
         Semantics: identical to running ``proposal`` sequentially —
         same-key commands get consecutive clocks in batch order
-        (fantoch_ps/src/protocol/common/table/votes.rs:133 ranges)."""
+        (fantoch_ps/src/protocol/common/table/votes.rs:133 ranges).
+
+        Residency: the clock table stays ON DEVICE between calls
+        (``resident_clock_proposal`` donates it back to itself); only the
+        per-row clock/vote_start columns cross the host boundary.  The
+        table is rebuilt from the host mirror when the key registry
+        outgrows the device capacity (pow2 schedule) or after a scalar
+        access dropped the device copy."""
         import jax
         import jax.numpy as jnp
 
-        from fantoch_tpu.ops.table_ops import batched_clock_proposal
+        from fantoch_tpu.ops.table_ops import resident_clock_proposal
 
         batch = len(keys)
-        key_idx = np.fromiter(
-            (self._index(k) for k in keys), np.int32, batch
-        )
+        ki = self._key_index
+        try:
+            idx_list = [ki[k] for k in keys]
+        except KeyError:
+            for k in keys:
+                self._index(k)
+            idx_list = [ki[k] for k in keys]
         mins = np.asarray(min_clocks, dtype=np.int64)
         # pad the key table to pow2 so XLA compiles O(log) programs as the
         # registry grows; pad the batch with private pad-bucket rows
         kcap = _pow2(max(self._count, 1) + 1)
         bcap = _pow2(batch)
-        prior = np.zeros(kcap, dtype=np.int64)
-        prior[: self._count] = self._clocks[: self._count]
-        hi = max(int(prior.max()), int(mins.max()) if batch else 0)
+        # 31-bit window guard without reading the device table: no bucket
+        # (pad included) can exceed max(previous bound, batch mins) plus
+        # the padded batch size, so the bound threads through batches
+        hi = max(self._host_max, int(mins.max()) if batch else 0)
         if hi + bcap + 1 > _INT32_MAX:
-            return None  # real-time micros clocks: sequential fallback
-        pk = np.full(bcap, kcap - 1, dtype=np.int32)  # pad bucket
+            # the incrementally-grown bound includes pad-bucket drift
+            # (+bcap per resident batch): materializing tightens it to
+            # the true table max, so only genuine real-time-micros
+            # clocks still overflow and pay the sequential fallback
+            self._materialize_host()
+            hi = max(self._host_max, int(mins.max()) if batch else 0)
+            if hi + bcap + 1 > _INT32_MAX:
+                return None
+        if self._dev_prior is None or self._dev_kcap < kcap:
+            # first batch, or the registry outgrew the device capacity:
+            # (re)build the resident table from the host mirror
+            self._materialize_host()
+            prior = np.zeros(kcap, dtype=np.int32)
+            prior[: self._count] = self._clocks[: self._count]
+            # jnp.array COPIES into an XLA-owned buffer.  device_put /
+            # jnp.asarray of a numpy array zero-copy ALIASES its host
+            # memory on the CPU backend, and resident_clock_proposal
+            # donates this buffer — donating the alias hands numpy-owned
+            # memory to XLA (use-after-free, segfaults under the
+            # persistent compile cache)
+            self._dev_prior = jnp.array(prior)
+            self._dev_kcap = kcap
+        pk = np.full(bcap, self._dev_kcap - 1, dtype=np.int32)  # pad bucket
         pm = np.zeros(bcap, dtype=np.int32)
-        pk[:batch] = key_idx
+        pk[:batch] = idx_list
         pm[:batch] = mins.astype(np.int32)
-        out = batched_clock_proposal(
-            jnp.asarray(prior.astype(np.int32)), jnp.asarray(pk), jnp.asarray(pm)
+        clock_d, start_d, new_prior = resident_clock_proposal(
+            self._dev_prior, jnp.asarray(pk), jnp.asarray(pm)
         )
-        # one blocking transfer for all three outputs (per-array np.asarray
-        # would pay a device round trip each on a remote-dispatch rig)
-        clock, vote_start, new_prior = jax.device_get(out)
-        clock = clock[:batch].astype(np.int64)
-        vote_start = vote_start[:batch].astype(np.int64)
-        new_prior = new_prior.astype(np.int64)
-        self._clocks[: self._count] = new_prior[: self._count]
-        return clock, vote_start
+        self._dev_prior = new_prior  # stays resident; donated next call
+        self._host_stale = True
+        self._host_max = hi + bcap
+        # one blocking transfer for the two row outputs (per-array
+        # np.asarray would pay a device round trip each on a
+        # remote-dispatch rig); the clock table never crosses
+        clock, vote_start = jax.device_get((clock_d, start_d))
+        return (
+            clock[:batch].astype(np.int64),
+            vote_start[:batch].astype(np.int64),
+        )
 
     def _proposal_batch_kernel(
         self, keys: List[Key], min_clocks: List[int]
@@ -202,9 +315,3 @@ class BatchedKeyClocks:
             out.append((int(clock[i]), votes))
         return out
 
-
-def _pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p

@@ -41,7 +41,12 @@ from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
 from fantoch_tpu.core.timing import SysTime
-from fantoch_tpu.executor.table import TableDetachedVotes, TableExecutor, TableVotes
+from fantoch_tpu.executor.table import (
+    TableDetachedVotes,
+    TableExecutor,
+    TableVotes,
+    TableVotesArraysBuilder,
+)
 from fantoch_tpu.protocol.base import (
     Action,
     BaseProcess,
@@ -244,6 +249,15 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
         self._gc_track = GCTrack(process_id, shard_id, config.n)
         self._to_processes: Deque[Action] = deque()
         self._to_executors: Deque[Any] = deque()
+        # batched commit seam: committed rows and detached votes accumulate
+        # as columns and drain as ONE TableVotesArrays per to_executors
+        # sweep — no per-command TableVotes objects on the batched path.
+        # Requires all of a process's table infos to reach one executor
+        # (the runner disables it via set_commit_arrays when the executor
+        # pool routes per key)
+        self._commit_arrays: Optional[TableVotesArraysBuilder] = (
+            TableVotesArraysBuilder() if config.batched_table_executor else None
+        )
         # accumulated detached votes, flushed by SendDetachedEvent
         self._detached = Votes()
         # MBump clocks that arrived before the MCollect (newt.rs:45,699-708).
@@ -361,7 +375,22 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
         return self._to_processes.popleft() if self._to_processes else None
 
     def to_executors(self):
+        if self._commit_arrays is not None and len(self._commit_arrays):
+            return self._commit_arrays.take()
         return self._to_executors.popleft() if self._to_executors else None
+
+    def set_commit_arrays(self, enabled: bool) -> None:
+        """Runner hook: the arrays commit seam assumes a single table
+        executor consumes this process's infos; per-key executor pools
+        must turn it off (falls back to per-command TableVotes)."""
+        if enabled and self._commit_arrays is None:
+            self._commit_arrays = TableVotesArraysBuilder()
+        elif not enabled and self._commit_arrays is not None:
+            # flush anything accumulated so no commit is lost
+            pending = self._commit_arrays.take()
+            if pending is not None:
+                self._to_executors.append(pending)
+            self._commit_arrays = None
 
     @classmethod
     def parallel(cls) -> bool:
@@ -600,8 +629,14 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             # held votes after a recovered commit: the ops are already in
             # our table, so the ranges can join it directly
             if not votes.is_empty():
-                for key, key_votes in votes:
-                    self._to_executors.append(TableDetachedVotes(key, key_votes))
+                if self._commit_arrays is not None:
+                    for key, key_votes in votes:
+                        self._commit_arrays.add_detached(key, key_votes)
+                else:
+                    for key, key_votes in votes:
+                        self._to_executors.append(
+                            TableDetachedVotes(key, key_votes)
+                        )
             return
         if clock == 0:
             # recovered noop (the dot never got a clock proposal anywhere
@@ -653,11 +688,18 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
                     )
                 )
             votes.merge(held)
-        for key, ops in cmd.iter_ops(self.bp.shard_id):
-            key_votes = votes.remove(key)
-            self._to_executors.append(
-                TableVotes(dot, clock, cmd.rifl, key, ops, key_votes)
-            )
+        if self._commit_arrays is not None:
+            # batched commit seam: rows go out as columns, not objects
+            for key, ops in cmd.iter_ops(self.bp.shard_id):
+                self._commit_arrays.add_row(
+                    dot, clock, cmd.rifl, key, ops, votes.remove(key)
+                )
+        else:
+            for key, ops in cmd.iter_ops(self.bp.shard_id):
+                key_votes = votes.remove(key)
+                self._to_executors.append(
+                    TableVotes(dot, clock, cmd.rifl, key, ops, key_votes)
+                )
 
         info.status = Status.COMMIT
         # a bump buffered between our commit and its own delivery is moot
@@ -681,6 +723,10 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             self._cmds.gc_single(dot)
 
     def _handle_mdetached(self, detached: Votes) -> None:
+        if self._commit_arrays is not None:
+            for key, key_votes in detached:
+                self._commit_arrays.add_detached(key, key_votes)
+            return
         for key, key_votes in detached:
             self._to_executors.append(TableDetachedVotes(key, key_votes))
 

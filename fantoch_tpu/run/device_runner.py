@@ -300,21 +300,27 @@ class _DriverCore:
         key = np.full((b, self.key_width), KEY_PAD, dtype=np.int32)
         src = np.zeros(b, dtype=np.int32)
         seq = np.zeros(b, dtype=np.int32)
-        for i, (dot, cmd) in enumerate(batch):
-            buckets = _bucket_row(
-                cmd, self.shard_id, self.key_buckets, self.key_width,
-                self.shard_count, cache=self._bucket_cache,
-            )
-            key[i, : len(buckets)] = buckets
-            src[i] = dot.source
-            seq[i] = self._device_seq(dot)
-            self._cmds[self._packed(dot.source, seq[i])] = (dot, cmd)
+        self._assemble_rows(batch, key, src, seq)
 
         self._state, out = self._step(
             self._state, jnp.asarray(key), jnp.asarray(src), jnp.asarray(seq)
         )
         self.rounds += 1
         return out
+
+    def _assemble_rows(self, batch, key_rows, src_row, seq_row) -> None:
+        """Fill one round's fixed-size key/src/seq columns in place and
+        register each command under its packed (source, window sequence)
+        — the caller guarantees the sequence window already fits."""
+        for i, (dot, cmd) in enumerate(batch):
+            buckets = _bucket_row(
+                cmd, self.shard_id, self.key_buckets, self.key_width,
+                self.shard_count, cache=self._bucket_cache,
+            )
+            key_rows[i, : len(buckets)] = buckets
+            src_row[i] = dot.source
+            seq_row[i] = self._device_seq(dot)
+            self._cmds[self._packed(dot.source, seq_row[i])] = (dot, cmd)
 
     def _execute_ordered(
         self, order, executed, work_src, work_seq
@@ -433,9 +439,14 @@ class _DriverCore:
         self._rekey_registry_for_window()
         st = self._state
         pend_seq = np.asarray(st.pend_seq, dtype=np.int64) - shift
+        # rebuilt state fields use jnp.array (an XLA-owned COPY), never
+        # jnp.asarray: asarray zero-copy aliases the numpy buffer on the
+        # CPU backend, and the step functions donate this state — donating
+        # an alias hands numpy-owned memory to XLA (use-after-free).
+        # Same rule at every _replace() rebase below.
         self._state = st._replace(
             pend_seq=jax.device_put(
-                jnp.asarray(pend_seq.astype(np.int32)), st.pend_seq.sharding
+                jnp.array(pend_seq.astype(np.int32)), st.pend_seq.sharding
             )
         )
 
@@ -580,17 +591,17 @@ class DeviceDriver(_DriverCore):
         self._frontier_base += fmin
         self._state = st._replace(
             key_clock=jax.device_put(
-                jnp.asarray(key_clock.astype(np.int32)), st.key_clock.sharding
+                jnp.array(key_clock.astype(np.int32)), st.key_clock.sharding
             ),
             frontier=jax.device_put(
-                jnp.asarray((frontier - fmin).astype(np.int32)),
+                jnp.array((frontier - fmin).astype(np.int32)),
                 st.frontier.sharding,
             ),
             next_gid=jax.device_put(
                 jnp.int32(self._next_gid - delta), st.next_gid.sharding
             ),
             pend_gid=jax.device_put(
-                jnp.asarray(pend_gid.astype(np.int32)), st.pend_gid.sharding
+                jnp.array(pend_gid.astype(np.int32)), st.pend_gid.sharding
             ),
         )
         self._next_gid -= delta
@@ -613,7 +624,7 @@ class DeviceDriver(_DriverCore):
         pend_seq = np.where(pend_gid >= 0, pend_seq, -1)
         self._state = st._replace(
             pend_seq=jax.device_put(
-                jnp.asarray(pend_seq.astype(np.int32)), st.pend_seq.sharding
+                jnp.array(pend_seq.astype(np.int32)), st.pend_seq.sharding
             )
         )
 
@@ -774,6 +785,13 @@ class NewtDeviceDriver(_DriverCore):
             self._mesh, f=f, tiny_quorums=tiny_quorums,
             live_replicas=live_replicas, shard_count=shard_count,
         )
+        # chained multi-round programs (step_chained), compiled per chain
+        # length on first use
+        self._step_kwargs = dict(
+            f=f, tiny_quorums=tiny_quorums,
+            live_replicas=live_replicas, shard_count=shard_count,
+        )
+        self._multi_step: Dict[int, object] = {}
         # no host identity mirror: the step outputs carry the working
         # rows' (src, seq) columns (NewtStepOutput.work_src/work_seq)
         self._pend_cap = pending_capacity
@@ -807,7 +825,7 @@ class NewtDeviceDriver(_DriverCore):
             key_clock=shift_table(st.key_clock, floor),
             vote_frontier=shift_table(st.vote_frontier, floor),
             pend_clock=jax.device_put(
-                jnp.asarray(pend_clock.astype(np.int32)),
+                jnp.array(pend_clock.astype(np.int32)),
                 st.pend_clock.sharding,
             ),
         )
@@ -834,6 +852,71 @@ class NewtDeviceDriver(_DriverCore):
         """Assemble + dispatch one Newt round (async); returns the round
         token for ``drain``."""
         return self._dispatch_dot_keyed(batch)
+
+    def step_chained(
+        self, batches: List[List[Tuple[Dot, Command]]]
+    ) -> List[ExecutorResult]:
+        """S rounds in ONE device dispatch
+        (parallel/mesh_step.jit_newt_multi_step): the host assembles all
+        S rounds' key/src/seq columns up front, the replica state threads
+        round-to-round on device via ``lax.scan``, and the chain pays a
+        single dispatch round-trip — on dispatch-dominated rigs (remote
+        tunnels: ~68 ms of a 71 ms round) per-round cost drops toward
+        kernel time, the serving twin of the votes-table plane's
+        ``fused_table_rounds``.
+
+        A mid-chain clock-window rebase cannot happen inside one
+        dispatch, so chains that could cross the reset threshold fall
+        back to per-round steps (which rebase in drain as usual)."""
+        import jax
+        import jax.numpy as jnp
+
+        from fantoch_tpu.parallel import mesh_step
+        from fantoch_tpu.parallel.mesh_step import KEY_PAD, NewtStepOutput
+
+        results = self.flush_pipeline()
+        S = len(batches)
+        if S == 0:
+            return results
+        work = self._pend_cap + self.batch_size
+        top = max(
+            (d.sequence for batch in batches for d, _ in batch), default=0
+        ) - self._seq_base
+        if (
+            self._max_clock + S * work >= self.CLOCK_RESET_THRESHOLD
+            or top >= self.SEQ_WINDOW_MAX
+        ):
+            # a window rebase (clock or dot-sequence) would have to land
+            # mid-chain — take the per-round path, which rebases in drain
+            for batch in batches:
+                results.extend(self.step(batch))
+            return results
+        b = self.batch_size
+        keys = np.full((S, b, self.key_width), KEY_PAD, dtype=np.int32)
+        srcs = np.zeros((S, b), dtype=np.int32)
+        seqs = np.zeros((S, b), dtype=np.int32)
+        for r, batch in enumerate(batches):
+            assert len(batch) <= b
+            self._assemble_rows(batch, keys[r], srcs[r], seqs[r])
+        multi = self._multi_step.get(S)
+        if multi is None:
+            multi = mesh_step.jit_newt_multi_step(
+                self._mesh, **self._step_kwargs
+            )
+            self._multi_step[S] = multi
+        self._state, outs = multi(
+            self._state, jnp.asarray(keys), jnp.asarray(srcs),
+            jnp.asarray(seqs),
+        )
+        self.rounds += S
+        # ONE device->host round trip for the whole chain, then the
+        # per-round host drains run over sliced numpy views
+        outs = jax.device_get(outs)
+        for r in range(S):
+            results.extend(
+                self.drain(NewtStepOutput(*(np.asarray(a)[r] for a in outs)))
+            )
+        return results
 
     def drain(self, out) -> List[ExecutorResult]:
         """Fetch one round's outputs, advance watermark/clock-window
@@ -1057,7 +1140,7 @@ class PaxosDeviceDriver(_DriverCore):
                 jnp.int32(0), st.exec_frontier.sharding
             ),
             pend_slot=jax.device_put(
-                jnp.asarray(pend_slot.astype(np.int32)), st.pend_slot.sharding
+                jnp.array(pend_slot.astype(np.int32)), st.pend_slot.sharding
             ),
         )
         self._next_slot -= delta

@@ -244,6 +244,13 @@ class ProcessRuntime:
             )
         self.workers = ToPool("workers", workers)
         self.executor_pool = ToPool("executors", executors)
+        if executors > 1:
+            # batched array commit seams (Newt's TableVotesArrays) span
+            # keys, but a multi-executor pool routes infos per key — fall
+            # back to per-command infos so key ownership stays intact
+            set_commit_arrays = getattr(self.process, "set_commit_arrays", None)
+            if set_commit_arrays is not None:
+                set_commit_arrays(False)
         self.executors = [
             protocol_cls.Executor(process_id, shard_id, config) for _ in range(executors)
         ]
