@@ -1,7 +1,7 @@
 # Test/bench entry points (the reference pins quality with Makefile:3-7 —
 # fmt + clippy + `cargo test` under a quickcheck budget; here the suite +
 # dryrun + bench are the equivalent gates).
-.PHONY: test test-fast test-chaos test-recovery dryrun bench bench-smoke
+.PHONY: test test-fast test-chaos test-recovery dryrun bench bench-smoke trace-smoke
 
 test:
 	python -m pytest tests/ -x -q
@@ -33,3 +33,9 @@ bench:
 # without a chip — the per-push CI slice runs this
 bench-smoke:
 	python bench.py --smoke
+
+# observability gate: tiny traced sim, byte-identical same-seed span
+# logs, Perfetto conversion + stage-latency report all validate — the
+# per-push CI slice runs this next to bench-smoke
+trace-smoke:
+	python scripts/trace_smoke.py

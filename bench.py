@@ -145,11 +145,16 @@ def child_main(mode: str) -> None:
 
     enable_compile_cache(jax)
 
+    from fantoch_tpu.observability.device import (
+        recompile_count,
+        subscribe_recompiles,
+    )
     from fantoch_tpu.ops.graph_resolve import (
         _residual_size_for,
         resolve_functional_keyed,
     )
 
+    subscribe_recompiles()
     platform = jax.devices()[0].platform
 
     key_np, dep_np, src_np, seq_np = build_workload(BATCH, CONFLICT)
@@ -224,6 +229,10 @@ def child_main(mode: str) -> None:
         "single_call_ms_p50": round(lo_p50, 3),
         "dispatch_overhead_ms": round(lo_p50 - p50, 3),
         "residual_size": residual,
+        # XLA backend compiles observed during the resolve warmup+timing
+        # (observability plane): >0 with a warm persistent cache means a
+        # shape/program change paid compile time inside this row
+        "graph_resolve_recompiles": recompile_count(),
     }
     # print the primary measurement NOW: if a secondary measurement hangs
     # past the parent's timeout, the parent still recovers this line from
@@ -733,6 +742,8 @@ def bench_table_path(
     ones = np.ones(batch, dtype=np.int64)
     ops_col = [(KVOp.put(""),)] * batch
 
+    plane_counters = {}
+
     def steady_rounds(plane: bool):
         config = Config(n, 1, newt_detached_send_interval_ms=5,
                         batched_table_executor=True,
@@ -760,6 +771,11 @@ def bench_table_path(
             times.append((time.perf_counter() - t0) * 1000.0)
             drained = sum(1 for _ in ex.to_clients_iter())
             assert drained == batch, f"steady round drained {drained}/{batch}"
+        if plane:
+            # per-dispatch device counters (observability plane): BENCH
+            # rows carry them so a kernel-side regression is explainable
+            # from the record alone
+            plane_counters.update(ex.device_counters() or {})
         return float(np.median(times[1:]))
 
     resident_ms = steady_rounds(plane=False)
@@ -800,6 +816,19 @@ def bench_table_path(
         "table_round_ms_resident": round(resident_ms, 1),
         "table_plane_round_ms": round(plane_ms, 1),
         "table_cmds_per_s_plane": int(batch / (plane_ms / 1000.0)),
+        # device-plane dispatch counters for the plane steady-state row
+        # (observability plane): occupancy = vote_rows / row_capacity —
+        # padding waste; residual_runs explain gap-feed overhead
+        "table_plane_dispatches": plane_counters.get("table_plane_dispatches", 0),
+        "table_plane_occupancy": round(
+            plane_counters.get("table_plane_vote_rows", 0)
+            / max(1, plane_counters.get("table_plane_row_capacity", 1)),
+            3,
+        ),
+        "table_plane_residual_runs": plane_counters.get(
+            "table_plane_residual_runs", 0
+        ),
+        "table_plane_kernel_ms": plane_counters.get("table_plane_kernel_ms", 0.0),
         **fused,
     }
 
@@ -1200,6 +1229,12 @@ def smoke_main() -> None:
 
     force_cpu_platform()
     enable_compile_cache()
+    from fantoch_tpu.observability.device import (
+        recompile_count,
+        subscribe_recompiles,
+    )
+
+    subscribe_recompiles()
     out = {"metric": "bench_smoke", "platform": "cpu"}
     out.update(bench_table_path(batch=2000, keys=256, n=3, rounds=2))
     out.update(
@@ -1207,9 +1242,11 @@ def smoke_main() -> None:
             total=1024, batch=256, families=("newt",), sweep=False
         )
     )
+    out["jax_recompiles"] = recompile_count()
     assert out["table_cmds_per_s_arrays"] > 1_000, out
     assert out["table_cmds_per_s_plane"] > 500, out
     assert out["serving_newt_cmds_per_s"] > 100, out
+    assert out["table_plane_dispatches"] > 0, out
     print(json.dumps(out))
 
 

@@ -1,0 +1,116 @@
+"""Trace tooling CLI: summarize / convert / diff span logs.
+
+The lifecycle tracing plane (fantoch_tpu/observability) writes JSONL
+span logs; this CLI turns them into answers:
+
+    # per-stage latency breakdown (p50/p95/p99 per segment, end-to-end)
+    python -m fantoch_tpu.bin.obs summarize trace.jsonl [more.jsonl ...]
+
+    # Chrome/Perfetto trace-event JSON (load at ui.perfetto.dev)
+    python -m fantoch_tpu.bin.obs to-perfetto trace.jsonl -o trace.json
+
+    # structural diff of two traces (same-seed sim runs must be empty)
+    python -m fantoch_tpu.bin.obs diff a.jsonl b.jsonl
+
+``summarize`` accepts several logs at once (a localhost cluster writes
+one per process plus the client plane) and assembles spans across them.
+No reference counterpart: fantoch's metrics_logger/tracer only ship
+aggregates; this is the per-command attribution layer on top.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def _load(paths: List[str]) -> List[Dict[str, Any]]:
+    from fantoch_tpu.observability.tracer import read_trace
+
+    events: List[Dict[str, Any]] = []
+    for path in paths:
+        events.extend(read_trace(path))
+    return events
+
+
+def cmd_summarize(args) -> int:
+    from fantoch_tpu.observability.report import summarize
+
+    out = summarize(_load(args.trace))
+    if args.json:
+        print(json.dumps(out, sort_keys=True))
+        return 0
+    print(f"spans: {out['spans']}  events: {out['events']}")
+    coverage = ", ".join(
+        f"{stage}={count}" for stage, count in out["stage_coverage"].items()
+    )
+    print(f"stage coverage: {coverage}")
+    if out["monotonic_violations"]:
+        print(f"MONOTONIC VIOLATIONS: {out['monotonic_violations']}")
+    print(f"{'segment':<22}{'count':>8}{'mean':>10}{'p50':>10}{'p95':>10}{'p99':>10}")
+    rows = dict(out.get("segments", {}))
+    if "end_to_end" in out:
+        rows["end_to_end"] = out["end_to_end"]
+    for name, row in rows.items():
+        print(
+            f"{name:<22}{row['count']:>8}"
+            f"{row['mean_us'] / 1000:>9.2f}m"
+            f"{row['p50_us'] / 1000:>9.2f}m"
+            f"{row['p95_us'] / 1000:>9.2f}m"
+            f"{row['p99_us'] / 1000:>9.2f}m"
+        )
+    for name, value in sorted(out.get("device_counters", {}).items()):
+        print(f"counter {name} = {value}")
+    return 0
+
+
+def cmd_to_perfetto(args) -> int:
+    from fantoch_tpu.observability.perfetto import write_perfetto
+
+    count = write_perfetto(_load(args.trace), args.output)
+    print(f"wrote {count} trace events to {args.output}")
+    return 0
+
+
+def cmd_diff(args) -> int:
+    from fantoch_tpu.observability.report import diff_events
+    from fantoch_tpu.observability.tracer import read_trace
+
+    mismatches = diff_events(read_trace(args.a), read_trace(args.b))
+    for line in mismatches:
+        print(line)
+    if not mismatches:
+        print("traces identical")
+        return 0
+    return 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="obs", description="dot-lifecycle trace tooling"
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("summarize", help="per-stage latency breakdown")
+    p.add_argument("trace", nargs="+", help="JSONL span log(s)")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.set_defaults(fn=cmd_summarize)
+
+    p = sub.add_parser("to-perfetto", help="convert to trace-event JSON")
+    p.add_argument("trace", nargs="+", help="JSONL span log(s)")
+    p.add_argument("-o", "--output", required=True, help="output .json path")
+    p.set_defaults(fn=cmd_to_perfetto)
+
+    p = sub.add_parser("diff", help="structural diff of two span logs")
+    p.add_argument("a")
+    p.add_argument("b")
+    p.set_defaults(fn=cmd_diff)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

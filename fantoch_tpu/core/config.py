@@ -103,6 +103,12 @@ class Config:
     # skip sending MCollectAck to the coordinator when the process is in the
     # fast quorum and the coordinator will ack anyway
     skip_fast_ack: bool = False
+    # per-dot lifecycle tracing (fantoch_tpu/observability): fraction of
+    # commands traced, selected by a deterministic hash of the command id
+    # (same seed => same sampled dot set).  0.0 disables tracing entirely
+    # (runners install the zero-cost no-op tracer); runners also need a
+    # trace destination (sim `trace_path` / run `trace_file`) to emit
+    trace_sample_rate: float = 0.0
 
     def __post_init__(self) -> None:
         # reference panics if f > n/2 only in specific protocols; the config

@@ -19,6 +19,7 @@ from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import ProcessId, Rifl, ShardId
 from fantoch_tpu.core.kvs import KVOpResult, Key
 from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.observability.tracer import NOOP_TRACER
 
 
 class ExecutorResult(NamedTuple):
@@ -57,11 +58,28 @@ class Executor(ABC, Generic[Info]):
     two-phase), SlotExecutor (total order by slot).
     """
 
+    # lifecycle tracer (observability plane): class-level no-op default so
+    # every executor is traceable without touching its __init__; runners
+    # install a real tracer per instance via set_tracer
+    tracer = NOOP_TRACER
+
     @abstractmethod
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config): ...
 
     def set_executor_index(self, index: int) -> None:
         """Executors are cloned per worker; each clone learns its index."""
+
+    def set_tracer(self, tracer) -> None:
+        """Runner hook: install the lifecycle tracer
+        (fantoch_tpu/observability)."""
+        self.tracer = tracer
+
+    def device_counters(self) -> Optional[dict]:
+        """Per-dispatch device-plane counters (dispatch count, batch
+        occupancy, kernel wall-ms...), folded into the run layer's
+        periodic metrics snapshot.  None when this executor drives no
+        device plane."""
+        return None
 
     def cleanup(self, time: SysTime) -> None:
         """Periodic housekeeping (cross-shard request retries...)."""

@@ -101,6 +101,8 @@ class GraphExecutor(Executor):
         self._store = KVStore(config.executor_monitor_execution_order)
         self._to_clients: Deque[ExecutorResult] = deque()
         self._to_executors: List[Tuple[ShardId, GraphExecutionInfo]] = []
+        # tracing: which handle_batch drain resolved each traced command
+        self._trace_batch = 0
 
     def set_executor_index(self, index: int) -> None:
         self.graph.executor_index = index
@@ -127,6 +129,7 @@ class GraphExecutor(Executor):
     def handle_batch(self, infos, time: SysTime) -> None:
         """Group runs of GraphAdds into one batched graph add (a single
         device resolve with the batched resolver), preserving info order."""
+        self._trace_batch += 1
         adds = []
 
         def flush():
@@ -229,7 +232,17 @@ class GraphExecutor(Executor):
                 self._to_executors.append((to_shard, GraphRequestReply(infos)))
 
     def _execute(self, cmd: Command) -> None:
+        tracer = self.tracer
+        if tracer.enabled:
+            # "ready" = the graph resolved the command into an executable
+            # position (stable SCC); "executed" = KVStore work done
+            tracer.span(
+                "ready", cmd.rifl, pid=self._process_id,
+                meta={"batch": self._trace_batch},
+            )
         self._to_clients.extend(cmd.execute(self._shard_id, self._store))
+        if tracer.enabled:
+            tracer.span("executed", cmd.rifl, pid=self._process_id)
 
     # --- executor routing (executor.rs:242-262) ---
 

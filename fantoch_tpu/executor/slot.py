@@ -27,6 +27,7 @@ class SlotExecutionInfo:
 
 class SlotExecutor(Executor):
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
+        self._process_id = process_id
         self._shard_id = shard_id
         self._execute_at_commit = config.execute_at_commit
         # only leader failover legitimately re-chooses a slot (takeover
@@ -65,7 +66,14 @@ class SlotExecutor(Executor):
             self._next_slot += 1
 
     def _execute(self, cmd: Command) -> None:
+        tracer = self.tracer
+        if tracer.enabled:
+            # slot order reached this command: ready and executed in the
+            # same drain (total-order executors have no separate wait)
+            tracer.span("ready", cmd.rifl, pid=self._process_id)
         self._to_clients.extend(cmd.execute(self._shard_id, self._store))
+        if tracer.enabled:
+            tracer.span("executed", cmd.rifl, pid=self._process_id)
 
     def to_clients(self) -> Optional[ExecutorResult]:
         return self._to_clients.popleft() if self._to_clients else None

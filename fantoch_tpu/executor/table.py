@@ -341,7 +341,10 @@ class TableExecutor(Executor):
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
         _, _, stability_threshold = config.newt_quorum_sizes()
+        self._process_id = process_id
         self._execute_at_commit = config.execute_at_commit
+        # tracing: which batch drain stabilized each traced command
+        self._trace_batch = 0
         self._table = MultiVotesTable(process_id, shard_id, config.n, stability_threshold)
         self._store = KVStore(config.executor_monitor_execution_order)
         self._to_clients: Deque[ExecutorResult] = deque()
@@ -389,6 +392,7 @@ class TableExecutor(Executor):
             yield merged
 
     def handle_batch(self, infos, time) -> None:
+        self._trace_batch += 1
         if self._plane is not None and not self._execute_at_commit:
             # device plane: every path funnels through the arrays seam so
             # the resident frontier state never forks from a host twin
@@ -467,6 +471,7 @@ class TableExecutor(Executor):
         tested)."""
         import numpy as np
 
+        self._trace_batch += 1
         B = len(batch.keys)
         det_keys = batch.det_keys or []
         D = len(det_keys)
@@ -781,6 +786,15 @@ class TableExecutor(Executor):
                         zip(prevs),
                     )
                 )
+                tracer = self.tracer
+                if tracer.enabled:
+                    for i in rows:
+                        rifl = (src_list[i], seq_list[i])
+                        tracer.span(
+                            "ready", rifl, pid=self._process_id,
+                            meta={"batch": self._trace_batch},
+                        )
+                        tracer.span("executed", rifl, pid=self._process_id)
                 return
         self._execute(
             key,
@@ -796,6 +810,14 @@ class TableExecutor(Executor):
             seq = np.fromiter((r.sequence for r, _ in to_execute), np.int64, m)
             self._order_arrays.append((src, seq))
             return
+        tracer = self.tracer
+        if tracer.enabled:
+            # "ready" = the timestamp became stable this batch
+            for rifl, _ops in to_execute:
+                tracer.span(
+                    "ready", rifl, pid=self._process_id,
+                    meta={"batch": self._trace_batch},
+                )
         store_execute = self._store.execute
         append = self._to_clients.append
         for rifl, ops in to_execute:
@@ -804,6 +826,25 @@ class TableExecutor(Executor):
             else:
                 results = tuple(store_execute(key, op, rifl) for op in ops)
             append(ExecutorResult(rifl, key, results))
+        if tracer.enabled:
+            for rifl, _ops in to_execute:
+                tracer.span("executed", rifl, pid=self._process_id)
+
+    def device_counters(self):
+        """Per-dispatch tallies of the resident votes-table plane (None
+        when the plane is off); folded into the run layer's periodic
+        metrics snapshot and the bench rows."""
+        if self._plane is None:
+            return None
+        plane = self._plane
+        return {
+            "table_plane_dispatches": plane.dispatches,
+            "table_plane_grows": plane.grows,
+            "table_plane_vote_rows": plane.stats["vote_rows"],
+            "table_plane_row_capacity": plane.stats["row_capacity"],
+            "table_plane_residual_runs": plane.stats["residual_runs"],
+            "table_plane_kernel_ms": round(plane.stats["kernel_ms"], 3),
+        }
 
     def take_order_arrays(self) -> Tuple["np.ndarray", "np.ndarray"]:
         """Concatenated (rifl_src, rifl_seq) execution-order columns since

@@ -24,6 +24,7 @@ are rejected at config time (core/config.py).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List
 
 import numpy as np
@@ -60,6 +61,7 @@ class DeviceTablePlane:
         "_res_end",
         "dispatches",
         "grows",
+        "stats",
     )
 
     def __init__(self, n: int, stability_threshold: int, key_buckets: int = 1024):
@@ -75,6 +77,15 @@ class DeviceTablePlane:
         self._res_start, self._res_end = empty, empty
         self.dispatches = 0
         self.grows = 0
+        # per-dispatch observability tallies (observability/device.py):
+        # vote_rows/row_capacity is the batch occupancy (padding waste),
+        # kernel_ms the blocking dispatch+transfer wall time
+        self.stats: Dict[str, float] = {
+            "vote_rows": 0,
+            "row_capacity": 0,
+            "residual_runs": 0,
+            "kernel_ms": 0.0,
+        }
 
     # --- key registry (string keys -> stable device buckets) ---
 
@@ -169,6 +180,7 @@ class DeviceTablePlane:
         pvalid = np.zeros(vcap, dtype=bool)
         pvalid[:V] = True
 
+        t0 = time.perf_counter()
         out = fused_votes_commit(
             self._frontier,
             jnp.asarray(pk),
@@ -184,7 +196,12 @@ class DeviceTablePlane:
             out[1:]
         )
         self.dispatches += 1
+        stats = self.stats
+        stats["kernel_ms"] += (time.perf_counter() - t0) * 1000.0
+        stats["vote_rows"] += V
+        stats["row_capacity"] += vcap
         res = np.flatnonzero(residual)
+        stats["residual_runs"] += len(res)
         self._res_key = run_key[res].astype(np.int64)
         self._res_by = (run_by[res] + 1).astype(np.int64)  # back to 1-based
         self._res_start = run_start[res].astype(np.int64)

@@ -1,0 +1,36 @@
+"""Per-dot lifecycle tracing plane (no direct reference counterpart —
+fantoch only ships aggregate counters via fantoch_prof; this package adds
+the per-command attribution layer those counters cannot answer).
+
+- :mod:`tracer` — sampled span emission (one schema for sim virtual time
+  and run wall clock) into a crash-consistent JSONL log;
+- :mod:`report` — span assembly, stage-latency breakdown (p50/p95/p99 per
+  stage over :class:`fantoch_tpu.core.metrics.Histogram`), trace diff;
+- :mod:`perfetto` — Chrome/Perfetto trace-event JSON conversion;
+- :mod:`device` — device-plane counters (dispatches, occupancy,
+  recompiles via jax.monitoring) folded into metrics snapshots.
+"""
+
+from fantoch_tpu.observability.tracer import (
+    EXTRA_STAGES,
+    NOOP_TRACER,
+    STAGES,
+    Tracer,
+    read_trace,
+    span_hash,
+)
+from fantoch_tpu.observability.device import (
+    recompile_count,
+    subscribe_recompiles,
+)
+
+__all__ = [
+    "EXTRA_STAGES",
+    "NOOP_TRACER",
+    "STAGES",
+    "Tracer",
+    "read_trace",
+    "span_hash",
+    "recompile_count",
+    "subscribe_recompiles",
+]

@@ -1,0 +1,64 @@
+"""Device-plane counters: per-dispatch tallies and XLA recompile events.
+
+The device planes (the resident votes-table plane, the serving drivers,
+the batched graph resolver) do their work in fused dispatches, so
+per-item latency attribution stops at the batch boundary — what remains
+observable is *per-dispatch*: how many dispatches, how full each batch
+was, how much kernel wall time, and whether XLA recompiled mid-run (the
+classic silent latency cliff).  These counters ride two channels:
+
+- folded into the periodic metrics snapshot
+  (:class:`fantoch_tpu.run.observe.ProcessMetrics.device`);
+- emitted as tracer counter events so a Perfetto timeline shows them
+  next to the spans of the batches they carried.
+
+Recompiles are counted by subscribing to ``jax.monitoring`` duration
+events (``.../backend_compile_duration`` fires once per XLA backend
+compile); the subscription is process-global and idempotent.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+_recompiles = 0
+_subscribed = False
+
+
+def subscribe_recompiles() -> bool:
+    """Start counting XLA backend compiles (idempotent; returns whether
+    the jax.monitoring hook is installed).  Safe to call before any jax
+    work — the listener costs nothing until a compile happens."""
+    global _subscribed
+    if _subscribed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:  # jax absent or too old: counters just stay 0
+        return False
+
+    def _on_duration(key: str, _secs: float) -> None:
+        global _recompiles
+        if key.endswith("backend_compile_duration"):
+            _recompiles += 1
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _subscribed = True
+    return True
+
+
+def recompile_count() -> int:
+    """XLA backend compiles observed since :func:`subscribe_recompiles`
+    (0 when never subscribed)."""
+    return _recompiles
+
+
+def merge_counters(
+    into: Dict[str, float], add: Optional[Dict[str, float]]
+) -> Dict[str, float]:
+    """Accumulate one executor's counter dict into a process-level one
+    (sums; used by the metrics snapshot fold)."""
+    if add:
+        for name, value in add.items():
+            into[name] = into.get(name, 0) + value
+    return into

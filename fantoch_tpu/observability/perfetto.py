@@ -1,0 +1,115 @@
+"""Chrome/Perfetto trace-event JSON conversion.
+
+Emits the (legacy, universally-supported) Trace Event Format that both
+``chrome://tracing`` and https://ui.perfetto.dev load directly:
+
+- one complete (``ph: "X"``) event per span *segment* on the
+  coordinating process's track, ``tid`` = issuing client, args carrying
+  the rifl/dot and any stage meta (path decision, batch id);
+- counter (``ph: "C"``) events for the device-plane tallies, one track
+  per counter name;
+- metadata (``ph: "M"``) events naming process tracks.
+
+Timestamps are microseconds, exactly as recorded (virtual in sim
+traces, wall clock in run traces).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from fantoch_tpu.observability.report import assemble_spans, span_segments
+
+# track for client-side-only spans; host-global counters (emitted with no
+# pid, e.g. jax_recompiles) get their own track rather than polluting it
+CLIENT_PID = 0
+GLOBAL_PID = -1
+
+
+def to_perfetto(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Convert a span-event stream to a trace-event JSON object."""
+    spans = assemble_spans(events)
+    trace: List[Dict[str, Any]] = []
+    pids = set()
+    for span in spans.values():
+        dot = span["dot"]
+        rifl = span["rifl"]
+        # the span's kept timeline: the coordinator, or (dotless,
+        # leader-based) the first process observed — never mislabel
+        # protocol work as client-side
+        pid = span["pid"] if span["pid"] is not None else CLIENT_PID
+        pids.add(pid)
+        for name, ta, tb in span_segments(span):
+            args: Dict[str, Any] = {"rifl": f"{rifl[0]}.{rifl[1]}"}
+            if dot is not None:
+                args["dot"] = f"{dot[0]}.{dot[1]}"
+            stage_to = name.split("->", 1)[1]
+            meta = span["meta"].get(stage_to)
+            if meta:
+                args.update(meta)
+            trace.append(
+                {
+                    "name": name,
+                    "cat": "dot",
+                    "ph": "X",
+                    "ts": ta,
+                    "dur": tb - ta,
+                    "pid": pid,
+                    "tid": rifl[0],
+                    "args": args,
+                }
+            )
+    for ev in events:
+        if ev.get("k") != "ctr":
+            continue
+        pid = ev.get("pid")
+        if pid is None:
+            pid = GLOBAL_PID
+        pids.add(pid)
+        trace.append(
+            {
+                "name": ev["name"],
+                "cat": "device",
+                "ph": "C",
+                "ts": ev["t"],
+                "pid": pid,
+                "args": {"value": ev["v"]},
+            }
+        )
+    for pid in sorted(pids):
+        trace.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {
+                    "name": (
+                        "clients" if pid == CLIENT_PID
+                        else "global" if pid == GLOBAL_PID
+                        else f"p{pid}"
+                    )
+                },
+            }
+        )
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def write_perfetto(events: List[Dict[str, Any]], path: str) -> int:
+    """Write the converted trace; returns the number of trace events."""
+    obj = to_perfetto(events)
+    with open(path, "w") as fh:
+        json.dump(obj, fh)
+    return len(obj["traceEvents"])
+
+
+def validate_perfetto(obj: Dict[str, Any]) -> None:
+    """Assert the minimal trace-event invariants the viewers rely on
+    (used by tests and the trace-smoke gate)."""
+    assert isinstance(obj.get("traceEvents"), list), "traceEvents missing"
+    for ev in obj["traceEvents"]:
+        assert "ph" in ev and "pid" in ev, ev
+        if ev["ph"] == "X":
+            assert "ts" in ev and "dur" in ev and ev["dur"] >= 0, ev
+        elif ev["ph"] == "C":
+            assert "ts" in ev and "value" in ev["args"], ev

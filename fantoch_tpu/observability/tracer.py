@@ -1,0 +1,223 @@
+"""Sampled per-dot span tracing: one schema across sim and run.
+
+Every traced command leaves a sequence of *span events* — one JSON line
+per lifecycle stage — keyed by its rifl (the id that exists from client
+submit to client reply; the dot joins at the ``payload`` stage, once the
+coordinator assigns it).  The same schema is emitted by the sim runner
+(virtual timestamps from :class:`fantoch_tpu.core.timing.SimTime`) and
+the run layer (wall clock), so a same-seed sim trace and a localhost
+trace are directly diffable: the PR-2 deterministic-trace property
+extended from message order to latency structure.
+
+Canonical stage chain (monotonic within a span)::
+
+    submit -> payload -> path -> commit -> ready -> executed -> reply
+
+- ``submit``/``reply`` are client-side (events carry ``cid``);
+- ``payload`` is the coordinator assigning the dot and owning the
+  payload; ``path`` is the fast/slow decision; ``commit`` the commit;
+- ``ready`` is the executor's stable/resolved point, ``executed`` the
+  KVStore execution (events carry ``pid``; the report keeps the
+  coordinator's timeline — ``pid == dot.source`` — so replicated stages
+  do not overlap).
+
+``recovery`` is an extra out-of-chain stage stamped when a dot enters
+recovery consensus.  *Counter events* (``k == "ctr"``) carry device-plane
+tallies (dispatch counts, batch occupancy, recompiles, kernel wall-ms)
+attached to the trace timeline.
+
+Sampling is a deterministic hash of the span id (:func:`span_hash` over
+``(rifl.source, rifl.sequence)``) against ``Config.trace_sample_rate``:
+the same seed yields the same sampled dot set, with no RNG state touched
+(the sim's determinism contract).  With the rate at 0 the tracer is the
+:data:`NOOP_TRACER` singleton — one attribute check per hook site.
+
+The log is crash-consistent JSONL: every line is a self-contained event
+written with sorted keys and compact separators (same-seed sim runs are
+byte-identical); a reader tolerates a truncated final line.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+# canonical per-command stage chain, in lifecycle order
+STAGES = (
+    "submit",
+    "payload",
+    "path",
+    "commit",
+    "ready",
+    "executed",
+    "reply",
+)
+# out-of-chain stages (do not participate in the stage-latency breakdown)
+EXTRA_STAGES = ("recovery",)
+
+_MASK64 = (1 << 64) - 1
+_SAMPLE_SPACE = 1 << 32
+
+
+def span_hash(source: int, sequence: int) -> int:
+    """Deterministic 32-bit mix of a (source, sequence) id pair
+    (splitmix64 finalizer over a golden-ratio combine).  Used for
+    sampling: stable across processes and runs, independent of
+    PYTHONHASHSEED and of any RNG state."""
+    x = (source * 0x9E3779B97F4A7C15 + sequence * 0xBF58476D1CE4E5B9) & _MASK64
+    x ^= x >> 31
+    x = (x * 0x94D049BB133111EB) & _MASK64
+    x ^= x >> 29
+    return x & (_SAMPLE_SPACE - 1)
+
+
+def _noop() -> "_NoopTracer":
+    return NOOP_TRACER
+
+
+class _NoopTracer:
+    """Zero-cost disabled tracer: hook sites guard on ``.enabled`` and
+    never build event payloads.  Pickles (and deep-copies) back to the
+    module singleton so protocol state holding it stays picklable (the
+    model checker pickles whole protocol instances)."""
+
+    enabled = False
+    sample_rate = 0.0
+
+    def sample(self, rifl) -> bool:
+        return False
+
+    def span(self, stage, rifl, dot=None, pid=None, cid=None, meta=None) -> None:
+        pass
+
+    def counter(self, name, value, pid=None, meta=None) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __reduce__(self):
+        return (_noop, ())
+
+
+NOOP_TRACER = _NoopTracer()
+
+
+class Tracer:
+    """Lock-light span emitter over a monotonic time source.
+
+    ``time`` is any :class:`fantoch_tpu.core.timing.SysTime` — the sim
+    passes its virtual clock, the run layer its wall clock — so emission
+    sites never thread timestamps through.  Writes are buffered complete
+    lines; ``flush()`` is cheap and the run layer calls it periodically
+    (crash consistency = the on-disk prefix is always parseable).
+    """
+
+    enabled = True
+
+    def __init__(self, time, path: str, sample_rate: float = 1.0,
+                 flush_every: int = 512):
+        self._time = time
+        self.path = path
+        self.sample_rate = max(0.0, min(1.0, float(sample_rate)))
+        self._threshold = int(self.sample_rate * _SAMPLE_SPACE)
+        self._fh = open(path, "w", buffering=1 << 16)
+        self._flush_every = flush_every
+        self._pending = 0
+        self._closed = False
+
+    # --- sampling ---
+
+    def sample(self, rifl) -> bool:
+        """Deterministic verdict for a span id (a Rifl or any
+        (source, sequence) pair)."""
+        return span_hash(rifl[0], rifl[1]) < self._threshold
+
+    # --- emission ---
+
+    def span(
+        self,
+        stage: str,
+        rifl,
+        dot=None,
+        pid: Optional[int] = None,
+        cid: Optional[int] = None,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        if span_hash(rifl[0], rifl[1]) >= self._threshold:
+            return
+        ev: Dict[str, Any] = {
+            "k": "span",
+            "stage": stage,
+            "rifl": [rifl[0], rifl[1]],
+            "t": self._time.micros(),
+        }
+        if dot is not None:
+            ev["dot"] = [dot[0], dot[1]]
+        if pid is not None:
+            ev["pid"] = pid
+        if cid is not None:
+            ev["cid"] = cid
+        if meta:
+            ev["m"] = meta
+        self._write(ev)
+
+    def counter(
+        self,
+        name: str,
+        value,
+        pid: Optional[int] = None,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        ev: Dict[str, Any] = {
+            "k": "ctr",
+            "name": name,
+            "v": value,
+            "t": self._time.micros(),
+        }
+        if pid is not None:
+            ev["pid"] = pid
+        if meta:
+            ev["m"] = meta
+        self._write(ev)
+
+    def _write(self, ev: Dict[str, Any]) -> None:
+        if self._closed:
+            return
+        # sorted keys + compact separators: same-seed sim traces must be
+        # byte-identical, so serialization is fully canonical
+        self._fh.write(json.dumps(ev, sort_keys=True, separators=(",", ":")))
+        self._fh.write("\n")
+        self._pending += 1
+        if self._pending >= self._flush_every:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._closed:
+            self._fh.flush()
+            self._pending = 0
+
+    def close(self) -> None:
+        if not self._closed:
+            self._fh.flush()
+            self._fh.close()
+            self._closed = True
+
+
+def read_trace(path: str) -> List[Dict[str, Any]]:
+    """Read a JSONL span log; a truncated final line (crash mid-write) is
+    dropped, everything before it is returned."""
+    out: List[Dict[str, Any]] = []
+    with open(path, "r") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                break  # torn tail — the crash-consistent prefix ends here
+    return out

@@ -32,6 +32,7 @@ from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import Dot, IdGen, ProcessId, ShardId
 from fantoch_tpu.core.metrics import Metrics
 from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.observability.tracer import NOOP_TRACER
 
 # Compact representation of which dots have been executed
 # (fantoch/src/protocol/mod.rs:40).
@@ -136,6 +137,15 @@ class Protocol(ABC):
         start per-dot recovery consensus for them — including dots whose
         payload never reached any live process (recovered as noops)."""
 
+    def set_tracer(self, tracer) -> None:
+        """Runner hook: install the lifecycle tracer
+        (fantoch_tpu/observability).  Default wires it into the shared
+        BaseProcess plumbing when present; protocols without a ``bp``
+        simply stay untraced."""
+        bp = getattr(self, "bp", None)
+        if bp is not None:
+            bp.tracer = tracer
+
     @abstractmethod
     def to_processes(self) -> Optional[Action]: ...
 
@@ -203,6 +213,9 @@ class BaseProcess:
         self._closest_shard_process: Dict[ShardId, ProcessId] = {}
         self._dot_gen = IdGen(process_id)
         self._metrics: Metrics = Metrics()
+        # lifecycle tracer (observability plane); runners swap in a real
+        # Tracer via Protocol.set_tracer when Config.trace_sample_rate > 0
+        self.tracer = NOOP_TRACER
 
     def discover(self, all_processes: List[Tuple[ProcessId, ShardId]]) -> bool:
         """Learn the (distance-sorted) process list; quorums are the closest
@@ -258,11 +271,23 @@ class BaseProcess:
     def metrics(self) -> Metrics:
         return self._metrics
 
-    def fast_path(self) -> None:
+    def fast_path(self, dot: Optional[Dot] = None, cmd=None) -> None:
         self._metrics.aggregate(ProtocolMetricsKind.FAST_PATH, 1)
+        if self.tracer.enabled and cmd is not None:
+            self.trace_span("path", cmd.rifl, dot=dot, meta={"path": "fast"})
 
-    def slow_path(self) -> None:
+    def slow_path(self, dot: Optional[Dot] = None, cmd=None) -> None:
         self._metrics.aggregate(ProtocolMetricsKind.SLOW_PATH, 1)
+        if self.tracer.enabled and cmd is not None:
+            self.trace_span("path", cmd.rifl, dot=dot, meta={"path": "slow"})
 
     def stable(self, count: int) -> None:
         self._metrics.aggregate(ProtocolMetricsKind.STABLE, count)
+
+    def trace_span(self, stage: str, rifl, dot: Optional[Dot] = None,
+                   meta=None) -> None:
+        """Emit one lifecycle span event at this process (no-op unless a
+        tracer is installed and the command is sampled)."""
+        if self.tracer.enabled:
+            self.tracer.span(stage, rifl, dot=dot, pid=self.process_id,
+                             meta=meta)

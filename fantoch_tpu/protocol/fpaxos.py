@@ -170,6 +170,8 @@ class FPaxos(Protocol):
             )
         # last virtual ms any message arrived from the current leader
         self._leader_heard: Optional[int] = None
+        # elections started here (tracer counters are running totals)
+        self._elections = 0
         # submissions forwarded but not yet chosen: re-forwarded on leader
         # change (Rifl -> Command); the leader dedups re-forwards below
         self._pending_forwards: Dict[Rifl, Command] = {}
@@ -271,6 +273,10 @@ class FPaxos(Protocol):
         if isinstance(out, SynodMSpawnCommander):
             # we're the leader: spawn the commander via a self-forward so it
             # can land on a slot-sharded worker
+            # trace: the leader allocating the slot is the dotless analog
+            # of the coordinator's payload stage
+            if self.bp.tracer.enabled:
+                self.bp.trace_span("payload", cmd.rifl, meta={"slot": out.slot})
             if self._failover:
                 self._register_allocation(out.value.rifl, out.slot)
             self._to_processes.append(
@@ -325,6 +331,8 @@ class FPaxos(Protocol):
             self._chosen_slots.add(slot)
             self._seen_rifls.add(cmd.rifl)
             self._pending_forwards.pop(cmd.rifl, None)
+        if self.bp.tracer.enabled:
+            self.bp.trace_span("commit", cmd.rifl, meta={"slot": slot})
         self._to_executors.append(SlotExecutionInfo(slot, cmd))
         if self.bp.config.gc_interval_ms is not None:
             self._gc_track.commit(slot)
@@ -373,6 +381,15 @@ class FPaxos(Protocol):
 
     def _start_election(self) -> None:
         prepare = self._multi_synod.new_prepare()
+        # trace: leader failover is the recovery trigger of the
+        # leader-based world (a counter, not a span — no single dot
+        # heals); counters are running totals, last observation wins
+        self._elections += 1
+        if self.bp.tracer.enabled:
+            self.bp.tracer.counter(
+                "fpaxos_elections", self._elections, pid=self.id,
+                meta={"ballot": prepare.ballot},
+            )
         # broadcast (self included: our own acceptor's promise counts)
         self._to_processes.append(ToSend(self.bp.all(), MPrepare(prepare.ballot)))
 

@@ -313,7 +313,9 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             assert self.supports_partial_replication(), (
                 f"{type(self).__name__} does not support multi-shard commands"
             )
-        self._handle_submit(dot, cmd, target_shard=True)
+        dot = self._handle_submit(dot, cmd, target_shard=True)
+        # trace: dot assigned + payload owned at the coordinator
+        self.bp.trace_span("payload", cmd.rifl, dot=dot)
 
     def handle(self, from_, from_shard_id, msg, time):
         if isinstance(msg, MCollect):
@@ -363,7 +365,7 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
 
     def _handle_submit(
         self, dot: Optional[Dot], cmd: Command, target_shard: bool
-    ) -> None:
+    ) -> Dot:
         dot = dot if dot is not None else self.bp.next_dot()
         # forward the submit to the other shards the command touches
         # (no-op for single-shard commands / forwarded submits)
@@ -371,6 +373,7 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
         deps = self.key_deps.add_cmd(dot, cmd, None)
         mcollect = MCollect(dot, cmd, deps, self.bp.fast_quorum())
         self._to_processes.append(ToSend(self.bp.all(), mcollect))
+        return dot
 
     def _handle_mcollect(self, from_, dot, cmd, quorum, remote_deps, time) -> None:
         info = self._cmds.get(dot)
@@ -447,10 +450,10 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             )
             return
         if fast_path:
-            self.bp.fast_path()
+            self.bp.fast_path(dot, info.cmd)
             self._mcommit_actions(dot, value)
         else:
-            self.bp.slow_path()
+            self.bp.slow_path(dot, info.cmd)
             ballot = info.synod.skip_prepare()
             self._to_processes.append(
                 ToSend(self.bp.write_quorum(), MConsensus(dot, ballot, value))
@@ -481,6 +484,11 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
 
     def _commit_bookkeeping(self, info, from_, dot, value) -> None:
         info.status = Status.COMMIT
+        if info.cmd is not None:
+            self.bp.trace_span(
+                "commit", info.cmd.rifl, dot=dot,
+                meta={"noop": True} if value.is_noop else None,
+            )
         out = info.synod.handle(from_, MChosen(value))
         assert out is None
         self._recovery_untrack(dot)

@@ -310,7 +310,9 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
         return connect_ok, dict(self.bp.closest_shard_process())
 
     def submit(self, dot: Optional[Dot], cmd: Command, time: SysTime) -> None:
-        self._handle_submit(dot, cmd, target_shard=True)
+        dot = self._handle_submit(dot, cmd, target_shard=True)
+        # trace: dot assigned + payload owned at the coordinator
+        self.bp.trace_span("payload", cmd.rifl, dot=dot)
 
     def submit_batch(self, pairs, time: SysTime) -> None:
         """Batched submit seam: one kernel-batched clock proposal covers
@@ -331,6 +333,9 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
         results = proposal_batch(cmds, [0] * len(cmds))
         for dot, cmd, (clock, process_votes) in zip(dots, cmds, results):
             self._emit_mcollect(dot, cmd, clock, process_votes)
+        if self.bp.tracer.enabled:
+            for dot, cmd in zip(dots, cmds):
+                self.bp.trace_span("payload", cmd.rifl, dot=dot)
 
     def handle(self, from_, from_shard_id, msg, time):
         if isinstance(msg, MCollect):
@@ -407,7 +412,7 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
 
     def _handle_submit(
         self, dot: Optional[Dot], cmd: Command, target_shard: bool
-    ) -> None:
+    ) -> Dot:
         dot = dot if dot is not None else self.bp.next_dot()
         self.partial_submit_actions(dot, cmd, target_shard)
         # propose: bump key clocks, consuming votes; those votes are either
@@ -415,6 +420,7 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
         # without the ack round) or kept for the MCollectAck aggregation
         clock, process_votes = self.key_clocks.proposal(cmd, 0)
         self._emit_mcollect(dot, cmd, clock, process_votes)
+        return dot
 
     def _emit_mcollect(
         self, dot: Dot, cmd: Command, clock: int, process_votes: Votes
@@ -477,7 +483,7 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             # commands are counted once (the reference skips accounting on
             # this path entirely, newt.rs:451-462, leaving commit totals
             # unverifiable under skip_fast_ack).
-            self.bp.fast_path()
+            self.bp.fast_path(dot, cmd)
             votes.merge(process_votes)
             self._mcommit_actions(info, dot, clock, votes)
         else:
@@ -544,11 +550,11 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             )
             return
         if max_count >= self.bp.config.f:
-            self.bp.fast_path()
+            self.bp.fast_path(dot, cmd)
             votes, info.votes = info.votes, Votes()
             self._mcommit_actions(info, dot, max_clock, votes)
         else:
-            self.bp.slow_path()
+            self.bp.slow_path(dot, cmd)
             ballot = info.synod.skip_prepare()
             self._to_processes.append(
                 ToSend(self.bp.write_quorum(), MConsensus(dot, ballot, max_clock))
@@ -702,6 +708,10 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
                 )
 
         info.status = Status.COMMIT
+        self.bp.trace_span(
+            "commit", cmd.rifl, dot=dot,
+            meta={"recovered": True} if recovered else None,
+        )
         # a bump buffered between our commit and its own delivery is moot
         # (detached votes already cover the commit clock); one trailing the
         # GC'd commit ages out of the bounded buffer instead

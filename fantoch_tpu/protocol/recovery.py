@@ -105,6 +105,9 @@ class RecoveryMixin:
     def _init_recovery(self) -> None:
         # dot -> virtual ms when it became pending (or last recovery try)
         self._pending_since: Dict[Dot, int] = {}
+        # prepares issued for never-payloaded dots (tracer counters are
+        # running totals)
+        self._unpayloaded_prepares = 0
 
     def _recovery_enabled(self) -> bool:
         cfg = self.bp.config
@@ -163,6 +166,22 @@ class RecoveryMixin:
             # per interval (next eligibility lands at now + delay)
             self._pending_since[dot] = now - delay * ((me - dot.source) % n)
             prepare = info.synod.new_prepare()
+            # trace: the dot entered recovery consensus (out-of-chain
+            # stage when the payload is known here, else a counter — a
+            # never-payloaded dot has no rifl to span against)
+            tracer = self.bp.tracer
+            if tracer.enabled:
+                if info.cmd is not None:
+                    tracer.span(
+                        "recovery", info.cmd.rifl, dot=dot, pid=me,
+                        meta={"ballot": prepare.ballot},
+                    )
+                else:
+                    self._unpayloaded_prepares += 1
+                    tracer.counter(
+                        "recovery_unpayloaded_prepares",
+                        self._unpayloaded_prepares, pid=me,
+                    )
             self._to_processes.append(
                 ToSend(self.bp.all(), MRecoveryPrepare(dot, prepare.ballot))
             )

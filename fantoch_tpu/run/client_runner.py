@@ -23,6 +23,7 @@ from fantoch_tpu.client.workload import Workload
 from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.ids import ClientId, ShardId
 from fantoch_tpu.core.timing import RunTime
+from fantoch_tpu.observability.tracer import NOOP_TRACER
 from fantoch_tpu.run.prelude import ClientHi, ClientHiAck, Register, Submit, ToClient
 from fantoch_tpu.run.rw import Rw, connect_with_retry
 
@@ -35,6 +36,7 @@ async def run_clients(
     workload: Workload,
     open_loop_interval_ms: Optional[int] = None,
     status_frequency: Optional[int] = None,
+    tracer=NOOP_TRACER,
 ) -> Dict[ClientId, Client]:
     """Drive `client_ids` against the cluster; returns the finished clients
     (latency data inside)."""
@@ -112,8 +114,13 @@ async def run_clients(
             if nxt is None:
                 break
             target_shard, cmd = nxt
+            if tracer.enabled:
+                tracer.span("submit", cmd.rifl, cid=client.id)
             needed = await submit(target_shard, cmd)
-            client.handle(await collect(client, needed), time)
+            results = await collect(client, needed)
+            if tracer.enabled:
+                tracer.span("reply", cmd.rifl, cid=client.id)
+            client.handle(results, time)
 
     async def open_loop(client: Client) -> None:
         pending = 0
@@ -131,6 +138,8 @@ async def run_clients(
                 rifl = cmd_result.rifl
                 buffered.setdefault(rifl, []).append(cmd_result)
                 if len(buffered[rifl]) == expect[rifl]:
+                    if tracer.enabled:
+                        tracer.span("reply", rifl, cid=client.id)
                     client.handle(buffered.pop(rifl), time)
                     del expect[rifl]
                     pending -= 1
@@ -142,6 +151,8 @@ async def run_clients(
                 break
             target_shard, cmd = nxt
             expect[cmd.rifl] = cmd.shard_count
+            if tracer.enabled:
+                tracer.span("submit", cmd.rifl, cid=client.id)
             await submit(target_shard, cmd)
             pending += 1
             await asyncio.sleep(open_loop_interval_ms / 1000)

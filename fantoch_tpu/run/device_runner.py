@@ -45,6 +45,7 @@ CommandResult over that same connection.
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
@@ -181,6 +182,13 @@ class _DriverCore:
         self.slow_paths = 0
         self.executed = 0
         self.stable_watermark = 0
+        # per-dispatch observability (observability/device.py):
+        # dispatched_rows vs dispatches*batch_size is the batch occupancy;
+        # dispatch/drain wall-ms split host assembly from device wait
+        self.dispatches = 0
+        self.dispatched_rows = 0
+        self.dispatch_wall_ms = 0.0
+        self.drain_wall_ms = 0.0
         # dispatch/drain pipelining (drivers implementing the
         # dispatch()/drain() split get step/step_pipelined for free)
         self._outstanding = None  # dispatched-but-undrained round token
@@ -240,13 +248,33 @@ class _DriverCore:
         return self._drain_tracked(tok)
 
     def _dispatch_tracked(self, batch):
+        t0 = time.perf_counter()
         tok = self.dispatch(batch)
+        self.dispatch_wall_ms += (time.perf_counter() - t0) * 1000.0
+        self.dispatches += 1
+        self.dispatched_rows += len(batch)
         self._undrained += 1
         return tok
 
     def _drain_tracked(self, tok):
         self._undrained -= 1  # inside drain, _undrained = OTHER in-flight
-        return self.drain(tok)
+        t0 = time.perf_counter()
+        out = self.drain(tok)
+        self.drain_wall_ms += (time.perf_counter() - t0) * 1000.0
+        return out
+
+    def device_counters(self) -> Dict[str, float]:
+        """Per-dispatch tallies for the metrics snapshot / bench rows:
+        occupancy = dispatched_rows / (dispatches * batch_size)."""
+        return {
+            "device_dispatches": self.dispatches,
+            "device_dispatched_rows": self.dispatched_rows,
+            "device_batch_capacity": self.dispatches * self.batch_size,
+            "device_dispatch_ms": round(self.dispatch_wall_ms, 3),
+            "device_drain_ms": round(self.drain_wall_ms, 3),
+            "device_pipelined_rounds": self.pipelined_rounds,
+            "device_seq_epochs": self.seq_epochs,
+        }
 
     def _pipeline_flush_needed(self, batch) -> bool:
         """True when the upcoming dispatch may trigger a rebase that
@@ -1549,6 +1577,9 @@ class DeviceRuntime:
             server.close()
 
     async def start(self) -> None:
+        from fantoch_tpu.observability.device import subscribe_recompiles
+
+        subscribe_recompiles()
         server = await asyncio.start_server(self._on_client, *self.client_addr)
         self._servers = [server]
         self.spawn(self._driver_task())
@@ -1560,6 +1591,8 @@ class DeviceRuntime:
         concurrently with driver.step, which runs to completion on the
         pool thread before the loop resumes): the snapshot task reads this
         consistent copy, not live counters mid-mutation."""
+        from fantoch_tpu.observability.device import recompile_count
+
         d = self.driver
         self._tallies = {
             "rounds": d.rounds,
@@ -1569,6 +1602,9 @@ class DeviceRuntime:
             "in_flight": d.in_flight,
             "stable_watermark": d.stable_watermark,
             "queued": len(self._submit_queue),
+            # per-dispatch device counters (observability/device.py)
+            **d.device_counters(),
+            "jax_recompiles": recompile_count(),
         }
 
     def _write_metrics_snapshot(self) -> None:

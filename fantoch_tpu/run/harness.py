@@ -73,6 +73,19 @@ async def run_localhost_cluster(
         import os
 
         os.makedirs(observe_dir, exist_ok=True)
+    # lifecycle tracing: with a sample rate and an observe dir, every
+    # runtime writes trace_p<pid>.jsonl and the client plane
+    # trace_clients.jsonl — bin/obs.py consumes all of them together
+    tracing = observe_dir is not None and config.trace_sample_rate > 0
+    client_tracer = None
+    if tracing:
+        from fantoch_tpu.core.timing import RunTime
+        from fantoch_tpu.observability.tracer import Tracer
+
+        client_tracer = Tracer(
+            RunTime(), f"{observe_dir}/trace_clients.jsonl",
+            config.trace_sample_rate,
+        )
     shard_count = config.shard_count
     shard_ids = {s: list(process_ids(s, config.n)) for s in range(shard_count)}
     all_pids = [pid for ids in shard_ids.values() for pid in ids]
@@ -120,6 +133,9 @@ async def run_localhost_cluster(
             execution_log=(
                 f"{observe_dir}/execution_p{pid}.log" if observe_dir else None
             ),
+            trace_file=(
+                f"{observe_dir}/trace_p{pid}.jsonl" if tracing else None
+            ),
             **(runtime_kwargs or {}),
         )
 
@@ -149,6 +165,7 @@ async def run_localhost_cluster(
                 },
                 workload,
                 open_loop_interval_ms=open_loop_interval_ms,
+                **({"tracer": client_tracer} if client_tracer is not None else {}),
             )
             for group, pid in client_groups
         )
@@ -180,12 +197,18 @@ async def run_localhost_cluster(
         # (it would keep poking runtimes that are being stopped)
         if chaos_task is not None and not chaos_task.done():
             chaos_task.cancel()
+        # failure paths skip the clean close below: flush so the span
+        # log's crash-consistent prefix covers everything emitted
+        if client_tracer is not None:
+            client_tracer.flush()
 
     await asyncio.sleep(extra_run_time_ms / 1000)
     # stop concurrently: a sequential shutdown leaves the last runtimes
     # watching already-stopped peers, and their failure detectors would
     # (correctly, but uselessly) report the shutdown as peer loss
     await asyncio.gather(*(runtime.stop() for runtime in runtimes.values()))
+    if client_tracer is not None:
+        client_tracer.close()
 
     clients: Dict[ClientId, Client] = {}
     for group in results:

@@ -19,7 +19,7 @@ import gzip
 import os
 import pickle
 from dataclasses import dataclass
-from typing import Any, BinaryIO, Dict, Iterator, List
+from typing import Any, BinaryIO, Dict, Iterator, List, Optional
 
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import ProcessId, ShardId
@@ -30,10 +30,16 @@ from fantoch_tpu.core.timing import RunTime
 @dataclass
 class ProcessMetrics:
     """One metrics snapshot: protocol ("workers") + executor metrics
-    (metrics_logger.rs:12-30)."""
+    (metrics_logger.rs:12-30), plus the device-plane counters
+    (fantoch_tpu/observability/device.py: dispatch counts, batch
+    occupancy, recompiles, kernel wall-ms — no reference counterpart;
+    the reference has no device planes).  ``device`` is None on
+    planes-off runs and on snapshots written before the field existed
+    (``read_metrics_snapshot`` backfills it on read)."""
 
     workers: List[Metrics]
     executors: List[Metrics]
+    device: Optional[Dict[str, float]] = None
 
 
 def write_metrics_snapshot(path: str, metrics: ProcessMetrics) -> None:
@@ -61,6 +67,9 @@ def read_metrics_snapshot(path: str) -> ProcessMetrics:
     with gzip.open(path, "rb") as fh:
         out = pickle.load(fh)
     assert isinstance(out, ProcessMetrics)
+    # snapshots written before the device-counter field existed unpickle
+    # without it in __dict__; reads still see None via the dataclass
+    # class-attribute default, so no backfill is needed
     return out
 
 

@@ -217,6 +217,7 @@ class ProcessRuntime:
         send_timeout_s: float = 30.0,
         heartbeat_interval_s: Optional[float] = 1.0,
         heartbeat_misses: int = 8,
+        trace_file: Optional[str] = None,
     ):
         self.protocol_cls = protocol_cls
         self.config = config
@@ -301,6 +302,22 @@ class ProcessRuntime:
             from fantoch_tpu.run.observe import ExecutionLogger
 
             self.execution_logger = ExecutionLogger(execution_log)
+        # per-runtime prof registry (utils/prof.py): installed into the
+        # context before tasks spawn, so several runtimes sharing one
+        # Python process (the localhost harness) never blend histograms
+        from fantoch_tpu.core.metrics import Metrics as _Metrics
+
+        self.prof_registry = _Metrics()
+        # per-dot lifecycle tracing (fantoch_tpu/observability): wall-clock
+        # spans into this runtime's own JSONL log
+        from fantoch_tpu.observability.tracer import NOOP_TRACER, Tracer
+
+        self.tracer = NOOP_TRACER
+        if trace_file is not None and config.trace_sample_rate > 0:
+            self.tracer = Tracer(self.time, trace_file, config.trace_sample_rate)
+        self.process.set_tracer(self.tracer)
+        for executor in self.executors:
+            executor.set_tracer(self.tracer)
         self._tasks: Set[asyncio.Task] = set()
         self._servers: List[asyncio.base_events.Server] = []
         self._connected = asyncio.Event()
@@ -355,6 +372,24 @@ class ProcessRuntime:
 
     async def start(self) -> None:
         """Listen, connect to all peers, then start worker/executor loops."""
+        # scope the prof registry to this runtime BEFORE any task spawns:
+        # every spawned task snapshots the context and records here (when
+        # start() runs as its own task — the harness pattern — the caller's
+        # context is untouched)
+        from fantoch_tpu.utils import prof
+
+        prof.set_registry(self.prof_registry)
+        # count XLA recompiles for the metrics snapshot when any device
+        # plane can compile (the hook is process-global and idempotent)
+        if (
+            self.config.device_table_plane
+            or self.config.batched_graph_executor
+            or self.config.batched_table_executor
+            or self.config.batched_pred_executor
+        ):
+            from fantoch_tpu.observability.device import subscribe_recompiles
+
+            subscribe_recompiles()
         peer_server = await asyncio.start_server(self._on_peer, *self.listen_addr)
         client_server = await asyncio.start_server(self._on_client, *self.client_addr)
         self._servers = [peer_server, client_server]
@@ -420,6 +455,8 @@ class ProcessRuntime:
             self.spawn(self._metrics_logger_task())
         if self.execution_logger is not None:
             self.spawn(self._execution_log_flush_task())
+        if self.tracer.enabled:
+            self.spawn(self._trace_flush_task())
         if self.tracer_show_interval_ms is not None:
             # the span-subscriber analog: enabling the tracer installs
             # latency spans over the hot paths automatically
@@ -451,6 +488,7 @@ class ProcessRuntime:
         if self.metrics_file is not None:
             # final snapshot so short runs always leave one behind
             self._write_metrics_snapshot()
+        self.tracer.close()
 
     # --- connection handlers ---
 
@@ -933,13 +971,44 @@ class ProcessRuntime:
     def _write_metrics_snapshot(self) -> None:
         from fantoch_tpu.run.observe import ProcessMetrics, write_metrics_snapshot
 
+        device = self._device_counters()
+        if device is not None and self.tracer.enabled:
+            # counters ride the trace too, next to the spans of the
+            # batches they carried.  jax_recompiles is host-process-global
+            # (a module tally in observability/device.py), so it goes out
+            # unattributed: co-hosted runtimes (the localhost harness)
+            # overwrite one (name, pid=None) observation instead of each
+            # claiming the same compiles — summing per-pid would n-fold it
+            for name, value in sorted(device.items()):
+                self.tracer.counter(
+                    name, value,
+                    pid=None if name == "jax_recompiles" else self.process.id,
+                )
         write_metrics_snapshot(
             self.metrics_file,
             ProcessMetrics(
                 [self.process.metrics()],
                 [e.metrics() for e in self.executors],
+                device,
             ),
         )
+
+    def _device_counters(self):
+        """Fold every executor's device-plane counters (plus the global
+        recompile tally) into one per-process dict; None when no device
+        plane contributed.  ``jax_recompiles`` is host-process-global
+        (``observability/device.py`` module tally): every co-hosted
+        runtime's snapshot carries the same total, so readers must not
+        sum it across runtimes of one host."""
+        from fantoch_tpu.observability.device import merge_counters, recompile_count
+
+        device: Dict[str, float] = {}
+        for executor in self.executors:
+            merge_counters(device, executor.device_counters())
+        if device:
+            device["jax_recompiles"] = recompile_count()
+            return device
+        return None
 
     async def _metrics_logger_task(self) -> None:
         """Periodic crash-consistent metrics snapshots
@@ -954,13 +1023,20 @@ class ProcessRuntime:
             await asyncio.sleep(1.0)
             self.execution_logger.flush()
 
+    async def _trace_flush_task(self) -> None:
+        """Periodic span-log flush: keeps the on-disk JSONL prefix fresh
+        (crash consistency — every flushed line is self-contained)."""
+        while True:
+            await asyncio.sleep(1.0)
+            self.tracer.flush()
+
     async def _tracer_task(self) -> None:
         """Periodic function-latency histogram dump (tracer.rs:16-44).
 
-        The prof registry is OS-process-global (like the reference's
-        ProfSubscriber); in the localhost harness several runtimes share
-        one Python process, so the dump is labeled accordingly rather than
-        claiming per-runtime ownership of the samples."""
+        The prof registry is scoped to this runtime (utils/prof.py
+        contextvar, installed in start() before tasks spawn), so the dump
+        owns its samples even when several runtimes share one Python
+        process in the localhost harness."""
         from fantoch_tpu.utils import prof
 
         while True:
@@ -968,7 +1044,7 @@ class ProcessRuntime:
             formatted = prof.format_snapshot()
             if formatted:
                 logger.info(
-                    "tracer (process-global registry, printed by p%s):\n%s",
+                    "tracer (p%s registry):\n%s",
                     self.process.id,
                     formatted,
                 )

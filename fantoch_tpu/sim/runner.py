@@ -24,6 +24,7 @@ from fantoch_tpu.core.metrics import Histogram, Metrics
 from fantoch_tpu.core.planet import Planet, Region
 from fantoch_tpu.errors import SimStalledError
 from fantoch_tpu.executor.monitor import ExecutionOrderMonitor
+from fantoch_tpu.observability.tracer import NOOP_TRACER, Tracer
 from fantoch_tpu.protocol.base import Protocol, ToForward, ToSend
 from fantoch_tpu.sim.faults import DEFER, DELIVER, DROP, FaultPlan, Nemesis, NemesisMark
 from fantoch_tpu.sim.schedule import Schedule
@@ -88,6 +89,7 @@ class Runner:
         client_regions: List[Region],
         seed: Optional[int] = None,
         fault_plan: Optional[FaultPlan] = None,
+        trace_path: Optional[str] = None,
     ):
         assert len(process_regions) == config.n, "one region per process"
         assert config.gc_interval_ms is not None, "sim requires gc running"
@@ -102,6 +104,14 @@ class Runner:
         self._nemesis: Optional[Nemesis] = (
             Nemesis(fault_plan) if fault_plan is not None else None
         )
+        # lifecycle tracing (fantoch_tpu/observability): virtual-clock
+        # spans over the shared sim time source — same seed, same virtual
+        # timestamps, byte-identical span log
+        self._tracer = NOOP_TRACER
+        if trace_path is not None and config.trace_sample_rate > 0:
+            self._tracer = Tracer(
+                self._simulation.time, trace_path, config.trace_sample_rate
+            )
 
         # a single shard in simulation
         shard_id = 0
@@ -128,6 +138,8 @@ class Runner:
             connect_ok, _ = process.discover(sorted_processes)
             assert connect_ok
             executor = protocol_cls.Executor(process.id, process.shard_id, config)
+            process.set_tracer(self._tracer)
+            executor.set_tracer(self._tracer)
             self._simulation.register_process(process, executor)
 
         # register clients
@@ -179,6 +191,10 @@ class Runner:
         self._reorder_messages = True
 
     @property
+    def tracer(self):
+        return self._tracer
+
+    @property
     def nemesis(self) -> Optional[Nemesis]:
         return self._nemesis
 
@@ -193,9 +209,17 @@ class Runner:
     ]:
         """Run to completion; returns (process metrics, executor monitors,
         per-region (issued commands, latency histogram ms))."""
+        tracer = self._tracer
         for client_id, process_id, cmd in self._simulation.start_clients():
+            if tracer.enabled:
+                tracer.span("submit", cmd.rifl, cid=client_id)
             self._schedule_submit(("client", client_id), process_id, cmd)
-        self._simulation_loop(extra_sim_time_ms)
+        try:
+            self._simulation_loop(extra_sim_time_ms)
+        finally:
+            # flush+close so the span log is complete (and readable) even
+            # when the loop raises a typed stall error
+            tracer.close()
         return (
             {pid: p.metrics() for pid, (p, _, _) in self._simulation.processes()},
             {pid: e.monitor() for pid, (_, e, _) in self._simulation.processes()},
@@ -240,9 +264,17 @@ class Runner:
             elif isinstance(action, SendToClient):
                 if action.client_id not in self._active_clients:
                     continue  # abandoned (attached to a crashed process)
+                if self._tracer.enabled:
+                    self._tracer.span(
+                        "reply", action.cmd_result.rifl, cid=action.client_id
+                    )
                 submit = self._simulation.forward_to_client(action.cmd_result)
                 if submit is not None:
                     process_id, cmd = submit
+                    if self._tracer.enabled:
+                        self._tracer.span(
+                            "submit", cmd.rifl, cid=action.client_id
+                        )
                     self._schedule_submit(("client", action.client_id), process_id, cmd)
                 else:
                     self._active_clients.discard(action.client_id)

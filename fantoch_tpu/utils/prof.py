@@ -5,38 +5,77 @@ turns span enter/exit into per-function latency histograms, printed
 periodically by the tracer task (fantoch/src/run/task/tracer.rs:16-44).
 
 Here the span surface is explicit: wrap hot functions with ``@profiled``
-or time a region with ``elapsed("name")``; latencies land in a global
-``Metrics`` histogram registry keyed by name (microseconds).  The runner's
+or time a region with ``elapsed("name")``; latencies land in a ``Metrics``
+histogram registry keyed by name (microseconds).  The runner's
 tracer task (``ProcessRuntime`` with ``tracer_show_interval_ms``) prints
 ``snapshot()`` on an interval.  For device work, prefer
 ``jax.profiler.TraceAnnotation`` (wired in executor/graph/batched.py) —
 this module covers the host side.
+
+Registry scoping: the registry is a *contextvar*, defaulting to one
+process-global ``Metrics``.  A runner that wants its samples isolated
+(several ``ProcessRuntime``s share one Python process in the localhost
+harness — a module global would blend their latencies) calls
+``set_registry(Metrics())`` before spawning its tasks: every task created
+afterwards snapshots that context and records into the runner's own
+registry, while other runners (and the default scope) stay untouched.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import functools
 import threading
 import time
-from typing import Callable, Dict, Iterator
+from typing import Callable, Dict, Iterator, Optional
 
 from fantoch_tpu.core.metrics import Histogram, Metrics
 
-_metrics: Metrics = Metrics()
+_default_metrics: Metrics = Metrics()
+_registry: "contextvars.ContextVar[Metrics]" = contextvars.ContextVar(
+    "fantoch_prof_registry", default=_default_metrics
+)
 _lock = threading.Lock()
+
+
+def get_registry() -> Metrics:
+    """The registry of the current context (the process-global default
+    unless a runner installed its own)."""
+    return _registry.get()
+
+
+def set_registry(metrics: Optional[Metrics] = None) -> Metrics:
+    """Install ``metrics`` (or a fresh ``Metrics``) as the current
+    context's registry; returns it.  Tasks spawned after this call record
+    into it (asyncio tasks snapshot the context at creation)."""
+    metrics = metrics if metrics is not None else Metrics()
+    _registry.set(metrics)
+    return metrics
+
+
+@contextlib.contextmanager
+def scoped_registry(metrics: Optional[Metrics] = None) -> Iterator[Metrics]:
+    """Context manager: a private registry for the enclosed region."""
+    metrics = metrics if metrics is not None else Metrics()
+    token = _registry.set(metrics)
+    try:
+        yield metrics
+    finally:
+        _registry.reset(token)
 
 
 @contextlib.contextmanager
 def elapsed(name: str) -> Iterator[None]:
-    """Time a region into the global histogram for `name` (microseconds)."""
+    """Time a region into the current registry's histogram for `name`
+    (microseconds)."""
     start = time.perf_counter()
     try:
         yield
     finally:
         micros = int((time.perf_counter() - start) * 1e6)
         with _lock:
-            _metrics.collect(name, micros)
+            _registry.get().collect(name, micros)
 
 
 def profiled(fn: Callable) -> Callable:
@@ -127,17 +166,20 @@ def uninstrument() -> None:
 
 
 def snapshot() -> Dict[str, Histogram]:
-    """Copy of the collected histograms (name -> Histogram)."""
+    """Copy of the current registry's histograms (name -> Histogram)."""
     with _lock:
         out: Metrics = Metrics()
-        out.merge(_metrics)
+        out.merge(_registry.get())
         return dict(out.collected)
 
 
 def reset() -> None:
-    global _metrics
+    """Clear the current registry in place (in place, not a rebind: tasks
+    that captured this registry at spawn keep recording into it)."""
     with _lock:
-        _metrics = Metrics()
+        reg = _registry.get()
+        reg.collected.clear()
+        reg.aggregated.clear()
 
 
 def format_snapshot() -> str:

@@ -1,0 +1,121 @@
+"""Trace smoke gate (``make trace-smoke``): run a tiny 3-process EPaxos
+sim with tracing at rate 1.0, twice with the same seed, then assert the
+whole observability pipeline end to end:
+
+- the two span logs are byte-identical (``obs diff`` empty) — the PR-2
+  determinism property extended to latency structure;
+- every committed command has a span whose canonical stages are
+  monotonic, and the per-stage segments telescope to the client latency;
+- the Perfetto conversion validates and the summarize report parses.
+
+CPU-only and tiny (a few hundred events); the per-push CI step runs it
+next to bench-smoke.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run_sim(trace_path: str, seed: int = 7) -> None:
+    from fantoch_tpu.client import ConflictRateKeyGen, Workload
+    from fantoch_tpu.core import Config, Planet
+    from fantoch_tpu.protocol import EPaxos
+    from fantoch_tpu.sim import Runner
+
+    config = Config(
+        n=3,
+        f=1,
+        gc_interval_ms=100,
+        executor_executed_notification_interval_ms=100,
+        trace_sample_rate=1.0,
+    )
+    planet = Planet.new("gcp")
+    regions = sorted(planet.regions())[:3]
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=2,
+        commands_per_client=4,
+        payload_size=1,
+    )
+    runner = Runner(
+        EPaxos,
+        planet,
+        config,
+        workload,
+        clients_per_process=2,
+        process_regions=list(regions),
+        client_regions=list(regions),
+        seed=seed,
+        trace_path=trace_path,
+    )
+    runner.run(extra_sim_time_ms=1000)
+
+
+def main() -> None:
+    from fantoch_tpu.observability.perfetto import to_perfetto, validate_perfetto
+    from fantoch_tpu.observability.report import (
+        assemble_spans,
+        monotonic_violations,
+        summarize,
+    )
+    from fantoch_tpu.observability.tracer import read_trace
+
+    with tempfile.TemporaryDirectory() as tmp:
+        a, b = f"{tmp}/a.jsonl", f"{tmp}/b.jsonl"
+        run_sim(a)
+        run_sim(b)
+        with open(a, "rb") as fa, open(b, "rb") as fb:
+            assert fa.read() == fb.read(), "same-seed traces must be byte-identical"
+
+        # the CLI agrees (exit 0 + "identical")
+        proc = subprocess.run(
+            [sys.executable, "-m", "fantoch_tpu.bin.obs", "diff", a, b],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        events = read_trace(a)
+        assert events, "trace must not be empty"
+        spans = assemble_spans(events)
+        assert len(spans) == 3 * 2 * 4, f"span per command, got {len(spans)}"
+        assert not monotonic_violations(spans)
+
+        report = summarize(events)
+        assert report["spans"] == len(spans)
+        assert report["end_to_end"]["count"] == len(spans)
+        assert report["monotonic_violations"] == 0
+
+        perfetto = to_perfetto(events)
+        validate_perfetto(perfetto)
+        # a serialized round-trip still validates (what the viewer loads)
+        validate_perfetto(json.loads(json.dumps(perfetto)))
+
+        out = f"{tmp}/trace.json"
+        proc = subprocess.run(
+            [sys.executable, "-m", "fantoch_tpu.bin.obs", "to-perfetto", a, "-o", out],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        with open(out) as fh:
+            validate_perfetto(json.load(fh))
+
+    print(json.dumps({
+        "metric": "trace_smoke",
+        "spans": len(spans),
+        "end_to_end_p99_ms": report["end_to_end"]["p99_us"] / 1000,
+        "ok": True,
+    }))
+
+
+if __name__ == "__main__":
+    main()
