@@ -3,6 +3,10 @@ batched dependency-graph resolution latency on one chip.
 
 Target (BASELINE.json): < 10 ms.  Prints one JSON line:
 {"metric": ..., "value": N, "unit": "ms", "vs_baseline": target_ms / N}.
+End-to-end serving rides alongside as a second headline triple
+({"serving_metric": "serving_newt_cmds_per_s", "serving_value": N,
+"serving_unit": "cmds/s"} — the depth-K pipelined serving loop,
+ROADMAP item 1).
 
 The workload mirrors the reference's ConflictRate key generator
 (fantoch/src/client/key_gen.rs:8,87-99): with probability 0.5 a command
@@ -304,6 +308,13 @@ def child_main(mode: str) -> None:
         record["table_error"] = repr(exc)[:200]
     try:
         record.update(bench_device_serving())
+        if "serving_newt_cmds_per_s" in record:
+            # end-to-end serving is a HEADLINE metric next to the kernel
+            # p50 (ROADMAP item 1): the pipelined Newt serving loop's
+            # cmds/s, promoted to its own top-level metric triple
+            record["serving_metric"] = "serving_newt_cmds_per_s"
+            record["serving_value"] = record["serving_newt_cmds_per_s"]
+            record["serving_unit"] = "cmds/s"
     except Exception as exc:  # noqa: BLE001
         print(f"# device-serving bench failed: {exc!r}", file=sys.stderr)
         record["serving_error"] = repr(exc)[:200]
@@ -422,7 +433,26 @@ def bench_local_pool(total: int = 1 << 19, conflict: float = 0.5):
             )
             for i, (key_m, dep_m, src_m, seq_m) in enumerate(measured)
         ]
-        all_shards = shards_a + [s for run in shard_runs for s in run]
+        # pipelined pool serving (4w only): the run/pipeline.py
+        # dispatch/drain split at the pool seam — both chunks in flight
+        # so IPC serialization of chunk k+1 overlaps the workers'
+        # ordering of chunk k.  Fresh dot ranges: re-adding measured
+        # dots would violate the committed-once invariant.
+        pipe_runs = []
+        if workers == 4:
+            pipe_runs = [
+                OrderingPool.shard_columns(
+                    key_m, src_m.astype(np.int64),
+                    seq_m.astype(np.int64) + 1 + (i + 3) * total,
+                    dep_m.astype(np.int64), workers,
+                )
+                for i, (key_m, dep_m, src_m, seq_m) in enumerate(
+                    build_workload(total, conflict, seed=s) for s in (24, 25)
+                )
+            ]
+        all_shards = shards_a + [
+            s for run in shard_runs + pipe_runs for s in run
+        ]
         with OrderingPool(workers) as pool:
             pool.prepare(max(len(s[0]) for s in all_shards))
             pool.run_shards(shards_a)  # warm
@@ -434,6 +464,16 @@ def bench_local_pool(total: int = 1 << 19, conflict: float = 0.5):
                 executed = sum(len(src) for src, _ in orders)
                 assert executed == total, f"pool ordered {executed}/{total}"
                 dt = run_dt if dt is None else min(dt, run_dt)
+            if pipe_runs:
+                t0 = time.perf_counter()
+                order_runs = pool.run_shards_pipelined(pipe_runs, depth=1)
+                pipe_dt = time.perf_counter() - t0
+                executed = sum(
+                    len(src) for orders in order_runs for src, _ in orders
+                )
+                want = len(pipe_runs) * total
+                assert executed == want, f"pool ordered {executed}/{want}"
+                out["pool_cmds_per_s_4w_pipelined"] = int(executed / pipe_dt)
         thr[workers] = total / dt
         out[f"pool_ms_{workers}w"] = round(dt * 1000.0, 1)
         out[f"pool_cmds_per_s_{workers}w"] = int(thr[workers])
@@ -893,12 +933,22 @@ def bench_device_serving(
     total: int = 32_768, batch: int = 4096, conflict: float = 0.5, n: int = 3,
     families: Tuple[str, ...] = ("newt", "caesar", "paxos"),
     sweep: bool = True,
+    pipeline_depth: int = None,
 ):
     """The served TPU path (run/device_runner.DeviceDriver): real Command
     objects through the device protocol round — batch assembly, the
     donated-state jit dispatch, and KVStore execution in device order —
     measured as steady-state rounds (first round excluded: it compiles).
     This is the round trip a `--device-step` server pays per batch.
+
+    The HEADLINE serving keys (``serving_newt_round_ms`` /
+    ``serving_newt_cmds_per_s``) measure the depth-K pipelined loop
+    (run/pipeline.py) — what a live ``--device-step`` server actually
+    runs under saturation; the pre-r07 synchronous round is kept as
+    ``serving_newt_sync_*`` so the overlap win stays visible.  Every
+    pipelined row stamps ``serving_pipeline_depth`` and a
+    ``*_idle_frac`` (fraction of the serving span the device sat idle —
+    the dispatch wall the loop exists to amortize).
 
     Also sweeps the compiled batch size (1k/4k/16k): the round cost is
     dispatch-dominated on CPU and sort-dominated on device, so cmds/s
@@ -908,6 +958,16 @@ def bench_device_serving(
 
     from fantoch_tpu.core import Command, Dot, KVOp, Rifl
     from fantoch_tpu.run.device_runner import DeviceDriver
+
+    from fantoch_tpu.run.pipeline import requested_pipeline_depth
+
+    # one-knob resolution shared with the serving loop (arg > env), with
+    # the bench's own default of 2 on top (transfer of round k+1 + emit
+    # of round k-1 overlap compute of round k)
+    depth = requested_pipeline_depth(pipeline_depth)
+    if depth is None:
+        depth = 2
+    assert depth >= 1, f"pipeline depth must be >= 1, got {depth}"
 
     rng = np.random.default_rng(21)
     hot = rng.random(total) < conflict
@@ -922,42 +982,45 @@ def bench_device_serving(
         for i in range(total)
     ]
 
-    def measure(batch_size: int, driver_cls=DeviceDriver):
+    def measure(batch_size: int, driver_cls=DeviceDriver, pipelined=False):
+        """Steady-state serving rounds; ``pipelined`` runs the depth-K
+        loop (dispatch runs ahead; the tail flushes inside the timed
+        region — it serves real commands).  Returns (round_ms, cmds/s,
+        idle_frac) with idle_frac from the driver's overlap counters."""
         driver = driver_cls(n, batch_size=batch_size, key_buckets=8192)
+        driver.pipeline_depth = depth if pipelined else 1
         driver.step(cmds[:batch_size])  # compile + warm
+        step = driver.step_pipelined if pipelined else driver.step
+        # idle_frac must cover only the steady-state timed region, not
+        # the compile round
+        driver.reset_overlap_instrument()
         t0 = time.perf_counter()
         served = 0
         for start in range(batch_size, total, batch_size):
-            served += len(driver.step(cmds[start : start + batch_size]))
+            served += len(step(cmds[start : start + batch_size]))
+        if pipelined:
+            served += len(driver.flush_pipeline())
         wall_ms = (time.perf_counter() - t0) * 1000.0
         rounds = (total - batch_size) // batch_size
         assert served == total - batch_size, f"served {served}/{total}"
-        return round(wall_ms / rounds, 2), int(served / (wall_ms / 1000.0))
+        idle = driver.device_counters().get("device_idle_frac", 0.0)
+        return (
+            round(wall_ms / rounds, 2),
+            int(served / (wall_ms / 1000.0)),
+            idle,
+        )
 
-    def measure_pipelined(batch_size: int):
-        """The saturated serving loop: dispatch round k+1 before draining
-        round k (DeviceDriver.step_pipelined), overlapping the device
-        round with the host emit loop."""
-        driver = DeviceDriver(n, batch_size=batch_size, key_buckets=8192)
-        driver.step(cmds[:batch_size])  # compile + warm
-        t0 = time.perf_counter()
-        served = 0
-        for start in range(batch_size, total, batch_size):
-            served += len(driver.step_pipelined(cmds[start : start + batch_size]))
-        served += len(driver.flush_pipeline())
-        wall_ms = (time.perf_counter() - t0) * 1000.0
-        rounds = (total - batch_size) // batch_size
-        assert served == total - batch_size, f"served {served}/{total}"
-        return round(wall_ms / rounds, 2), int(served / (wall_ms / 1000.0))
-
-    round_ms, cmds_per_s = measure(batch)
-    pipe_ms, pipe_cps = measure_pipelined(batch)
+    round_ms, cmds_per_s, sync_idle = measure(batch)
+    pipe_ms, pipe_cps, pipe_idle = measure(batch, pipelined=True)
     out = {
         "serving_batch": batch,
+        "serving_pipeline_depth": depth,
         "serving_round_ms": round_ms,
         "serving_cmds_per_s": cmds_per_s,
+        "serving_idle_frac": sync_idle,
         "serving_pipelined_round_ms": pipe_ms,
         "serving_pipelined_cmds_per_s": pipe_cps,
+        "serving_pipelined_idle_frac": pipe_idle,
     }
     # the other three consensus families' serving rounds at one batch
     # size — Newt (timestamp proposal + stability), Caesar (timestamp +
@@ -974,9 +1037,31 @@ def bench_device_serving(
         try:
             from fantoch_tpu.run import device_runner as _drivers
 
-            fam_ms, fam_cps = measure(batch, getattr(_drivers, fam_classes[name]))
-            out[f"serving_{name}_round_ms"] = fam_ms
-            out[f"serving_{name}_cmds_per_s"] = fam_cps
+            cls = getattr(_drivers, fam_classes[name])
+            if name == "newt":
+                # the headline family: serving_newt_* IS the pipelined
+                # depth-K loop (redefined r07, the steady-state
+                # redefinition move of table_cmds_per_s_arrays r06); the
+                # synchronous round keeps the old definition as _sync
+                sync_ms, sync_cps, fam_sync_idle = measure(batch, cls)
+                fam_ms, fam_cps, fam_idle = measure(
+                    batch, cls, pipelined=True
+                )
+                out["serving_newt_sync_round_ms"] = sync_ms
+                out["serving_newt_sync_cmds_per_s"] = sync_cps
+                out["serving_newt_sync_idle_frac"] = fam_sync_idle
+                out["serving_newt_round_ms"] = fam_ms
+                out["serving_newt_cmds_per_s"] = fam_cps
+                out["serving_newt_idle_frac"] = fam_idle
+                out["serving_newt_definition"] = (
+                    f"depth-{depth} pipelined serving loop "
+                    "(run/pipeline.py, r07); pre-r07 synchronous round "
+                    "kept as serving_newt_sync_*"
+                )
+            else:
+                fam_ms, fam_cps, _ = measure(batch, cls)
+                out[f"serving_{name}_round_ms"] = fam_ms
+                out[f"serving_{name}_cmds_per_s"] = fam_cps
         except Exception as exc:  # noqa: BLE001
             print(f"# {name} serving bench failed: {exc!r}", file=sys.stderr)
             out[f"serving_{name}_error"] = repr(exc)[:200]
@@ -984,27 +1069,47 @@ def bench_device_serving(
         # chained Newt serving (NewtDeviceDriver.step_chained): S rounds
         # per device dispatch — the serving twin of the fused table
         # rounds, what drops serving_newt_round_ms on dispatch-dominated
-        # rigs.  Needs >= 2 full chains past the warm round.
+        # rigs.  Needs >= 2 full chains past the warm round.  The
+        # _pipelined variant composes S in-dispatch rounds x depth-K
+        # in-flight chains (step_chained_pipelined).
         try:
             out.update(_measure_newt_chained(cmds, total, batch, n))
         except Exception as exc:  # noqa: BLE001
             print(f"# newt chained serving bench failed: {exc!r}", file=sys.stderr)
             out["serving_newt_chained_error"] = repr(exc)[:200]
+        try:
+            out.update(
+                _measure_newt_chained(cmds, total, batch, n, depth=depth)
+            )
+        except Exception as exc:  # noqa: BLE001
+            print(
+                f"# newt chained+pipelined serving bench failed: {exc!r}",
+                file=sys.stderr,
+            )
+            out["serving_newt_chained_pipelined_error"] = repr(exc)[:200]
     if sweep:
         for other in (1024, 16384):
             if total < 2 * other:
                 continue  # needs >= one steady-state round past the warm one
-            ms, cps = measure(other)
+            ms, cps, _ = measure(other)
             out[f"serving_round_ms_{other // 1024}k"] = ms
             out[f"serving_cmds_per_s_{other // 1024}k"] = cps
     return out
 
 
-def _measure_newt_chained(cmds, total: int, batch: int, n: int, chain: int = 3):
-    """Per-round cost of the S-rounds-per-dispatch Newt serving chain."""
+def _measure_newt_chained(
+    cmds, total: int, batch: int, n: int, chain: int = 3, depth: int = 0
+):
+    """Per-round cost of the S-rounds-per-dispatch Newt serving chain;
+    ``depth > 0`` composes it with the depth-K pipeline
+    (step_chained_pipelined: S in-dispatch rounds x K in-flight chain
+    dispatches — chaining amortizes the dispatch round trip, pipelining
+    overlaps the surviving transfer + emit with compute)."""
     from fantoch_tpu.run.device_runner import NewtDeviceDriver
 
     driver = NewtDeviceDriver(n, batch_size=batch, key_buckets=8192)
+    if depth:
+        driver.pipeline_depth = depth
     driver.step(cmds[:batch])  # compile the single-step + warm state
     batches = [
         cmds[start : start + batch] for start in range(batch, total, batch)
@@ -1013,20 +1118,34 @@ def _measure_newt_chained(cmds, total: int, batch: int, n: int, chain: int = 3):
     if n_groups < 2:
         return {}  # not enough rounds for a steady-state chained measure
     groups = [batches[i * chain : (i + 1) * chain] for i in range(n_groups)]
-    driver.step_chained(groups[0])  # compile the chained program
+    run = driver.step_chained_pipelined if depth else driver.step_chained
+    run(groups[0])  # compile the chained program
+    if depth:
+        driver.flush_pipeline()
+    # idle_frac must cover only the steady-state timed region, not the
+    # compile dispatches above
+    driver.reset_overlap_instrument()
     served = 0
     t0 = time.perf_counter()
     for group in groups[1:]:
-        served += len(driver.step_chained(group))
+        served += len(run(group))
+    if depth:
+        served += len(driver.flush_pipeline())
     wall_ms = (time.perf_counter() - t0) * 1000.0
     rounds = (n_groups - 1) * chain
     expected = rounds * batch
     assert served == expected, f"chained served {served}/{expected}"
-    return {
+    prefix = "serving_newt_chained_pipelined" if depth else "serving_newt_chained"
+    out = {
         "serving_newt_chain": chain,
-        "serving_newt_chained_round_ms": round(wall_ms / rounds, 2),
-        "serving_newt_chained_cmds_per_s": int(served / (wall_ms / 1000.0)),
+        f"{prefix}_round_ms": round(wall_ms / rounds, 2),
+        f"{prefix}_cmds_per_s": int(served / (wall_ms / 1000.0)),
     }
+    if depth:
+        out[f"{prefix}_idle_frac"] = driver.device_counters().get(
+            "device_idle_frac", 0.0
+        )
+    return out
 
 
 def _run_child(mode: str, timeout_s: int):
@@ -1239,7 +1358,8 @@ def smoke_main() -> None:
     out.update(bench_table_path(batch=2000, keys=256, n=3, rounds=2))
     out.update(
         bench_device_serving(
-            total=1024, batch=256, families=("newt",), sweep=False
+            total=1024, batch=256, families=("newt",), sweep=False,
+            pipeline_depth=2,
         )
     )
     out["jax_recompiles"] = recompile_count()
@@ -1247,6 +1367,18 @@ def smoke_main() -> None:
     assert out["table_cmds_per_s_plane"] > 500, out
     assert out["serving_newt_cmds_per_s"] > 100, out
     assert out["table_plane_dispatches"] > 0, out
+    # the depth-2 pipelined serving loop: pipelined throughput must not
+    # regress below the synchronous round (0.6x slack: CI hosts are slow,
+    # shared, and CPU "device" rounds compete with the emit loop for the
+    # same cores), and the overlap instrument must be present and sane
+    assert out["serving_pipeline_depth"] == 2, out
+    assert out["serving_newt_sync_cmds_per_s"] > 100, out
+    assert (
+        out["serving_newt_cmds_per_s"]
+        >= 0.6 * out["serving_newt_sync_cmds_per_s"]
+    ), out
+    assert 0.0 <= out["serving_newt_idle_frac"] <= 1.0, out
+    assert 0.0 <= out["serving_newt_sync_idle_frac"] <= 1.0, out
     print(json.dumps(out))
 
 

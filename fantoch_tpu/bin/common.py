@@ -74,6 +74,11 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--skip-fast-ack", action="store_true")
     parser.add_argument("--batched-graph-executor", action="store_true",
                         help="order committed commands with the batched device resolver")
+    parser.add_argument("--serving-pipeline-depth", type=int, default=None,
+                        metavar="K",
+                        help="device serving pipeline depth (run/pipeline.py): "
+                        "dispatched-but-undrained rounds kept in flight; "
+                        "default FANTOCH_SERVING_PIPELINE_DEPTH env, else 1")
 
 
 def config_from_args(args: argparse.Namespace):
@@ -95,6 +100,7 @@ def config_from_args(args: argparse.Namespace):
         caesar_wait_condition=args.caesar_wait_condition,
         skip_fast_ack=args.skip_fast_ack,
         batched_graph_executor=args.batched_graph_executor,
+        serving_pipeline_depth=args.serving_pipeline_depth,
     )
 
 

@@ -61,8 +61,44 @@ def cmd_summarize(args) -> int:
             f"{row['p95_us'] / 1000:>9.2f}m"
             f"{row['p99_us'] / 1000:>9.2f}m"
         )
-    for name, value in sorted(out.get("device_counters", {}).items()):
+    counters = out.get("device_counters", {})
+    for name, value in sorted(counters.items()):
         print(f"counter {name} = {value}")
+    _print_overlap(counters)
+    return 0
+
+
+def _print_overlap(counters) -> int:
+    """One-line dispatch/drain overlap readout from the per-dispatch
+    device counters (run/pipeline.py): how the serving wall split
+    between host batch assembly (dispatch), host drain (fetch + emit),
+    and device-busy time — and the ``device_idle_frac`` the pipelined
+    loop is meant to drive toward 0."""
+    from fantoch_tpu.observability.device import derive_idle_frac
+
+    if not any(k in counters for k in ("device_dispatch_ms", "device_busy_ms")):
+        return 0
+    counters = derive_idle_frac(dict(counters))
+    dispatch = counters.get("device_dispatch_ms", 0.0)
+    drain = counters.get("device_drain_ms", 0.0)
+    fetch = counters.get("device_fetch_ms", 0.0)
+    busy = counters.get("device_busy_ms", 0.0)
+    span = counters.get("device_span_ms", 0.0)
+    parts = [
+        f"dispatch {dispatch:.1f}ms",
+        f"drain {drain:.1f}ms (fetch {fetch:.1f}ms)",
+    ]
+    if span:
+        parts.append(f"device busy {busy:.1f}ms of {span:.1f}ms span")
+    if "device_idle_frac" in counters:
+        parts.append(f"idle_frac {counters['device_idle_frac']:.3f}")
+    depth = counters.get("device_pipeline_depth")
+    if depth:
+        parts.append(f"depth {int(depth)}")
+    pipelined = counters.get("device_pipelined_rounds")
+    if pipelined is not None:
+        parts.append(f"pipelined_rounds {int(pipelined)}")
+    print("device overlap: " + "  ".join(parts))
     return 0
 
 

@@ -59,8 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--device-pipeline", choices=["auto", "on", "off"], default="auto",
         help="dispatch/drain overlap for saturated serving (auto = on for "
-        "non-CPU backends; overlap needs a compute resource besides the "
-        "host cores)")
+        "non-CPU backends, or whenever a pipeline depth was requested; "
+        "overlap needs a compute resource besides the host cores).  The "
+        "in-flight depth is the --serving-pipeline-depth config flag "
+        "(one knob: flag > FANTOCH_SERVING_PIPELINE_DEPTH env > 1)")
     parser.add_argument("--device-pending", type=int, default=256,
                         help="device pending-buffer capacity")
     parser.add_argument(

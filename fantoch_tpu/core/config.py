@@ -103,6 +103,15 @@ class Config:
     # skip sending MCollectAck to the coordinator when the process is in the
     # fast quorum and the coordinator will ack anyway
     skip_fast_ack: bool = False
+    # device serving pipeline depth (run/pipeline.py): how many
+    # dispatched-but-undrained device rounds the serving loop keeps in
+    # flight, overlapping host<->device transfer and result emit with
+    # device compute (depth K = K rounds of delivery lag).  None = the
+    # FANTOCH_SERVING_PIPELINE_DEPTH env var, else 1 (the classic
+    # double-buffered overlap); an explicit value also opts the
+    # DeviceRuntime into pipelining on CPU backends (new knob; no
+    # reference counterpart — the reference's runner is message-at-a-time)
+    serving_pipeline_depth: Optional[int] = None
     # per-dot lifecycle tracing (fantoch_tpu/observability): fraction of
     # commands traced, selected by a deterministic hash of the command id
     # (same seed => same sampled dot set).  0.0 disables tracing entirely
@@ -117,6 +126,14 @@ class Config:
             raise ValueError("n must be positive")
         if self.f > self.n:
             raise ValueError(f"f = {self.f} must not exceed n = {self.n}")
+        if (
+            self.serving_pipeline_depth is not None
+            and self.serving_pipeline_depth < 1
+        ):
+            raise ValueError(
+                f"serving_pipeline_depth = {self.serving_pipeline_depth} "
+                "must be >= 1"
+            )
         if self.device_table_plane and self.newt_clock_bump_interval_ms is not None:
             # real-time clock bumps vote wall-clock micros, which overflow
             # the plane's 31-bit device-clock window (ops/table_ops.py)

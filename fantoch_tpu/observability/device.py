@@ -53,12 +53,45 @@ def recompile_count() -> int:
     return _recompiles
 
 
+# fold semantics per counter kind: most keys are monotone tallies and
+# SUM across executors; gauges would be nonsense summed — ratios are
+# dropped (derive_idle_frac recomputes from the folded walls) and
+# configuration gauges fold by max
+_RATIO_KEYS = frozenset({"device_idle_frac"})
+_GAUGE_MAX_KEYS = frozenset({"device_pipeline_depth"})
+
+
 def merge_counters(
     into: Dict[str, float], add: Optional[Dict[str, float]]
 ) -> Dict[str, float]:
     """Accumulate one executor's counter dict into a process-level one
-    (sums; used by the metrics snapshot fold)."""
+    (used by the metrics snapshot fold): tallies sum, ratio keys are
+    skipped (:func:`derive_idle_frac` recomputes them from the folded
+    busy/span walls), configuration gauges (pipeline depth) fold by
+    max."""
     if add:
         for name, value in add.items():
-            into[name] = into.get(name, 0) + value
+            if name in _RATIO_KEYS:
+                continue
+            if name in _GAUGE_MAX_KEYS:
+                into[name] = max(into.get(name, 0), value)
+            else:
+                into[name] = into.get(name, 0) + value
     return into
+
+
+def derive_idle_frac(counters: Dict[str, float]) -> Dict[str, float]:
+    """Recompute ``device_idle_frac`` from (possibly folded)
+    ``device_busy_ms`` / ``device_span_ms`` wall totals: the fraction of
+    the serving span the device sat idle waiting on host assembly/emit —
+    the number the pipelined serving loop (run/pipeline.py) exists to
+    drive toward 0.  Spans of co-hosted executors overlap in wall time,
+    so after a fold this is an approximation (busy and span inflate
+    together); per-driver counters are exact."""
+    span = counters.get("device_span_ms", 0.0)
+    if span and span > 0:
+        busy = counters.get("device_busy_ms", 0.0)
+        counters["device_idle_frac"] = round(
+            max(0.0, 1.0 - busy / span), 4
+        )
+    return counters

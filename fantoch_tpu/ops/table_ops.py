@@ -211,6 +211,23 @@ def resident_clock_proposal(
     return _proposal_core(prior, key, min_clock)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def resident_clock_bump(
+    prior: jax.Array,  # int32[K], DONATED — stays device-resident
+    idx: jax.Array,  # int32[M] — bumped buckets (pad rows use K-1)
+    clock: jax.Array,  # int32[M] — bumped-to clock per bucket (pad: 0)
+):
+    """Fold host-side scalar clock bumps into the resident key-clock
+    table WITHOUT dropping residency: a scatter-max of the bumped
+    buckets' new clocks (bumps are monotone, so max == set here, and max
+    keeps pad rows harmless).  This is what keeps live Newt's scalar
+    detached-bumps between submit batches from degrading the proposal
+    path to upload-per-batch: the table stays on device and only the
+    O(bumps) columns cross the host boundary (the BENCH_DEV round-6
+    "device-side bump kernel" note, shipped)."""
+    return prior.at[idx].max(clock)
+
+
 @functools.partial(jax.jit, static_argnames=("threshold",))
 def stable_clocks(frontiers: jax.Array, *, threshold: int) -> jax.Array:
     """Stable clock per key: the ``(n - threshold)``-th smallest of the n

@@ -43,20 +43,22 @@ class BatchedKeyClocks:
     across batches (``ops/table_ops.resident_clock_proposal`` with a
     donated prior): successive ``proposal_batch_arrays`` calls never
     re-upload or re-download the table.  The host ``_clocks`` mirror goes
-    stale while the device copy leads; any scalar-path access
-    (``proposal``/``detached``/``detached_all``) re-materializes the host
-    view and drops the device table (rebuilt lazily on the next batch).
-    Live Newt interleaves scalar detached-bumps between submit batches,
-    so THERE the proposal path degrades to upload-per-batch (the pre-
-    resident behavior, never worse); uninterrupted residency is the
-    executor-plane / fused-chain / device-serving regime.  Holding it
-    across scalar bumps would need a device-side bump kernel that
-    returns the generated vote ranges (see BENCH_DEV round 6).
+    stale while the device copy leads; scalar-path accesses
+    (``proposal``/``detached``/``detached_all``) re-sync the host view
+    but KEEP the device table resident — their bumps are recorded and
+    folded into the next batch dispatch as one O(bumps) scatter-max
+    (``ops/table_ops.resident_clock_bump``, donated), so live Newt's
+    scalar detached-bumps between submit batches no longer degrade the
+    proposal path to upload-per-batch (the pre-r07 regression BENCH_DEV
+    round 6 documented).  The device copy is dropped only when the key
+    registry outgrows its capacity, on pickling, and when a genuine
+    31-bit overflow forces the sequential fallback.
     """
 
     __slots__ = (
         "process_id", "shard_id", "_key_index", "_keys", "_clocks", "_count",
         "_dev_prior", "_dev_kcap", "_host_stale", "_host_max",
+        "_pending_bumps", "resident_uploads",
     )
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId):
@@ -73,34 +75,51 @@ class BatchedKeyClocks:
         # window guard must not read the device table, so the bound is
         # maintained incrementally and tightened at materialize time
         self._host_max = 0
+        # scalar bumps applied to the host mirror while the device table
+        # stays resident: bucket -> bumped-to clock, folded into the next
+        # batch dispatch (scatter-max) and cleared
+        self._pending_bumps: Dict[int, int] = {}
+        # full-table uploads (first build + capacity regrows + rebuilds
+        # after a drop) — the residency regression instrument: steady
+        # state holds this at 1 however many scalar bumps interleave
+        self.resident_uploads = 0
+
+    def _sync_host(self) -> None:
+        """Refresh the host mirror from the resident device table WITHOUT
+        dropping it (scalar paths need current clock values; their bumps
+        ride ``_pending_bumps`` back to the device).  Buckets registered
+        after the last batch hold 0 on both sides; the device table's
+        last slot is the pad bucket and is never copied.  ``_host_max``
+        is NOT tightened here: it still bounds the resident pad bucket's
+        accumulated garbage."""
+        if self._dev_prior is not None and self._host_stale:
+            import jax
+
+            dev = np.asarray(jax.device_get(self._dev_prior)).astype(np.int64)
+            # never copy the device table's LAST slot: it is the pad
+            # bucket, whose clock accumulates garbage from pad rows.
+            # A real key at that index can only have registered after
+            # the last dispatch (dispatch guarantees real indices
+            # <= len(dev) - 2), so its live clock is the host's 0
+            take = min(self._count, len(dev) - 1)
+            self._clocks[:take] = dev[:take]
+            self._host_stale = False
 
     def _materialize_host(self) -> None:
-        """Sync the host mirror from the resident device table and drop
-        the device copy (the caller is about to read or mutate host
-        state).  Buckets registered after the last batch hold 0 on both
-        sides; the device table's last slot is the pad bucket and is
-        never copied."""
+        """Sync the host mirror and DROP the device copy (the caller is
+        about to rebuild it, pickle, or fall back to the sequential
+        path).  Pending scalar bumps are already in the host mirror, so
+        they die with the device copy; the window bound tightens to the
+        actual table max (pad-bucket garbage is dropped here)."""
+        self._sync_host()
         if self._dev_prior is not None:
-            if self._host_stale:
-                import jax
-
-                dev = np.asarray(jax.device_get(self._dev_prior)).astype(np.int64)
-                # never copy the device table's LAST slot: it is the pad
-                # bucket, whose clock accumulates garbage from pad rows.
-                # A real key at that index can only have registered after
-                # the last dispatch (dispatch guarantees real indices
-                # <= len(dev) - 2), so its live clock is the host's 0
-                take = min(self._count, len(dev) - 1)
-                self._clocks[:take] = dev[:take]
-                self._host_stale = False
-                # tighten the incrementally-grown window bound to the
-                # actual table max (pad-bucket garbage is dropped here)
-                if self._count:
-                    self._host_max = int(self._clocks[: self._count].max())
-                else:
-                    self._host_max = 0
             self._dev_prior = None
             self._dev_kcap = 0
+            if self._count:
+                self._host_max = int(self._clocks[: self._count].max())
+            else:
+                self._host_max = 0
+        self._pending_bumps.clear()
 
     def __getstate__(self):
         # device buffers don't pickle (sim snapshots / the model checker):
@@ -113,6 +132,9 @@ class BatchedKeyClocks:
         }
 
     def __setstate__(self, state):
+        # pre-r07 pickles lack the residency-fix fields
+        self._pending_bumps = {}
+        self.resident_uploads = 0
         for k, v in state.items():
             setattr(self, k, v)
         self._dev_prior = None
@@ -141,30 +163,33 @@ class BatchedKeyClocks:
     # --- scalar SequentialKeyClocks interface ---
 
     def proposal(self, cmd: Command, min_clock: int) -> Tuple[int, Votes]:
-        self._materialize_host()
+        self._sync_host()
         clock = max(min_clock, self._cmd_clock(cmd) + 1)
         votes = Votes()
         self.detached(cmd, clock, votes)
         return clock, votes
 
     def detached(self, cmd: Command, up_to: int, votes: Votes) -> None:
-        self._materialize_host()
+        self._sync_host()
         for key in cmd.keys(self.shard_id):
             self._maybe_bump(key, up_to, votes)
 
     def detached_all(self, up_to: int, votes: Votes) -> None:
         # vectorized sweep over every registered key (the clock-bump event
         # touches the whole table, newt.rs:983-1006)
-        self._materialize_host()
+        self._sync_host()
         self._host_max = max(self._host_max, up_to)
         count = self._count
         current = self._clocks[:count]
         behind = np.nonzero(current < up_to)[0]
+        resident = self._dev_prior is not None
         for idx in behind.tolist():
             votes.add(
                 self._keys[idx],
                 VoteRange(self.process_id, int(current[idx]) + 1, up_to),
             )
+            if resident:
+                self._pending_bumps[idx] = up_to
         current[behind] = up_to
 
     @classmethod
@@ -185,6 +210,10 @@ class BatchedKeyClocks:
             self._clocks[idx] = up_to
             if up_to > self._host_max:
                 self._host_max = up_to
+            if self._dev_prior is not None:
+                # the resident device table still holds `current`; the
+                # next batch dispatch folds this bump in (scatter-max)
+                self._pending_bumps[idx] = up_to
 
     # --- the batched proposal seam ---
 
@@ -279,6 +308,27 @@ class BatchedKeyClocks:
             # persistent compile cache)
             self._dev_prior = jnp.array(prior)
             self._dev_kcap = kcap
+            self.resident_uploads += 1
+        elif self._pending_bumps:
+            # scalar bumps interleaved since the last batch: fold them
+            # into the resident table as ONE donated scatter-max —
+            # O(bumps) host->device traffic instead of the full-table
+            # re-upload the pre-r07 scalar path paid.  No rebuild above
+            # means every bumped bucket is < _dev_kcap - 1 (the pad
+            # slot), so the scatter stays inside the real region.
+            from fantoch_tpu.ops.table_ops import resident_clock_bump
+
+            items = sorted(self._pending_bumps.items())
+            m = len(items)
+            mcap = _pow2(m)
+            bidx = np.full(mcap, self._dev_kcap - 1, dtype=np.int32)
+            bval = np.zeros(mcap, dtype=np.int32)
+            bidx[:m] = [i for i, _ in items]
+            bval[:m] = [v for _, v in items]
+            self._dev_prior = resident_clock_bump(
+                self._dev_prior, jnp.asarray(bidx), jnp.asarray(bval)
+            )
+            self._pending_bumps.clear()
         pk = np.full(bcap, self._dev_kcap - 1, dtype=np.int32)  # pad bucket
         pm = np.zeros(bcap, dtype=np.int32)
         pk[:batch] = idx_list

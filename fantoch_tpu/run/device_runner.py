@@ -45,9 +45,8 @@ CommandResult over that same connection.
 from __future__ import annotations
 
 import asyncio
-import time
 from collections import deque
-from typing import Any, Deque, Dict, List, Optional, Tuple
+from typing import Any, Deque, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -57,6 +56,11 @@ from fantoch_tpu.core.ids import ClientId, Dot, ProcessId, Rifl, ShardId
 from fantoch_tpu.core.kvs import KVStore
 from fantoch_tpu.executor.aggregate import AggregatePending
 from fantoch_tpu.executor.base import ExecutorResult
+from fantoch_tpu.run.pipeline import (
+    PipelineCore,
+    requested_pipeline_depth,
+    resolve_pipeline_depth,
+)
 from fantoch_tpu.run.prelude import (
     ClientHi,
     ClientHiAck,
@@ -139,11 +143,13 @@ def _bucket_row(
     return buckets
 
 
-class _DriverCore:
+class _DriverCore(PipelineCore):
     """The host-side machinery every device driver shares: the in-flight
     command registry, the overflow requeue channel, the KVStore, the
-    serving tallies (the BaseProcess metrics twin), and the 31-bit
-    dot-sequence window.  Keeping it in one place keeps the three
+    serving tallies (the BaseProcess metrics twin), the 31-bit
+    dot-sequence window, and — via :class:`PipelineCore`
+    (run/pipeline.py) — the depth-K dispatch/drain pipeline with its
+    staging ingest ring.  Keeping it in one place keeps the four
     protocol drivers from silently diverging on the registry/requeue
     contract.
 
@@ -182,105 +188,22 @@ class _DriverCore:
         self.slow_paths = 0
         self.executed = 0
         self.stable_watermark = 0
-        # per-dispatch observability (observability/device.py):
-        # dispatched_rows vs dispatches*batch_size is the batch occupancy;
-        # dispatch/drain wall-ms split host assembly from device wait
-        self.dispatches = 0
-        self.dispatched_rows = 0
-        self.dispatch_wall_ms = 0.0
-        self.drain_wall_ms = 0.0
-        # dispatch/drain pipelining (drivers implementing the
-        # dispatch()/drain() split get step/step_pipelined for free)
-        self._outstanding = None  # dispatched-but-undrained round token
-        self.pipelined_rounds = 0  # rounds whose dispatch overlapped a drain
-        # rounds dispatched and not yet entered drain — during a drain
-        # this counts OTHER in-flight rounds (unlike has_outstanding,
-        # which is False mid-flush even with round k+1 dispatched), so
-        # rebase paths can assert nothing is in flight
-        self._undrained = 0
+        # the depth-K dispatch/drain pipeline + staging ingest ring +
+        # per-dispatch counters (step/step_pipelined/flush_pipeline and
+        # _staging come from PipelineCore; drivers implement the
+        # dispatch()/drain() split)
+        self._init_pipeline()
 
     @property
     def in_flight(self) -> int:
         """Commands registered but not yet executed (device pending)."""
         return len(self._cmds)
 
-    # --- dispatch/drain pipelining scaffold (shared by every driver
-    # that implements the dispatch()/drain() split) ---
-
-    @property
-    def has_outstanding(self) -> bool:
-        """A dispatched-but-undrained pipelined round exists."""
-        return self._outstanding is not None
-
-    def step(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
-        """One synchronous round: flush any pipelined round, dispatch,
-        drain."""
-        results = self.flush_pipeline()
-        tok = self._dispatch_tracked(batch)
-        results.extend(self._drain_tracked(tok))
-        return results
-
-    def step_pipelined(self, batch: List[Tuple[Dot, Command]]) -> List[ExecutorResult]:
-        """Dispatch ``batch`` as round k+1, then drain round k (the
-        previously dispatched round) and return ITS results — one round
-        of delivery lag in exchange for overlapping device compute with
-        the host emit loop.  Call ``flush_pipeline`` to retire the final
-        round."""
-        if self._outstanding is not None and self._pipeline_flush_needed(batch):
-            # an epoch/window rebase would invalidate the in-flight
-            # round's identity or clock accounting — retire it first
-            # (rare: once per int32 window)
-            early = self.flush_pipeline()
-            self._outstanding = self._dispatch_tracked(batch)
-            return early
-        tok = self._dispatch_tracked(batch)
-        if self._outstanding is not None:
-            self.pipelined_rounds += 1
-        results = self.flush_pipeline()
-        self._outstanding = tok
-        return results
-
-    def flush_pipeline(self) -> List[ExecutorResult]:
-        """Drain the outstanding pipelined round, if any."""
-        if self._outstanding is None:
-            return []
-        tok, self._outstanding = self._outstanding, None
-        return self._drain_tracked(tok)
-
-    def _dispatch_tracked(self, batch):
-        t0 = time.perf_counter()
-        tok = self.dispatch(batch)
-        self.dispatch_wall_ms += (time.perf_counter() - t0) * 1000.0
-        self.dispatches += 1
-        self.dispatched_rows += len(batch)
-        self._undrained += 1
-        return tok
-
-    def _drain_tracked(self, tok):
-        self._undrained -= 1  # inside drain, _undrained = OTHER in-flight
-        t0 = time.perf_counter()
-        out = self.drain(tok)
-        self.drain_wall_ms += (time.perf_counter() - t0) * 1000.0
-        return out
-
-    def device_counters(self) -> Dict[str, float]:
-        """Per-dispatch tallies for the metrics snapshot / bench rows:
-        occupancy = dispatched_rows / (dispatches * batch_size)."""
-        return {
-            "device_dispatches": self.dispatches,
-            "device_dispatched_rows": self.dispatched_rows,
-            "device_batch_capacity": self.dispatches * self.batch_size,
-            "device_dispatch_ms": round(self.dispatch_wall_ms, 3),
-            "device_drain_ms": round(self.drain_wall_ms, 3),
-            "device_pipelined_rounds": self.pipelined_rounds,
-            "device_seq_epochs": self.seq_epochs,
-        }
-
     def _pipeline_flush_needed(self, batch) -> bool:
         """True when the upcoming dispatch may trigger a rebase that
-        must not happen with a round in flight.  Every driver's dot
-        drivers share the sequence-window trigger; drivers add their own
-        (gid epoch, clock window)."""
+        must not happen with rounds in flight.  The dot drivers all
+        share the sequence-window trigger; drivers add their own
+        (gid epoch, clock window, slot log)."""
         if not batch:
             return False
         top = max(dot.sequence for dot, _ in batch) - self._seq_base
@@ -325,9 +248,11 @@ class _DriverCore:
         assert len(batch) <= self.batch_size
         self._ensure_seq_window(batch)
         b = self.batch_size
-        key = np.full((b, self.key_width), KEY_PAD, dtype=np.int32)
-        src = np.zeros(b, dtype=np.int32)
-        seq = np.zeros(b, dtype=np.int32)
+        key, src, seq = self._staging(
+            ("key", (b, self.key_width), np.int32, KEY_PAD),
+            ("src", (b,), np.int32, 0),
+            ("seq", (b,), np.int32, 0),
+        )
         self._assemble_rows(batch, key, src, seq)
 
         self._state, out = self._step(
@@ -433,6 +358,12 @@ class _DriverCore:
         top = max(dot.sequence for dot, _ in batch) - self._seq_base
         if top < self.SEQ_WINDOW_MAX:
             return
+        # the rebase rewrites device-resident sequence columns an
+        # in-flight round still references; _pipeline_flush_needed
+        # shares the trigger, so pipelined paths flushed already
+        assert self._undrained == 0, (
+            "dot-sequence window advance with a pipelined round in flight"
+        )
         live = [dot.sequence for dot, _ in batch]
         live += [dot.sequence for dot, _ in self._cmds.values()]
         live += [dot.sequence for dot, _ in self._requeue]
@@ -526,6 +457,16 @@ class _DriverCore:
             for entry in self._cmds.values()
             for dot in (entry[0],)
         }
+
+
+class _ChainToken(NamedTuple):
+    """Round token for an S-rounds-in-one-dispatch chain
+    (``NewtDeviceDriver.step_chained``): the un-fetched device outputs
+    plus the chain length, so the pipeline can carry whole chains in
+    flight and the drain can slice per-round outputs after ONE fetch."""
+
+    outs: Any
+    rounds: int
 
 
 class DeviceDriver(_DriverCore):
@@ -686,11 +627,13 @@ class DeviceDriver(_DriverCore):
         from fantoch_tpu.parallel.mesh_step import KEY_PAD
 
         b = self.batch_size
-        key = np.full((b, self.key_width), KEY_PAD, dtype=np.int32)
-        src = np.zeros(b, dtype=np.int32)
-        seq = np.zeros(b, dtype=np.int32)
+        key, src, seq = self._staging(
+            ("key", (b, self.key_width), np.int32, KEY_PAD),
+            ("src", (b,), np.int32, 0),
+            ("seq", (b,), np.int32, 0),
+        )
         if self._next_gid + b >= self.GID_RESET_THRESHOLD:
-            assert self._outstanding is None, (
+            assert self._undrained == 0, (
                 "gid epoch reset with a pipelined round in flight; "
                 "flush_pipeline first"
             )
@@ -718,14 +661,9 @@ class DeviceDriver(_DriverCore):
     def drain(self, out) -> List[ExecutorResult]:
         """Fetch one round's outputs and execute its resolved commands
         in device order against the KVStore."""
-        import jax
-
-        # one pytree fetch: device_get issues async copies for every output
-        # leaf before blocking, so the round pays ONE device->host round
-        # trip instead of one per field (through a remote-dispatch tunnel
-        # each blocking np.asarray costs a full ~76 ms round trip —
-        # measured as ~7x the serving-round wall time, BENCH_DEV round 5)
-        out = jax.device_get(out)
+        # one pytree fetch, one device->host round trip, and the
+        # busy/idle bookkeeping point (PipelineCore._fetch)
+        out = self._fetch(out)
 
         order = np.asarray(out.order)
         resolved = np.asarray(out.resolved)
@@ -868,11 +806,14 @@ class NewtDeviceDriver(_DriverCore):
         # drain may advance the clock window only with nothing in
         # flight (an in-flight round's clocks are in pre-shift units);
         # per-bucket clocks grow by at most the working-set size per
-        # round, so a one-working-set margin guarantees the next drain
-        # stays under the threshold while a round is outstanding
+        # round, so a margin of one working set per in-flight round
+        # (chains count their S rounds) plus the upcoming one guarantees
+        # every drain stays under the threshold while rounds are
+        # outstanding
         work = self._pend_cap + self.batch_size
+        margin = (self._undrained_rounds + 1) * work
         return (
-            self._max_clock + work >= self.CLOCK_RESET_THRESHOLD
+            self._max_clock + margin >= self.CLOCK_RESET_THRESHOLD
             or super()._pipeline_flush_needed(batch)
         )
 
@@ -881,45 +822,40 @@ class NewtDeviceDriver(_DriverCore):
         token for ``drain``."""
         return self._dispatch_dot_keyed(batch)
 
-    def step_chained(
+    def _chain_windows_blocked(
         self, batches: List[List[Tuple[Dot, Command]]]
-    ) -> List[ExecutorResult]:
-        """S rounds in ONE device dispatch
-        (parallel/mesh_step.jit_newt_multi_step): the host assembles all
-        S rounds' key/src/seq columns up front, the replica state threads
-        round-to-round on device via ``lax.scan``, and the chain pays a
-        single dispatch round-trip — on dispatch-dominated rigs (remote
-        tunnels: ~68 ms of a 71 ms round) per-round cost drops toward
-        kernel time, the serving twin of the votes-table plane's
-        ``fused_table_rounds``.
-
-        A mid-chain clock-window rebase cannot happen inside one
-        dispatch, so chains that could cross the reset threshold fall
-        back to per-round steps (which rebase in drain as usual)."""
-        import jax
-        import jax.numpy as jnp
-
-        from fantoch_tpu.parallel import mesh_step
-        from fantoch_tpu.parallel.mesh_step import KEY_PAD, NewtStepOutput
-
-        results = self.flush_pipeline()
+    ) -> bool:
+        """True when a window rebase (clock or dot-sequence) could land
+        mid-chain — inside one dispatch no rebase can happen, so such
+        chains must take the per-round path (which rebases in drain as
+        usual).  The clock margin counts every round still in flight
+        plus this chain's S."""
         S = len(batches)
-        if S == 0:
-            return results
         work = self._pend_cap + self.batch_size
         top = max(
             (d.sequence for batch in batches for d, _ in batch), default=0
         ) - self._seq_base
-        if (
-            self._max_clock + S * work >= self.CLOCK_RESET_THRESHOLD
+        return (
+            self._max_clock + (self._undrained_rounds + S) * work
+            >= self.CLOCK_RESET_THRESHOLD
             or top >= self.SEQ_WINDOW_MAX
-        ):
-            # a window rebase (clock or dot-sequence) would have to land
-            # mid-chain — take the per-round path, which rebases in drain
-            for batch in batches:
-                results.extend(self.step(batch))
-            return results
+        )
+
+    def _dispatch_chain(self, batches: List[List[Tuple[Dot, Command]]]):
+        """Assemble + dispatch S rounds as ONE device program
+        (parallel/mesh_step.jit_newt_multi_step, compiled per chain
+        length on first use); returns the chain token for ``drain``.
+        The caller checked ``_chain_windows_blocked`` first."""
+        import jax.numpy as jnp
+
+        from fantoch_tpu.parallel import mesh_step
+        from fantoch_tpu.parallel.mesh_step import KEY_PAD
+
+        S = len(batches)
         b = self.batch_size
+        # chains allocate fresh staging (shape varies with S and chains
+        # already amortize the dispatch; the ring serves the per-round
+        # hot path)
         keys = np.full((S, b, self.key_width), KEY_PAD, dtype=np.int32)
         srcs = np.zeros((S, b), dtype=np.int32)
         seqs = np.zeros((S, b), dtype=np.int32)
@@ -937,24 +873,84 @@ class NewtDeviceDriver(_DriverCore):
             jnp.asarray(seqs),
         )
         self.rounds += S
-        # ONE device->host round trip for the whole chain, then the
-        # per-round host drains run over sliced numpy views
-        outs = jax.device_get(outs)
-        for r in range(S):
-            results.extend(
-                self.drain(NewtStepOutput(*(np.asarray(a)[r] for a in outs)))
-            )
+        return _ChainToken(outs, S)
+
+    def _token_rounds(self, tok) -> int:
+        return tok.rounds if isinstance(tok, _ChainToken) else 1
+
+    def step_chained(
+        self, batches: List[List[Tuple[Dot, Command]]]
+    ) -> List[ExecutorResult]:
+        """S rounds in ONE device dispatch: the host assembles all S
+        rounds' key/src/seq columns up front, the replica state threads
+        round-to-round on device via ``lax.scan``, and the chain pays a
+        single dispatch round-trip — on dispatch-dominated rigs (remote
+        tunnels: ~68 ms of a 71 ms round) per-round cost drops toward
+        kernel time, the serving twin of the votes-table plane's
+        ``fused_table_rounds``."""
+        results = self.flush_pipeline()
+        S = len(batches)
+        if S == 0:
+            return results
+        if self._chain_windows_blocked(batches):
+            for batch in batches:
+                results.extend(self.step(batch))
+            return results
+        tok = self._track_dispatch(
+            lambda: self._dispatch_chain(batches),
+            sum(len(b) for b in batches),
+            S * self.batch_size,
+            S,
+        )
+        results.extend(self._drain_tracked(tok))
         return results
 
-    def drain(self, out) -> List[ExecutorResult]:
-        """Fetch one round's outputs, advance watermark/clock-window
-        bookkeeping, and execute its stable commands in (clock, dot)
-        order."""
-        import jax
+    def step_chained_pipelined(
+        self, batches: List[List[Tuple[Dot, Command]]]
+    ) -> List[ExecutorResult]:
+        """The composed serving mode: S in-dispatch rounds per chain x
+        up to ``pipeline_depth`` chains in flight — chaining amortizes
+        the dispatch round trip, pipelining overlaps the surviving
+        transfer + host emit with device compute.  Results arrive up to
+        ``pipeline_depth`` chains late; ``flush_pipeline`` retires the
+        tail.  Chains that could cross a window rebase flush and fall
+        back to synchronous per-round steps."""
+        S = len(batches)
+        if S == 0:
+            return []
+        if self._chain_windows_blocked(batches):
+            results = self.flush_pipeline()
+            for batch in batches:
+                results.extend(self.step(batch))
+            return results
+        return self._pipeline_dispatch(
+            lambda: self._dispatch_chain(batches),
+            sum(len(b) for b in batches),
+            S * self.batch_size,
+            S,
+        )
 
-        # one pytree fetch, one device->host round trip (see DeviceDriver)
-        out = jax.device_get(out)
+    def drain(self, tok) -> List[ExecutorResult]:
+        """Fetch one round token's outputs (a single round or a whole
+        chain — ONE device->host round trip either way) and execute its
+        stable commands in (clock, dot) order."""
+        from fantoch_tpu.parallel.mesh_step import NewtStepOutput
 
+        if isinstance(tok, _ChainToken):
+            outs = self._fetch(tok.outs)
+            results: List[ExecutorResult] = []
+            for r in range(tok.rounds):
+                results.extend(
+                    self._drain_round(
+                        NewtStepOutput(*(np.asarray(a)[r] for a in outs))
+                    )
+                )
+            return results
+        return self._drain_round(self._fetch(tok))
+
+    def _drain_round(self, out) -> List[ExecutorResult]:
+        """One (already fetched) round's drain: advance watermark /
+        clock-window bookkeeping and execute its stable commands."""
         device_wm = int(out.stable_watermark)
         # overflow trigger = the MAX committed clock (a hot key's clock
         # races ahead while cold keys pin the min watermark); the rebase
@@ -1060,10 +1056,8 @@ class CaesarDeviceDriver(_DriverCore):
     def drain(self, out) -> List[ExecutorResult]:
         """Fetch one round's outputs and execute its wait-cleared
         commands in (clock, dot) order."""
-        import jax
-
-        # one pytree fetch, one device->host round trip (see DeviceDriver)
-        out = jax.device_get(out)
+        # one pytree fetch, one device->host round trip (PipelineCore)
+        out = self._fetch(out)
 
         wm = int(out.watermark)
         if wm >= self.CLOCK_GUARD:
@@ -1181,9 +1175,12 @@ class PaxosDeviceDriver(_DriverCore):
 
     def _pipeline_flush_needed(self, batch) -> bool:
         # a slot-epoch reset replaces next_slot/frontier/pending state
-        # that an in-flight round's outputs reference pre-rebase
+        # that an in-flight round's outputs reference pre-rebase; the
+        # host slot mirror only advances at drain, so while rounds are
+        # in flight the device counter leads it by up to one batch each
         return (
-            self._next_slot + self.batch_size >= self.SLOT_RESET_THRESHOLD
+            self._next_slot + (self._undrained + 1) * self.batch_size
+            >= self.SLOT_RESET_THRESHOLD
             or super()._pipeline_flush_needed(batch)
         )
 
@@ -1206,9 +1203,11 @@ class PaxosDeviceDriver(_DriverCore):
                 )
         self._ensure_seq_window(batch)
         b = self.batch_size
-        valid = np.zeros(b, dtype=bool)
-        src = np.zeros(b, dtype=np.int32)
-        seq = np.zeros(b, dtype=np.int32)
+        valid, src, seq = self._staging(
+            ("valid", (b,), bool, False),
+            ("src", (b,), np.int32, 0),
+            ("seq", (b,), np.int32, 0),
+        )
         for i, (dot, cmd) in enumerate(batch):
             valid[i] = True
             src[i] = dot.source
@@ -1224,13 +1223,11 @@ class PaxosDeviceDriver(_DriverCore):
     def drain(self, tok) -> List[ExecutorResult]:
         """Fetch one round's outputs and execute its contiguous slot
         prefix against the KVStore."""
-        import jax
-
         out, n_batch = tok
-        # one pytree fetch, one device->host round trip (see DeviceDriver);
+        # one pytree fetch, one device->host round trip (PipelineCore);
         # the round's own exec_frontier rides in the output, so a later
         # dispatched round cannot leak its frontier into this one
-        out = jax.device_get(out)
+        out = self._fetch(out)
 
         order = np.asarray(out.order)
         executed = np.asarray(out.executed)
@@ -1444,6 +1441,7 @@ class DeviceRuntime:
         metrics_file: Optional[str] = None,
         metrics_interval_ms: int = 5000,
         pipeline: Optional[bool] = None,
+        pipeline_depth: Optional[int] = None,
         mesh=None,
     ):
         from fantoch_tpu.core.ids import AtomicIdGen
@@ -1509,14 +1507,27 @@ class DeviceRuntime:
                 monitor_execution_order=monitor_execution_order,
                 mesh=mesh,
             )
-        explicit = pipeline
+        # in-flight depth: explicit arg > Config.serving_pipeline_depth >
+        # FANTOCH_SERVING_PIPELINE_DEPTH env > 1 (run/pipeline.py) —
+        # live serving and the bench rig share one resolution, and ANY
+        # of the three spellings counts as the CPU pipelining opt-in
+        depth_requested = (
+            requested_pipeline_depth(pipeline_depth, config) is not None
+        )
+        self.pipeline_depth = resolve_pipeline_depth(pipeline_depth, config)
+        self.driver.pipeline_depth = self.pipeline_depth
         if pipeline is None:
             # dispatch/drain overlap needs a compute resource besides the
             # host cores: on a CPU backend "device" rounds and the emit
             # loop share the same cores (measured 16% WORSE pipelined,
-            # BENCH_DEV round 5), so auto-enable only off-CPU
+            # BENCH_DEV round 5), so auto-enable only off-CPU — unless a
+            # pipeline depth was explicitly configured, which IS the
+            # opt-in (depth > 1 is meaningless with pipelining off)
             device0 = np.asarray(self.driver._mesh.devices).flat[0]
-            pipeline = getattr(device0, "platform", "cpu") != "cpu"
+            pipeline = (
+                getattr(device0, "platform", "cpu") != "cpu"
+                or depth_requested
+            )
         # every driver implements the dispatch/drain split, so the
         # scaffold's step_pipelined is always available
         self.pipeline = bool(pipeline)

@@ -229,6 +229,7 @@ async def run_device_server(
     open_loop_interval_ms: Optional[int] = None,
     monitor_execution_order: bool = True,
     pipeline: Optional[bool] = None,
+    pipeline_depth: Optional[int] = None,
 ):
     """Boot the TPU serving path (run/device_runner.py) on a localhost
     port and drive real TCP clients against it; returns
@@ -247,6 +248,7 @@ async def run_device_server(
         pending_capacity=pending_capacity,
         monitor_execution_order=monitor_execution_order,
         pipeline=pipeline,
+        pipeline_depth=pipeline_depth,
     )
     await runtime.start()
     client_task = asyncio.ensure_future(

@@ -160,9 +160,21 @@ class OrderingPool:
         """Dispatch one pre-sharded workload and wait for every worker's
         (order_src, order_seq); wall time across the call is the
         aggregate ordering latency."""
+        self.submit_shards(shards)
+        return self.drain_shards()
+
+    def submit_shards(self, shards) -> None:
+        """Ship one pre-sharded workload to the workers WITHOUT waiting —
+        the dispatch half of the run/pipeline.py dispatch/drain split at
+        process granularity.  Each pipe is FIFO, so workloads drain in
+        submission order; ``drain_shards`` retires the oldest."""
         assert len(shards) == self.workers
         for conn, (key, src, seq, dep) in zip(self._conns, shards):
             conn.send(("add", src, seq, key, dep))
+
+    def drain_shards(self) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Wait for every worker's (order_src, order_seq) of the oldest
+        submitted workload."""
         orders = []
         for conn in self._conns:
             kind, order_src, order_seq = conn.recv()
@@ -171,6 +183,74 @@ class OrderingPool:
             assert kind == "done"
             orders.append((order_src, order_seq))
         return orders
+
+    def run_shards_pipelined(
+        self, workloads, depth: int = 1
+    ) -> List[List[Tuple[np.ndarray, np.ndarray]]]:
+        """Run a sequence of pre-sharded workloads keeping up to
+        ``depth`` of them in flight across the worker processes: IPC
+        serialization of workload k+1 overlaps the workers' ordering of
+        workload k (the serving loop's depth-K overlap applied to the
+        host pool).  Results come back in submission order.
+
+        Sends run on a feeder thread: the worker loop is strict
+        recv->process->send, so a single-threaded submit-then-drain
+        deadlocks as soon as a pickled workload and a pending result
+        together exceed the pipe's socket buffer (each side blocked in
+        send, neither reading).  With the feeder owning the send
+        direction and this thread the recv direction, the main thread is
+        always free to drain — each duplex Connection is used by exactly
+        one thread per direction, never the same operation concurrently.
+        A semaphore caps submitted-but-undrained workloads at
+        ``depth + 1`` (depth remain in flight while one drains — the
+        PipelineCore convention, so depth=1 really does overlap the IPC
+        of workload k+1 with the workers' ordering of workload k); the
+        drain loop never blocks on a workload the feeder has not
+        confirmed submitting, so a feeder failure raises instead of
+        hanging the caller."""
+        assert depth >= 1
+        import threading
+
+        workloads = list(workloads)
+        sem = threading.Semaphore(depth + 1)
+        cond = threading.Condition()
+        submitted = [0]
+        feeder_error: List[BaseException] = []
+
+        def feeder() -> None:
+            try:
+                for workload in workloads:
+                    sem.acquire()
+                    self.submit_shards(workload)
+                    with cond:
+                        submitted[0] += 1
+                        cond.notify()
+            except BaseException as exc:  # noqa: BLE001 — rethrown below
+                with cond:
+                    feeder_error.append(exc)
+                    cond.notify()
+
+        thread = threading.Thread(target=feeder, daemon=True)
+        thread.start()
+        results: List[List[Tuple[np.ndarray, np.ndarray]]] = []
+        try:
+            for i in range(len(workloads)):
+                with cond:
+                    while submitted[0] <= i and not feeder_error:
+                        cond.wait()
+                    if submitted[0] <= i:
+                        # feeder died before this workload went out: the
+                        # workers will never answer it — raise, don't hang
+                        raise RuntimeError(
+                            "pool feeder failed"
+                        ) from feeder_error[0]
+                results.append(self.drain_shards())
+                sem.release()
+        finally:
+            thread.join(timeout=60)
+        if feeder_error:
+            raise RuntimeError("pool feeder failed") from feeder_error[0]
+        return results
 
     def close(self) -> None:
         for conn in self._conns:

@@ -1,0 +1,358 @@
+"""The shared dispatch/drain pipeline core for device-resident serving.
+
+Every device serving plane pays the same round shape: assemble a batch on
+the host, dispatch one fused device program (async), fetch its outputs
+(blocking), emit results.  On dispatch-dominated rigs the ~68 ms
+host<->device round trip dwarfs the ~3 ms kernel (BENCH_TPU_LATEST
+``dispatch_overhead_ms``), so the only way to keep the device busy is to
+run dispatch N rounds ahead of drain — transfer of round i+1 and the
+host-side result emit of round i-1 overlap with compute of round i, the
+nonblocking-execution move of the GraphBLAS lazy-evaluation line
+(PAPERS.md) applied to consensus serving.
+
+This module is the one place that machinery lives (the ROADMAP item-5
+refactor seam): drivers implement a ``dispatch(batch) -> token`` /
+``drain(token) -> results`` split and inherit
+
+  * :class:`PipelineCore` — a depth-K in-flight ring of round tokens
+    (``step`` / ``step_pipelined`` / ``flush_pipeline``), per-dispatch
+    wall-split counters, and the device busy/idle instrument
+    (``device_idle_frac``);
+  * :class:`IngestRing` — K+1 pre-staged host staging buffer sets for
+    batch assembly, cycled round-robin so the columns a still-in-flight
+    round reads (``jnp.asarray`` zero-copy aliases host numpy on the CPU
+    backend) are never rewritten under it.
+
+Depth semantics: ``pipeline_depth`` is the maximum number of
+dispatched-but-undrained rounds ``step_pipelined`` leaves in flight, i.e.
+the delivery lag in rounds.  Depth 1 is the classic double-buffered
+overlap; deeper pipelines amortize jittery transfer latency at the cost
+of K rounds of result lag.  ``step`` (synchronous) always flushes first,
+so mixing the two is safe.
+
+Donation discipline (the PR 4 XLA-ownership rule): the pipeline never
+donates host staging buffers — only the drivers' device-resident *state*
+is donated, and state rebuilds go through ``jnp.array`` copies.  Staging
+columns are plain (non-donated) inputs, so ring reuse after drain is the
+only aliasing hazard, and the ring's size (depth + 1) closes it.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+ENV_PIPELINE_DEPTH = "FANTOCH_SERVING_PIPELINE_DEPTH"
+DEFAULT_PIPELINE_DEPTH = 1
+
+
+def requested_pipeline_depth(
+    explicit: Optional[int] = None, config: Any = None
+) -> Optional[int]:
+    """The explicitly requested serving pipeline depth, by precedence:
+    an explicit value, then ``Config.serving_pipeline_depth``, then the
+    ``FANTOCH_SERVING_PIPELINE_DEPTH`` env var — or None when no channel
+    requested one.  Any of the three spellings counts as the pipelining
+    opt-in on CPU backends (they are one knob, not three)."""
+    depth = explicit
+    if depth is None and config is not None:
+        depth = getattr(config, "serving_pipeline_depth", None)
+    if depth is None:
+        raw = os.environ.get(ENV_PIPELINE_DEPTH)
+        if raw:
+            depth = int(raw)
+    return None if depth is None else int(depth)
+
+
+def resolve_pipeline_depth(
+    explicit: Optional[int] = None, config: Any = None
+) -> int:
+    """:func:`requested_pipeline_depth` with the default applied: 1 (the
+    classic one-deep overlap) when nothing was requested."""
+    depth = requested_pipeline_depth(explicit, config)
+    if depth is None:
+        depth = DEFAULT_PIPELINE_DEPTH
+    if depth < 1:
+        raise ValueError(f"serving pipeline depth must be >= 1, got {depth}")
+    return depth
+
+
+class IngestRing:
+    """K+1 pre-staged host staging buffer sets, cycled round-robin.
+
+    Each slot holds one set of named numpy columns (the per-round
+    key/src/seq staging arrays).  ``acquire()`` resets the next slot's
+    columns to their fill values in place and returns them — no per-round
+    allocation, and a slot is only revisited after ``slots`` more
+    acquires, which the pipeline guarantees is after its round drained
+    (rounds in flight <= depth < slots).
+    """
+
+    __slots__ = ("_slots", "_specs", "_next")
+
+    def __init__(
+        self, slots: int, specs: Sequence[Tuple[str, tuple, Any, Any]]
+    ):
+        """``specs``: (name, shape, dtype, fill) per staging column."""
+        assert slots >= 1
+        self._specs = list(specs)
+        self._slots = [
+            tuple(
+                np.full(shape, fill, dtype=dtype)
+                for _name, shape, dtype, fill in self._specs
+            )
+            for _ in range(slots)
+        ]
+        self._next = 0
+
+    @property
+    def slots(self) -> int:
+        return len(self._slots)
+
+    def acquire(self) -> Tuple[np.ndarray, ...]:
+        """The next slot's columns, reset in place to their fill values
+        (in spec order)."""
+        arrays = self._slots[self._next]
+        self._next = (self._next + 1) % len(self._slots)
+        for arr, (_name, _shape, _dtype, fill) in zip(arrays, self._specs):
+            arr.fill(fill)
+        return arrays
+
+
+class PipelineCore:
+    """Depth-K dispatch/drain pipelining plus the per-dispatch counters
+    every device serving driver shares.
+
+    Subclasses implement ``dispatch(batch) -> token`` (async: must not
+    block on device completion) and ``drain(token) -> results`` (fetches
+    outputs via :meth:`_fetch` and emits).  ``_pipeline_flush_needed``
+    gates dispatches that would rebase state an in-flight round still
+    references (sequence/clock/gid windows) — the pipeline retires every
+    outstanding round first.
+
+    Required subclass attribute: ``batch_size`` (the compiled per-round
+    row capacity, read by the occupancy counters) must be set before
+    ``_init_pipeline``.  ``seq_epochs`` (window-advance tally) is
+    reported when present, 0 otherwise.
+    """
+
+    def _init_pipeline(self) -> None:
+        self.pipeline_depth = DEFAULT_PIPELINE_DEPTH
+        assert hasattr(self, "batch_size"), (
+            "PipelineCore subclasses must set batch_size before "
+            "_init_pipeline"
+        )
+        self._ring: Optional[IngestRing] = None  # lazy staging ring
+        # per-dispatch observability (observability/device.py):
+        # dispatched_rows vs dispatched_capacity is the batch occupancy;
+        # dispatch/drain wall-ms split host assembly from device wait
+        self.dispatches = 0
+        self.dispatched_rows = 0
+        self.dispatched_capacity = 0
+        self.dispatch_wall_ms = 0.0
+        self.drain_wall_ms = 0.0
+        self.fetch_wall_ms = 0.0  # blocking device->host wait inside drains
+        self.pipelined_rounds = 0  # rounds dispatched over an in-flight one
+        # the in-flight ring: dispatched-but-undrained round tokens, FIFO
+        self._inflight: Deque[Any] = deque()
+        # rounds dispatched and not yet entered drain — during a drain
+        # this counts OTHER in-flight rounds (unlike has_outstanding,
+        # which is False mid-flush even with round k+1 dispatched), so
+        # rebase paths can assert nothing is in flight
+        self._undrained = 0
+        # like _undrained but in protocol ROUNDS (a chained token carries
+        # S rounds per dispatch): the clock-window margins are per round
+        self._undrained_rounds = 0
+        # device busy/idle instrument: a busy window opens when a dispatch
+        # leaves the host (device has work) and closes at the fetch that
+        # retires the LAST in-flight round; span is first dispatch ->
+        # last fetch.  idle = span - busy = wall the device sat waiting
+        # on host assembly/emit — the number the pipeline exists to kill.
+        self._busy_t0: Optional[float] = None
+        self._busy_ms = 0.0
+        self._span_t0: Optional[float] = None
+        self._span_end: Optional[float] = None
+
+    def _staging(self, *specs) -> Tuple[np.ndarray, ...]:
+        """The next pre-staged host staging slot for batch assembly:
+        ``pipeline_depth + 1`` ring slots, so the columns a
+        still-in-flight round zero-copy aliases (``jnp.asarray`` on the
+        CPU backend) are never rewritten before that round drains."""
+        slots = self.pipeline_depth + 1
+        if self._ring is None or self._ring.slots < slots:
+            self._ring = IngestRing(slots, specs)
+        return self._ring.acquire()
+
+    def reset_overlap_instrument(self) -> None:
+        """Zero the busy/idle instrument (callers time a steady-state
+        region after warm/compile rounds; requires nothing in flight so
+        no busy window is open)."""
+        assert self._undrained == 0, (
+            "overlap-instrument reset with rounds in flight"
+        )
+        self._busy_t0 = self._span_t0 = self._span_end = None
+        self._busy_ms = 0.0
+
+    # --- the serving surface ---
+
+    @property
+    def has_outstanding(self) -> bool:
+        """At least one dispatched-but-undrained pipelined round exists."""
+        return bool(self._inflight)
+
+    def step(self, batch) -> List[Any]:
+        """One synchronous round: flush any pipelined rounds, dispatch,
+        drain."""
+        results = self.flush_pipeline()
+        tok = self._dispatch_tracked(batch)
+        results.extend(self._drain_tracked(tok))
+        return results
+
+    def step_pipelined(self, batch) -> List[Any]:
+        """Dispatch ``batch`` and drain only rounds beyond the configured
+        ``pipeline_depth`` — results arrive up to ``pipeline_depth`` calls
+        late in exchange for overlapping device compute with host batch
+        assembly and the result-emit loop.  Call ``flush_pipeline`` to
+        retire the tail."""
+        if self._inflight and self._pipeline_flush_needed(batch):
+            # an epoch/window rebase would invalidate an in-flight
+            # round's identity or clock accounting — retire them all
+            # first (rare: once per int32 window)
+            early = self.flush_pipeline()
+            self._inflight.append(self._dispatch_tracked(batch))
+            return early
+        return self._pipeline_dispatch(
+            lambda: self.dispatch(batch), len(batch), self.batch_size, 1
+        )
+
+    def _pipeline_dispatch(
+        self, fn, rows: int, capacity: int, rounds: int
+    ) -> List[Any]:
+        """The shared pipelined-dispatch tail: tally overlap, push the
+        new round token, drain down to depth.  Chained drivers reuse it
+        with their chain thunks (the caller handled any flush trigger)."""
+        if self._inflight:
+            self.pipelined_rounds += rounds
+        self._inflight.append(self._track_dispatch(fn, rows, capacity, rounds))
+        return self._drain_to_depth()
+
+    def flush_pipeline(self) -> List[Any]:
+        """Drain every outstanding pipelined round, oldest first."""
+        results: List[Any] = []
+        while self._inflight:
+            results.extend(self._drain_tracked(self._inflight.popleft()))
+        return results
+
+    def _drain_to_depth(self) -> List[Any]:
+        results: List[Any] = []
+        while len(self._inflight) > self.pipeline_depth:
+            results.extend(self._drain_tracked(self._inflight.popleft()))
+        return results
+
+    # --- tracked dispatch/drain plumbing ---
+
+    def _dispatch_tracked(self, batch):
+        return self._track_dispatch(
+            lambda: self.dispatch(batch), len(batch), self.batch_size, 1
+        )
+
+    def _track_dispatch(self, fn, rows: int, capacity: int, rounds: int):
+        t0 = time.perf_counter()
+        if self._span_t0 is None:
+            self._span_t0 = t0
+        tok = fn()
+        t1 = time.perf_counter()
+        self.dispatch_wall_ms += (t1 - t0) * 1000.0
+        self.dispatches += 1
+        self.dispatched_rows += rows
+        self.dispatched_capacity += capacity
+        self._undrained += 1
+        self._undrained_rounds += rounds
+        if self._busy_t0 is None:
+            # the device has work from the moment the dispatch call
+            # returns (the submit is async); host assembly before it
+            # counts as idle, which is the point of the instrument
+            self._busy_t0 = t1
+        return tok
+
+    def _drain_tracked(self, tok):
+        # inside drain, _undrained counts OTHER in-flight rounds
+        self._undrained -= 1
+        self._undrained_rounds -= self._token_rounds(tok)
+        t0 = time.perf_counter()
+        out = self.drain(tok)
+        self.drain_wall_ms += (time.perf_counter() - t0) * 1000.0
+        return out
+
+    def _token_rounds(self, tok) -> int:
+        """Protocol rounds one dispatch token carries (chained drivers
+        override for their chain tokens)."""
+        return 1
+
+    def _fetch(self, out):
+        """ONE blocking pytree fetch for a round's outputs: device_get
+        issues async copies for every leaf before blocking, so the round
+        pays a single device->host round trip instead of one per field
+        (through a remote-dispatch tunnel each blocking np.asarray costs
+        a full ~76 ms round trip, BENCH_DEV round 5).  Also the busy/idle
+        bookkeeping point: when this fetch retires the last in-flight
+        round, the device goes idle until the next dispatch."""
+        import jax
+
+        t0 = time.perf_counter()
+        out = jax.device_get(out)
+        t1 = time.perf_counter()
+        self.fetch_wall_ms += (t1 - t0) * 1000.0
+        if self._undrained == 0 and self._busy_t0 is not None:
+            self._busy_ms += (t1 - self._busy_t0) * 1000.0
+            self._busy_t0 = None
+        self._span_end = t1
+        return out
+
+    def _pipeline_flush_needed(self, batch) -> bool:
+        """True when the upcoming dispatch may trigger a rebase that must
+        not happen with rounds in flight; drivers extend with their
+        window triggers."""
+        return False
+
+    # --- the counters (metrics snapshots / bench rows) ---
+
+    def device_counters(self) -> Dict[str, float]:
+        """Per-dispatch tallies for the metrics snapshot / bench rows:
+        occupancy = dispatched_rows / dispatched_capacity; busy/span give
+        ``device_idle_frac`` — the fraction of the serving span the
+        device sat idle waiting on the host (the pipelined loop's whole
+        job is driving it toward 0)."""
+        now = time.perf_counter()
+        busy_ms = self._busy_ms
+        span_ms = 0.0
+        if self._span_t0 is not None:
+            span_end = self._span_end
+            if self._busy_t0 is not None:
+                # rounds still in flight: close the open windows at `now`
+                # for a consistent mid-run snapshot
+                busy_ms += (now - self._busy_t0) * 1000.0
+                span_end = now
+            if span_end is not None:
+                span_ms = (span_end - self._span_t0) * 1000.0
+        idle_frac = (
+            max(0.0, 1.0 - busy_ms / span_ms) if span_ms > 0 else 0.0
+        )
+        return {
+            "device_dispatches": self.dispatches,
+            "device_dispatched_rows": self.dispatched_rows,
+            "device_batch_capacity": self.dispatched_capacity,
+            "device_dispatch_ms": round(self.dispatch_wall_ms, 3),
+            "device_drain_ms": round(self.drain_wall_ms, 3),
+            "device_fetch_ms": round(self.fetch_wall_ms, 3),
+            "device_busy_ms": round(busy_ms, 3),
+            "device_span_ms": round(span_ms, 3),
+            "device_idle_frac": round(idle_frac, 4),
+            "device_pipeline_depth": self.pipeline_depth,
+            "device_pipelined_rounds": self.pipelined_rounds,
+            "device_seq_epochs": getattr(self, "seq_epochs", 0),
+        }

@@ -1000,12 +1000,19 @@ class ProcessRuntime:
         (``observability/device.py`` module tally): every co-hosted
         runtime's snapshot carries the same total, so readers must not
         sum it across runtimes of one host."""
-        from fantoch_tpu.observability.device import merge_counters, recompile_count
+        from fantoch_tpu.observability.device import (
+            derive_idle_frac,
+            merge_counters,
+            recompile_count,
+        )
 
         device: Dict[str, float] = {}
         for executor in self.executors:
             merge_counters(device, executor.device_counters())
         if device:
+            # dispatch/drain overlap instrument: idle frac from the
+            # folded busy/span walls (frac itself never sums)
+            derive_idle_frac(device)
             device["jax_recompiles"] = recompile_count()
             return device
         return None

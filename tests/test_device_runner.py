@@ -1267,6 +1267,267 @@ def test_device_runtime_pipelined_tcp_serving(protocol):
     assert len(seen) == len(set(seen)) == 4 * COMMANDS_PER_CLIENT
 
 
+@pytest.mark.parametrize("depth", [1, 2, 3])
+@pytest.mark.parametrize("proto_cls", ["epaxos", "newt"])
+def test_depth_k_pipelined_parity(proto_cls, depth):
+    """The depth-K loop is pure scheduling: at every depth the pipelined
+    run (with a mid-stream flush_pipeline thrown in) produces exactly
+    the sync driver's execution — same per-round result values in the
+    same order, same per-key monitor order, same tallies."""
+    from fantoch_tpu.run.device_runner import NewtDeviceDriver
+
+    cls = {"epaxos": DeviceDriver, "newt": NewtDeviceDriver}[proto_cls]
+    mk = lambda: cls(3, batch_size=16, key_buckets=64,  # noqa: E731
+                     monitor_execution_order=True)
+
+    def batches():
+        out, seq = [], 0
+        for _r in range(7):
+            batch = []
+            for j in range(4):
+                seq += 1
+                key = "hot" if (seq % 2) else f"priv{j}"
+                batch.append(_put(1, seq, key, f"v{seq}"))
+            out.append(batch)
+        return out
+
+    d_sync, d_pipe = mk(), mk()
+    d_pipe.pipeline_depth = depth
+    sync_rounds = [d_sync.step(b) for b in batches()]
+    pipe_rounds = []
+    for r, b in enumerate(batches()):
+        pipe_rounds.append(d_pipe.step_pipelined(b))
+        if r == 3:  # mid-stream flush must retire in order, then refill
+            pipe_rounds.append(d_pipe.flush_pipeline())
+            assert not d_pipe.has_outstanding
+    pipe_rounds.append(d_pipe.flush_pipeline())
+    assert not d_pipe.has_outstanding
+
+    def flat(rounds):
+        return [(r.rifl, r.key, tuple(r.op_results)) for rr in rounds for r in rr]
+
+    assert flat(pipe_rounds) == flat(sync_rounds)
+    # the lag is exactly min(depth, rounds so far): round 0's results
+    # surface on call `depth`
+    if depth < 4:
+        assert flat(pipe_rounds[:depth]) == []
+        assert flat(pipe_rounds[depth : depth + 1]) == flat(sync_rounds[0:1])
+    assert d_pipe.executed == d_sync.executed == 28
+    assert d_pipe.in_flight == 0
+    for key in d_sync.store.monitor.keys():
+        assert (
+            d_pipe.store.monitor.get_order(key)
+            == d_sync.store.monitor.get_order(key)
+        )
+    counters = d_pipe.device_counters()
+    assert counters["device_pipeline_depth"] == depth
+    assert 0.0 <= counters["device_idle_frac"] <= 1.0
+    assert counters["device_busy_ms"] <= counters["device_span_ms"] + 1e-6
+
+
+@pytest.mark.parametrize("depth", [2, 3])
+def test_seq_window_advance_races_inflight_dispatches(depth):
+    """A dot-sequence window advance may only run with the pipeline
+    empty: forcing a tiny window mid-stream must early-flush the
+    in-flight rounds, rebase, and keep bit-for-bit parity with a sync
+    driver under the same tiny window."""
+    from fantoch_tpu.run.device_runner import NewtDeviceDriver
+
+    def mk():
+        d = NewtDeviceDriver(3, batch_size=8, key_buckets=64,
+                             monitor_execution_order=True)
+        d.SEQ_WINDOW_MAX = 24  # instance override: advance every ~3 rounds
+        return d
+
+    def batches():
+        out, seq = [], 0
+        for _r in range(10):
+            batch = []
+            for _j in range(4):
+                seq += 1
+                batch.append(_put(1, seq, "hot" if seq % 2 else "cold",
+                                  f"v{seq}"))
+            out.append(batch)
+        return out
+
+    d_sync, d_pipe = mk(), mk()
+    d_pipe.pipeline_depth = depth
+    sync_rounds = [d_sync.step(b) for b in batches()]
+    pipe_rounds = [d_pipe.step_pipelined(b) for b in batches()]
+    pipe_rounds.append(d_pipe.flush_pipeline())
+
+    def flat(rounds):
+        return [(r.rifl, r.key, tuple(r.op_results)) for rr in rounds for r in rr]
+
+    assert flat(pipe_rounds) == flat(sync_rounds)
+    assert d_pipe.seq_epochs >= 1  # the window really advanced mid-run
+    assert d_pipe.seq_epochs == d_sync.seq_epochs
+    assert d_pipe.executed == d_sync.executed == 40
+    for key in d_sync.store.monitor.keys():
+        assert (
+            d_pipe.store.monitor.get_order(key)
+            == d_sync.store.monitor.get_order(key)
+        )
+
+
+def test_pipelined_requeue_interleaving():
+    """Device pending-buffer overflow requeues interleave with the
+    depth-2 pipeline: degraded rounds carry + overflow while rounds are
+    in flight, requeued commands re-enter through pipelined rounds, and
+    after healing everything executes exactly once with the hot-key
+    previous-value chain intact.  Topology per
+    test_newt_runtime_requeue_after_degraded_round: n=5/f=2/live=1 makes
+    the first degraded round's commits a carried (priority) backlog and
+    later rounds' rows uncommitted — so the overflow tail is
+    requeue-able, never committed."""
+    from fantoch_tpu.parallel import mesh_step
+    from fantoch_tpu.run.device_runner import NewtDeviceDriver
+
+    d = NewtDeviceDriver(5, f=2, batch_size=8, key_buckets=64,
+                         pending_capacity=12,
+                         monitor_execution_order=True)
+    d.pipeline_depth = 2
+    healthy = d._step
+    values = {i + 1: f"v{i + 1}" for i in range(20)}
+    results = {}
+
+    def absorb(rs):
+        for r in rs:
+            assert r.rifl.sequence not in results, "duplicate result"
+            results[r.rifl.sequence] = r.op_results[0]
+
+    # healthy pipelined round seeds the hot-key chain
+    absorb(d.step_pipelined([_put(1, s, "hot", values[s]) for s in range(1, 5)]))
+    # degrade to one live replica with rounds in flight: round d1 still
+    # commits (agreeing proposals) but cannot stabilize; round d2's rows
+    # stay uncommitted and, with the committed backlog carried first,
+    # overflow the 12-slot pending buffer into the host requeue
+    d._step = mesh_step.jit_newt_step(d._mesh, f=2, live_replicas=1)
+    absorb(d.step_pipelined([_put(1, s, "hot", values[s]) for s in range(5, 13)]))
+    absorb(d.step_pipelined([_put(1, s, "hot", values[s]) for s in range(13, 21)]))
+    absorb(d.flush_pipeline())
+    assert d.in_flight > 0  # carried (committed backlog + uncommitted)
+    requeued = d.take_requeue()
+    assert requeued, "pending capacity 12 must have overflowed"
+
+    # heal and feed requeues back through pipelined rounds until drained
+    # (empty rounds at the tail let the carried backlog stabilize)
+    d._step = healthy
+    pending = requeued
+    for _ in range(30):
+        absorb(d.step_pipelined(pending[:4]))
+        pending = pending[4:] + d.take_requeue()
+        if not pending and d.in_flight == 0 and not d.has_outstanding:
+            break
+    absorb(d.flush_pipeline())
+    while d.in_flight or d._requeue:
+        absorb(d.step(d.take_requeue()))
+    assert sorted(results) == sorted(values)
+    order = d.store.monitor.get_order("hot")
+    assert len(order) == 20 and len(set(order)) == 20
+    chain = [results[r.sequence] for r in order]
+    expected = [None] + [values[r.sequence] for r in order[:-1]]
+    assert chain == expected
+
+
+def test_chained_pipelined_parity():
+    """step_chained_pipelined (S in-dispatch rounds x depth-K in-flight
+    chains) reproduces the sync per-round execution exactly, like
+    step_chained but with chains carried in flight."""
+    from fantoch_tpu.run.device_runner import NewtDeviceDriver
+
+    mk = lambda: NewtDeviceDriver(3, batch_size=8, key_buckets=64,  # noqa: E731
+                                  monitor_execution_order=True)
+
+    def batches():
+        out, seq = [], 0
+        for _r in range(12):
+            batch = []
+            for j in range(4):
+                seq += 1
+                key = "hot" if (seq % 2) else f"priv{j}"
+                batch.append(_put(1, seq, key, f"v{seq}"))
+            out.append(batch)
+        return out
+
+    d_sync, d_chp = mk(), mk()
+    d_chp.pipeline_depth = 2
+    bs = batches()
+    groups = [bs[i * 3 : (i + 1) * 3] for i in range(4)]
+    sync_rounds = [d_sync.step(b) for b in bs]
+    chp_rounds = [d_chp.step_chained_pipelined(g) for g in groups]
+    chp_rounds.append(d_chp.flush_pipeline())
+
+    def flat(rounds):
+        return [(r.rifl, r.key, tuple(r.op_results)) for rr in rounds for r in rr]
+
+    assert flat(chp_rounds) == flat(sync_rounds)
+    assert d_chp.executed == d_sync.executed == 48
+    assert not d_chp.has_outstanding and d_chp.in_flight == 0
+    for key in d_sync.store.monitor.keys():
+        assert (
+            d_chp.store.monitor.get_order(key)
+            == d_sync.store.monitor.get_order(key)
+        )
+    # one dispatch per chain (the tail flush only drains), rounds
+    # counted per protocol round
+    assert d_chp.dispatches == 4
+    assert d_chp.rounds == 12
+
+
+def test_runtime_resolves_depth_from_config():
+    """Config.serving_pipeline_depth reaches the driver, and an explicit
+    depth opts the runtime into pipelining even on the CPU backend."""
+    from fantoch_tpu.run.device_runner import DeviceRuntime
+    from fantoch_tpu.run.harness import free_port
+
+    runtime = DeviceRuntime(
+        Config(3, 1, serving_pipeline_depth=2),
+        ("127.0.0.1", free_port()),
+        batch_size=8,
+        key_buckets=64,
+    )
+    assert runtime.pipeline_depth == 2
+    assert runtime.driver.pipeline_depth == 2
+    assert runtime.pipeline  # depth request == pipelining opt-in
+
+
+def test_device_runtime_depth2_tcp_serving():
+    """Saturated TCP serving through the depth-2 loop answers every
+    client with per-key order agreement and retires the pipeline."""
+    config = Config(3, 1, serving_pipeline_depth=2)
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=1,
+        commands_per_client=COMMANDS_PER_CLIENT,
+        payload_size=1,
+    )
+    runtime, clients = asyncio.run(
+        run_device_server(
+            config,
+            workload,
+            client_count=4,
+            batch_size=8,
+            open_loop_interval_ms=1,
+            protocol="newt",
+        )
+    )
+    for client in clients.values():
+        assert client.issued_commands == COMMANDS_PER_CLIENT
+        assert len(list(client.data().latency_data())) == COMMANDS_PER_CLIENT
+    driver = runtime.driver
+    assert driver.pipeline_depth == 2
+    assert driver.executed == 4 * COMMANDS_PER_CLIENT
+    assert driver.in_flight == 0 and not driver.has_outstanding
+    monitor = driver.store.monitor
+    seen = [rifl for key in monitor.keys() for rifl in monitor.get_order(key)]
+    assert len(seen) == len(set(seen)) == 4 * COMMANDS_PER_CLIENT
+    counters = runtime._tallies
+    assert 0.0 <= counters["device_idle_frac"] <= 1.0
+    assert counters["device_pipeline_depth"] == 2
+
+
 def test_runtime_pipeline_engages_on_backlog():
     """Deterministic pipeline engagement: a backlog deeper than the batch
     is enqueued before the driver task first runs, so the queue is

@@ -65,3 +65,69 @@ def test_pool_matches_per_key_arrival_order():
             ]
             assert got == want
     assert len(seen) == len(key)
+
+
+def test_pool_pipelined_matches_sequential():
+    """run_shards_pipelined (depth-K in-flight workloads across the
+    worker processes — the serving loop's overlap at the host pool seam)
+    returns exactly the per-workload orders of sequential run_shards
+    calls, in submission order."""
+    workloads = []
+    for seed in (3, 4, 5, 6):
+        key, dep, src, seq = _workload(batch=512, seed=seed)
+        workloads.append(OrderingPool.shard_columns(key, src, seq, dep, 2))
+    rows = max(len(s[0]) for wl in workloads for s in wl)
+
+    with OrderingPool(2) as pool:
+        pool.prepare(rows)
+        sequential = [pool.run_shards(wl) for wl in workloads]
+    with OrderingPool(2) as pool:
+        pool.prepare(rows)
+        pipelined = pool.run_shards_pipelined(workloads, depth=2)
+
+    assert len(pipelined) == len(sequential) == 4
+    for seq_orders, pipe_orders in zip(sequential, pipelined):
+        for (ss, sq), (ps, pq) in zip(seq_orders, pipe_orders):
+            assert (ss == ps).all() and (sq == pq).all()
+
+
+def test_pool_pipelined_feeder_failure_raises():
+    """A workload the feeder cannot submit (wrong shard count) raises
+    RuntimeError instead of hanging the drain loop on results that will
+    never arrive — including when it follows a good workload."""
+    key, dep, src, seq = _workload(batch=64)
+    good = OrderingPool.shard_columns(key, src, seq, dep, 2)
+    bad = good[:1]  # one shard for a 2-worker pool
+    with OrderingPool(2) as pool:
+        pool.prepare(64)
+        with pytest.raises(RuntimeError, match="pool feeder failed"):
+            pool.run_shards_pipelined([bad], depth=1)
+        key2, dep2, src2, seq2 = _workload(batch=64, seed=9)
+        good2 = OrderingPool.shard_columns(key2, src2, seq2 + 1000, dep2, 2)
+        with pytest.raises(RuntimeError, match="pool feeder failed"):
+            pool.run_shards_pipelined([good2, bad], depth=1)
+
+
+def test_pool_pipelined_survives_pipe_buffer_sized_payloads():
+    """Workloads whose pickled columns exceed the pipe's socket buffer
+    (a few hundred KB) used to deadlock a naive submit-then-drain loop:
+    the parent blocked sending workload k+1 into a full pipe while the
+    worker blocked sending result k the other way.  The feeder-thread
+    split must keep large payloads flowing."""
+    workloads = []
+    base = 0
+    for seed in (7, 8):
+        key, dep, src, seq = _workload(batch=120_000, keys=512, seed=seed)
+        workloads.append(
+            OrderingPool.shard_columns(key, src, seq + base, dep, 2)
+        )
+        base += 200_000  # disjoint dot ranges across workloads
+    rows = max(len(s[0]) for wl in workloads for s in wl)
+    with OrderingPool(2) as pool:
+        pool.prepare(rows)
+        results = pool.run_shards_pipelined(workloads, depth=1)
+    assert len(results) == 2
+    for wl, orders in zip(workloads, results):
+        want = sum(len(s[0]) for s in wl)
+        got = sum(len(src) for src, _ in orders)
+        assert got == want

@@ -254,6 +254,54 @@ def test_table_plane_device_counters():
     assert TableExecutor(1, 0, Config(3, 1)).device_counters() is None
 
 
+def test_idle_frac_fold_semantics():
+    """``device_idle_frac`` is a ratio: the fold must never sum it
+    across executors; ``derive_idle_frac`` recomputes it from the folded
+    busy/span wall totals (clamped to [0, 1])."""
+    from fantoch_tpu.observability.device import derive_idle_frac, merge_counters
+
+    a = {"device_busy_ms": 30.0, "device_span_ms": 100.0,
+         "device_idle_frac": 0.7, "device_pipeline_depth": 2}
+    b = {"device_busy_ms": 50.0, "device_span_ms": 100.0,
+         "device_idle_frac": 0.5, "device_pipeline_depth": 2}
+    folded = merge_counters(merge_counters({}, a), b)
+    assert "device_idle_frac" not in folded  # ratios never sum
+    assert folded["device_pipeline_depth"] == 2  # gauges fold by max
+    derive_idle_frac(folded)
+    assert abs(folded["device_idle_frac"] - (1 - 80.0 / 200.0)) < 1e-9
+    # busy > span (overlapping spans after a fold) clamps at 0, and a
+    # missing/zero span derives nothing
+    assert derive_idle_frac(
+        {"device_busy_ms": 5.0, "device_span_ms": 1.0}
+    )["device_idle_frac"] == 0.0
+    assert "device_idle_frac" not in derive_idle_frac({"device_busy_ms": 5.0})
+
+
+def test_obs_summarize_prints_overlap(capsys):
+    """bin/obs.py summarize surfaces the dispatch/drain overlap line
+    from the per-dispatch device counters."""
+    from fantoch_tpu.bin.obs import _print_overlap
+
+    _print_overlap(
+        {
+            "device_dispatch_ms": 12.5,
+            "device_drain_ms": 40.0,
+            "device_fetch_ms": 33.0,
+            "device_busy_ms": 45.0,
+            "device_span_ms": 60.0,
+            "device_pipeline_depth": 2,
+            "device_pipelined_rounds": 7,
+        }
+    )
+    line = capsys.readouterr().out
+    assert "device overlap:" in line
+    assert "idle_frac 0.250" in line
+    assert "depth 2" in line and "pipelined_rounds 7" in line
+    # no overlap counters -> silent (plane-only traces)
+    _print_overlap({"table_plane_dispatches": 3})
+    assert capsys.readouterr().out == ""
+
+
 # --- dot-lifecycle tracing plane (fantoch_tpu/observability) ---
 
 

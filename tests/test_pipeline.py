@@ -1,0 +1,216 @@
+"""The shared dispatch/drain pipeline core (run/pipeline.py), tested
+host-only: a fake driver stands in for the device planes so depth
+semantics, flush ordering, the ingest ring's reuse discipline, and the
+busy/idle counters are covered on every jax pin (the real-driver twin
+lives in tests/test_device_runner.py, which needs jax >= 0.5)."""
+
+import numpy as np
+import pytest
+
+from fantoch_tpu.run.pipeline import (
+    DEFAULT_PIPELINE_DEPTH,
+    ENV_PIPELINE_DEPTH,
+    IngestRing,
+    PipelineCore,
+    resolve_pipeline_depth,
+)
+
+
+class _FakeDriver(PipelineCore):
+    """dispatch() records the batch; drain() 'executes' it.  Tokens are
+    (round_index, batch); results are (round_index, item) tuples — enough
+    to assert ordering and lag exactly."""
+
+    def __init__(self, flush_at=None):
+        self.batch_size = 8
+        self._init_pipeline()
+        self._round = 0
+        self.drained = []
+        self.flush_at = flush_at or set()
+
+    def dispatch(self, batch):
+        tok = (self._round, list(batch))
+        self._round += 1
+        return tok
+
+    def drain(self, tok):
+        r, batch = tok
+        self.drained.append(r)
+        return [(r, item) for item in batch]
+
+    def _pipeline_flush_needed(self, batch):
+        return any(item in self.flush_at for item in batch)
+
+
+def test_resolve_depth_precedence(monkeypatch):
+    monkeypatch.delenv(ENV_PIPELINE_DEPTH, raising=False)
+    assert resolve_pipeline_depth() == DEFAULT_PIPELINE_DEPTH == 1
+    monkeypatch.setenv(ENV_PIPELINE_DEPTH, "3")
+    assert resolve_pipeline_depth() == 3
+
+    class Cfg:
+        serving_pipeline_depth = 2
+
+    # config beats env; explicit beats config
+    assert resolve_pipeline_depth(None, Cfg()) == 2
+    assert resolve_pipeline_depth(5, Cfg()) == 5
+
+    class CfgNone:
+        serving_pipeline_depth = None
+
+    assert resolve_pipeline_depth(None, CfgNone()) == 3  # falls to env
+    with pytest.raises(ValueError):
+        resolve_pipeline_depth(0)
+
+
+def test_config_serving_pipeline_depth_validates():
+    from fantoch_tpu.core import Config
+
+    assert Config(3, 1, serving_pipeline_depth=2).serving_pipeline_depth == 2
+    with pytest.raises(ValueError):
+        Config(3, 1, serving_pipeline_depth=0)
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_depth_k_lag_and_order(depth):
+    """step_pipelined returns results exactly ``depth`` calls late, in
+    dispatch order, and flush_pipeline retires the tail oldest-first."""
+    d = _FakeDriver()
+    d.pipeline_depth = depth
+    rounds = [[f"r{i}a", f"r{i}b"] for i in range(6)]
+    outs = [d.step_pipelined(b) for b in rounds]
+    # the first `depth` calls return nothing; call k returns round k-depth
+    for k, out in enumerate(outs):
+        if k < depth:
+            assert out == []
+        else:
+            r = k - depth
+            assert out == [(r, item) for item in rounds[r]]
+    assert len(d._inflight) == depth and d.has_outstanding
+    tail = d.flush_pipeline()
+    expected = [
+        (r, item) for r in range(6 - depth, 6) for item in rounds[r]
+    ]
+    assert tail == expected
+    assert not d.has_outstanding and d._undrained == 0
+    assert d.drained == sorted(d.drained)  # strict FIFO retirement
+    assert d.pipelined_rounds == 5  # every dispatch after the first
+
+
+def test_step_flushes_pipeline_first():
+    """A synchronous step retires every in-flight round before its own,
+    so mixing step/step_pipelined can never reorder results."""
+    d = _FakeDriver()
+    d.pipeline_depth = 2
+    assert d.step_pipelined(["a"]) == []
+    assert d.step_pipelined(["b"]) == []
+    out = d.step(["c"])
+    assert out == [(0, "a"), (1, "b"), (2, "c")]
+    assert not d.has_outstanding
+
+
+def test_flush_needed_retires_all_before_dispatch():
+    """When a dispatch would rebase state in-flight rounds reference,
+    every outstanding round drains FIRST and the new round dispatches
+    into an empty pipeline (the window-rebase early-flush contract)."""
+    d = _FakeDriver(flush_at={"RESET"})
+    d.pipeline_depth = 3
+    for i in range(3):
+        assert d.step_pipelined([f"x{i}"]) == []
+    out = d.step_pipelined(["RESET"])
+    assert out == [(0, "x0"), (1, "x1"), (2, "x2")]
+    assert len(d._inflight) == 1  # the RESET round went in flight
+    assert d.flush_pipeline() == [(3, "RESET")]
+
+
+def test_counters_sane_and_idle_frac_bounded():
+    d = _FakeDriver()
+    d.pipeline_depth = 2
+    for i in range(5):
+        d.step_pipelined([f"v{i}", f"w{i}"])
+    d.flush_pipeline()
+    c = d.device_counters()
+    assert c["device_dispatches"] == 5
+    assert c["device_dispatched_rows"] == 10
+    assert c["device_batch_capacity"] == 5 * d.batch_size
+    assert c["device_pipeline_depth"] == 2
+    assert c["device_pipelined_rounds"] == 4
+    assert 0.0 <= c["device_idle_frac"] <= 1.0
+    assert c["device_busy_ms"] <= c["device_span_ms"] + 1e-6
+    assert c["device_dispatch_ms"] >= 0 and c["device_drain_ms"] >= 0
+
+
+def test_counters_snapshot_mid_flight():
+    """device_counters must be readable with rounds still in flight (the
+    periodic metrics task does) without perturbing the instrument."""
+    d = _FakeDriver()
+    d.pipeline_depth = 2
+    d.step_pipelined(["a"])
+    c = d.device_counters()
+    assert c["device_dispatches"] == 1
+    assert 0.0 <= c["device_idle_frac"] <= 1.0
+    assert d.flush_pipeline() == [(0, "a")]
+    c2 = d.device_counters()
+    assert c2["device_busy_ms"] <= c2["device_span_ms"] + 1e-6
+
+
+def test_ingest_ring_cycles_and_resets():
+    ring = IngestRing(
+        3,
+        (
+            ("key", (4, 2), np.int32, -1),
+            ("src", (4,), np.int32, 0),
+        ),
+    )
+    assert ring.slots == 3
+    key0, src0 = ring.acquire()
+    key0[0, 0] = 7
+    src0[1] = 9
+    key1, _src1 = ring.acquire()
+    assert key1 is not key0  # distinct slots back to back
+    _ = ring.acquire()
+    key0b, src0b = ring.acquire()  # wrapped: slot 0 again, reset
+    assert key0b is key0 and src0b is src0
+    assert (key0b == -1).all() and (src0b == 0).all()
+
+
+def test_ingest_ring_slot_never_reused_while_in_flight():
+    """The driver contract: with PipelineCore._staging (the production
+    ring sizing: slots = depth + 1), the staging columns of any round
+    still in flight are never handed out again — the zero-copy-alias
+    safety argument for jnp.asarray staging."""
+
+    class RingDriver(_FakeDriver):
+        def __init__(self):
+            super().__init__()
+            self.live = {}  # round -> staging array it aliases
+
+        def dispatch(self, batch):
+            (col,) = self._staging(("col", (4,), np.int64, 0))
+            col[: len(batch)] = batch
+            tok = (self._round, col, list(batch))
+            self._round += 1
+            # no OTHER in-flight round may alias this slot
+            for r, other in self.live.items():
+                assert other is not col, f"slot of round {r} reused in flight"
+            self.live[tok[0]] = col
+            return tok
+
+        def drain(self, tok):
+            r, col, batch = tok
+            # the round's staging columns are untouched at drain time
+            assert list(col[: len(batch)]) == batch
+            del self.live[r]
+            self.drained.append(r)
+            return [(r, v) for v in batch]
+
+    for depth in (1, 2, 3):
+        d = RingDriver()
+        d.pipeline_depth = depth
+        outs = []
+        for i in range(8):
+            outs.extend(d.step_pipelined([10 * i + 1, 10 * i + 2]))
+        outs.extend(d.flush_pipeline())
+        assert [v for _r, v in outs] == [
+            10 * i + j for i in range(8) for j in (1, 2)
+        ]

@@ -129,6 +129,92 @@ def test_proposal_batch_arrays_matches_objects():
             assert (by, s, e) == (1, int(start[i]), int(clock[i]))
 
 
+def test_scalar_interleaving_keeps_device_residency():
+    """The PR 4 regression, fixed: scalar detached-bumps between batch
+    dispatches (live Newt's submit-batch shape) must NOT drop the
+    resident device clock table — the bumps fold into the next dispatch
+    as one scatter-max (ops/table_ops.resident_clock_bump) and the table
+    is uploaded exactly ONCE, while results stay bit-for-bit equal to
+    the sequential twin."""
+    rng = random.Random(11)
+    seq = SequentialKeyClocks(1, SHARD)
+    bat = BatchedKeyClocks(1, SHARD)
+    next_id = 0
+    for _round in range(5):
+        # a batch dispatch (makes the table resident / keeps it so) ...
+        keys, mins, cmds = [], [], []
+        for _ in range(rng.randrange(4, 24)):
+            key = f"k{rng.randrange(5)}"
+            keys.append(key)
+            cmds.append(put_cmd(next_id, [key]))
+            next_id += 1
+            mins.append(rng.randrange(0, 30))
+        expected = [seq.proposal(c, m) for c, m in zip(cmds, mins)]
+        clock, start = bat.proposal_batch_arrays(keys, mins)
+        for i, (ce, ve) in enumerate(expected):
+            assert int(clock[i]) == ce
+            ((_k, [(by, s, e)]),) = (
+                (k, [(v.by, v.start, v.end) for v in rs]) for k, rs in ve
+            )
+            assert (by, s, e) == (1, int(start[i]), int(clock[i]))
+        assert bat._dev_prior is not None, "table dropped by the batch"
+        # ... then live-Newt-style scalar interleavings: detached bumps
+        # (commit clocks) and a periodic detached_all (clock-bump event)
+        bump = put_cmd(next_id, ["k0", "k2"])
+        next_id += 1
+        up_to = 40 * (_round + 1)
+        vs, vb = Votes(), Votes()
+        seq.detached(bump, up_to, vs)
+        bat.detached(bump, up_to, vb)
+        assert votes_of(vs) == votes_of(vb)
+        if _round == 2:
+            vs, vb = Votes(), Votes()
+            seq.detached_all(up_to + 3, vs)
+            bat.detached_all(up_to + 3, vb)
+            assert votes_of(vs) == votes_of(vb)
+        assert bat._dev_prior is not None, "table dropped by a scalar bump"
+        assert bat._pending_bumps, "scalar bumps must be recorded for fold"
+    # the whole interleaved run re-uploaded the table exactly once (the
+    # first build): residency held across every scalar interleaving
+    assert bat.resident_uploads == 1
+    # scalar reads see the folded/bumped clocks (host mirror re-syncs)
+    for key in ("k0", "k1", "k2", "k3", "k4"):
+        cs, _ = seq.proposal(put_cmd(next_id, [key]), 0)
+        cb, _ = bat.proposal(put_cmd(next_id + 1, [key]), 0)
+        next_id += 2
+        assert cs == cb
+
+
+def test_residency_survives_registry_growth_rebuild():
+    """A key registry outgrowing the device capacity rebuilds the table
+    from the host mirror (one more upload) with pending scalar bumps
+    already folded into that mirror — no bump is lost across a rebuild."""
+    seq = SequentialKeyClocks(1, SHARD)
+    bat = BatchedKeyClocks(1, SHARD)
+    keys0 = [f"k{i}" for i in range(4)]
+    expected = [seq.proposal(put_cmd(i, [keys0[i]]), 0) for i in range(4)]
+    clock, _ = bat.proposal_batch_arrays(keys0, [0, 0, 0, 0])
+    assert [int(c) for c in clock] == [c for c, _ in expected]
+    uploads0 = bat.resident_uploads
+    # scalar bump, then a batch that registers enough new keys to force
+    # a capacity regrow: the rebuild must carry the bump
+    vs, vb = Votes(), Votes()
+    seq.detached(put_cmd(10, ["k0"]), 50, vs)
+    bat.detached(put_cmd(10, ["k0"]), 50, vb)
+    assert votes_of(vs) == votes_of(vb)
+    grow_keys = [f"g{i}" for i in range(64)]
+    expected = [
+        seq.proposal(put_cmd(100 + i, [k]), 0) for i, k in enumerate(grow_keys)
+    ]
+    clock, _ = bat.proposal_batch_arrays(grow_keys, [0] * len(grow_keys))
+    assert [int(c) for c in clock] == [c for c, _ in expected]
+    assert bat.resident_uploads == uploads0 + 1  # the regrow rebuild
+    # k0's bumped clock survived the rebuild on the device side
+    cs, _ = seq.proposal(put_cmd(200, ["k0"]), 0)
+    cb, _ = bat.proposal(put_cmd(201, ["k0"]), 0)
+    assert cs == cb == 51
+
+
 def test_handle_batch_arrays_oracle_equivalence():
     """The array-native executor seam executes exactly what the
     per-info object path executes, in the same per-key order — across a
