@@ -1,7 +1,7 @@
 # Test/bench entry points (the reference pins quality with Makefile:3-7 —
 # fmt + clippy + `cargo test` under a quickcheck budget; here the suite +
 # dryrun + bench are the equivalent gates).
-.PHONY: test test-fast test-chaos test-recovery dryrun bench bench-smoke trace-smoke
+.PHONY: test test-fast test-chaos test-recovery test-restart test-device-stripped dryrun bench bench-smoke trace-smoke
 
 test:
 	python -m pytest tests/ -x -q
@@ -21,6 +21,21 @@ test-chaos:
 # checker rows
 test-recovery:
 	python -m pytest tests/ -x -q -m recovery
+
+# the restart-and-rejoin slice: WAL durability edges, snapshot/restore,
+# crash-restart chaos rows (restored tolerance), TCP WAL recovery +
+# on_peer_up revival
+test-restart:
+	python -m pytest tests/ -x -q -m restart
+
+# close the tier-1 coverage hole on the pinned jax: run
+# tests/test_device_runner.py from a guard-stripped copy (the module
+# skips itself on jax < 0.5 because jaxlib 0.4.x segfaults flakily while
+# tracing the drivers' scan bodies) in its own pytest process, the way
+# PR 6 validated its changes.  On jax >= 0.5 the regular suite already
+# covers the module and this is a no-op
+test-device-stripped:
+	python scripts/run_device_stripped.py
 
 dryrun:
 	python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"

@@ -1382,7 +1382,54 @@ def smoke_main() -> None:
     print(json.dumps(out))
 
 
+def compare_records(path_a: str, path_b: str) -> int:
+    """``bench.py --compare A.json B.json``: print new/old ratios for the
+    numeric keys two round records share — with the REDEFINITION GUARD
+    for the serving family.
+
+    ``serving_newt_*`` was redefined in r07 (BENCH_r06 and earlier
+    measured the synchronous round; r07+ measure the depth-K pipelined
+    loop, stamped via ``serving_newt_definition``).  Comparing a pre-r07
+    ``serving_*`` value against a post-r07 one is a category error — the
+    pipelined loop trades per-round latency for overlap — so serving
+    keys are only compared when both records carry the SAME
+    ``serving_newt_definition`` stamp (absent counts as the pre-r07
+    synchronous definition); mismatches are listed, not ratioed.
+    Returns the number of keys skipped by the guard."""
+    with open(path_a) as fh:
+        old = json.load(fh)
+    with open(path_b) as fh:
+        new = json.load(fh)
+    old_def = old.get("serving_newt_definition")
+    new_def = new.get("serving_newt_definition")
+    serving_comparable = old_def == new_def
+    skipped = 0
+    for key in sorted(set(old) & set(new)):
+        old_v, new_v = old[key], new[key]
+        if not isinstance(old_v, (int, float)) or not isinstance(new_v, (int, float)):
+            continue
+        if isinstance(old_v, bool) or isinstance(new_v, bool):
+            continue
+        if key.startswith("serving_") and not serving_comparable:
+            skipped += 1
+            print(f"{key}: SKIPPED (serving_newt_definition mismatch: "
+                  f"{old_def!r} vs {new_def!r} — r07 redefined the serving "
+                  f"family; see BENCH_DEV.md)")
+            continue
+        ratio = (new_v / old_v) if old_v else float("inf")
+        print(f"{key}: {old_v} -> {new_v} (x{ratio:.3f})")
+    if skipped:
+        print(f"# {skipped} serving key(s) guarded: pre-r07 serving_* rows "
+              "(BENCH_r01-r05) measure the synchronous round, not the "
+              "pipelined loop", file=sys.stderr)
+    return skipped
+
+
 def main() -> None:
+    if "--compare" in sys.argv[1:]:
+        index = sys.argv.index("--compare")
+        compare_records(sys.argv[index + 1], sys.argv[index + 2])
+        return
     if "--smoke" in sys.argv[1:]:
         smoke_main()
         return

@@ -79,6 +79,11 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
                         help="device serving pipeline depth (run/pipeline.py): "
                         "dispatched-but-undrained rounds kept in flight; "
                         "default FANTOCH_SERVING_PIPELINE_DEPTH env, else 1")
+    parser.add_argument("--wal-sync", default=None,
+                        choices=("always", "interval", "never"),
+                        help="durable command-log fsync policy (run/wal.py); "
+                        "default FANTOCH_WAL_SYNC env, else 'interval'; only "
+                        "consulted when the server runs with --wal-dir")
 
 
 def config_from_args(args: argparse.Namespace):
@@ -101,6 +106,7 @@ def config_from_args(args: argparse.Namespace):
         skip_fast_ack=args.skip_fast_ack,
         batched_graph_executor=args.batched_graph_executor,
         serving_pipeline_depth=args.serving_pipeline_depth,
+        wal_sync=args.wal_sync,
     )
 
 

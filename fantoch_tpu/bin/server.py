@@ -107,6 +107,13 @@ def build_parser() -> argparse.ArgumentParser:
              "contended machines (testbeds sharing one core) so CPU "
              "starvation does not read as peer death")
     parser.add_argument("--execution-log", default=None)
+    parser.add_argument("--wal-dir", default=None, metavar="DIR",
+                        help="durable command log + snapshots (run/wal.py): "
+                        "on a restart with the same dir the server recovers "
+                        "(snapshot + tail replay) and rejoins via MSync "
+                        "instead of starting empty")
+    parser.add_argument("--wal-snapshot-interval", type=int, default=2000,
+                        metavar="MS", help="WAL snapshot cadence")
     parser.add_argument("--tracer-show-interval", type=int, default=None, metavar="MS")
     parser.add_argument("--log-file", default=None)
     return parser
@@ -225,6 +232,8 @@ async def serve(args: argparse.Namespace) -> None:
         tracer_show_interval_ms=args.tracer_show_interval,
         heartbeat_interval_s=args.heartbeat_interval,
         heartbeat_misses=args.heartbeat_misses,
+        wal_dir=args.wal_dir,
+        wal_snapshot_interval_ms=args.wal_snapshot_interval,
     )
     await runtime.start()
     print(f"p{args.id} ({args.protocol}) up on {args.ip}:{args.port}", flush=True)

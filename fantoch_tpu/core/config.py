@@ -112,6 +112,15 @@ class Config:
     # DeviceRuntime into pipelining on CPU backends (new knob; no
     # reference counterpart — the reference's runner is message-at-a-time)
     serving_pipeline_depth: Optional[int] = None
+    # durable command-log fsync policy (run/wal.py): "always" fsyncs
+    # every append (commit-durable before anything acks it), "interval"
+    # fsyncs on the runtime's periodic WAL tick (bounded loss window),
+    # "never" leaves durability to the OS.  One knob like
+    # serving_pipeline_depth: None = the FANTOCH_WAL_SYNC env var, else
+    # "interval"; an explicit value here beats both.  Only consulted when
+    # a runtime is given a wal_dir (new knob; no reference counterpart —
+    # the reference's runner has no durability story)
+    wal_sync: Optional[str] = None
     # per-dot lifecycle tracing (fantoch_tpu/observability): fraction of
     # commands traced, selected by a deterministic hash of the command id
     # (same seed => same sampled dot set).  0.0 disables tracing entirely
@@ -133,6 +142,13 @@ class Config:
             raise ValueError(
                 f"serving_pipeline_depth = {self.serving_pipeline_depth} "
                 "must be >= 1"
+            )
+        if self.wal_sync is not None and self.wal_sync not in (
+            "always", "interval", "never",
+        ):
+            raise ValueError(
+                f"wal_sync = {self.wal_sync!r} must be one of "
+                "'always' | 'interval' | 'never'"
             )
         if self.device_table_plane and self.newt_clock_bump_interval_ms is not None:
             # real-time clock bumps vote wall-clock micros, which overflow

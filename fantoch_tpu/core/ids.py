@@ -119,6 +119,12 @@ class AtomicIdGen:
     def next_id(self) -> Dot:
         return Dot(self._source, next(self._counter))
 
+    def resume_after(self, sequence: int) -> None:
+        """Restart support: never hand out sequences at or below
+        ``sequence`` (the WAL's recovered dot lease).  Boot-time only —
+        callers must not race this with next_id."""
+        self._counter = itertools.count(sequence + 1)
+
 
 def process_ids(shard_id: ShardId, n: int) -> Iterator[ProcessId]:
     """Process ids of one shard: shard s owns ids s*n+1..=(s+1)*n.

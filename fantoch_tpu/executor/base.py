@@ -81,6 +81,30 @@ class Executor(ABC, Generic[Info]):
         device plane."""
         return None
 
+    def snapshot(self) -> bytes:
+        """Durable image of the executor state (ordering structures,
+        KVStore, emit frontier).  Device-resident planes pickle their
+        host mirrors and lazily re-materialize on the first dispatch
+        after :meth:`restore` (one re-upload, counted by the plane).  The
+        tracer is excluded and reattached by the restorer."""
+        import pickle
+
+        saved = self.__dict__.pop("tracer", None)
+        try:
+            return pickle.dumps(self)
+        finally:
+            if saved is not None:
+                self.__dict__["tracer"] = saved
+
+    @classmethod
+    def restore(cls, blob: bytes) -> "Executor":
+        """Rebuild an executor instance from :meth:`snapshot` output."""
+        import pickle
+
+        executor = pickle.loads(blob)
+        assert isinstance(executor, Executor), type(executor).__name__
+        return executor
+
     def cleanup(self, time: SysTime) -> None:
         """Periodic housekeeping (cross-shard request retries...)."""
 

@@ -844,6 +844,9 @@ class TableExecutor(Executor):
             "table_plane_row_capacity": plane.stats["row_capacity"],
             "table_plane_residual_runs": plane.stats["residual_runs"],
             "table_plane_kernel_ms": round(plane.stats["kernel_ms"], 3),
+            # host->device frontier materializations: stays at 1 in
+            # steady state; restart-from-snapshot costs exactly one more
+            "table_plane_resident_uploads": plane.resident_uploads,
         }
 
     def take_order_arrays(self) -> Tuple["np.ndarray", "np.ndarray"]:

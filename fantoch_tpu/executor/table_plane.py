@@ -55,12 +55,14 @@ class DeviceTablePlane:
         "_keys",
         "_cap",
         "_frontier",
+        "_host_mirror",
         "_res_key",
         "_res_by",
         "_res_start",
         "_res_end",
         "dispatches",
         "grows",
+        "resident_uploads",
         "stats",
     )
 
@@ -72,11 +74,18 @@ class DeviceTablePlane:
         self._keys: List[Key] = []
         self._cap = _pow2(max(key_buckets, 2))
         self._frontier = None  # lazy: created on first dispatch
+        # host copy awaiting re-materialization (restart/unpickle path);
+        # None while the live matrix is device-resident
+        self._host_mirror = None
         empty = np.empty(0, dtype=np.int64)
         self._res_key, self._res_by = empty, empty
         self._res_start, self._res_end = empty, empty
         self.dispatches = 0
         self.grows = 0
+        # host->device frontier materializations: 1 for the lazy initial
+        # upload, +1 per restore-from-snapshot re-upload (the recovery
+        # acceptance signal: restart costs ONE upload, not one per batch)
+        self.resident_uploads = 0
         # per-dispatch observability tallies (observability/device.py):
         # vote_rows/row_capacity is the batch occupancy (padding waste),
         # kernel_ms the blocking dispatch+transfer wall time
@@ -118,8 +127,56 @@ class DeviceTablePlane:
             # would zero-copy alias ``padded``'s numpy memory on CPU, and
             # fused_votes_commit donates this buffer (use-after-free)
             self._frontier = jnp.array(padded)
+            self.resident_uploads += 1
         self._cap = new_cap
         self.grows += 1
+
+    def _materialize(self) -> None:
+        """Ensure the frontier matrix is device-resident: lazy initial
+        creation, or the ONE re-upload from the host mirror after
+        restore-from-snapshot (the restart plane's lazy
+        re-materialization seam — same discipline as
+        ``BatchedKeyClocks``)."""
+        if self._frontier is not None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        if self._host_mirror is not None:
+            padded = np.zeros((self._cap, self.n), dtype=np.int32)
+            rows = min(len(self._host_mirror), self._cap)
+            padded[:rows] = self._host_mirror[:rows]
+            # jnp.array: XLA-owned copy (the donation-safety rule)
+            self._frontier = jnp.array(padded)
+            self._host_mirror = None
+        else:
+            self._frontier = jax.device_put(
+                jnp.zeros((self._cap, self.n), dtype=jnp.int32)
+            )
+        self.resident_uploads += 1
+
+    # --- durability (Executor.snapshot pickles through here) ---
+
+    def __getstate__(self):
+        state = {
+            slot: getattr(self, slot)
+            for slot in self.__slots__
+            if slot not in ("_frontier", "_host_mirror")
+        }
+        host = self._host_mirror
+        if self._frontier is not None:
+            import jax
+
+            host = np.asarray(jax.device_get(self._frontier)).astype(np.int32)
+        state["_host_mirror"] = host
+        return state
+
+    def __setstate__(self, state) -> None:
+        for slot, value in state.items():
+            setattr(self, slot, value)
+        # device state never survives a pickle: the next dispatch
+        # re-materializes from the host mirror (ONE counted upload)
+        self._frontier = None
 
     # --- the fused commit dispatch ---
 
@@ -153,10 +210,7 @@ class DeviceTablePlane:
         vend = np.concatenate([self._res_end, vend])
         V = len(vkey)
 
-        if self._frontier is None:
-            self._frontier = jax.device_put(
-                jnp.zeros((self._cap, self.n), dtype=jnp.int32)
-            )
+        self._materialize()
         if V == 0:
             # nothing to apply: stability unchanged — read it off the
             # resident state with the plain (non-donating) kernel
@@ -216,6 +270,8 @@ class DeviceTablePlane:
         import jax
 
         if self._frontier is None:
+            if self._host_mirror is not None:
+                return self._host_mirror[: self.key_count].astype(np.int64)
             return np.zeros((self.key_count, self.n), dtype=np.int64)
         host = np.asarray(jax.device_get(self._frontier)).astype(np.int64)
         return host[: self.key_count]

@@ -131,6 +131,56 @@ class Protocol(ABC):
         use it to trigger failover without waiting out their own
         protocol-level timeout."""
 
+    def on_peer_up(self, peer_id: ProcessId, time: SysTime) -> None:
+        """Run-layer detector notification symmetric to
+        :meth:`on_peer_down`: a peer declared dead is reachable again (it
+        restarted, or the silence was a false positive).  Default no-op;
+        protocols that route around dead peers (FPaxos pending-forwards,
+        election candidate sets) refresh those targets here so a returned
+        replica stops being routed around."""
+
+    def rejoin(self, time: SysTime) -> None:
+        """Restart hook: queue catch-up actions after this (restored)
+        process re-enters the mesh.  Default no-op; protocols with the
+        sync plane (protocol/sync.py) broadcast ``MSync`` with their
+        committed horizon so live peers stream the commits this process
+        missed while down."""
+
+    def note_durable_commits(self, dots) -> None:
+        """Restart-replay hook: commit dots whose effects the WAL tail
+        replay already applied to the executors.  Default no-op;
+        GC-tracking protocols fold them into the committed clock so the
+        rejoin horizon covers them (protocol/commit_gc.py)."""
+
+    def snapshot(self) -> bytes:
+        """Durable image of the full protocol state (commit info, per-dot
+        synod state, dedup tables, GC clocks).  The tracer is excluded —
+        an open span log is not durable state — and reattached by the
+        restorer via :meth:`set_tracer`.  Restart = ``restore(snapshot)``
+        [+ WAL tail replay in the run layer] + :meth:`rejoin` catch-up."""
+        import pickle
+
+        bp = getattr(self, "bp", None)
+        saved = None
+        if bp is not None and bp.tracer is not NOOP_TRACER:
+            saved, bp.tracer = bp.tracer, NOOP_TRACER
+        try:
+            return pickle.dumps(self)
+        finally:
+            if saved is not None:
+                bp.tracer = saved
+
+    @classmethod
+    def restore(cls, blob: bytes) -> "Protocol":
+        """Rebuild a protocol instance from :meth:`snapshot` output."""
+        import pickle
+
+        process = pickle.loads(blob)
+        assert isinstance(process, cls), (
+            f"snapshot holds {type(process).__name__}, not {cls.__name__}"
+        )
+        return process
+
     def nudge_recovery(self, dots, time: SysTime) -> None:
         """Executor-watchdog hint: these dots are missing dependencies of
         committed commands.  Default no-op; recovery-capable protocols

@@ -66,6 +66,17 @@ class CommitGCMixin:
             return False
         return True
 
+    def note_durable_commits(self, dots) -> None:
+        """Restart-replay hook (run/wal.py): fold WAL-tail commit dots
+        into the committed clock so the rejoin horizon (MSync) covers
+        them — peers must not re-stream commits whose effects the
+        executor tail replay already applied (re-applying would execute
+        them twice).  Single-shard only, like the sync plane."""
+        if self.bp.config.shard_count != 1:
+            return
+        for dot in dots:
+            self._gc_track.add_to_clock(dot)
+
     def handle_gc_event(self) -> None:
         """Periodic: broadcast our committed clock."""
         committed = self._gc_track.clock()

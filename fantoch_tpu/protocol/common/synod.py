@@ -91,7 +91,14 @@ class Synod(Generic[V]):
         > n once a recovery prepare ran (ballot = id + n * round)."""
         return self._proposer._ballot
 
-    def handle(self, from_: ProcessId, msg) -> Optional[SynodMessage]:
+    def handle(self, from_: ProcessId, msg, free_choice_adjust=None) -> Optional[SynodMessage]:
+        """``free_choice_adjust`` (optional, transient — callers pass it
+        per call so nothing unpicklable sticks to consensus state) maps
+        the proposal-generator's value right before it is proposed.  It
+        applies ONLY on the free-choice path (no promise carried an
+        accepted ballot); a value bound by a prior accept is never
+        touched.  The recovery plane uses it to lift recovered clocks
+        above the promise quorum's stability floor."""
         if isinstance(msg, MChosen):
             self._chosen = True
             self._acceptor.set_value(msg.value)
@@ -101,7 +108,9 @@ class Synod(Generic[V]):
         if isinstance(msg, MAccept):
             return self._chosen_msg() or self._acceptor.handle_accept(msg.ballot, msg.value)
         if isinstance(msg, MPromise):
-            return self._proposer.handle_promise(from_, msg.ballot, msg.accepted)
+            return self._proposer.handle_promise(
+                from_, msg.ballot, msg.accepted, free_choice_adjust
+            )
         if isinstance(msg, MAccepted):
             return self._proposer.handle_accepted(from_, msg.ballot, self._acceptor)
         raise AssertionError(f"unknown synod message {msg}")
@@ -143,7 +152,7 @@ class _Proposer(Generic[V]):
         proposal, self._proposal = self._proposal, None
         return promises, proposal
 
-    def handle_promise(self, from_, ballot, accepted) -> Optional[MAccept]:
+    def handle_promise(self, from_, ballot, accepted, free_choice_adjust=None) -> Optional[MAccept]:
         if ballot != self._ballot:
             return None
         self._promises[from_] = accepted
@@ -151,12 +160,15 @@ class _Proposer(Generic[V]):
             return None
         promises, _ = self._reset_state()
         # pick the value accepted at the highest ballot; if none was accepted
-        # (all ballot 0), ask the proposal generator
+        # (all ballot 0), ask the proposal generator — the one point where
+        # the value is a free (therefore adjustable) choice
         highest_from = max(promises, key=lambda p: promises[p][0])
         highest_ballot = promises[highest_from][0]
         if highest_ballot == 0:
             values = {p: v for p, (_b, v) in promises.items()}
             proposal = self._proposal_gen(values)
+            if free_choice_adjust is not None:
+                proposal = free_choice_adjust(proposal)
         else:
             proposal = promises[highest_from][1]
         self._proposal = proposal

@@ -192,6 +192,23 @@ class BatchedKeyClocks:
                 self._pending_bumps[idx] = up_to
         current[behind] = up_to
 
+    def backfill_votes(self) -> Votes:
+        """Array twin of ``SequentialKeyClocks.backfill_votes``: one
+        ``[1, clock]`` range per known key — the contiguous prefix of
+        every vote this process ever issued (see the host twin for why
+        that invariant holds).  Used by the rejoin plane
+        (protocol/sync.py); does not disturb device residency."""
+        self._sync_host()
+        votes = Votes()
+        count = self._count
+        clocks = self._clocks[:count]
+        for idx in np.nonzero(clocks > 0)[0].tolist():
+            votes.add(
+                self._keys[idx],
+                VoteRange(self.process_id, 1, int(clocks[idx])),
+            )
+        return votes
+
     @classmethod
     def parallel(cls) -> bool:
         return False

@@ -134,6 +134,21 @@ class SequentialKeyClocks:
         for key in self._clocks:
             self._maybe_bump(key, up_to, votes)
 
+    def backfill_votes(self) -> Votes:
+        """Re-statement of every vote this process ever issued: one
+        ``[1, clock]`` range per known key.  Proposals and detached bumps
+        both advance ``_clocks`` by exactly the ranges they vote, so a
+        process's issued votes on a key are always the contiguous prefix
+        up to its clock.  Safe to re-send wholesale (ranges dedup in the
+        vote tables) — the rejoin plane (protocol/sync.py) uses it to
+        heal the vote-frontier gaps a restarted replica would otherwise
+        stall below forever."""
+        votes = Votes()
+        for key, clock in self._clocks.items():
+            if clock > 0:
+                votes.add(key, VoteRange(self.process_id, 1, clock))
+        return votes
+
     @classmethod
     def parallel(cls) -> bool:
         return False

@@ -452,6 +452,22 @@ class FPaxos(Protocol):
             self._leader_heard = time.millis()
             self._start_election()
 
+    def on_peer_up(self, peer_id: ProcessId, time: SysTime) -> None:
+        """Detector hook symmetric to :meth:`on_peer_down`: the peer is
+        reachable again (restarted, or a false positive).  It re-enters
+        the election candidate ring — a later failover may elect it —
+        and our pending forwards are re-sent toward the current leader:
+        frames queued while the peer was declared dead were dropped, and
+        the leader's rifl dedup makes the re-forward exactly-once."""
+        if not self._failover:
+            return
+        self._down.discard(peer_id)
+        if self._pending_forwards and self._leader != self.id:
+            for cmd in self._pending_forwards.values():
+                self._to_processes.append(
+                    ToSend({self._leader}, MForwardSubmit(cmd))
+                )
+
     # --- worker routing (fpaxos.rs:416-465) ---
 
     @staticmethod

@@ -27,6 +27,18 @@ class GCTrack:
         """Contiguous frontier of locally committed dots."""
         return self._my_clock.frontier()
 
+    def my_clock(self) -> AEClock[ProcessId]:
+        """Copy of the full committed clock (frontier + above-exceptions):
+        the horizon a restarted replica sends with MSync.  Unlike
+        ``_cmds`` this is never trimmed by GC, so it also covers commits
+        whose info was already collected locally."""
+        return self._my_clock.copy()
+
+    def contains(self, dot: Dot) -> bool:
+        """Whether ``dot`` was ever committed here (GC'd or not)."""
+        events = self._my_clock.get(dot.source)
+        return events is not None and events.contains(dot.sequence)
+
     def add_to_clock(self, dot: Dot) -> None:
         self._my_clock.add(dot.source, dot.sequence)
         assert len(self._my_clock) == self._n, "dots must belong to this shard"

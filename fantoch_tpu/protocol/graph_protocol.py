@@ -57,13 +57,18 @@ from fantoch_tpu.protocol.recovery import (
     RecoveryEvent,
     RecoveryMixin,
 )
+from fantoch_tpu.protocol.sync import MSync, MSyncReply, SyncMixin
 from fantoch_tpu.protocol.partial import (
     MForwardSubmit,
     MShardAggregatedCommit,
     MShardCommit,
     PartialCommitMixin,
 )
-from fantoch_tpu.run.routing import worker_dot_index_shift
+from fantoch_tpu.run.routing import (
+    GC_WORKER_INDEX,
+    worker_dot_index_shift,
+    worker_index_no_shift,
+)
 
 
 # --- messages (epaxos.rs:675-702 / atlas.rs:836-871) ---
@@ -225,7 +230,7 @@ class GraphCommandInfo:
         self.quorum_deps = QuorumDeps(quorum_deps_size)
 
 
-class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
+class GraphProtocol(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol):
     """Common skeleton; see module docstring for the specialization points."""
 
     Executor = GraphExecutor
@@ -330,6 +335,8 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             self._handle_mconsensusack(from_, msg.dot, msg.ballot)
         elif self.handle_recovery_message(from_, msg, time):
             pass
+        elif self.handle_sync_message(from_, msg, time):
+            pass
         elif self.handle_partial_message(from_, msg):
             pass
         elif not self.handle_gc_message(from_, msg):
@@ -376,6 +383,8 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
         return dot
 
     def _handle_mcollect(self, from_, dot, cmd, quorum, remote_deps, time) -> None:
+        if self._gc_track.contains(dot):
+            return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         if info.status != Status.START:
             return
@@ -430,6 +439,8 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             self._handle_mcommit(buf_from, dot, buf_value, time)
 
     def _handle_mcollectack(self, from_, dot, deps) -> None:
+        if self._gc_track.contains(dot):
+            return  # straggler for a GC'd dot: do not resurrect its info
         if not self.coordinator_self_ack():
             assert from_ != self.bp.process_id
         info = self._cmds.get(dot)
@@ -460,6 +471,8 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             )
 
     def _handle_mcommit(self, from_, dot, value, time) -> None:
+        if self._gc_track.contains(dot):
+            return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         if info.status == Status.COMMIT:
             return
@@ -498,6 +511,8 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             self._cmds.gc_single(dot)
 
     def _handle_mconsensus(self, from_, dot, ballot, value, cmd=None, time=None) -> None:
+        if self._gc_track.contains(dot):
+            return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         if cmd is not None and info.cmd is None:
             self._adopt_recovered_payload(dot, info, cmd, time)
@@ -517,6 +532,8 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             raise AssertionError(f"unexpected synod output {out}")
 
     def _handle_mconsensusack(self, from_, dot, ballot) -> None:
+        if self._gc_track.contains(dot):
+            return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         out = info.synod.handle(from_, SynodMAccepted(ballot))
         if out is None:
@@ -548,6 +565,24 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
         # commits must carry the cross-shard aggregate
         if info.cmd is None or info.cmd.shard_count == 1:
             self._to_processes.append(ToSend({to}, MCommit(dot, value)))
+
+    # --- rejoin sync hooks (protocol/sync.py) ---
+
+    def _sync_record(self, dot, info):
+        # the decided value lives in the per-dot synod once MChosen ran
+        # (commit bookkeeping); cmd is None for recovered noops
+        return (dot, info.cmd, info.synod.value())
+
+    def _apply_sync_record(self, from_, record, time) -> None:
+        dot, cmd, value = record
+        if self._gc_track.contains(dot):
+            return  # committed (and possibly executed + GC'd) here already
+        info = self._cmds.get(dot)
+        if info.status == Status.COMMIT:
+            return
+        if cmd is not None and info.cmd is None:
+            self._adopt_recovered_payload(dot, info, cmd, time)
+        self._handle_mcommit(from_, dot, value, time)
 
     # --- partial-replication adapters (deps union; atlas.rs:559-650) ---
 
@@ -583,6 +618,10 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             ),
         ):
             return worker_dot_index_shift(msg.dot)
+        if isinstance(msg, (MSync, MSyncReply)):
+            # dotless rejoin traffic: serialized on the GC worker (whose
+            # committed clock it reads and whose retention it rides)
+            return worker_index_no_shift(GC_WORKER_INDEX)
         gc_index = CommitGCMixin.gc_message_index(msg)
         if gc_index is not None:
             return gc_index[0]

@@ -74,6 +74,11 @@ class CommandsInfo(Generic[I]):
         """Remove and return the info for `dot` (None if absent)."""
         return self._infos.pop(dot, None)
 
+    def items(self):
+        """Live (dot, info) pairs (insertion order) — the sync plane's
+        scan surface (protocol/sync.py)."""
+        return self._infos.items()
+
     def __len__(self) -> int:
         return len(self._infos)
 

@@ -69,6 +69,7 @@ from fantoch_tpu.protocol.common.synod import (
 from fantoch_tpu.protocol.common.table_clocks import (
     KeyClocks,
     QuorumClocks,
+    VoteRange,
     Votes,
 )
 from fantoch_tpu.protocol.gc import GCTrack
@@ -85,6 +86,7 @@ from fantoch_tpu.protocol.recovery import (
     RecoveryEvent,
     RecoveryMixin,
 )
+from fantoch_tpu.protocol.sync import MSync, MSyncReply, SyncMixin
 from fantoch_tpu.run.routing import (
     worker_dot_index_shift,
     worker_index_no_shift,
@@ -194,6 +196,26 @@ def _recovery_proposal_gen(values):
     return max(values.values(), default=0)
 
 
+def _subtract_pending(votes: Votes, pending: Dict[str, list], by: ProcessId) -> Votes:
+    """Remove ``pending`` intervals (per key) from backfill ``votes``
+    (each key holds contiguous [1, clock] ranges by ``by``) — the
+    consumed-for-pending-dots exclusion of the rejoin backfill."""
+    out = Votes()
+    for key, key_votes in votes:
+        holes = sorted(pending.get(key, ()))
+        for vote in key_votes:
+            cursor = vote.start
+            for hole_start, hole_end in holes:
+                if hole_end < cursor or hole_start > vote.end:
+                    continue
+                if hole_start > cursor:
+                    out.add(key, VoteRange(by, cursor, hole_start - 1))
+                cursor = max(cursor, hole_end + 1)
+            if cursor <= vote.end:
+                out.add(key, VoteRange(by, cursor, vote.end))
+    return out
+
+
 def _newt_info_factory(pid, _sid, cfg, fq, _wq) -> "NewtInfo":
     """Picklable per-dot info factory (the model checker pickles state)."""
     return NewtInfo(pid, cfg.n, cfg.f, fq)
@@ -224,7 +246,7 @@ CLOCK_BUMP_WORKER_INDEX = 1
 _MBUMP_BUFFER_CAP = 4096
 
 
-class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
+class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol):
     Executor = TableExecutor
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
@@ -359,6 +381,8 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             self._handle_mbump(msg.dot, msg.clock)
         elif self.handle_recovery_message(from_, msg, time):
             pass
+        elif self.handle_sync_message(from_, msg, time):
+            pass
         elif self.handle_partial_message(from_, msg):
             pass
         elif not self.handle_gc_message(from_, msg):
@@ -435,6 +459,8 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
         self._to_processes.append(ToSend(self.bp.all(), mcollect))
 
     def _handle_mcollect(self, from_, dot, cmd, quorum, remote_clock, votes, time) -> None:
+        if self._gc_track.contains(dot):
+            return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         if info.status != Status.START:
             return
@@ -522,6 +548,8 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             self._handle_mcommit(buf_from, dot, buf_clock, buf_votes, buf_recovered)
 
     def _handle_mcollectack(self, from_, dot, clock, remote_votes) -> None:
+        if self._gc_track.contains(dot):
+            return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         if info.status != Status.COLLECT:
             return
@@ -609,6 +637,22 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
     def _recovery_consensus_msg(self, dot, ballot, value, cmd):
         return MConsensus(dot, ballot, value, cmd)
 
+    def _recovery_promise_floor(self, info) -> int:
+        # current max key clock over the dot's keys: upper-bounds every
+        # vote this acceptor has issued for them, and therefore any
+        # stability its column contributed to
+        if info.cmd is None:
+            return 0
+        return self.key_clocks._cmd_clock(info.cmd)
+
+    def _recovery_adjust_value(self, info, value, floor: int):
+        # free-choice clocks lift STRICTLY above the quorum's floor: at
+        # the floor itself a smaller dot would still sort before an
+        # already-executed equal-clock command.  Noop (0) stays noop.
+        if value == 0:
+            return value
+        return max(value, floor + 1)
+
     def _recovery_chosen_reply(self, to, dot, info, value) -> None:
         # same single-shard guard as the late-MConsensus reply; recovered
         # so the receiver re-broadcasts any votes it still holds
@@ -616,6 +660,61 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             self._to_processes.append(
                 ToSend({to}, MCommit(dot, value, info.votes, recovered=True))
             )
+
+    # --- rejoin sync hooks (protocol/sync.py) ---
+
+    def _sync_record(self, dot, info):
+        # clock 0 == recovered noop; the commit's quorum votes were
+        # consumed into tables long ago, so the record carries none — the
+        # backfill re-statement below supplies the frontier coverage
+        return (dot, info.cmd, info.synod.value())
+
+    def _apply_sync_record(self, from_, record, time) -> None:
+        dot, cmd, clock = record
+        if self._gc_track.contains(dot):
+            return  # committed (and possibly executed + GC'd) here already
+        info = self._cmds.get(dot)
+        if info.status == Status.COMMIT:
+            return
+        if cmd is not None and info.cmd is None:
+            self._adopt_recovered_payload(dot, info, cmd, time)
+        # recovered=True: if we held consumed-but-unshipped votes for the
+        # dot across the crash, the commit handler re-broadcasts them
+        # commit-coupled so no peer's frontier keeps our gap
+        self._handle_mcommit(from_, dot, clock, Votes(), recovered=True)
+
+    def _sync_backfill_actions(self, targets) -> None:
+        """Vote-frontier healing: our issued votes are exactly [1, clock]
+        per key (see KeyClocks.backfill_votes) — re-state them toward the
+        rejoin participants (ranges dedup in the vote tables), MINUS the
+        ranges consumed for still-pending dots.  Those must only ever
+        travel commit-coupled: a table that sees them detached before
+        the dot's ops would let stability overtake the commit and
+        execute around it (the order-divergence hazard the commit
+        handler's held-vote discipline exists to prevent).  The pending
+        copies the recovery plane keeps (``info.votes``) are exactly
+        that exclusion set, so backfill requires recovery enabled.
+        Ordering note: the sync plane appends the backfill AFTER the
+        MSyncReply record chunks, so a receiver folds every missing
+        commit's ops in before the frontier re-statement arrives."""
+        if not self._recovery_enabled():
+            return
+        votes = self.key_clocks.backfill_votes()
+        if votes.is_empty():
+            return
+        me = self.bp.process_id
+        pending: Dict[str, list] = {}
+        for _dot, info in self._cmds.items():
+            if info.status == Status.COMMIT or info.votes.is_empty():
+                continue
+            for key, key_votes in info.votes:
+                for vote in key_votes:
+                    if vote.by == me:
+                        pending.setdefault(key, []).append((vote.start, vote.end))
+        if pending:
+            votes = _subtract_pending(votes, pending, me)
+        if not votes.is_empty():
+            self._to_processes.append(ToSend(set(targets), MDetached(votes)))
 
     # --- partial-replication adapters (clock max; newt.rs:825-895) ---
 
@@ -629,6 +728,21 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
         return MCommit(dot, data, local if local is not None else Votes())
 
     def _handle_mcommit(self, from_, dot, clock, votes: Votes, recovered=False) -> None:
+        if self._gc_track.contains(dot):
+            # straggler for a dot already committed-everywhere and GC'd
+            # (late retransmit, held-vote re-broadcast, rejoin traffic):
+            # `_cmds.get` would resurrect a fresh START info and a later
+            # payload adoption could REPLAY the commit — double-adding
+            # the ops to the table.  The ops executed long ago; only the
+            # carried vote ranges still matter (fold them detached)
+            if not votes.is_empty():
+                if self._commit_arrays is not None:
+                    for key, key_votes in votes:
+                        self._commit_arrays.add_detached(key, key_votes)
+                else:
+                    for key, key_votes in votes:
+                        self._to_executors.append(TableDetachedVotes(key, key_votes))
+            return
         info = self._cmds.get(dot)
         if info.status == Status.COMMIT:
             # duplicate commit — typically a member re-broadcasting its
@@ -741,6 +855,8 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             self._to_executors.append(TableDetachedVotes(key, key_votes))
 
     def _handle_mconsensus(self, from_, dot, ballot, clock, cmd=None, time=None) -> None:
+        if self._gc_track.contains(dot):
+            return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         if cmd is not None and info.cmd is None:
             self._adopt_recovered_payload(dot, info, cmd, time)
@@ -763,6 +879,8 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             raise AssertionError(f"unexpected synod output {out}")
 
     def _handle_mconsensusack(self, from_, dot, ballot) -> None:
+        if self._gc_track.contains(dot):
+            return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         out = info.synod.handle(from_, SynodMAccepted(ballot))
         if out is None:
@@ -817,6 +935,10 @@ class Newt(PartialCommitMixin, RecoveryMixin, CommitGCMixin, Protocol):
             return worker_index_no_shift(CLOCK_BUMP_WORKER_INDEX)
         if isinstance(msg, MDetached):
             # any worker may feed detached votes to the executors
+            return worker_index_no_shift(0)
+        if isinstance(msg, (MSync, MSyncReply)):
+            # dotless rejoin traffic: serialized on the GC worker (whose
+            # committed clock it reads and whose retention it rides)
             return worker_index_no_shift(0)
         gc_index = CommitGCMixin.gc_message_index(msg)
         if gc_index is not None:

@@ -28,7 +28,13 @@ Protocol flow (per overdue dot):
    protocol's ``proposal_gen`` runs over the ballot-0 reports: the union
    of reported deps / the max reported clock, or the protocol's *noop*
    bottom for dots never payloaded anywhere visible (owner crashed before
-   its MCollect got out).
+   its MCollect got out).  On that free-choice path the value is also
+   passed through the protocol's ``_recovery_adjust_value`` with the max
+   ``clock_floor`` the promises carried: Newt lifts recovered clocks
+   strictly above the quorum's current key clocks, so a recovery-decided
+   timestamp can never land at or below timestamps the survivors may
+   already have executed past (the live-vs-reconstructed order
+   divergence a *restarted* replica would otherwise expose).
 5. **Phase 2** — the chosen value flows through the protocols' existing
    MConsensus/MConsensusAck handlers (broadcast rather than
    write-quorum-only, since quorum members may be the dead ones) and
@@ -81,6 +87,17 @@ class MRecoveryPromise:
     ballot: int
     accepted: Tuple[int, Any]  # (accepted ballot, value)
     cmd: Optional[Command]  # payload piggyback for processes that miss it
+    # the acceptor's current clock floor for the dot's keys (Newt: max
+    # key clock; 0 when the payload is unknown or the protocol has no
+    # clocks).  When the recovered value is a FREE choice (no promise
+    # carried an accepted ballot), the proposer lifts the chosen clock
+    # above the quorum's max floor: an n-f promise quorum intersects
+    # every stability-threshold set, so the max floor upper-bounds any
+    # timestamp that may already be stable — without the lift, a
+    # recovered clock can land BELOW timestamps the survivors already
+    # executed past, and a replica that later reconstructs order from
+    # table state (a restarted one) diverges from the live history
+    clock_floor: int = 0
 
 
 @dataclass
@@ -108,6 +125,9 @@ class RecoveryMixin:
         # prepares issued for never-payloaded dots (tracer counters are
         # running totals)
         self._unpayloaded_prepares = 0
+        # dot -> (ballot, max promise clock_floor) for the free-choice
+        # clock lift (see MRecoveryPromise.clock_floor)
+        self._promise_floors: Dict[Dot, Tuple[int, int]] = {}
 
     def _recovery_enabled(self) -> bool:
         cfg = self.bp.config
@@ -128,6 +148,9 @@ class RecoveryMixin:
     def _recovery_untrack(self, dot: Dot) -> None:
         if self._recovery_enabled():
             self._pending_since.pop(dot, None)
+            # floor bookkeeping for an abandoned/committed round must not
+            # outlive the dot (it is not GC'd with the per-dot info)
+            self._promise_floors.pop(dot, None)
 
     # --- triggers ---
 
@@ -195,7 +218,8 @@ class RecoveryMixin:
             self._handle_recovery_prepare(from_, msg.dot, msg.ballot)
         elif isinstance(msg, MRecoveryPromise):
             self._handle_recovery_promise(
-                from_, msg.dot, msg.ballot, msg.accepted, msg.cmd, time
+                from_, msg.dot, msg.ballot, msg.accepted, msg.cmd, time,
+                getattr(msg, "clock_floor", 0),
             )
         else:
             return False
@@ -210,7 +234,10 @@ class RecoveryMixin:
             self._to_processes.append(
                 ToSend(
                     {from_},
-                    MRecoveryPromise(dot, out.ballot, out.accepted, info.cmd),
+                    MRecoveryPromise(
+                        dot, out.ballot, out.accepted, info.cmd,
+                        self._recovery_promise_floor(info),
+                    ),
                 )
             )
         elif isinstance(out, SynodMChosen):
@@ -227,16 +254,34 @@ class RecoveryMixin:
         accepted: Tuple[int, Any],
         cmd: Optional[Command],
         time: SysTime,
+        clock_floor: int = 0,
     ) -> None:
         info = self._cmds.get(dot)
         if cmd is not None and info.cmd is None:
             # adopt the piggybacked payload so a later commit can execute
             # even if the original MCollect never reached us
             self._adopt_recovered_payload(dot, info, cmd, time)
-        out = info.synod.handle(from_, SynodMPromise(ballot, accepted))
+        # floor bookkeeping for the free-choice clock lift: track the max
+        # reported floor per (dot, ballot) round; the synod applies the
+        # adjuster ONLY when the value is a free choice (no promise
+        # carried an accepted ballot), so a bound value is never touched
+        state = self._promise_floors.get(dot)
+        if state is None or state[0] != ballot:
+            state = (ballot, 0)
+        state = (ballot, max(state[1], clock_floor))
+        self._promise_floors[dot] = state
+        floor = state[1]
+
+        def adjust(value):
+            return self._recovery_adjust_value(info, value, floor)
+
+        out = info.synod.handle(
+            from_, SynodMPromise(ballot, accepted), free_choice_adjust=adjust
+        )
         if out is None:
             return  # not this ballot, or still below n - f promises
         assert isinstance(out, SynodMAccept), f"unexpected synod output {out}"
+        self._promise_floors.pop(dot, None)
         # broadcast (not write-quorum-only): the write quorum was sized for
         # the failure-free path and may contain the dead processes recovery
         # is routing around; phase-2 still only needs f + 1 accepts
@@ -248,6 +293,21 @@ class RecoveryMixin:
         )
 
     # --- hooks for the host protocol ---
+
+    def _recovery_promise_floor(self, info) -> int:
+        """The acceptor's clock floor for the dot's keys (see
+        MRecoveryPromise.clock_floor).  Default 0 — clockless protocols
+        (the graph family) never lift."""
+        return 0
+
+    def _recovery_adjust_value(self, info, value, floor: int):
+        """Lift a FREE-choice recovered value above the promise quorum's
+        max clock floor.  Default identity; Newt lifts non-noop clocks to
+        ``max(value, floor + 1)`` so a recovered timestamp can never land
+        at or below a timestamp the survivors may already have executed
+        past (which would make live execution order diverge from the
+        canonical (clock, dot) order a restarted replica reconstructs)."""
+        return value
 
     def _adopt_recovered_payload(self, dot: Dot, info, cmd: Command, time: SysTime) -> None:
         info.cmd = cmd

@@ -1,0 +1,140 @@
+"""Rejoin catch-up: committed-command sync for restarted replicas.
+
+A replica that crashes and restarts from its WAL + snapshot (run/wal.py,
+sim crash-restart) knows everything it committed before the crash but
+nothing the mesh decided while it was down.  Peers dropped its frames the
+moment they declared it dead, so the network never replays that history —
+the returning replica must *pull* it.  This mixin is the pull:
+
+1. **MSync** — on :meth:`rejoin` the restarted process broadcasts its
+   committed-dot horizon: the GC tracker's own AEClock (contiguous
+   frontier + above-exceptions), which survives in the snapshot and —
+   because GC only trims ``_cmds``, never the clock — also covers commits
+   whose info was already garbage-collected locally.
+2. **MSyncReply** — each live peer scans its commit-info store for
+   committed dots outside that horizon and streams protocol-specific
+   commit records back, chunked (:data:`SYNC_CHUNK` per message) so one
+   reply never balloons.  Retention is guaranteed by the
+   executed-everywhere GC clock: while the requester was down its
+   executed frontier froze, so the mesh's stability meet — and therefore
+   GC — stalled at its last notification; everything it missed is still
+   in some live peer's ``_cmds``.
+3. **Apply** — the requester applies each record through the protocol's
+   normal commit machinery (payload adoption + MCommit handler), which is
+   idempotent per dot (``Status.COMMIT`` short-circuit), so the same
+   record arriving from several peers — or racing a recovery-decided
+   commit — is exactly-once.
+
+Protocols plug in two hooks (:meth:`SyncMixin._sync_record` /
+:meth:`SyncMixin._apply_sync_record`) plus an optional
+:meth:`SyncMixin._sync_backfill_actions` used by Newt: vote-frontier gaps
+cannot be reconstructed from commit records alone, but every process's
+issued votes on a key are exactly the contiguous range ``[1, its key
+clock]``, so peers (and the rejoiner) re-state that range wholesale as
+detached votes — ranges dedup in the vote tables, and the restarted
+replica's stability frontier heals instead of stalling below a
+permanent gap.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Tuple
+
+from fantoch_tpu.core.ids import ProcessId
+from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.protocol.base import ToSend
+
+# commit records per MSyncReply message: bounds per-message work at the
+# requester and keeps the sim's per-delivery cost flat
+SYNC_CHUNK = 128
+
+
+@dataclass
+class MSync:
+    """Restarted replica -> everyone: my committed horizon (an
+    ``AEClock[ProcessId]``); send me what I missed."""
+
+    committed: Any
+
+
+@dataclass
+class MSyncReply:
+    """One chunk of protocol-specific commit records past the
+    requester's horizon."""
+
+    records: List[Tuple]
+
+
+class SyncMixin:
+    """Requires from the host protocol: ``self.bp`` (BaseProcess),
+    ``self._cmds`` (CommandsInfo with ``items()``), ``self._gc_track``
+    (GCTrack), ``self._to_processes`` (deque), and a ``Status`` whose
+    committed state is ``"commit"``.  Single-shard only, like the
+    recovery plane (cross-shard commit aggregation state dies with the
+    dot owner)."""
+
+    _SYNC_STATUS_COMMIT = "commit"
+
+    def _sync_enabled(self) -> bool:
+        return self.bp.config.shard_count == 1
+
+    # --- the restarted side ---
+
+    def rejoin(self, time: SysTime) -> None:
+        if not self._sync_enabled():
+            return
+        targets = self.bp.all_but_me()
+        if not targets:
+            return
+        self._to_processes.append(
+            ToSend(targets, MSync(self._gc_track.my_clock()))
+        )
+        self._sync_backfill_actions(targets)
+
+    # --- wire handlers ---
+
+    def handle_sync_message(self, from_: ProcessId, msg: Any, time: SysTime) -> bool:
+        """Dispatch a sync message; returns False if ``msg`` is not one."""
+        if isinstance(msg, MSync):
+            self._handle_msync(from_, msg.committed, time)
+        elif isinstance(msg, MSyncReply):
+            for record in msg.records:
+                self._apply_sync_record(from_, record, time)
+        else:
+            return False
+        return True
+
+    def _handle_msync(self, from_: ProcessId, committed, time: SysTime) -> None:
+        if not self._sync_enabled():
+            return
+        records = []
+        # sorted: chunk contents are a pure function of protocol state,
+        # not dict insertion history — same-seed traces stay identical
+        for dot, info in sorted(self._cmds.items()):
+            if info.status != self._SYNC_STATUS_COMMIT:
+                continue
+            if committed.contains(dot.source, dot.sequence):
+                continue
+            records.append(self._sync_record(dot, info))
+        for start in range(0, len(records), SYNC_CHUNK):
+            self._to_processes.append(
+                ToSend({from_}, MSyncReply(records[start : start + SYNC_CHUNK]))
+            )
+        # even with no missing commits the requester may have vote gaps
+        self._sync_backfill_actions({from_})
+
+    # --- hooks for the host protocol ---
+
+    def _sync_backfill_actions(self, targets) -> None:
+        """Optional: queue frontier-backfill actions toward ``targets``
+        (Newt's detached-vote re-statement).  Default no-op."""
+
+    def _sync_record(self, dot, info):
+        """One commit record for ``dot`` (committed here, unknown to the
+        requester)."""
+        raise NotImplementedError
+
+    def _apply_sync_record(self, from_: ProcessId, record, time: SysTime) -> None:
+        """Apply one peer commit record; must be idempotent per dot."""
+        raise NotImplementedError

@@ -70,6 +70,7 @@ class LinkState:
         "seq",
         "resend",
         "dead",
+        "writer_task",
     )
 
     def __init__(self, peer_id: int, addr: Tuple[str, int], index: int, rw: Any):
@@ -77,6 +78,11 @@ class LinkState:
         self.addr = addr
         self.index = index
         self.rw = rw
+        # the one live writer task draining this link (runner-owned):
+        # revival must cancel it before spawning a replacement — a stale
+        # writer parked on queue.get() never observed dead=True, and two
+        # writers interleaving one seq window silently lose frames
+        self.writer_task = None
         # the queue the writer task drains (set by the runner; with a
         # delay line this is the line's sink, not the enqueue side)
         self.queue: Optional[asyncio.Queue] = None
@@ -122,3 +128,12 @@ class PeerLinks:
         self.dead = True
         for link in self.links:
             link.dead = True
+
+    def mark_alive(self) -> None:
+        """Revive a peer declared lost (it restarted, or the silence was
+        a false positive): frames flow again and each link's writer —
+        respawned by the runner — reconnects and resends its unacked
+        window."""
+        self.dead = False
+        for link in self.links:
+            link.dead = False

@@ -63,11 +63,15 @@ class ProcessHi:
     """Peer-link handshake.  ``link`` identifies which of the sender's
     ``multiplexing`` links this connection carries: the receiver keys its
     dedup state on (process_id, link) so a reconnected link resumes where
-    its predecessor stopped (run/links.py)."""
+    its predecessor stopped (run/links.py).  ``incarnation`` is the
+    sender's WAL boot counter (run/wal.py): a *restarted* process starts
+    a fresh sequence space, so the receiver resets its per-link dedup
+    when the incarnation changes — same-life reconnects keep it."""
 
     process_id: ProcessId
     shard_id: ShardId
     link: int = 0
+    incarnation: int = 0
 
 
 @dataclass

@@ -83,6 +83,26 @@ def _peek_is_submit(queue: "asyncio.Queue") -> bool:
         return False
 
 
+def _info_commit_dots(info: Any) -> List[Any]:
+    """The commit dots a logged execution info carries (WAL replay uses
+    them to advance the restored committed horizon).  Per-command infos
+    expose ``.dot``; the array batches carry dot columns; dotless infos
+    (detached votes, requests, slot infos) contribute none."""
+    from fantoch_tpu.core.ids import Dot
+
+    dot = getattr(info, "dot", None)
+    if isinstance(dot, Dot):
+        return [dot]
+    dot_src = getattr(info, "dot_src", None)
+    dot_seq = getattr(info, "dot_seq", None)
+    if dot_src is not None and dot_seq is not None:
+        return [
+            Dot(int(source), int(sequence))
+            for source, sequence in zip(dot_src, dot_seq)
+        ]
+    return []
+
+
 def executor_index(info: Any, size: int) -> Optional[int]:
     """Executor routing: by key hash when the info names a key
     (fantoch/src/executor/mod.rs:161-166), else executor 0.  A ``key``
@@ -170,7 +190,7 @@ class _ClientSession:
                 self.pending.wait_for(cmd)
                 self._emit(self.pending.drain_early(cmd.rifl))
                 dot = (
-                    self.runtime.dot_gen.next_id()
+                    self.runtime.next_dot()
                     if self.runtime.protocol_cls.leaderless()
                     else None
                 )
@@ -218,6 +238,8 @@ class ProcessRuntime:
         heartbeat_interval_s: Optional[float] = 1.0,
         heartbeat_misses: int = 8,
         trace_file: Optional[str] = None,
+        wal_dir: Optional[str] = None,
+        wal_snapshot_interval_ms: int = 2000,
     ):
         self.protocol_cls = protocol_cls
         self.config = config
@@ -257,6 +279,20 @@ class ProcessRuntime:
         ]
         for index, executor in enumerate(self.executors):
             executor.set_executor_index(index)
+        # restart plane (run/wal.py): durable command log + snapshots.
+        # Recovery runs HERE — before executor state sharing and tracer
+        # wiring — so everything downstream operates on restored objects.
+        self.wal = None
+        self.incarnation = 0
+        self._recovered = False
+        self._dot_lease = 0
+        self._lease_gap_dots: List[Any] = []
+        self._wal_snapshot_interval_ms = wal_snapshot_interval_ms
+        if wal_dir is not None:
+            from fantoch_tpu.run.wal import Wal, resolve_wal_sync
+
+            self.wal = Wal(wal_dir, sync=resolve_wal_sync(config.wal_sync))
+            self._recover_from_wal()
         # secondary request-serving executors share the primary's vertex
         # index (the reference's SharedMap across clones, index.rs:19-22):
         # peer-shard requests must be answerable from *pending* vertices or
@@ -266,6 +302,9 @@ class ProcessRuntime:
             for executor in self.executors[1:]:
                 executor.share_state_from(self.executors[0])
         self.dot_gen = AtomicIdGen(process_id)
+        if self._dot_lease:
+            # never re-issue a pre-crash sequence (the WAL dot lease)
+            self.dot_gen.resume_after(self._dot_lease)
         self.client_sessions: Dict[ClientId, _ClientSession] = {}
         assert multiplexing >= 1
         self.multiplexing = multiplexing
@@ -284,6 +323,10 @@ class ProcessRuntime:
         # receiver-side dedup state, keyed (peer, link) so it survives
         # reconnects of the underlying TCP connection
         self._link_recv_seq: Dict[Tuple[ProcessId, int], int] = {}
+        # last seen WAL incarnation per peer: a bumped incarnation means
+        # the peer RESTARTED (fresh seq space) and its dedup state resets;
+        # same-life reconnects keep it (run/wal.py)
+        self._peer_incarnations: Dict[ProcessId, int] = {}
         # live peer-connection rws -> peer id, for the chaos hook
         self._chaos_rws: Dict[Rw, ProcessId] = {}
         # per-connection artificial delay in ms (delay.rs:6-39): outbound
@@ -331,6 +374,165 @@ class ProcessRuntime:
         # worker tears the cluster down loudly instead of stalling it
         self.failure: Optional[BaseException] = None
         self.failed = asyncio.Event()
+
+    # --- restart plane (run/wal.py) ---
+
+    def _recover_from_wal(self) -> None:
+        """Boot-time restart: load the latest snapshot, replay the log
+        tail into the executors, resume the dot lease, and bump the
+        incarnation.  ``start()`` triggers the rejoin sync (MSync
+        catch-up past our horizon) once the mesh is connected."""
+        state = self.wal.recover()
+        self.incarnation = self.wal.incarnation
+        self._dot_lease = state.dot_lease
+        snap = state.snapshot
+        replayed = 0
+        if snap is not None:
+            self.process = self.protocol_cls.restore(snap["protocol"])
+            blobs = snap["executors"]
+            assert len(blobs) == len(self.executors), (
+                "executor pool size changed across restart"
+            )
+            from fantoch_tpu.executor.base import Executor as _Executor
+
+            self.executors = [_Executor.restore(blob) for blob in blobs]
+            for index, executor in enumerate(self.executors):
+                executor.set_executor_index(index)
+            if self.executor_pool.size > 1:
+                # re-apply the per-key-pool arrays opt-out to the
+                # restored protocol instance
+                set_commit_arrays = getattr(self.process, "set_commit_arrays", None)
+                if set_commit_arrays is not None:
+                    set_commit_arrays(False)
+            # infos queued but unconsumed at snapshot time ride the
+            # snapshot (they predate the log position the tail starts at)
+            for info in snap.get("queued_infos", ()):
+                self._replay_info(info)
+                replayed += 1
+        for kind, payload in state.tail:
+            if kind == "info":
+                self._replay_info(payload)
+                replayed += 1
+        # fold every replayed commit dot into the restored protocol's
+        # committed clock: the rejoin horizon (MSync) must cover the
+        # tail, or peers would re-stream commits whose effects the
+        # executor replay already applied — a second application would
+        # execute them twice (exactly-once across restart)
+        tail_dots = sorted(
+            {
+                dot
+                for _kind, payload in state.tail
+                if _kind == "info"
+                for dot in _info_commit_dots(payload)
+            }
+            | {
+                dot
+                for payload in ((snap or {}).get("queued_infos", ()))
+                for dot in _info_commit_dots(payload)
+            }
+        )
+        if tail_dots:
+            self.process.note_durable_commits(tail_dots)
+        # the dot lease's unissued remainder: [last-committed-own-seq+1,
+        # lease] sequences may never be issued again, and GC stability
+        # is a meet of CONTIGUOUS frontiers — an unfilled gap would
+        # freeze the whole mesh's stable frontier for this source
+        # forever.  Rejoin nudges the hole dots into recovery consensus
+        # (they commit as noops where nobody ever saw them; in-flight
+        # ones resolve to their real value), restoring contiguity.
+        self._lease_gap_dots = self._compute_lease_gap()
+        self.wal_replayed_infos = replayed
+        self._recovered = snap is not None or bool(state.tail)
+        if self._recovered:
+            logger.warning(
+                "p%s: recovered from WAL (incarnation %d, snapshot=%s, "
+                "%d replayed commit infos); rejoin sync runs after connect",
+                self.process.id,
+                self.incarnation,
+                snap is not None,
+                replayed,
+            )
+
+    def _compute_lease_gap(self) -> List[Any]:
+        """Own-source dots at or below the recovered lease that are not
+        in the committed clock: never-issued remainder of the last lease
+        batch plus pre-crash in-flight dots.  Bounded by
+        DOT_LEASE_BATCH + the in-flight window."""
+        if not self._dot_lease:
+            return []
+        clock = getattr(self.process, "_gc_track", None)
+        if clock is None or self.config.shard_count != 1:
+            return []
+        from fantoch_tpu.core.ids import Dot
+
+        me = self.process.id
+        mine = clock.my_clock().get(me)
+        return [
+            Dot(me, sequence)
+            for sequence in range(1, self._dot_lease + 1)
+            if mine is None or not mine.contains(sequence)
+        ]
+
+    def _replay_info(self, info: Any) -> None:
+        """Re-feed one logged commit info into its executor.  Results are
+        discarded — their client sessions died with the previous life
+        (clients reconnect and the rifl-dedup seams make re-submission
+        exactly-once); KVStore effects are deterministic re-applies in
+        the original order, so the store converges to the crash state."""
+        executor = self.executors[self._executor_position(info)]
+        executor.handle_batch([info], self.time)
+        for _result in executor.to_clients_iter():
+            pass
+        for _out in executor.to_executors_iter():
+            pass
+
+    def _write_wal_snapshot(self) -> None:
+        """One crash-consistent snapshot: protocol + executors + the
+        infos currently queued toward the executor pool (logged before
+        the snapshot's position but not yet applied — without them the
+        tail would skip their effects).  Runs between task steps on the
+        cooperative loop, so the capture is atomic w.r.t. handlers."""
+        queued: List[Any] = []
+        for position in range(self.executor_pool.size):
+            inner = getattr(self.executor_pool.queue(position), "_queue", None)
+            if inner:
+                queued.extend(inner)
+        self.wal.save_snapshot(
+            {
+                "protocol": self.process.snapshot(),
+                "executors": [executor.snapshot() for executor in self.executors],
+                "queued_infos": queued,
+                "dot_lease": self._dot_lease,
+            }
+        )
+
+    async def _wal_task(self) -> None:
+        """Periodic WAL tick: fsync appends (the ``interval`` policy's
+        loss bound) and take rotation-bounded snapshots so restart is
+        snapshot + a short tail, and the log stays finite."""
+        loop = asyncio.get_running_loop()
+        snap_interval = self._wal_snapshot_interval_ms / 1000
+        tick = min(1.0, snap_interval)
+        last_snapshot = loop.time()
+        while True:
+            await asyncio.sleep(tick)
+            self.wal.sync()
+            if loop.time() - last_snapshot >= snap_interval:
+                self._write_wal_snapshot()
+                last_snapshot = loop.time()
+
+    def next_dot(self):
+        """Dot allocation with the WAL lease: the generator's high
+        watermark is persisted (fsync'd regardless of policy) in
+        DOT_LEASE_BATCH strides ahead of use, so a restarted process can
+        never re-issue a live sequence."""
+        dot = self.dot_gen.next_id()
+        if self.wal is not None and dot.sequence > self._dot_lease:
+            from fantoch_tpu.run.wal import DOT_LEASE_BATCH
+
+            self._dot_lease = dot.sequence + DOT_LEASE_BATCH
+            self.wal.append_lease(self._dot_lease)
+        return dot
 
     # --- lifecycle ---
 
@@ -404,7 +606,10 @@ class ProcessRuntime:
             for index in range(self.multiplexing):
                 rw = await connect_with_retry(addr)
                 await rw.send(
-                    ProcessHi(self.process.id, self.process.shard_id, index)
+                    ProcessHi(
+                        self.process.id, self.process.shard_id, index,
+                        self.incarnation,
+                    )
                 )
                 link = LinkState(peer_id, addr, index, rw)
                 self._chaos_rws[rw] = peer_id
@@ -424,7 +629,7 @@ class ProcessRuntime:
                 else:
                     queue = WarnQueue(f"writer->p{peer_id}")
                     link.queue = queue
-                self.spawn(self._peer_writer_task(link))
+                link.writer_task = self.spawn(self._peer_writer_task(link))
                 self.spawn(self._ack_reader_task(link, rw))
                 links.queues.append(queue)
                 links.links.append(link)
@@ -465,6 +670,12 @@ class ProcessRuntime:
 
             prof.auto_instrument()
             self.spawn(self._tracer_task())
+        if self.wal is not None:
+            self.spawn(self._wal_task())
+        if self._recovered:
+            # rejoin: now that the mesh is connected, broadcast MSync so
+            # live peers stream the commits we missed while down
+            self.workers.forward_to(0, ("rejoin", None))
         self._connected.set()
 
     async def stop(self) -> None:
@@ -488,6 +699,11 @@ class ProcessRuntime:
         if self.metrics_file is not None:
             # final snapshot so short runs always leave one behind
             self._write_metrics_snapshot()
+        if self.wal is not None:
+            # flush, no final snapshot: every recovery is crash-shaped
+            # (last periodic snapshot + tail), so the restart path the
+            # tests exercise is the one production would take
+            self.wal.close()
         self.tracer.close()
 
     # --- connection handlers ---
@@ -498,6 +714,23 @@ class ProcessRuntime:
         if hi is None:
             return  # dialer gave up (e.g. crashed mid-handshake)
         assert isinstance(hi, ProcessHi), f"unexpected handshake {hi}"
+        incarnation = getattr(hi, "incarnation", 0)
+        known = self._peer_incarnations.get(hi.process_id)
+        if known is not None and incarnation != known:
+            # the peer RESTARTED: its links number frames from 1 again —
+            # reset per-link dedup or every new frame would be swallowed
+            # as a duplicate of the previous life
+            for key in list(self._link_recv_seq):
+                if key[0] == hi.process_id:
+                    self._link_recv_seq[key] = 0
+            logger.warning(
+                "p%s: peer p%s handshake with new incarnation %d "
+                "(was %d): link dedup reset",
+                self.process.id, hi.process_id, incarnation, known,
+            )
+        self._peer_incarnations[hi.process_id] = incarnation
+        if hi.process_id in self.dead_peers:
+            self._declare_peer_up(hi.process_id)
         self._chaos_rws[rw] = hi.process_id
         self.spawn(
             self._reader_task(
@@ -556,6 +789,10 @@ class ProcessRuntime:
             if frame is None:
                 return
             self._last_heard[from_] = loop.time()
+            if from_ in self.dead_peers:
+                # frames from a peer we declared dead: it is back (wrong
+                # call, or it restarted and reconnected) — revive it
+                self._declare_peer_up(from_)
             kind, seq, payload = frame
             if kind != KIND_DATA:
                 continue
@@ -734,7 +971,10 @@ class ProcessRuntime:
                     self.send_timeout_s,
                 )
                 await rw.send(
-                    ProcessHi(self.process.id, self.process.shard_id, link.index)
+                    ProcessHi(
+                        self.process.id, self.process.shard_id, link.index,
+                        self.incarnation,
+                    )
                 )
             except (ConnectionError, OSError, asyncio.TimeoutError) as exc:
                 last = exc
@@ -829,6 +1069,56 @@ class ProcessRuntime:
             # protocol-level silence timeout
             self.workers.forward_to(0, ("peer_down", peer_id))
 
+    def _declare_peer_up(self, peer_id: ProcessId) -> None:
+        """The detector hook symmetric to ``_declare_peer_lost``: a peer
+        we declared dead is demonstrably reachable again (a frame
+        arrived, or it re-handshook after a restart).  Frames flow to it
+        again, its writer tasks respawn (reconnecting and resending the
+        unacked window), and the protocol hears ``on_peer_up`` so
+        recovery-ring / pending-forward targets stop routing around it."""
+        if peer_id not in self.dead_peers or self._stopping:
+            return
+        self.dead_peers.discard(peer_id)
+        links = self._peer_writers.get(peer_id)
+        if links is not None:
+            links.mark_alive()
+            for link in links.links:
+                # a writer parked on queue.get() at declare-lost time
+                # never observed dead=True and would wake into a second
+                # life alongside the revival writer, interleaving one
+                # seq window across two tasks — retire it first
+                if link.writer_task is not None and not link.writer_task.done():
+                    link.writer_task.cancel()
+                # reconnect BEFORE resuming the writer: the old rw was
+                # locally aborted, and asyncio silently discards writes
+                # to a closed transport (flush does not raise), so a
+                # writer resumed on it would drop frames forever
+                link.writer_task = self.spawn(self._revive_link(link))
+        self._last_heard[peer_id] = asyncio.get_event_loop().time()
+        logger.warning(
+            "p%s: peer p%s is back (%d/%d same-shard processes alive)",
+            self.process.id,
+            peer_id,
+            1 + sum(
+                1
+                for pid in self.peers
+                if self._shard_of.get(pid) == self.process.shard_id
+                and pid not in self.dead_peers
+            ),
+            self.config.n,
+        )
+        self.workers.forward_to(0, ("peer_up", peer_id))
+
+    async def _revive_link(self, link: LinkState) -> None:
+        """Revival path: dial the returned peer fresh (resending the
+        unacked window), then resume the writer task on the new rw."""
+        try:
+            await self._reconnect_link(link)
+        except PeerLostError as exc:
+            self._declare_peer_lost(link.peer_id, exc)
+            return
+        await self._peer_writer_task(link)
+
     def inject_link_failure(self, peer_id: Optional[ProcessId] = None) -> int:
         """Chaos hook for tests: hard-kill the live peer-link sockets (all
         of them, or only those to/from ``peer_id``), simulating the
@@ -872,6 +1162,15 @@ class ProcessRuntime:
                 process.handle_executed(item[1], self.time)
             elif kind == "peer_down":
                 process.on_peer_down(item[1], self.time)
+            elif kind == "peer_up":
+                process.on_peer_up(item[1], self.time)
+            elif kind == "rejoin":
+                process.rejoin(self.time)
+                if self._lease_gap_dots:
+                    # lease-gap healing: recovery commits the hole dots
+                    # (noops where never issued) so the mesh's contiguous
+                    # committed frontier for this source does not freeze
+                    process.nudge_recovery(self._lease_gap_dots, self.time)
             else:
                 raise AssertionError(f"unknown worker item {item}")
             self._drain_protocol()
@@ -906,6 +1205,11 @@ class ProcessRuntime:
             else:
                 raise AssertionError(f"unknown action {action}")
         for info in process.to_executors_iter():
+            if self.wal is not None:
+                # durability point: every commit info is logged before it
+                # can reach an executor — restart replays exactly the
+                # records past the snapshot (append-then-apply order)
+                self.wal.append("info", info)
             position = executor_index(info, self.executor_pool.size)
             self.executor_pool.forward_to(position, info)
 
@@ -1070,4 +1374,9 @@ class ProcessRuntime:
             for executor in self.executors:
                 executed = executor.executed(self.time)
                 if executed is not None:
+                    if self.wal is not None:
+                        # the executor emit frontier rides the log too:
+                        # a recovered tail shows how far execution got,
+                        # next to the commit records that drove it
+                        self.wal.append("frontier", executed)
                     self.workers.forward_to(0, ("executed", executed))

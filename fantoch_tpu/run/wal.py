@@ -1,0 +1,321 @@
+"""Durable per-process command log + snapshots: the restart plane's disk.
+
+The reference's run layer assumes restartable processes (its GC only
+reclaims commit info once a dot is *executed everywhere*, so a returning
+replica can always be caught up from a live peer); this module supplies
+the durable half of that assumption for our runner: an append-only log of
+commit records (the protocol's ``to_executors`` stream) plus periodic
+whole-state snapshots, so a crashed :class:`ProcessRuntime` restarts as
+``load snapshot -> replay log tail -> MSync catch-up`` instead of staying
+dead.
+
+Design:
+
+* **Framing** — every record is ``magic(2B) | length(4B) | crc32(4B) |
+  payload`` with a pickled ``(kind, obj)`` payload.  The reader stops at
+  the first short/corrupt frame: the same crash-consistent
+  torn-tail-tolerant discipline as the tracer JSONL
+  (observability/tracer.py) — a crash mid-append loses at most the
+  record being written, never the prefix.  Reopening for append
+  truncates the torn tail so new records never chain onto garbage.
+* **Fsync policy** — ``always`` fsyncs every append (commit-durable
+  before the frame is acknowledged anywhere), ``interval`` fsyncs on the
+  runtime's periodic WAL tick (bounded loss window, the default), and
+  ``never`` leaves durability to the OS.  One knob, resolved like
+  ``serving_pipeline_depth``: explicit ``Config.wal_sync`` beats the
+  ``FANTOCH_WAL_SYNC`` env var beats the ``interval`` default.
+* **Segments + snapshots** — the log is a sequence of
+  ``wal-<seq>.seg`` files.  ``save_snapshot`` first rotates to a fresh
+  segment, then atomically (tmp + rename + dir fsync) writes
+  ``snapshot-<seq>.bin`` whose tag names the first segment of its tail;
+  segments below the tag (and older snapshots) are pruned.  Snapshot
+  cadence rides the executed-everywhere GC retention: anything the
+  snapshot captured is by construction at or past what peers may have
+  GC'd, so ``snapshot + tail + MSync`` always reconnects to the mesh's
+  retained history and the log stays finite.
+* **Dot lease** — a restarted process must never re-issue a dot sequence
+  it handed out before the crash.  ``lease`` records persist a high
+  watermark in batches of :data:`DOT_LEASE_BATCH` (fsync'd regardless of
+  policy: a lease is cheap and must not be outrun by its own dots);
+  recovery resumes allocation above the highest lease seen.
+* **Incarnation** — each recovery bumps a boot counter (``boot`` file).
+  Peer links carry it in their handshake so receivers reset per-link
+  sequence dedup for a restarted sender (its frames restart at seq 1 and
+  must not be swallowed as duplicates of the previous life).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+_MAGIC = 0xFA17
+_HEADER = struct.Struct("<HII")  # magic, payload length, crc32(payload)
+
+# dot-lease batch: one fsync'd lease record per this many allocations
+DOT_LEASE_BATCH = 1024
+
+WAL_SYNC_POLICIES = ("always", "interval", "never")
+
+
+def resolve_wal_sync(config_value: Optional[str]) -> str:
+    """One knob, ``serving_pipeline_depth`` style: explicit config value
+    beats the FANTOCH_WAL_SYNC env var beats the ``interval`` default."""
+    if config_value is not None:
+        policy = config_value
+    else:
+        policy = os.environ.get("FANTOCH_WAL_SYNC") or "interval"
+    if policy not in WAL_SYNC_POLICIES:
+        raise ValueError(
+            f"wal_sync must be one of {WAL_SYNC_POLICIES}, got {policy!r}"
+        )
+    return policy
+
+
+@dataclass
+class RecoveredState:
+    """What :meth:`Wal.recover` found on disk."""
+
+    snapshot: Optional[dict]  # save_snapshot payload, None on a fresh dir
+    tail: List[Tuple[str, Any]] = field(default_factory=list)
+    incarnation: int = 0
+    dot_lease: int = 0
+    # last executor emit frontier logged in the tail (None when no
+    # frontier record survived): how far execution had provably gotten
+    frontier: Any = None
+
+
+def _segment_name(seq: int) -> str:
+    return f"wal-{seq:08d}.seg"
+
+
+def _snapshot_name(seq: int) -> str:
+    return f"snapshot-{seq:08d}.bin"
+
+
+def _listed(directory: str, prefix: str, suffix: str) -> List[Tuple[int, str]]:
+    out = []
+    for name in os.listdir(directory):
+        if name.startswith(prefix) and name.endswith(suffix):
+            try:
+                seq = int(name[len(prefix) : -len(suffix)])
+            except ValueError:
+                continue
+            out.append((seq, name))
+    out.sort()
+    return out
+
+
+def _fsync_dir(directory: str) -> None:
+    fd = os.open(directory, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def read_segment(path: str) -> Tuple[List[Tuple[str, Any]], int]:
+    """Read one segment; returns (records, valid_byte_length).  Stops at
+    the first torn/corrupt frame — the crash-consistent prefix ends
+    there (same contract as ``tracer.read_trace``)."""
+    records: List[Tuple[str, Any]] = []
+    valid = 0
+    with open(path, "rb") as fh:
+        data = fh.read()
+    offset = 0
+    while offset + _HEADER.size <= len(data):
+        magic, length, crc = _HEADER.unpack_from(data, offset)
+        if magic != _MAGIC:
+            break
+        payload = data[offset + _HEADER.size : offset + _HEADER.size + length]
+        if len(payload) < length or zlib.crc32(payload) != crc:
+            break
+        try:
+            records.append(pickle.loads(payload))
+        except Exception:
+            break
+        offset += _HEADER.size + length
+        valid = offset
+    return records, valid
+
+
+class Wal:
+    """Append-only durable log with segment rotation and snapshots.
+
+    Construction alone never touches prior state; call :meth:`recover`
+    once (before appending) to load it — recovery also truncates any torn
+    tail and bumps the incarnation counter.
+    """
+
+    def __init__(self, directory: str, sync: str = "interval",
+                 segment_bytes: int = 4 << 20):
+        assert sync in WAL_SYNC_POLICIES, sync
+        self.directory = directory
+        self.sync_policy = sync
+        self.segment_bytes = segment_bytes
+        os.makedirs(directory, exist_ok=True)
+        self._fh = None
+        self._seq = 0  # current segment sequence
+        self._dirty = False
+        self.incarnation = 0
+        self.appended = 0  # records appended this boot (observability)
+        self.replayed = 0  # tail records handed to recover()'s caller
+
+    # --- recovery ---
+
+    def recover(self) -> RecoveredState:
+        """Load the latest snapshot + the log tail past it, truncate any
+        torn tail, bump the incarnation, and open for append."""
+        directory = self.directory
+        snapshots = _listed(directory, "snapshot-", ".bin")
+        snapshot = None
+        tail_from = 1
+        while snapshots:
+            seq, name = snapshots[-1]
+            try:
+                with open(os.path.join(directory, name), "rb") as fh:
+                    snapshot = pickle.load(fh)
+                tail_from = seq
+                break
+            except Exception:
+                # torn snapshot (crash between create and rename cannot
+                # happen — rename is atomic — but tolerate manual damage)
+                snapshots.pop()
+        segments = [
+            (seq, name)
+            for seq, name in _listed(directory, "wal-", ".seg")
+            if seq >= tail_from
+        ]
+        tail: List[Tuple[str, Any]] = []
+        dot_lease = 0 if snapshot is None else int(snapshot.get("dot_lease", 0))
+        for index, (seq, name) in enumerate(segments):
+            path = os.path.join(directory, name)
+            records, valid = read_segment(path)
+            tail.extend(records)
+            size = os.path.getsize(path)
+            if valid < size:
+                # torn tail: only meaningful in the last segment, but a
+                # mid-chain tear (lost writes) must also stop replay —
+                # records past a tear may postdate state we did not see.
+                # The dropped later segments are UNLINKED, not just
+                # skipped: appends resume in the truncated segment, and
+                # a later recovery would otherwise resurrect the stale
+                # segments AFTER the new records (out-of-order replay)
+                with open(path, "r+b") as fh:
+                    fh.truncate(valid)
+                for _seq, stale in segments[index + 1 :]:
+                    os.unlink(os.path.join(directory, stale))
+                del segments[index + 1 :]
+                break
+        frontier = None
+        for kind, obj in tail:
+            if kind == "lease":
+                dot_lease = max(dot_lease, int(obj))
+            elif kind == "frontier":
+                frontier = obj  # last one wins (they are monotone)
+        self.replayed = len(tail)
+        # incarnation bump, persisted before anything else this boot
+        boot_path = os.path.join(directory, "boot")
+        incarnation = 0
+        if os.path.exists(boot_path):
+            try:
+                with open(boot_path, "r") as fh:
+                    incarnation = int(fh.read().strip() or 0)
+            except ValueError:
+                incarnation = 0
+        self.incarnation = incarnation + 1
+        tmp = boot_path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(str(self.incarnation))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, boot_path)
+        _fsync_dir(directory)
+        # append to the last live segment (or start the first)
+        self._seq = segments[-1][0] if segments else tail_from
+        self._open_segment()
+        return RecoveredState(snapshot, tail, self.incarnation, dot_lease, frontier)
+
+    # --- append path ---
+
+    def _open_segment(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+        path = os.path.join(self.directory, _segment_name(self._seq))
+        self._fh = open(path, "ab")
+
+    def _ensure_open(self) -> None:
+        if self._fh is None:
+            self._seq = max(self._seq, 1)
+            self._open_segment()
+
+    def append(self, kind: str, obj: Any, force_sync: bool = False) -> None:
+        self._ensure_open()
+        payload = pickle.dumps((kind, obj))
+        self._fh.write(_HEADER.pack(_MAGIC, len(payload), zlib.crc32(payload)))
+        self._fh.write(payload)
+        self._dirty = True
+        self.appended += 1
+        if force_sync or self.sync_policy == "always":
+            self.sync(force=True)
+        if self._fh.tell() >= self.segment_bytes:
+            self.rotate()
+
+    def append_lease(self, sequence: int) -> None:
+        """Persist a dot-allocation high watermark.  Always fsync'd: a
+        lease outrun by its own dots would let a restarted process
+        re-issue live sequences."""
+        self.append("lease", int(sequence), force_sync=True)
+
+    def sync(self, force: bool = False) -> None:
+        """Flush (and fsync unless the policy is ``never``) buffered
+        appends; the runtime's periodic WAL tick drives the ``interval``
+        policy through here."""
+        if self._fh is None or not self._dirty:
+            return
+        self._fh.flush()
+        if force or self.sync_policy != "never":
+            os.fsync(self._fh.fileno())
+        self._dirty = False
+
+    def rotate(self) -> int:
+        """Close the current segment and start the next; returns the new
+        segment's sequence."""
+        self.sync()
+        self._ensure_open()
+        self._seq += 1
+        self._open_segment()
+        return self._seq
+
+    # --- snapshots ---
+
+    def save_snapshot(self, payload: dict) -> None:
+        """Atomically persist a state snapshot covering everything before
+        the current log position, then prune segments (and snapshots) the
+        new snapshot obsoletes — the rotation that keeps the log bounded
+        by the snapshot cadence instead of run length."""
+        tail_seq = self.rotate()
+        path = os.path.join(self.directory, _snapshot_name(tail_seq))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            pickle.dump(payload, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(self.directory)
+        for seq, name in _listed(self.directory, "wal-", ".seg"):
+            if seq < tail_seq:
+                os.unlink(os.path.join(self.directory, name))
+        for seq, name in _listed(self.directory, "snapshot-", ".bin"):
+            if seq < tail_seq:
+                os.unlink(os.path.join(self.directory, name))
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self.sync()
+            self._fh.close()
+            self._fh = None

@@ -29,7 +29,10 @@ Fault model (see README "Fault model" for the contract):
   the partition never heals.
 * **Crash** — a process stops at ``at_ms``: inbound messages are dropped,
   its periodic events stop, and clients attached to it are abandoned
-  (the runner stops waiting for them).
+  (the runner stops waiting for them).  With ``restart_at_ms`` set the
+  crash is a crash-*restart* instead: the process returns to service
+  from its durable image (snapshot/restore seam + MSync rejoin; see the
+  :class:`Crash` docstring) and its clients are deferred, not abandoned.
 * **Pause** — a transient freeze ``[at_ms, until_ms)``: inbound traffic
   and periodic events are deferred and replayed at resume, modelling a
   stop-the-world (GC pause, VM migration) rather than a crash.
@@ -118,8 +121,39 @@ class Partition:
 
 @dataclass(frozen=True)
 class Crash:
+    """A process failure at ``at_ms``, in one of two modes:
+
+    * **Crash-forever** (``restart_at_ms=None``, the PR-2 behavior):
+      the process stops for good — inbound messages are dropped, its
+      periodic events stop, and clients attached to it are abandoned.
+      Every such crash permanently burns one unit of the ``n - f``
+      budget.
+    * **Crash-restart** (``restart_at_ms`` set): the process loses all
+      volatile state at ``at_ms`` and returns to service at
+      ``restart_at_ms``.  The runner captures a *durable image* at the
+      crash instant — the ``snapshot()`` seam on Protocol and Executor,
+      modelling a synchronous WAL (``wal_sync=always``: every input
+      applied before the crash was logged and is replayed; messages in
+      flight at the crash are lost) — and at restart rebuilds the
+      process from that image via ``restore()``, reschedules its
+      periodic events, and runs the rejoin protocol
+      (``Protocol.rejoin`` -> MSync catch-up from live peers, bounded by
+      the executed-everywhere GC retention).  While the process is down,
+      process-to-process messages to it are dropped (peers declared it
+      dead); *client* messages are deferred past the restart with
+      retransmit jitter (the client-reconnect-and-resubmit semantics of
+      the run layer's reliable links), so its clients are NOT abandoned.
+      A restarted process restores the full ``n - f`` tolerance budget —
+      the chaos matrix asserts a *subsequent* crash of a different
+      process still completes.
+    """
+
     process_id: int
     at_ms: int
+    restart_at_ms: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        assert self.restart_at_ms is None or self.restart_at_ms > self.at_ms
 
 
 @dataclass(frozen=True)
@@ -160,9 +194,13 @@ class FaultPlan:
         """Uniform loss on every link (retransmitted by default)."""
         return self.with_link_fault(drop=drop, **kwargs)
 
-    def with_crash(self, process_id: int, at_ms: int) -> "FaultPlan":
+    def with_crash(
+        self, process_id: int, at_ms: int, restart_at_ms: Optional[int] = None
+    ) -> "FaultPlan":
+        """Crash-forever by default; pass ``restart_at_ms`` for a
+        deterministic crash-and-restart (see :class:`Crash`)."""
         return dataclasses.replace(
-            self, crashes=self.crashes + (Crash(process_id, at_ms),)
+            self, crashes=self.crashes + (Crash(process_id, at_ms, restart_at_ms),)
         )
 
     def with_pause(self, process_id: int, at_ms: int, until_ms: int) -> "FaultPlan":
@@ -210,7 +248,13 @@ class Nemesis:
         self.plan = plan
         self.rng = random.Random(plan.seed)
         self.trace: List[Tuple[int, str, str]] = []
-        self._crash_at = {c.process_id: c.at_ms for c in plan.crashes}
+        # pid -> [(at_ms, restart_at_ms | None)] downtime windows; None
+        # restart = crash-forever (a pid may crash again after a restart)
+        self._crash_windows: dict = {}
+        for crash in plan.crashes:
+            self._crash_windows.setdefault(crash.process_id, []).append(
+                (crash.at_ms, crash.restart_at_ms)
+            )
 
     # --- trace ---
 
@@ -230,8 +274,18 @@ class Nemesis:
     # --- fault state (pure functions of virtual time) ---
 
     def is_dead(self, process_id: int, now: int) -> bool:
-        at = self._crash_at.get(process_id)
-        return at is not None and now >= at
+        for at, restart in self._crash_windows.get(process_id, ()):
+            if now >= at and (restart is None or now < restart):
+                return True
+        return False
+
+    def restart_pending(self, process_id: int, now: int) -> Optional[int]:
+        """The restart time of the downtime window covering ``now``, or
+        None when the process is alive or crashed forever."""
+        for at, restart in self._crash_windows.get(process_id, ()):
+            if now >= at and restart is not None and now < restart:
+                return restart
+        return None
 
     def paused_until(self, process_id: int, now: int) -> Optional[int]:
         for pause in self.plan.pauses:
@@ -246,6 +300,13 @@ class Nemesis:
             out.append(
                 (crash.at_ms, NemesisMark("crash", f"p{crash.process_id}", crash.process_id))
             )
+            if crash.restart_at_ms is not None:
+                out.append(
+                    (
+                        crash.restart_at_ms,
+                        NemesisMark("restart", f"p{crash.process_id}", crash.process_id),
+                    )
+                )
         for pause in self.plan.pauses:
             out.append((pause.at_ms, NemesisMark("pause", f"p{pause.process_id}")))
             out.append((pause.until_ms, NemesisMark("resume", f"p{pause.process_id}")))
@@ -277,6 +338,20 @@ class Nemesis:
         src, dst = self._pid(from_key), self._pid(to_key)
         label = f"{from_key[0]}{from_key[1]}->{to_key[0]}{to_key[1]} {type(msg).__name__}"
         if dst is not None and self.is_dead(dst, now):
+            restart = self.restart_pending(dst, now)
+            if restart is not None and from_key[0] == "client":
+                # client traffic to a down-but-restarting process defers
+                # past the restart (the client reconnects and resubmits —
+                # the run layer's reliable-link semantics); peer traffic
+                # still drops: peers declared the process dead and the
+                # rejoin protocol, not the network, replays history
+                delay = (
+                    (restart - now)
+                    + base_delay_ms
+                    + self.rng.randint(1, self.plan.retransmit_base_ms)
+                )
+                self.record(now, "defer-restart", f"{label} +{delay}ms")
+                return [delay]
             self.record(now, "drop-dead", label)
             return []
         delay = base_delay_ms

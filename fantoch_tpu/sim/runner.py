@@ -131,6 +131,13 @@ class Runner:
         self._process_to_region: Dict[ProcessId, Region] = {
             pid: region for pid, _, region in to_discover
         }
+        # crash-restart plane: durable images captured at crash instants
+        # (pid -> (protocol snapshot, executor snapshot, pending copy))
+        # and the periodic-event actions dropped while a restarting
+        # process was down (rescheduled at restart — each periodic stream
+        # has exactly one live action, so a dropped one must come back)
+        self._durable_images: Dict[ProcessId, Tuple[bytes, bytes, Any]] = {}
+        self._stalled_periodics: Dict[ProcessId, List[Any]] = {}
 
         # register processes (discover with distance-sorted lists)
         for region, process in processes:
@@ -314,6 +321,26 @@ class Runner:
         if verdict == DELIVER:
             return action
         if verdict == DROP:
+            restart_at = self._nemesis.restart_pending(process_id, now)
+            if restart_at is not None:
+                if isinstance(action, SubmitToProc):
+                    # in-flight client submit at the crash: the client
+                    # reconnects and resubmits after the restart (the
+                    # reliable-link semantics; same policy as send-time
+                    # defer in Nemesis.on_send)
+                    delay = (restart_at - now) + self._nemesis.rng.randint(
+                        1, self._nemesis.plan.retransmit_base_ms
+                    )
+                    self._nemesis.record(
+                        now, "defer-restart", f"SubmitToProc->p{process_id} +{delay}ms"
+                    )
+                    self._schedule.schedule(self._simulation.time, delay, action)
+                    return None
+                if periodic:
+                    # stash the stream's one live action; the restart
+                    # handler reschedules it
+                    self._stalled_periodics.setdefault(process_id, []).append(action)
+                    return None
             # dead process: periodic events stop for good (never
             # rescheduled); in-flight messages evaporate
             if not periodic:
@@ -326,6 +353,23 @@ class Runner:
     def _handle_nemesis_mark(self, mark: NemesisMark, now: int) -> None:
         self._nemesis.record(now, mark.kind, mark.detail)
         if mark.kind == "crash" and mark.process_id is not None:
+            if self._nemesis.restart_pending(mark.process_id, now) is not None:
+                # crash-restart: capture the durable image at the crash
+                # instant — the snapshot()/restore() seam, modelling a
+                # synchronous WAL (wal_sync=always: every input applied
+                # before the crash was logged; in-flight messages are
+                # lost).  Clients stay active: their traffic defers past
+                # the restart instead of evaporating.
+                protocol, executor, pending = self._simulation.get_process(
+                    mark.process_id
+                )
+                self._durable_images[mark.process_id] = (
+                    protocol.snapshot(),
+                    executor.snapshot(),
+                    copy.deepcopy(pending),
+                )
+                self._nemesis.record(now, "durable-image", mark.detail)
+                return
             # abandon clients attached to the dead process: their commands
             # can no longer complete, so the loop must not wait for them
             doomed = {
@@ -338,6 +382,24 @@ class Runner:
                 self._nemesis.record(
                     now, "clients-abandoned", ",".join(map(str, sorted(doomed)))
                 )
+        elif mark.kind == "restart" and mark.process_id is not None:
+            self._restart_process(mark.process_id)
+
+    def _restart_process(self, process_id: ProcessId) -> None:
+        """Bring a crashed process back: restore protocol + executor from
+        the durable image, re-register, reschedule the periodic streams
+        that died with it, then run the rejoin protocol (MSync catch-up
+        from live peers past the restored commit horizon)."""
+        proto_blob, exec_blob, pending = self._durable_images.pop(process_id)
+        protocol = self._protocol_cls.restore(proto_blob)
+        executor = self._protocol_cls.Executor.restore(exec_blob)
+        protocol.set_tracer(self._tracer)
+        executor.set_tracer(self._tracer)
+        self._simulation.replace_process(protocol, executor, pending)
+        for action in self._stalled_periodics.pop(process_id, []):
+            self._schedule.schedule(self._simulation.time, action.delay_ms, action)
+        protocol.rejoin(self._simulation.time)
+        self._send_to_processes_and_executors(process_id)
 
     # --- handlers ---
 

@@ -29,6 +29,15 @@ class Simulation:
         pending = AggregatePending(process_id, process.shard_id)
         self._processes[process_id] = (process, executor, pending)
 
+    def replace_process(
+        self, process: Protocol, executor: Executor, pending: AggregatePending
+    ) -> None:
+        """Swap in a restarted process (restored from its durable image):
+        the restart plane's re-registration seam (sim/runner.py)."""
+        process_id = process.id
+        assert process_id in self._processes, "restart requires a registered process"
+        self._processes[process_id] = (process, executor, pending)
+
     def register_client(self, client: Client) -> None:
         assert client.id not in self._clients, "client registered twice"
         self._clients[client.id] = client

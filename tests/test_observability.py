@@ -249,6 +249,7 @@ def test_table_plane_device_counters():
         "table_plane_row_capacity": 0,
         "table_plane_residual_runs": 0,
         "table_plane_kernel_ms": 0,
+        "table_plane_resident_uploads": 0,
     }
     # plane off -> no counters contributed
     assert TableExecutor(1, 0, Config(3, 1)).device_counters() is None

@@ -1,0 +1,236 @@
+"""Durable command log (run/wal.py): the durability edges the restart
+plane's correctness rests on.
+
+* torn-tail truncation — a crash mid-record loses that record only; the
+  crash-consistent prefix replays, and the reopened log never chains new
+  records onto garbage;
+* duplicate replay — a crash between append and ack means a peer resends
+  a message whose effects the WAL already replayed: the protocol layer's
+  status / rifl dedup makes re-delivery exactly-once;
+* segment rotation racing the GC clock — snapshots rotate + prune, so the
+  log stays bounded by the snapshot cadence while every record past the
+  snapshot survives;
+* dot lease — a restarted process never re-issues a pre-crash sequence;
+* fsync-policy resolution — one knob, config > env > default.
+"""
+
+import os
+import pickle
+
+import pytest
+
+from fantoch_tpu.core import Command, Config, Dot, KVOp, Rifl
+from fantoch_tpu.core.timing import SimTime
+from fantoch_tpu.run.wal import (
+    DOT_LEASE_BATCH,
+    Wal,
+    read_segment,
+    resolve_wal_sync,
+)
+
+pytestmark = pytest.mark.restart
+
+
+def test_append_recover_roundtrip(tmp_path):
+    wal = Wal(str(tmp_path), sync="always")
+    wal.recover()
+    records = [("info", {"dot": (1, i), "payload": "x" * i}) for i in range(20)]
+    for kind, obj in records:
+        wal.append(kind, obj)
+    wal.close()
+    state = Wal(str(tmp_path)).recover()
+    assert state.snapshot is None
+    assert state.tail == records
+    assert state.incarnation == 2  # one bump per recover()
+
+
+def test_torn_tail_truncated_mid_record(tmp_path):
+    wal = Wal(str(tmp_path), sync="always")
+    wal.recover()
+    for i in range(10):
+        wal.append("info", ("rec", i))
+    wal.close()
+    # crash mid-write: chop bytes off the last record
+    seg = sorted(p for p in os.listdir(tmp_path) if p.endswith(".seg"))[-1]
+    path = os.path.join(tmp_path, seg)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(size - 7)
+    records, valid = read_segment(path)
+    assert [obj for _k, obj in records] == [("rec", i) for i in range(9)]
+    assert valid < size - 7  # the torn record's prefix is not "valid"
+    # recovery returns the prefix, truncates, and appends cleanly after
+    wal2 = Wal(str(tmp_path), sync="always")
+    state = wal2.recover()
+    assert [obj for _k, obj in state.tail] == [("rec", i) for i in range(9)]
+    assert os.path.getsize(path) == valid
+    wal2.append("info", ("rec", "post-crash"))
+    wal2.close()
+    state = Wal(str(tmp_path)).recover()
+    assert [obj for _k, obj in state.tail][-1] == ("rec", "post-crash")
+
+
+def test_corrupt_mid_chain_stops_replay(tmp_path):
+    """A flipped byte mid-segment (lost/rotted write) must stop replay at
+    the corruption — records past a tear may postdate unseen state."""
+    wal = Wal(str(tmp_path), sync="always")
+    wal.recover()
+    for i in range(10):
+        wal.append("info", ("rec", i))
+    wal.close()
+    seg = sorted(p for p in os.listdir(tmp_path) if p.endswith(".seg"))[-1]
+    path = os.path.join(tmp_path, seg)
+    with open(path, "r+b") as fh:
+        fh.seek(os.path.getsize(path) // 2)
+        fh.write(b"\xff")
+    state = Wal(str(tmp_path)).recover()
+    objs = [obj for _k, obj in state.tail]
+    assert objs == [("rec", i) for i in range(len(objs))]  # a strict prefix
+    assert len(objs) < 10
+
+
+def test_mid_chain_tear_unlinks_later_segments(tmp_path):
+    """A tear in a non-final segment drops the later segments from
+    replay AND from disk: appends resume in the truncated segment, so a
+    later recovery must never resurrect the stale segments after the
+    new records (out-of-order replay)."""
+    wal = Wal(str(tmp_path), sync="always", segment_bytes=1)  # rotate per append
+    wal.recover()
+    for i in range(4):
+        wal.append("info", ("rec", i))
+    wal.close()
+    segs = sorted(p for p in os.listdir(tmp_path) if p.endswith(".seg"))
+    assert len(segs) > 2
+    first_nonempty = next(
+        p for p in segs if os.path.getsize(os.path.join(tmp_path, p))
+    )
+    with open(os.path.join(tmp_path, first_nonempty), "r+b") as fh:
+        fh.seek(2)
+        fh.write(b"\xff")
+    wal2 = Wal(str(tmp_path), sync="always")
+    state = wal2.recover()
+    assert state.tail == []  # replay stopped at the torn first segment
+    survivors = sorted(p for p in os.listdir(tmp_path) if p.endswith(".seg"))
+    assert survivors == [first_nonempty] or survivors == segs[:1] + [first_nonempty]
+    wal2.append("info", ("rec", "new"))
+    wal2.close()
+    state = Wal(str(tmp_path)).recover()
+    assert [obj for _k, obj in state.tail] == [("rec", "new")]
+
+
+def test_snapshot_rotation_prunes_and_replays_tail_only(tmp_path):
+    wal = Wal(str(tmp_path), sync="always", segment_bytes=256)
+    wal.recover()
+    for i in range(30):
+        wal.append("info", ("pre", i))
+    wal.save_snapshot({"state": "S", "dot_lease": 7})
+    for i in range(5):
+        wal.append("info", ("post", i))
+    wal.close()
+    # rotation pruned everything the snapshot covers: the log is bounded
+    # by the snapshot cadence, not the run length
+    segs = [p for p in os.listdir(tmp_path) if p.endswith(".seg")]
+    snap_tag = max(
+        int(p[len("snapshot-"):-len(".bin")])
+        for p in os.listdir(tmp_path)
+        if p.startswith("snapshot-")
+    )
+    assert all(int(p[len("wal-"):-len(".seg")]) >= snap_tag for p in segs)
+    state = Wal(str(tmp_path)).recover()
+    assert state.snapshot == {"state": "S", "dot_lease": 7}
+    assert [obj for _k, obj in state.tail] == [("post", i) for i in range(5)]
+    assert state.dot_lease == 7
+
+
+def test_second_snapshot_obsoletes_first(tmp_path):
+    wal = Wal(str(tmp_path), sync="always")
+    wal.recover()
+    wal.append("info", ("a", 1))
+    wal.save_snapshot({"v": 1})
+    wal.append("info", ("b", 2))
+    wal.save_snapshot({"v": 2})
+    wal.append("info", ("c", 3))
+    wal.close()
+    snaps = [p for p in os.listdir(tmp_path) if p.startswith("snapshot-")]
+    assert len(snaps) == 1
+    state = Wal(str(tmp_path)).recover()
+    assert state.snapshot == {"v": 2}
+    assert [obj for _k, obj in state.tail] == [("c", 3)]
+
+
+def test_dot_lease_resumes_above_issued(tmp_path):
+    wal = Wal(str(tmp_path), sync="interval")
+    wal.recover()
+    wal.append_lease(DOT_LEASE_BATCH)
+    wal.append_lease(3 * DOT_LEASE_BATCH)
+    # crash WITHOUT close: leases are fsync'd regardless of policy
+    state = Wal(str(tmp_path)).recover()
+    assert state.dot_lease == 3 * DOT_LEASE_BATCH
+    from fantoch_tpu.core.ids import AtomicIdGen
+
+    gen = AtomicIdGen(1)
+    gen.resume_after(state.dot_lease)
+    assert gen.next_id().sequence == 3 * DOT_LEASE_BATCH + 1
+
+
+def test_incarnation_bumps_per_recovery(tmp_path):
+    incs = [Wal(str(tmp_path)).recover().incarnation for _ in range(3)]
+    assert incs == [1, 2, 3]
+
+
+def test_resolve_wal_sync_precedence(monkeypatch):
+    monkeypatch.delenv("FANTOCH_WAL_SYNC", raising=False)
+    assert resolve_wal_sync(None) == "interval"
+    monkeypatch.setenv("FANTOCH_WAL_SYNC", "never")
+    assert resolve_wal_sync(None) == "never"
+    assert resolve_wal_sync("always") == "always"  # config beats env
+    with pytest.raises(ValueError):
+        resolve_wal_sync("sometimes")
+    with pytest.raises(ValueError):
+        Config(3, 1, wal_sync="sometimes")
+
+
+def test_duplicate_redelivery_after_replay_is_exactly_once():
+    """Crash between append and ack: the WAL replayed the commit, then a
+    peer's reconnect resends the same MCommit.  The restored protocol's
+    per-dot status dedup must swallow it — no second executor info, so
+    nothing re-executes through the rifl/KVStore seam."""
+    from fantoch_tpu.protocol.graph_protocol import EPaxos, MCollect, MCommit
+
+    time = SimTime()
+    config = Config(3, 1, gc_interval_ms=100)
+    procs = {}
+    for pid in (1, 2, 3):
+        p, _events = EPaxos.new(pid, 0, config)
+        ok, _ = p.discover([(1, 0), (2, 0), (3, 0)])
+        assert ok
+        procs[pid] = p
+
+    cmd = Command.from_single(Rifl(100, 1), 0, "k", KVOp.put("v"))
+    procs[1].submit(None, cmd, time)
+    # drive the full commit at p1 synchronously
+    import copy as _copy
+
+    from fantoch_tpu.protocol.base import ToForward
+
+    msgs = [(1, a) for a in procs[1].to_processes_iter()]
+    commit_msg = None
+    while msgs:
+        from_, action = msgs.pop(0)
+        targets = [from_] if isinstance(action, ToForward) else sorted(action.target)
+        for to in targets:
+            msg = _copy.deepcopy(action.msg)
+            if isinstance(msg, MCommit):
+                commit_msg = msg
+            procs[to].handle(from_, 0, msg, time)
+            msgs.extend((to, a) for a in procs[to].to_processes_iter())
+    assert commit_msg is not None
+    infos_first = list(procs[2].to_executors_iter())
+    assert infos_first, "the commit must have produced execution info"
+
+    # crash + restore p2 from its snapshot (state includes the commit)...
+    restored = EPaxos.restore(procs[2].snapshot())
+    # ...then the duplicate arrives from the resend window
+    restored.handle(1, 0, commit_msg, time)
+    assert list(restored.to_executors_iter()) == []
+    assert list(restored.to_processes_iter()) == []
