@@ -1,7 +1,7 @@
 # Test/bench entry points (the reference pins quality with Makefile:3-7 —
 # fmt + clippy + `cargo test` under a quickcheck budget; here the suite +
 # dryrun + bench are the equivalent gates).
-.PHONY: test test-fast test-chaos test-recovery test-restart test-device-stripped dryrun bench bench-smoke trace-smoke
+.PHONY: test test-fast test-chaos test-recovery test-restart test-overload test-device-stripped dryrun bench bench-smoke trace-smoke overload-smoke
 
 test:
 	python -m pytest tests/ -x -q
@@ -27,6 +27,12 @@ test-recovery:
 # on_peer_up revival
 test-restart:
 	python -m pytest tests/ -x -q -m restart
+
+# the overload-control slice: bounded queues + watermark backpressure,
+# admission sheds + client backoff/deadlines, open-loop bursts, the
+# SlowProcess nemesis, and the queue-gauge metrics export
+test-overload:
+	python -m pytest tests/ -x -q -m overload
 
 # close the tier-1 coverage hole on the pinned jax: run
 # tests/test_device_runner.py from a guard-stripped copy (the module
@@ -54,3 +60,10 @@ bench-smoke:
 # per-push CI slice runs this next to bench-smoke
 trace-smoke:
 	python scripts/trace_smoke.py
+
+# overload gate: tiny CPU open-loop burst at ~2x saturation against a
+# tight admission limit — bounded queue depths, typed sheds reaching
+# clients, nonzero goodput while shedding, post-burst latency back to
+# baseline — the per-push CI slice runs this next to bench/trace-smoke
+overload-smoke:
+	python scripts/overload_smoke.py

@@ -323,6 +323,13 @@ def child_main(mode: str) -> None:
     except Exception as exc:  # noqa: BLE001
         print(f"# local-pool bench failed: {exc!r}", file=sys.stderr)
         record["pool_error"] = repr(exc)[:200]
+    try:
+        # latency-under-load curve (overload plane): pure asyncio, so it
+        # rides both the cpu and tpu children unchanged
+        record.update(bench_overload())
+    except Exception as exc:  # noqa: BLE001
+        print(f"# overload bench failed: {exc!r}", file=sys.stderr)
+        record["overload_error"] = repr(exc)[:200]
     # scaling row last and chip only: CPU sorts at 4M would eat the
     # fallback child's whole budget, and a cold 4M compile must not
     # crowd out the rows above on first run after a kernel change
@@ -1336,6 +1343,77 @@ def _attach_last_tpu(line: str) -> str:
     except Exception as exc:  # noqa: BLE001
         print(f"# could not attach TPU record: {exc!r}", file=sys.stderr)
         return line
+
+
+def bench_overload(
+    commands_per_client: int = 30,
+    clients_per_process: int = 3,
+    rate_points=(0.5, 1.0, 2.0),
+) -> dict:
+    """Latency-under-load row (the standard consensus-paper plot: offered
+    rate on x, p50/p99 + goodput on y, cf. the reference's fantoch_plot
+    throughput-latency figure) against a localhost EPaxos n=3 TCP
+    cluster.  Phase 1 measures closed-loop saturation throughput; phase 2
+    sweeps seeded open-loop Poisson arrivals at fractions of it with
+    admission control + client backoff engaged (run/backpressure.py), so
+    the 2x point exercises shedding.  Pure asyncio (no device): the row
+    measures the serving/overload plane, not a kernel.  The phase runner
+    is shared with the CI gate (run/harness.run_overload_phase), so the
+    bench row and ``make overload-smoke`` cannot drift on accounting."""
+    from fantoch_tpu.client import ConflictRateKeyGen, Workload
+    from fantoch_tpu.core import Config
+    from fantoch_tpu.protocol import EPaxos
+    from fantoch_tpu.run.harness import run_overload_phase
+
+    def workload():
+        return Workload(
+            shard_count=1,
+            key_gen=ConflictRateKeyGen(30),
+            keys_per_command=1,
+            commands_per_client=commands_per_client,
+            payload_size=16,
+        )
+
+    config = Config(
+        n=3, f=1,
+        gc_interval_ms=50,
+        executor_executed_notification_interval_ms=50,
+        admission_limit=8,
+        queue_capacity=1024,
+        overload_retry_after_ms=5,
+    )
+
+    def run(rate_per_client=None):
+        return run_overload_phase(
+            EPaxos, config, workload(), clients_per_process,
+            arrival_rate_per_s=rate_per_client, arrival_seed=13,
+        )
+
+    out = {
+        "overload_definition": (
+            "open-loop Poisson sweep vs closed-loop saturation; EPaxos "
+            "n=3 localhost TCP, admission_limit=8, backoff retries (r08)"
+        )
+    }
+    base = run()
+    saturation = base["goodput_cmds_per_s"]
+    out["overload_saturation_cmds_per_s"] = saturation
+    out["overload_closed_loop_p50_ms"] = base["p50_ms"]
+    # one client pool per process (the harness's shard-0 topology)
+    total_clients = config.n * clients_per_process
+    for frac in rate_points:
+        per_client = max(1.0, frac * saturation / total_clients)
+        tag = f"{frac}x".replace(".", "_")
+        row = run(rate_per_client=per_client)
+        out[f"overload_{tag}_offered_cmds_per_s"] = int(
+            per_client * total_clients
+        )
+        out[f"overload_{tag}_goodput_cmds_per_s"] = row["goodput_cmds_per_s"]
+        out[f"overload_{tag}_p50_ms"] = row["p50_ms"]
+        out[f"overload_{tag}_p99_ms"] = row["p99_ms"]
+        out[f"overload_{tag}_sheds"] = row["sheds"]
+        out[f"overload_{tag}_queue_depth_hwm"] = row["queue_depth_hwm"]
+    return out
 
 
 def smoke_main() -> None:

@@ -36,6 +36,18 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--interval", type=int, default=None, metavar="MS",
                         help="open-loop submit interval; omit for closed loop")
+    parser.add_argument("--arrival-rate", type=float, default=None,
+                        metavar="PER_S",
+                        help="open-loop Poisson arrival rate per client "
+                        "(run/backpressure.OpenLoopPacer); mutually "
+                        "exclusive with --interval")
+    parser.add_argument("--arrival-seed", type=int, default=None,
+                        help="seed for the Poisson arrival gaps and the "
+                        "overload-retry jitter (reproducible schedules)")
+    parser.add_argument("--deadline", type=int, default=None, metavar="MS",
+                        help="per-command deadline budget across overload "
+                        "retries: once it expires the command is shed, "
+                        "not executed late")
     # workload flags (client.rs:100-151)
     parser.add_argument("--key-gen", choices=["conflict_rate", "zipf"],
                         default="conflict_rate")
@@ -92,13 +104,19 @@ async def drive(args: argparse.Namespace) -> None:
         shard_addresses,
         workload,
         open_loop_interval_ms=args.interval,
+        arrival_rate_per_s=args.arrival_rate,
+        arrival_seed=args.arrival_seed,
+        deadline_ms=args.deadline,
         status_frequency=args.status_frequency,
     )
     elapsed_s = time.perf_counter() - t0
 
     latencies = []  # ClientData latencies are microseconds (data.py)
+    sheds = retries = 0
     for client in clients.values():
         latencies.extend(client.data().latency_data())
+        sheds += client.shed_commands
+        retries += client.overload_retries
     latencies.sort()
     total = len(latencies)
 
@@ -112,6 +130,10 @@ async def drive(args: argparse.Namespace) -> None:
         # subprocess's interpreter/JAX startup — the honest throughput base)
         "elapsed_s": round(elapsed_s, 3),
         "throughput_cmds_per_s": round(total / elapsed_s, 1) if elapsed_s else None,
+        # overload plane: completed/total is the goodput; sheds are
+        # deadline-expired commands the plane refused to execute late
+        "shed_commands": sheds,
+        "overload_retries": retries,
         "latency_ms": {
             "min": ms(latencies[0]) if total else None,
             "p50": ms(latencies[total // 2]) if total else None,

@@ -84,6 +84,24 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
                         help="durable command-log fsync policy (run/wal.py); "
                         "default FANTOCH_WAL_SYNC env, else 'interval'; only "
                         "consulted when the server runs with --wal-dir")
+    parser.add_argument("--queue-capacity", type=int, default=None,
+                        metavar="N",
+                        help="high watermark of the run-layer bounded queues "
+                        "(run/backpressure.py): readers pause past it; "
+                        "default 8192, 0 = unbounded legacy")
+    parser.add_argument("--admission-limit", type=int, default=None,
+                        metavar="N",
+                        help="client-edge admission depth: past it new "
+                        "submissions are shed with a typed Overloaded "
+                        "reply + retry-after hint; omit to disable shedding")
+    parser.add_argument("--overload-retry-after", type=int, default=100,
+                        metavar="MS",
+                        help="base retry-after hint on Overloaded replies")
+    parser.add_argument("--link-unacked-cap", type=int, default=None,
+                        metavar="N",
+                        help="cap on a peer link's unacked resend window "
+                        "(run/links.py): past it the link is declared lost "
+                        "via the typed path; default 32768, 0 = uncapped")
 
 
 def config_from_args(args: argparse.Namespace):
@@ -107,6 +125,10 @@ def config_from_args(args: argparse.Namespace):
         batched_graph_executor=args.batched_graph_executor,
         serving_pipeline_depth=args.serving_pipeline_depth,
         wal_sync=args.wal_sync,
+        queue_capacity=args.queue_capacity,
+        admission_limit=args.admission_limit,
+        overload_retry_after_ms=args.overload_retry_after,
+        link_unacked_cap=args.link_unacked_cap,
     )
 
 

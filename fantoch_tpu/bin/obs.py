@@ -65,6 +65,24 @@ def cmd_summarize(args) -> int:
     for name, value in sorted(counters.items()):
         print(f"counter {name} = {value}")
     _print_overlap(counters)
+    _print_overload(counters)
+    return 0
+
+
+def _print_overload(counters) -> int:
+    """One-line overload-plane readout from the queue/shed counters
+    (run/backpressure.py): worst queue depth high-watermark across
+    processes, total sheds, and backpressure pauses — the signal that a
+    run was (or was not) operating past its admission edge."""
+    names = ("queue_depth_hwm", "shed_submissions", "backpressure_pauses")
+    if not any(name in counters for name in names):
+        return 0
+    parts = [
+        f"queue depth hwm {int(counters.get('queue_depth_hwm', 0))}",
+        f"sheds {int(counters.get('shed_submissions', 0))}",
+        f"backpressure pauses {int(counters.get('backpressure_pauses', 0))}",
+    ]
+    print("overload: " + "  ".join(parts))
     return 0
 
 

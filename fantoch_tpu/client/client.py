@@ -37,6 +37,12 @@ class Client:
         self._pending = Pending()
         self._data = ClientData()
         self._status_frequency = status_frequency
+        # overload-plane tallies (run/backpressure.py): submissions the
+        # server shed (typed Overloaded replies retried with backoff)
+        # and commands this client itself abandoned past their deadline
+        # budget — goodput accounting for the latency-under-load plots
+        self.overload_retries = 0
+        self.shed_commands = 0
 
     @property
     def id(self) -> ClientId:
@@ -76,6 +82,20 @@ class Client:
                 self._workload.issued_commands,
                 self._workload.commands_per_client,
             )
+        return self.done
+
+    def shed(self, rifl) -> None:
+        """Abandon an in-flight command (deadline budget expired while
+        the server kept shedding it): no latency sample is recorded —
+        shed work is *not* executed late — and the shed is tallied for
+        the goodput accounting."""
+        self._pending.cancel(rifl)
+        self.shed_commands += 1
+
+    @property
+    def done(self) -> bool:
+        """Workload fully generated and nothing in flight (completed or
+        shed) — the drivers' shared termination predicate."""
         return self._workload.finished() and self._pending.is_empty()
 
     def data(self) -> ClientData:

@@ -44,5 +44,17 @@ class ClientData:
         times = self._data.keys()
         return min(times), max(times)
 
+    def span_millis(self) -> Tuple[float, int]:
+        """(first command's submit time, last command's end time), ms —
+        the client's actual serving span reconstructed from the records
+        (submit = end - latency), so throughput accounting can exclude
+        harness boot/teardown wall it never served through."""
+        assert self._data, "no data recorded"
+        first = min(
+            end - max(latencies) / 1000.0
+            for end, latencies in self._data.items()
+        )
+        return first, max(self._data)
+
     def command_count(self) -> int:
         return sum(len(ls) for ls in self._data.values())

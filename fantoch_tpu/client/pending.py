@@ -27,5 +27,11 @@ class Pending:
         assert start_time <= end_time, "time must be monotonic"
         return end_time - start_time, end_time // 1000
 
+    def cancel(self, rifl: Rifl) -> None:
+        """Drop an in-flight command without recording a latency — the
+        shed path of the overload plane (a command abandoned past its
+        deadline budget must not pollute the latency data)."""
+        self._pending.pop(rifl, None)
+
     def is_empty(self) -> bool:
         return not self._pending

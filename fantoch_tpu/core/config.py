@@ -121,6 +121,33 @@ class Config:
     # a runtime is given a wal_dir (new knob; no reference counterpart —
     # the reference's runner has no durability story)
     wal_sync: Optional[str] = None
+    # overload-control plane (run/backpressure.py).  queue_capacity is
+    # the high watermark of every run-layer bounded queue (worker /
+    # executor pools, peer-writer queues, client reply queues): past it
+    # the queue closes its credit gate and upstream socket readers pause
+    # (pressure propagates peer-to-peer via TCP instead of as unbounded
+    # heap); the gate re-opens once drained below half.  None = the
+    # built-in default (backpressure.DEFAULT_QUEUE_CAPACITY, 8192);
+    # 0 = unbounded legacy warn-only queues.  The reference's channels
+    # warn-then-BLOCK on full (fantoch/src/run/task/chan.rs:36-58);
+    # producers here share one cooperative loop, so the plane is
+    # credit-based pause/resume plus shedding, never blocking puts
+    queue_capacity: Optional[int] = None
+    # admission control at the client-facing edge: when the serving
+    # queue depth reaches this bound, new submissions are rejected with
+    # a typed Overloaded reply (errors.OverloadedError client-side)
+    # carrying a retry-after hint, instead of queueing without bound.
+    # None disables shedding (the legacy accept-everything behavior)
+    admission_limit: Optional[int] = None
+    # base retry-after hint stamped on Overloaded replies; the server
+    # scales it by how far past the admission limit the queue sits
+    overload_retry_after_ms: int = 100
+    # cap on a live-but-slow peer link's unacked resend window
+    # (run/links.py): past it the link is declared lost through the
+    # existing typed PeerLostError -> quorum-check path instead of
+    # buffering unboundedly.  None = the built-in default
+    # (backpressure.DEFAULT_UNACKED_CAP); 0 = uncapped legacy
+    link_unacked_cap: Optional[int] = None
     # per-dot lifecycle tracing (fantoch_tpu/observability): fraction of
     # commands traced, selected by a deterministic hash of the command id
     # (same seed => same sampled dot set).  0.0 disables tracing entirely
@@ -149,6 +176,27 @@ class Config:
             raise ValueError(
                 f"wal_sync = {self.wal_sync!r} must be one of "
                 "'always' | 'interval' | 'never'"
+            )
+        if self.queue_capacity is not None and self.queue_capacity < 0:
+            raise ValueError(
+                f"queue_capacity = {self.queue_capacity} must be >= 0 "
+                "(0 = unbounded)"
+            )
+        if self.queue_capacity is not None and self.queue_capacity == 1:
+            raise ValueError("queue_capacity = 1 cannot hold a burst; use >= 2")
+        if self.admission_limit is not None and self.admission_limit < 1:
+            raise ValueError(
+                f"admission_limit = {self.admission_limit} must be >= 1"
+            )
+        if self.overload_retry_after_ms < 1:
+            raise ValueError(
+                f"overload_retry_after_ms = {self.overload_retry_after_ms} "
+                "must be >= 1"
+            )
+        if self.link_unacked_cap is not None and self.link_unacked_cap < 0:
+            raise ValueError(
+                f"link_unacked_cap = {self.link_unacked_cap} must be >= 0 "
+                "(0 = uncapped)"
             )
         if self.device_table_plane and self.newt_clock_bump_interval_ms is not None:
             # real-time clock bumps vote wall-clock micros, which overflow

@@ -85,6 +85,45 @@ class StalledExecutionError(FaultToleranceError):
         )
 
 
+class OverloadedError(FaultToleranceError):
+    """A submission was shed by admission control at a client-facing edge
+    (run/process_runner.py sessions, run/device_runner.py submit ring):
+    the edge's queue depth crossed ``Config.admission_limit``, and
+    executing the command late would only collapse latency for everyone.
+
+    ``retry_after_ms`` is the server's hint (scaled by how far past the
+    limit the queue sits); clients retry with capped exponential backoff
+    floored by it (run/backpressure.Backoff), shedding the command
+    themselves once its deadline budget expires.
+    """
+
+    def __init__(self, depth: int, limit: int, retry_after_ms: int):
+        self.depth = depth
+        self.limit = limit
+        self.retry_after_ms = retry_after_ms
+        super().__init__(
+            f"overloaded: queue depth {depth} >= admission limit {limit}; "
+            f"retry after {retry_after_ms}ms"
+        )
+
+
+class DeadlineExceededError(FaultToleranceError):
+    """A command's per-command deadline budget expired before it completed
+    — the client plane shed it (stopped retrying / stopped waiting)
+    rather than let stale work consume capacity.  Carried as a client
+    statistic in normal operation; raised only when a driver is asked to
+    fail on sheds."""
+
+    def __init__(self, rifl, waited_ms: float, deadline_ms: float):
+        self.rifl = rifl
+        self.waited_ms = waited_ms
+        self.deadline_ms = deadline_ms
+        super().__init__(
+            f"deadline exceeded for {rifl}: waited {waited_ms:.0f}ms of a "
+            f"{deadline_ms:.0f}ms budget"
+        )
+
+
 class SimStalledError(FaultToleranceError):
     """The simulation passed its virtual-time bound with clients still
     waiting — the whole-system analog of :class:`StalledExecutionError`

@@ -63,6 +63,15 @@ class AggregatePending:
             self._pending[rifl] = result
         result.increment_key_count()
 
+    def cancel(self, rifl: Rifl) -> None:
+        """Withdraw a tracked command (the overload plane's deadline-shed
+        path: the client will never resubmit, so the aggregation entry —
+        and any buffered early partials — must not outlive it)."""
+        self._pending.pop(rifl, None)
+        dropped = self._early.pop(rifl, None)
+        if dropped:
+            self._early_count -= len(dropped)
+
     def drain_early(self, rifl: Rifl) -> Optional[CommandResult]:
         """Apply partials that raced ahead of ``wait_for(rifl)``; returns
         the CommandResult if they already complete it."""

@@ -137,14 +137,19 @@ def monotonic_violations(
 
 def counters_total(events: Iterable[Dict[str, Any]]) -> Dict[str, float]:
     """Final value per counter name (counters are emitted as running
-    totals; the last observation wins per (name, pid), then pids sum)."""
+    totals; the last observation wins per (name, pid), then pids sum —
+    except depth gauges, where the cluster-wide value is the worst
+    process's, not the sum of everyone's)."""
     last: Dict[Tuple[str, Optional[int]], float] = {}
     for ev in events:
         if ev.get("k") == "ctr":
             last[(ev["name"], ev.get("pid"))] = ev["v"]
     out: Dict[str, float] = {}
     for (name, _pid), value in last.items():
-        out[name] = out.get(name, 0) + value
+        if name.endswith("_hwm") or name == "queue_depth":
+            out[name] = max(out.get(name, 0), value)
+        else:
+            out[name] = out.get(name, 0) + value
     return out
 
 

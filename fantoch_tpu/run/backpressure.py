@@ -1,0 +1,228 @@
+"""Overload-control primitives: bounded queues, cooperative backpressure,
+retry backoff, and open-loop (Poisson) arrival pacing.
+
+The reference bounds every channel and *blocks* producers on full
+(fantoch/src/run/task/chan.rs:36-58, warn-then-block) — safe there because
+each task owns a thread.  Here every producer is a synchronous handler on
+one cooperative asyncio loop, so a blocking put would deadlock the very
+consumer that needs to drain the queue.  The plane is therefore
+credit-based instead of blocking:
+
+* :class:`BoundedQueue` — an instrumented ``asyncio.Queue`` with a
+  high/low watermark gate.  ``put_nowait`` never blocks (synchronous
+  handlers stay safe); instead the queue *closes its credit gate* at the
+  high watermark and re-opens it once drained below the low one.  The
+  tasks that CAN pause — socket reader tasks, whose pause propagates to
+  the sender peer-to-peer via TCP flow control — await
+  :meth:`BoundedQueue.wait_for_credit` between frames, so pressure flows
+  back to the producing process instead of accumulating as unbounded
+  heap.  Depth high-watermarks, pause and overflow tallies ride the
+  queue for the metrics plane.
+* :class:`Backoff` — capped exponential backoff with full jitter for
+  clients retrying a shed (:class:`~fantoch_tpu.errors.OverloadedError`)
+  submission; honors the server's retry-after hint as a floor.
+* :func:`poisson_intervals` / :class:`OpenLoopPacer` — seeded
+  open-loop arrival pacing (exponential inter-arrival gaps at a target
+  rate): the load instrument that makes overload *measurable*, since a
+  closed-loop client pool self-throttles and can never push the system
+  past saturation.
+
+Admission control (the warn-then-*shed* half of the plane) lives at the
+client-facing edges — ``run/process_runner.py`` sessions and the
+``run/device_runner.py`` submit ring — which consult these watermarks and
+reply with a typed ``Overloaded`` frame carrying a retry-after hint
+instead of queueing past the bound.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Any, Dict, Iterator, Optional
+
+from fantoch_tpu.utils import logger
+
+# default high watermark for run-layer queues (the old WarnQueue warn
+# threshold: what used to only shout now also gates); low = half of high
+DEFAULT_QUEUE_CAPACITY = 8192
+# default cap on a live-but-slow peer link's unacked resend window
+# (run/links.py): ~512 acked strides of ACK_EVERY=64 frames.  A peer that
+# silent-drops this many acks is indistinguishable from a dead one, and
+# buffering further only converts its slowness into our OOM
+DEFAULT_UNACKED_CAP = 1 << 15
+
+
+class BoundedQueue(asyncio.Queue):
+    """Instrumented queue with a high/low-watermark credit gate.
+
+    ``capacity=None`` keeps the legacy warn-only behavior (unbounded,
+    depth gauges still tracked).  With a capacity, ``put_nowait`` still
+    never blocks or raises — producers are synchronous handlers on the
+    cooperative loop — but the credit gate closes at ``capacity`` and
+    re-opens at ``low`` (hysteresis, like the warn re-arm below), and
+    puts landing while the gate is closed are tallied as ``overflows``
+    (pressure the cooperative pause upstream could not absorb, e.g.
+    self-delivered protocol messages).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        capacity: Optional[int] = DEFAULT_QUEUE_CAPACITY,
+        low: Optional[int] = None,
+        warn_size: int = 8192,
+    ):
+        super().__init__()
+        self.name = name
+        assert capacity is None or capacity >= 2, capacity
+        self.capacity = capacity
+        self.low = (
+            low if low is not None else (capacity // 2 if capacity else 0)
+        )
+        self._warn_size = warn_size
+        self._warn_next = warn_size
+        # gauges for the metrics plane (run/observe.py ProcessMetrics)
+        self.depth_hwm = 0
+        self.pauses = 0  # times the credit gate closed
+        self.overflows = 0  # puts while the gate was already closed
+        self._credit = asyncio.Event()
+        self._credit.set()
+
+    def put_nowait(self, item: Any) -> None:  # type: ignore[override]
+        super().put_nowait(item)
+        depth = self.qsize()
+        if depth > self.depth_hwm:
+            self.depth_hwm = depth
+        if self.capacity is not None and depth >= self.capacity:
+            if self._credit.is_set():
+                self._credit.clear()
+                self.pauses += 1
+                logger.warning(
+                    "queue %s over its high watermark (%d >= %d): "
+                    "pausing upstream readers",
+                    self.name,
+                    depth,
+                    self.capacity,
+                )
+            else:
+                self.overflows += 1
+        if depth >= self._warn_next:
+            logger.warning(
+                "queue %s is full (%d items >= %d): consumer falling behind",
+                self.name,
+                depth,
+                self._warn_next,
+            )
+            self._warn_next *= 2
+
+    def get_nowait(self) -> Any:  # type: ignore[override]
+        item = super().get_nowait()
+        depth = self.qsize()
+        if not self._credit.is_set() and depth <= self.low:
+            self._credit.set()
+        # hysteresis: re-arm only once the queue genuinely drained (half
+        # the threshold) — a queue hovering AT the threshold must not warn
+        # on every put
+        if depth < self._warn_size // 2:
+            self._warn_next = self._warn_size
+        return item
+
+    @property
+    def gated(self) -> bool:
+        """True while the credit gate is closed (depth crossed the high
+        watermark and has not drained below the low one yet)."""
+        return not self._credit.is_set()
+
+    async def wait_for_credit(self) -> None:
+        """Cooperative pause point for tasks that may stop producing
+        (socket readers): returns once depth is back below the low
+        watermark.  Consumers run on the same loop, so awaiting here is
+        what lets them drain."""
+        await self._credit.wait()
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "depth": self.qsize(),
+            "depth_hwm": self.depth_hwm,
+            "capacity": self.capacity if self.capacity is not None else 0,
+            "pauses": self.pauses,
+            "overflows": self.overflows,
+        }
+
+
+class Backoff:
+    """Capped exponential backoff with full jitter for overload retries.
+
+    Same shape as :class:`fantoch_tpu.run.links.ReconnectPolicy` but for
+    the client submission plane: each shed submission waits
+    ``min(base * factor^attempt, cap)`` scaled by full jitter, floored by
+    the server's retry-after hint (the server sees its own queue depth;
+    the client should not retry sooner than that).
+    """
+
+    def __init__(
+        self,
+        base_ms: float = 25.0,
+        factor: float = 2.0,
+        cap_ms: float = 1000.0,
+        rng: Optional[random.Random] = None,
+    ):
+        self.base_ms = base_ms
+        self.factor = factor
+        self.cap_ms = cap_ms
+        self._rng = rng or random
+        self.attempt = 0
+
+    def next_delay_ms(self, retry_after_hint_ms: float = 0.0) -> float:
+        delay = min(self.base_ms * (self.factor ** self.attempt), self.cap_ms)
+        self.attempt += 1
+        return max(retry_after_hint_ms, self._rng.uniform(0, delay))
+
+    def reset(self) -> None:
+        self.attempt = 0
+
+
+def log_per_doubling(count: int) -> bool:
+    """True on counts 1, 2, 4, 8, ... — the shared rate limit for
+    per-shed warnings (a sustained burst sheds thousands of times; the
+    log must keep shouting without spamming, like the queue warn)."""
+    return count > 0 and count & (count - 1) == 0
+
+
+def poisson_intervals(
+    rate_per_s: float, rng: Optional[random.Random] = None
+) -> Iterator[float]:
+    """Seeded exponential inter-arrival gaps (seconds) for an open-loop
+    Poisson arrival process at ``rate_per_s``."""
+    assert rate_per_s > 0, rate_per_s
+    rng = rng or random
+    while True:
+        yield rng.expovariate(rate_per_s)
+
+
+class OpenLoopPacer:
+    """Arrival pacing for one open-loop client: ``next_gap_s()`` yields
+    the wait before the next submission — a fixed interval (the legacy
+    ``open_loop_interval_ms`` mode) or seeded Poisson gaps at a target
+    per-client rate."""
+
+    def __init__(
+        self,
+        interval_ms: Optional[int] = None,
+        rate_per_s: Optional[float] = None,
+        seed: Optional[int] = None,
+    ):
+        assert (interval_ms is None) != (rate_per_s is None), (
+            "exactly one of interval_ms / rate_per_s"
+        )
+        self._interval_ms = interval_ms
+        self._gaps = (
+            poisson_intervals(rate_per_s, random.Random(seed))
+            if rate_per_s is not None
+            else None
+        )
+
+    def next_gap_s(self) -> float:
+        if self._gaps is not None:
+            return next(self._gaps)
+        return self._interval_ms / 1000.0

@@ -3,19 +3,33 @@
 Reference: fantoch/src/run/mod.rs:448-832.  A client task pool shares one
 TCP connection per shard; a demux task per connection routes CommandResults
 back to the issuing client by rifl source.  Closed-loop clients keep one
-outstanding command; open-loop clients submit on a fixed interval
-regardless of completions (mod.rs:526-664).
+outstanding command; open-loop clients submit on a pacing schedule
+regardless of completions (mod.rs:526-664) — a fixed interval, or seeded
+Poisson arrivals at a target per-client rate (the overload plane's load
+instrument: closed loops self-throttle and can never push the system past
+saturation).
+
+Overload control (run/backpressure.py): a server past its admission limit
+replies with a typed ``Overloaded`` frame instead of queueing the
+submission.  Both drivers retry with capped exponential backoff + full
+jitter, floored by the server's retry-after hint; a per-command
+``deadline_ms`` budget bounds the retrying — once it expires the client
+*sheds* the command (no latency sample, tallied on the client) rather
+than execute it late.
 
 Multi-shard commands: the client Submits to the target shard and Registers
 the command with every other shard it touches (mod.rs:757-764); each shard
 executes its part and returns one CommandResult, aggregated client-side —
 the ShardsPending role of mod.rs:859-917 is played by the per-command
-``needed`` counter in the drivers below.
+``needed`` counter in the drivers below.  Admission sheds happen at the
+target shard *before* protocol submission, so non-target shards never
+produce partials for a shed command and the retry re-runs the full path.
 """
 
 from __future__ import annotations
 
 import asyncio
+import random
 from typing import Dict, List, Optional, Tuple
 
 from fantoch_tpu.client.client import Client
@@ -24,7 +38,16 @@ from fantoch_tpu.core.command import Command
 from fantoch_tpu.core.ids import ClientId, ShardId
 from fantoch_tpu.core.timing import RunTime
 from fantoch_tpu.observability.tracer import NOOP_TRACER
-from fantoch_tpu.run.prelude import ClientHi, ClientHiAck, Register, Submit, ToClient
+from fantoch_tpu.run.backpressure import Backoff, BoundedQueue, OpenLoopPacer
+from fantoch_tpu.run.prelude import (
+    ClientHi,
+    ClientHiAck,
+    Overloaded,
+    Register,
+    Submit,
+    ToClient,
+    Unregister,
+)
 from fantoch_tpu.run.rw import Rw, connect_with_retry
 
 Address = Tuple[str, int]
@@ -35,11 +58,28 @@ async def run_clients(
     shard_addresses: Dict[ShardId, Address],
     workload: Workload,
     open_loop_interval_ms: Optional[int] = None,
+    arrival_rate_per_s: Optional[float] = None,
+    arrival_seed: Optional[int] = None,
+    deadline_ms: Optional[int] = None,
+    raise_on_shed: bool = False,
     status_frequency: Optional[int] = None,
     tracer=NOOP_TRACER,
 ) -> Dict[ClientId, Client]:
     """Drive `client_ids` against the cluster; returns the finished clients
-    (latency data inside)."""
+    (latency data + overload tallies inside).
+
+    ``open_loop_interval_ms`` / ``arrival_rate_per_s`` select the
+    open-loop driver (at most one): fixed-interval pacing, or seeded
+    Poisson arrivals at ``arrival_rate_per_s`` *per client*.
+    ``deadline_ms`` is the per-command budget across overload retries;
+    on expiry the command is shed and tallied — or, with
+    ``raise_on_shed``, the typed ``DeadlineExceededError`` (chained to
+    the server's ``OverloadedError``) propagates instead, for drivers
+    that treat any shed as failure.
+    """
+    assert open_loop_interval_ms is None or arrival_rate_per_s is None, (
+        "pick one open-loop pacing mode: interval or arrival rate"
+    )
     rws: Dict[ShardId, Rw] = {}
     for shard_id, addr in sorted(shard_addresses.items()):
         rw = await connect_with_retry(addr)
@@ -61,7 +101,12 @@ async def run_clients(
     for client in clients.values():
         client.connect({shard_id: 0 for shard_id in rws})
 
-    queues: Dict[ClientId, asyncio.Queue] = {cid: asyncio.Queue() for cid in client_ids}
+    # reply queues ride the bounded/instrumented plane too: the demux is
+    # a socket reader, so a client that stops collecting pauses its
+    # connection's stream (TCP backpressure) instead of growing the heap
+    queues: Dict[ClientId, BoundedQueue] = {
+        cid: BoundedQueue(f"client[{cid}]") for cid in client_ids
+    }
 
     # sentinel fanned out to every client queue when a demux dies (EOF or
     # error), so the wait loops below fail loudly instead of hanging
@@ -73,42 +118,101 @@ async def run_clients(
                 msg = await rw.recv()
                 if msg is None:
                     return
+                if isinstance(msg, Overloaded):
+                    queues[msg.rifl.source].put_nowait(msg)
+                    continue
                 assert isinstance(msg, ToClient)
-                queues[msg.cmd_result.rifl.source].put_nowait(msg.cmd_result)
+                queue = queues[msg.cmd_result.rifl.source]
+                queue.put_nowait(msg.cmd_result)
+                if queue.gated:
+                    # cooperative backpressure: one client fell behind
+                    # collecting — pause this connection's stream until
+                    # it drains (head-of-line by design: that IS the TCP
+                    # flow-control semantics pressure propagates through)
+                    await queue.wait_for_credit()
         finally:
             for queue in queues.values():
                 queue.put_nowait(eof_sentinel)
 
     demux_tasks = [asyncio.ensure_future(demux(rw)) for rw in rws.values()]
 
-    async def submit(target_shard: ShardId, cmd: Command) -> int:
+    async def submit(
+        target_shard: ShardId, cmd: Command, register: bool = True
+    ) -> int:
         """Submit + per-shard registration; returns the number of
         CommandResults to expect (one per shard touched).  All frames are
         written first, then the touched connections flush concurrently —
-        no serialized per-shard round-trips on the submit path."""
+        no serialized per-shard round-trips on the submit path.
+
+        Overload retries pass ``register=False``: the first attempt's
+        Registers persist at the non-target shards (a shed happens at
+        the target *before* protocol submission, so they are still
+        waiting), and re-sending one would RESET the aggregation entry
+        (``AggregatePending.wait_for`` replaces it), discarding any
+        partials that raced ahead of the retry's Register — a wiped
+        partial would hang the client forever."""
         touched = []
-        for shard_id in cmd.shards():
-            if shard_id != target_shard:
-                rws[shard_id].write(Register(cmd))
-                touched.append(rws[shard_id])
+        if register:
+            for shard_id in cmd.shards():
+                if shard_id != target_shard:
+                    rws[shard_id].write(Register(cmd))
+                    touched.append(rws[shard_id])
         rws[target_shard].write(Submit(cmd))
         touched.append(rws[target_shard])
         await asyncio.gather(*(rw.flush() for rw in touched))
         return cmd.shard_count
 
-    async def collect(client: Client, needed: int) -> list:
-        results = []
-        for _ in range(needed):
-            cmd_result = await queues[client.id].get()
-            if cmd_result is eof_sentinel:
+    async def unregister(target_shard: ShardId, cmd: Command) -> None:
+        """Withdraw a deadline-shed multi-shard command's Registers: the
+        non-target shards hold an aggregation entry nothing will ever
+        complete (the target shard shed before submission, so they never
+        saw — and never will see — any partials)."""
+        others = [
+            rws[shard_id]
+            for shard_id in cmd.shards()
+            if shard_id != target_shard
+        ]
+        for rw in others:
+            rw.write(Unregister(cmd.rifl))
+        await asyncio.gather(*(rw.flush() for rw in others))
+
+    def _retry_rng(client_id: ClientId) -> random.Random:
+        # seeded jitter when the caller wants reproducible schedules;
+        # fresh entropy otherwise (live clients must not thunder-herd)
+        if arrival_seed is None:
+            return random.Random()
+        return random.Random(arrival_seed * 7919 + client_id)
+
+    def _deadline_error(rifl, waited_ms: float, msg: Overloaded):
+        """The typed deadline-shed error, chained to the server's
+        OverloadedError — one construction for both drivers."""
+        from fantoch_tpu.errors import DeadlineExceededError
+
+        error = DeadlineExceededError(rifl, waited_ms, deadline_ms)
+        error.__cause__ = msg.to_error()
+        return error
+
+    async def collect(client: Client, needed: int):
+        """Gather one command's outcome: ``("ok", results)`` once all
+        ``needed`` per-shard results arrived, or ``("overloaded", msg)``
+        when the target shard shed the submission (a shed happens before
+        protocol submission, so no partials can precede or follow it)."""
+        results: list = []
+        while len(results) < needed:
+            item = await queues[client.id].get()
+            if item is eof_sentinel:
                 raise ConnectionError(
                     f"client {client.id}: server connection closed with an "
                     "outstanding command"
                 )
-            results.append(cmd_result)
-        return results
+            if isinstance(item, Overloaded):
+                assert not results, "shed raced a partial result"
+                return "overloaded", item
+            results.append(item)
+        return "ok", results
 
     async def closed_loop(client: Client) -> None:
+        rng = _retry_rng(client.id)
         while True:
             nxt = client.next_cmd(time)
             if nxt is None:
@@ -116,59 +220,151 @@ async def run_clients(
             target_shard, cmd = nxt
             if tracer.enabled:
                 tracer.span("submit", cmd.rifl, cid=client.id)
+            backoff = Backoff(rng=rng)
+            started_ms = time.millis()
             needed = await submit(target_shard, cmd)
-            results = await collect(client, needed)
-            if tracer.enabled:
-                tracer.span("reply", cmd.rifl, cid=client.id)
-            client.handle(results, time)
+            while True:
+                kind, payload = await collect(client, needed)
+                if kind == "ok":
+                    if tracer.enabled:
+                        tracer.span("reply", cmd.rifl, cid=client.id)
+                    client.handle(payload, time)
+                    break
+                client.overload_retries += 1
+                delay_ms = backoff.next_delay_ms(payload.retry_after_ms)
+                waited_ms = time.millis() - started_ms
+                if deadline_ms is not None and waited_ms + delay_ms > deadline_ms:
+                    # deadline budget exhausted: shed, don't execute late
+                    client.shed(cmd.rifl)
+                    await unregister(target_shard, cmd)
+                    if raise_on_shed:
+                        raise _deadline_error(cmd.rifl, waited_ms, payload)
+                    break
+                await asyncio.sleep(delay_ms / 1000)
+                # register=False: the first attempt's Registers persist
+                needed = await submit(target_shard, cmd, register=False)
 
     async def open_loop(client: Client) -> None:
         pending = 0
         eof = False
         expect: Dict[object, int] = {}  # rifl -> results still to arrive
+        inflight: Dict[object, Tuple[ShardId, Command]] = {}  # for retries
+        started_ms: Dict[object, float] = {}
+        backoffs: Dict[object, Backoff] = {}
+        retry_tasks: set = set()
+        rng = _retry_rng(client.id)
+        pacer = OpenLoopPacer(
+            interval_ms=open_loop_interval_ms,
+            rate_per_s=arrival_rate_per_s,
+            seed=(
+                None
+                if arrival_seed is None
+                else arrival_seed * 104729 + client.id
+            ),
+        )
+
+        def _forget(rifl) -> None:
+            nonlocal pending
+            del expect[rifl]
+            inflight.pop(rifl, None)
+            started_ms.pop(rifl, None)
+            backoffs.pop(rifl, None)
+            pending -= 1
+
+        shed_errors: list = []
+
+        async def resubmit_later(msg: Overloaded) -> None:
+            rifl = msg.rifl
+            client.overload_retries += 1
+            backoff = backoffs.setdefault(rifl, Backoff(rng=rng))
+            delay_ms = backoff.next_delay_ms(msg.retry_after_ms)
+            waited_ms = time.millis() - started_ms[rifl]
+            if deadline_ms is not None and waited_ms + delay_ms > deadline_ms:
+                client.shed(rifl)
+                target_shard, cmd = inflight[rifl]
+                await unregister(target_shard, cmd)
+                if raise_on_shed:
+                    shed_errors.append(_deadline_error(rifl, waited_ms, msg))
+                _forget(rifl)
+                return
+            await asyncio.sleep(delay_ms / 1000)
+            target_shard, cmd = inflight[rifl]
+            # register=False: the first attempt's Registers persist
+            await submit(target_shard, cmd, register=False)
 
         async def collector() -> None:
             nonlocal pending, eof
             buffered: Dict[object, list] = {}
             while True:
-                cmd_result = await queues[client.id].get()
-                if cmd_result is eof_sentinel:
+                item = await queues[client.id].get()
+                if item is eof_sentinel:
                     eof = True
                     return
-                rifl = cmd_result.rifl
-                buffered.setdefault(rifl, []).append(cmd_result)
+                if isinstance(item, Overloaded):
+                    if item.rifl not in expect:
+                        continue  # already shed past its deadline
+                    task = asyncio.ensure_future(resubmit_later(item))
+                    retry_tasks.add(task)
+                    task.add_done_callback(retry_tasks.discard)
+                    continue
+                rifl = item.rifl
+                if rifl not in expect:
+                    continue  # shed while a retry was in flight
+                buffered.setdefault(rifl, []).append(item)
                 if len(buffered[rifl]) == expect[rifl]:
                     if tracer.enabled:
                         tracer.span("reply", rifl, cid=client.id)
                     client.handle(buffered.pop(rifl), time)
-                    del expect[rifl]
-                    pending -= 1
+                    _forget(rifl)
 
         collect_task = asyncio.ensure_future(collector())
-        while True:
+        while not shed_errors:  # fail fast mid-generation on raise_on_shed
+            # gap BEFORE each submission (including the first): N clients
+            # starting together must not fire a synchronized burst — the
+            # same arrival process as the sim's open loop, where the
+            # first arrival is itself an exponential gap from t=0
+            await asyncio.sleep(pacer.next_gap_s())
             nxt = client.next_cmd(time)
             if nxt is None:
                 break
             target_shard, cmd = nxt
             expect[cmd.rifl] = cmd.shard_count
+            inflight[cmd.rifl] = (target_shard, cmd)
+            started_ms[cmd.rifl] = time.millis()
             if tracer.enabled:
                 tracer.span("submit", cmd.rifl, cid=client.id)
             await submit(target_shard, cmd)
             pending += 1
-            await asyncio.sleep(open_loop_interval_ms / 1000)
-        while pending > 0 and not eof:
+        while pending > 0 and not eof and not shed_errors:
             await asyncio.sleep(0.01)
+        for task in list(retry_tasks):
+            task.cancel()
         collect_task.cancel()
+        if shed_errors:
+            raise shed_errors[0]
         if eof and pending > 0:
             raise ConnectionError(
                 f"client {client.id}: server connection closed with "
                 f"{pending} outstanding commands"
             )
 
-    driver = open_loop if open_loop_interval_ms is not None else closed_loop
-    await asyncio.gather(*(driver(client) for client in clients.values()))
-    for task in demux_tasks:
-        task.cancel()
-    for rw in rws.values():
-        rw.close()
+    open_looped = (
+        open_loop_interval_ms is not None or arrival_rate_per_s is not None
+    )
+    driver = open_loop if open_looped else closed_loop
+    driver_tasks = [
+        asyncio.ensure_future(driver(client)) for client in clients.values()
+    ]
+    try:
+        await asyncio.gather(*driver_tasks)
+    finally:
+        # raise_on_shed (or any driver failure) must not orphan sibling
+        # drivers and the demux tasks on the loop past the raise (cancel
+        # is a no-op for tasks that already completed)
+        for task in driver_tasks:
+            task.cancel()
+        for task in demux_tasks:
+            task.cancel()
+        for rw in rws.values():
+            rw.close()
     return clients

@@ -57,6 +57,7 @@ from fantoch_tpu.core.kvs import KVStore
 from fantoch_tpu.executor.aggregate import AggregatePending
 from fantoch_tpu.executor.base import ExecutorResult
 from fantoch_tpu.run.pipeline import (
+    BoundedSubmitRing,
     PipelineCore,
     requested_pipeline_depth,
     resolve_pipeline_depth,
@@ -64,6 +65,7 @@ from fantoch_tpu.run.pipeline import (
 from fantoch_tpu.run.prelude import (
     ClientHi,
     ClientHiAck,
+    Overloaded,
     Register,
     Submit,
     ToClient,
@@ -1332,6 +1334,31 @@ class _DeviceClientSession:
         self.rw.write(ToClient(CommandResult(cmd.rifl, 0)))
         self._flush_needed.set()
 
+    def _shed(self, cmd: Command) -> None:
+        """Admission-control shed: typed Overloaded reply + retry-after
+        hint (run/backpressure.py plane; the client retries with capped
+        backoff or sheds the command itself at its deadline)."""
+        from fantoch_tpu.run.backpressure import log_per_doubling
+
+        runtime = self.runtime
+        ring = runtime._submit_queue
+        ring.sheds += 1
+        retry_after = runtime.retry_after_ms()
+        if log_per_doubling(ring.sheds):
+            logger.warning(
+                "shedding submission %s from client %s: submit ring at its "
+                "bound (%d >= %s); retry after %dms; %d sheds total",
+                cmd.rifl, cmd.rifl.source, len(ring), ring.capacity,
+                retry_after, ring.sheds,
+            )
+        self.rw.write(
+            Overloaded(
+                cmd.rifl, retry_after, depth=len(ring),
+                limit=ring.capacity or 0,
+            )
+        )
+        self._flush_needed.set()
+
     def _validate(self, cmd: Command) -> Optional[str]:
         """The session-boundary twin of the driver's `_bucket_row`
         contract; returns the rejection reason for commands the compiled
@@ -1399,6 +1426,13 @@ class _DeviceClientSession:
                     why = self._validate(cmd)
                     if why is not None:
                         self._reject(cmd, why)
+                        continue
+                    if not self.runtime.has_capacity():
+                        # admission control: the submit ring is at its
+                        # bound — shed with a typed Overloaded + hint
+                        # BEFORE tracking, so the retry re-runs the
+                        # full path with no leftover aggregation state
+                        self._shed(cmd)
                         continue
                     self.track(cmd)
                     self.runtime.rifl_sessions[cmd.rifl] = self
@@ -1538,7 +1572,13 @@ class DeviceRuntime:
         # holds one connection per shard; only the target shard's carries
         # the Submit)
         self.rifl_sessions: Dict[Rifl, _DeviceClientSession] = {}
-        self._submit_queue: Deque[Tuple[Dot, Command]] = deque()
+        # bounded submit ring (run/pipeline.py): the device serving
+        # loop's admission edge.  Config.admission_limit bounds queued
+        # submissions; past it sessions shed with a typed Overloaded
+        # reply + retry-after hint (None = legacy unbounded)
+        self._submit_queue: BoundedSubmitRing = BoundedSubmitRing(
+            capacity=config.admission_limit
+        )
         self._tallies: Dict[str, int] = {}
         self._publish_tallies()
         self._work = asyncio.Event()
@@ -1613,6 +1653,11 @@ class DeviceRuntime:
             "in_flight": d.in_flight,
             "stable_watermark": d.stable_watermark,
             "queued": len(self._submit_queue),
+            # overload plane: submit-ring bound, depth high-watermark,
+            # and admission sheds (run/pipeline.BoundedSubmitRing)
+            "queued_hwm": self._submit_queue.depth_hwm,
+            "queue_capacity": self._submit_queue.capacity or 0,
+            "shed_submissions": self._submit_queue.sheds,
             # per-dispatch device counters (observability/device.py)
             **d.device_counters(),
             "jax_recompiles": recompile_count(),
@@ -1645,8 +1690,38 @@ class DeviceRuntime:
         session = _DeviceClientSession(self, Rw(reader, writer))
         self.spawn(session.run(), fatal=False)
 
+    def has_capacity(self) -> bool:
+        """Admission check for sessions: False once the submit ring sits
+        at its bound (the session sheds with a typed Overloaded reply
+        instead of queueing).  Check-then-submit is race-free: sessions
+        and the driver share one cooperative loop."""
+        ring = self._submit_queue
+        return ring.capacity is None or len(ring) < ring.capacity
+
+    def retry_after_ms(self) -> int:
+        """The shed reply's retry-after hint, scaled by how many rounds
+        of drain the current backlog represents."""
+        base = self.config.overload_retry_after_ms
+        ring = self._submit_queue
+        if ring.capacity is None:
+            return base
+        return base * max(1, len(ring) // max(1, self.driver.batch_size))
+
     def submit(self, dot: Dot, cmd: Command) -> None:
-        self._submit_queue.append((dot, cmd))
+        if not self._submit_queue.try_push((dot, cmd)):
+            # unreachable via sessions (has_capacity() is checked on the
+            # same cooperative tick, with no await between check and
+            # submit) — a real exception, not an assert, so a future
+            # caller that skips the check fails LOUDLY (the driver task
+            # tears the runtime down) instead of silently dropping the
+            # command under python -O
+            from fantoch_tpu.errors import OverloadedError
+
+            raise OverloadedError(
+                len(self._submit_queue),
+                self._submit_queue.capacity or 0,
+                self.retry_after_ms(),
+            )
         self._work.set()
 
     def drop_session(self, session: "_DeviceClientSession") -> None:

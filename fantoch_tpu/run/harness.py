@@ -50,6 +50,9 @@ async def run_localhost_cluster(
     workload: Workload,
     clients_per_process: int,
     open_loop_interval_ms: Optional[int] = None,
+    arrival_rate_per_s: Optional[float] = None,
+    arrival_seed: Optional[int] = None,
+    deadline_ms: Optional[int] = None,
     extra_run_time_ms: int = 500,
     workers: int = 1,
     executors: int = 1,
@@ -165,6 +168,9 @@ async def run_localhost_cluster(
                 },
                 workload,
                 open_loop_interval_ms=open_loop_interval_ms,
+                arrival_rate_per_s=arrival_rate_per_s,
+                arrival_seed=arrival_seed,
+                deadline_ms=deadline_ms,
                 **({"tracer": client_tracer} if client_tracer is not None else {}),
             )
             for group, pid in client_groups
@@ -216,6 +222,91 @@ async def run_localhost_cluster(
     return runtimes, clients
 
 
+def run_overload_phase(
+    protocol_cls,
+    config: Config,
+    workload: Workload,
+    clients_per_process: int,
+    arrival_rate_per_s: Optional[float] = None,
+    arrival_seed: Optional[int] = None,
+    deadline_ms: Optional[int] = None,
+    extra_run_time_ms: int = 100,
+) -> dict:
+    """One measured load phase against a fresh localhost cluster — the
+    shared instrument of ``bench.py bench_overload`` and
+    ``scripts/overload_smoke.py`` (one implementation, so the CI gate and
+    the bench row cannot drift on accounting semantics).
+
+    Boots, drives the client pool (closed loop, or open-loop Poisson at
+    ``arrival_rate_per_s`` per client), tears down; returns goodput,
+    latency percentiles, the overload-plane tallies, and the depth
+    high-watermarks split by queue family.  ``bound_violations`` lists
+    queues whose depth high-watermark passed 2x their configured
+    capacity: the capacity is a *pause watermark*, not a hard cap
+    (``put_nowait`` never blocks — synchronous producers may overshoot
+    while a gate drains, tallied as overflows), so bounded-ness is
+    pinned as "never past 2x the watermark", while the truly hard bounds
+    (the device submit ring, the admission limit) assert exactly.
+    """
+    runtimes, clients = asyncio.run(
+        run_localhost_cluster(
+            protocol_cls, config, workload, clients_per_process,
+            arrival_rate_per_s=arrival_rate_per_s,
+            arrival_seed=arrival_seed,
+            deadline_ms=deadline_ms,
+            extra_run_time_ms=extra_run_time_ms,
+        )
+    )
+    latencies = sorted(
+        value
+        for client in clients.values()
+        for value in client.data().latency_data()
+    )
+    # goodput over the SERVING span (first submit to last completion,
+    # reconstructed from the client records) — not the harness wall,
+    # which includes cluster boot/connect and would deflate the
+    # saturation estimate the burst rates are calibrated against
+    spans = [
+        client.data().span_millis()
+        for client in clients.values()
+        if list(client.data().latency_data())
+    ]
+    wall_s = (
+        (max(end for _s, end in spans) - min(start for start, _e in spans))
+        / 1000.0
+        if spans
+        else 0.0
+    )
+    queue_hwm = unacked_hwm = 0
+    violations = []
+    for runtime in runtimes.values():
+        for name, row in runtime.queue_stats().items():
+            if name.startswith("unacked->"):
+                unacked_hwm = max(unacked_hwm, row["depth_hwm"])
+            else:
+                queue_hwm = max(queue_hwm, row["depth_hwm"])
+            if row["capacity"] and row["depth_hwm"] > 2 * row["capacity"]:
+                violations.append((name, row["depth_hwm"], row["capacity"]))
+    total = len(latencies)
+    return {
+        "completed": total,
+        "goodput_cmds_per_s": int(total / wall_s) if wall_s > 0 else 0,
+        "p50_ms": round(latencies[total // 2] / 1000.0, 2) if total else None,
+        "p99_ms": (
+            round(latencies[int(total * 0.99)] / 1000.0, 2) if total else None
+        ),
+        "sheds": sum(r.shed_submissions for r in runtimes.values()),
+        "backpressure_pauses": sum(
+            r.backpressure_pauses for r in runtimes.values()
+        ),
+        "client_retries": sum(c.overload_retries for c in clients.values()),
+        "shed_commands": sum(c.shed_commands for c in clients.values()),
+        "queue_depth_hwm": int(queue_hwm),
+        "unacked_depth_hwm": int(unacked_hwm),
+        "bound_violations": violations,
+    }
+
+
 async def run_device_server(
     config: Config,
     workload: Workload,
@@ -227,6 +318,9 @@ async def run_device_server(
     key_width: int = 1,
     pending_capacity: int = 64,
     open_loop_interval_ms: Optional[int] = None,
+    arrival_rate_per_s: Optional[float] = None,
+    arrival_seed: Optional[int] = None,
+    deadline_ms: Optional[int] = None,
     monitor_execution_order: bool = True,
     pipeline: Optional[bool] = None,
     pipeline_depth: Optional[int] = None,
@@ -259,6 +353,9 @@ async def run_device_server(
             {s: ("127.0.0.1", port) for s in range(config.shard_count)},
             workload,
             open_loop_interval_ms=open_loop_interval_ms,
+            arrival_rate_per_s=arrival_rate_per_s,
+            arrival_seed=arrival_seed,
+            deadline_ms=deadline_ms,
         )
     )
     failure_task = asyncio.ensure_future(runtime.failed.wait())

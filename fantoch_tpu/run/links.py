@@ -26,6 +26,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Deque, List, Optional, Tuple
 
+from fantoch_tpu.run.backpressure import DEFAULT_UNACKED_CAP
+
 # link-frame kinds (rw.py link framing)
 KIND_DATA = 0
 KIND_ACK = 1
@@ -67,17 +69,34 @@ class LinkState:
         "rw",
         "queue",
         "unacked",
+        "unacked_cap",
+        "unacked_hwm",
         "seq",
         "resend",
         "dead",
         "writer_task",
     )
 
-    def __init__(self, peer_id: int, addr: Tuple[str, int], index: int, rw: Any):
+    def __init__(
+        self,
+        peer_id: int,
+        addr: Tuple[str, int],
+        index: int,
+        rw: Any,
+        unacked_cap: int = DEFAULT_UNACKED_CAP,
+    ):
         self.peer_id = peer_id
         self.addr = addr
         self.index = index
         self.rw = rw
+        # overload control (run/backpressure.py): cap on the resend
+        # window a live-but-slow peer may pin.  Dead peers already drop
+        # frames (PeerLinks.put_nowait); a connected peer that reads but
+        # never acks is the remaining unbounded-buffer path — past the
+        # cap the link is declared lost via the existing typed
+        # PeerLostError -> quorum-check route.  0 = uncapped (legacy)
+        self.unacked_cap = unacked_cap
+        self.unacked_hwm = 0
         # the one live writer task draining this link (runner-owned):
         # revival must cancel it before spawning a replacement — a stale
         # writer parked on queue.get() never observed dead=True, and two
@@ -96,6 +115,20 @@ class LinkState:
     def next_seq(self) -> int:
         self.seq += 1
         return self.seq
+
+    def note_sent(self, seq: int, frame: bytes) -> bool:
+        """Record a sent-but-unacked frame; returns True while the
+        resend window is within its cap, False once the cap is crossed
+        (the writer then declares the peer lost instead of buffering
+        further)."""
+        self.unacked.append((seq, frame))
+        depth = len(self.unacked)
+        if depth > self.unacked_hwm:
+            self.unacked_hwm = depth
+        return not self.over_unacked_cap()
+
+    def over_unacked_cap(self) -> bool:
+        return bool(self.unacked_cap) and len(self.unacked) > self.unacked_cap
 
     def ack(self, seq: int) -> None:
         while self.unacked and self.unacked[0][0] <= seq:

@@ -40,6 +40,14 @@ class ProcessMetrics:
     workers: List[Metrics]
     executors: List[Metrics]
     device: Optional[Dict[str, float]] = None
+    # overload-control plane (run/backpressure.py): per-queue depth /
+    # depth-high-watermark / pause / overflow gauges (``queues``) plus
+    # the process-level shed/backpressure running totals (``overload``).
+    # WarnQueue used to only *log* a falling-behind consumer; these make
+    # it a gauge that survives the run.  None on snapshots written
+    # before the fields existed (dataclass defaults cover old pickles)
+    queues: Optional[Dict[str, Dict[str, float]]] = None
+    overload: Optional[Dict[str, float]] = None
 
 
 def write_metrics_snapshot(path: str, metrics: ProcessMetrics) -> None:

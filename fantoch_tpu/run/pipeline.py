@@ -123,6 +123,54 @@ class IngestRing:
         return arrays
 
 
+class BoundedSubmitRing:
+    """Bounded FIFO of pending submissions feeding a serving loop — the
+    device runtime's admission edge (run/backpressure.py plane).
+
+    ``try_push`` refuses entries past ``capacity`` (the caller replies
+    with a typed Overloaded frame instead of queueing without bound);
+    the depth high-watermark rides the ring for the metrics snapshot,
+    and the admission edge that refuses a command tallies it on
+    ``sheds`` (the ring only *checks* the bound — counting belongs to
+    whoever owns the reply, so one shed is never counted twice).
+    ``capacity=None`` keeps the legacy unbounded behavior.
+    """
+
+    __slots__ = ("capacity", "depth_hwm", "sheds", "_items")
+
+    def __init__(self, capacity: Optional[int] = None):
+        assert capacity is None or capacity >= 1
+        self.capacity = capacity
+        self.depth_hwm = 0
+        self.sheds = 0
+        self._items: Deque[Any] = deque()
+
+    def try_push(self, item: Any) -> bool:
+        if self.capacity is not None and len(self._items) >= self.capacity:
+            return False
+        self._items.append(item)
+        if len(self._items) > self.depth_hwm:
+            self.depth_hwm = len(self._items)
+        return True
+
+    def popleft(self) -> Any:
+        return self._items.popleft()
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __bool__(self) -> bool:
+        return bool(self._items)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "depth": len(self._items),
+            "depth_hwm": self.depth_hwm,
+            "capacity": self.capacity if self.capacity is not None else 0,
+            "sheds": self.sheds,
+        }
+
+
 class PipelineCore:
     """Depth-K dispatch/drain pipelining plus the per-dispatch counters
     every device serving driver shares.

@@ -12,47 +12,32 @@ from __future__ import annotations
 
 import asyncio
 from dataclasses import dataclass
-from typing import Any, List
+from typing import Any, Dict, List, Optional
 
 from fantoch_tpu.core.command import Command, CommandResult
-from fantoch_tpu.core.ids import ClientId, ProcessId, ShardId
+from fantoch_tpu.core.ids import ClientId, ProcessId, Rifl, ShardId
+from fantoch_tpu.run.backpressure import BoundedQueue
 from fantoch_tpu.run.routing import WorkerIndex, resolve_index
-from fantoch_tpu.utils import logger
 
 
-class WarnQueue(asyncio.Queue):
-    """Queue that warns when its depth crosses a threshold — the analog of
-    the reference's bounded channels (fantoch/src/run/task/chan.rs:36-58,
-    warn-then-block on full).  Producers here are synchronous handlers on
-    one cooperative loop, so blocking them would deadlock the consumer;
-    instead the overload signal surfaces loudly (once per doubling above
-    the threshold, so a runaway queue keeps shouting but doesn't spam)."""
+class WarnQueue(BoundedQueue):
+    """The analog of the reference's bounded channels
+    (fantoch/src/run/task/chan.rs:36-58, warn-then-block on full), now
+    riding the overload-control plane (run/backpressure.BoundedQueue):
+    producers here are synchronous handlers on one cooperative loop, so
+    blocking them would deadlock the consumer; instead the queue warns
+    (once per doubling, so a runaway queue keeps shouting but doesn't
+    spam), tracks depth gauges, and — when bounded — closes a credit
+    gate the socket-reader tasks pause on, so pressure propagates
+    peer-to-peer via TCP instead of as unbounded heap."""
 
-    def __init__(self, name: str, warn_size: int = 8192):
-        super().__init__()
-        self._warn_name = name
-        self._warn_size = warn_size
-        self._warn_next = warn_size
-
-    def put_nowait(self, item: Any) -> None:  # type: ignore[override]
-        super().put_nowait(item)
-        if self.qsize() >= self._warn_next:
-            logger.warning(
-                "queue %s is full (%d items >= %d): consumer falling behind",
-                self._warn_name,
-                self.qsize(),
-                self._warn_next,
-            )
-            self._warn_next *= 2
-
-    def get_nowait(self) -> Any:  # type: ignore[override]
-        item = super().get_nowait()
-        # hysteresis: re-arm only once the queue genuinely drained (half
-        # the threshold) — a queue hovering AT the threshold must not warn
-        # on every put
-        if self.qsize() < self._warn_size // 2:
-            self._warn_next = self._warn_size
-        return item
+    def __init__(
+        self,
+        name: str,
+        warn_size: int = 8192,
+        capacity: Optional[int] = None,
+    ):
+        super().__init__(name, capacity=capacity, warn_size=warn_size)
 
 
 # --- handshakes (prelude.rs:38-50) ---
@@ -100,6 +85,18 @@ class Register:
 
 
 @dataclass
+class Unregister:
+    """Client -> non-target shard: withdraw a multi-shard command's
+    Register (the command was shed past its deadline and will never be
+    submitted again).  Without it, each deadline-shed multi-shard command
+    would leak one aggregation entry per non-target shard for the life
+    of the session — the unbounded-state class the overload plane
+    exists to close."""
+
+    rifl: Rifl
+
+
+@dataclass
 class Submit:
     cmd: Command
 
@@ -107,6 +104,29 @@ class Submit:
 @dataclass
 class ToClient:
     cmd_result: CommandResult
+
+
+@dataclass
+class Overloaded:
+    """Server -> client: the submission was shed by admission control
+    (the edge queue depth crossed ``Config.admission_limit``) — the wire
+    form of :class:`fantoch_tpu.errors.OverloadedError`.  The client
+    plane retries with capped exponential backoff floored by
+    ``retry_after_ms`` (run/backpressure.Backoff) or sheds the command
+    itself once its deadline budget expires.  No reference counterpart:
+    the reference's channels block the whole connection instead of
+    rejecting a single command."""
+
+    rifl: Rifl
+    retry_after_ms: int
+    depth: int = 0
+    limit: int = 0
+
+    def to_error(self):
+        """The typed client-side form of this frame."""
+        from fantoch_tpu.errors import OverloadedError
+
+        return OverloadedError(self.depth, self.limit, self.retry_after_ms)
 
 
 # --- process wire protocol: protocol/executor split (prelude.rs:71-77) ---
@@ -136,12 +156,17 @@ class POEExecutor:
 
 
 class ToPool:
-    """Vector of queues with WorkerIndex routing (pool.rs:11-138)."""
+    """Vector of queues with WorkerIndex routing (pool.rs:11-138).
 
-    def __init__(self, name: str, size: int):
+    ``capacity`` bounds each queue with the watermark credit gate
+    (run/backpressure.py): socket readers feeding the pool await
+    :meth:`wait_for_credit` between frames, pausing their TCP stream
+    while any destination queue sits above its high watermark."""
+
+    def __init__(self, name: str, size: int, capacity: Optional[int] = None):
         self.name = name
-        self._queues: List[asyncio.Queue] = [
-            WarnQueue(f"{name}[{i}]") for i in range(size)
+        self._queues: List[WarnQueue] = [
+            WarnQueue(f"{name}[{i}]", capacity=capacity) for i in range(size)
         ]
 
     @property
@@ -150,6 +175,28 @@ class ToPool:
 
     def queue(self, position: int) -> asyncio.Queue:
         return self._queues[position]
+
+    @property
+    def gated(self) -> bool:
+        """True while any member queue's credit gate is closed."""
+        return any(queue.gated for queue in self._queues)
+
+    async def wait_for_credit(self) -> None:
+        """Pause point for reader tasks: returns once every member queue
+        is back below its low watermark (consumers share the loop, so
+        awaiting here is what drains them)."""
+        for queue in self._queues:
+            if queue.gated:
+                await queue.wait_for_credit()
+
+    def max_depth(self) -> int:
+        """The deepest member queue right now — the admission-control
+        depth signal (the bottleneck queue, not the sum: one wedged
+        worker is what collapses latency)."""
+        return max(queue.qsize() for queue in self._queues)
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        return {queue.name: queue.stats() for queue in self._queues}
 
     def forward(self, index: WorkerIndex, item: Any) -> None:
         """Route `item` by worker index.

@@ -48,9 +48,14 @@ from fantoch_tpu.run.links import (
     PeerLinks,
     ReconnectPolicy,
 )
+from fantoch_tpu.run.backpressure import (
+    DEFAULT_QUEUE_CAPACITY,
+    DEFAULT_UNACKED_CAP,
+)
 from fantoch_tpu.run.prelude import (
     ClientHi,
     ClientHiAck,
+    Overloaded,
     PingReply,
     PingReq,
     POEExecutor,
@@ -60,6 +65,7 @@ from fantoch_tpu.run.prelude import (
     Submit,
     ToClient,
     ToPool,
+    Unregister,
     WarnQueue,
 )
 from fantoch_tpu.run.routing import worker_dot_index_shift
@@ -117,10 +123,16 @@ def executor_index(info: Any, size: int) -> Optional[int]:
 class _StampingQueue(WarnQueue):
     """Queue whose items carry their entry time — the delay line's source
     (delay.rs timestamps messages on entry, :6-39).  Inherits the
-    warn-on-depth overload signal (delayed links back up first)."""
+    warn-on-depth overload signal and the bounded watermark gate
+    (delayed links back up first)."""
 
-    def __init__(self, name: str, loop: asyncio.AbstractEventLoop):
-        super().__init__(name)
+    def __init__(
+        self,
+        name: str,
+        loop: asyncio.AbstractEventLoop,
+        capacity: Optional[int] = None,
+    ):
+        super().__init__(name, capacity=capacity)
         self._stamp_loop = loop
 
     def put_nowait(self, item: Any) -> None:  # type: ignore[override]
@@ -143,6 +155,29 @@ class _ClientSession:
 
     def deliver(self, result: ExecutorResult) -> None:
         self._emit(self.pending.add_executor_result(result))
+
+    def _shed(self, rifl, depth: int, limit: int) -> None:
+        """Admission control: reject a submission with a typed Overloaded
+        reply + retry-after hint instead of queueing past the bound —
+        warn-then-shed where the reference warn-then-blocks (chan.rs:
+        36-58); blocking is the *reader pause* below, reserved for depths
+        between the admission limit and the hard queue capacity."""
+        runtime = self.runtime
+        runtime.shed_submissions += 1
+        retry_after = runtime.config.overload_retry_after_ms * max(
+            1, depth // max(1, limit)
+        )
+        from fantoch_tpu.run.backpressure import log_per_doubling
+
+        if log_per_doubling(runtime.shed_submissions):
+            logger.warning(
+                "p%s: shedding submission %s (edge depth %d >= admission "
+                "limit %d; retry after %dms; %d sheds total)",
+                runtime.process.id, rifl, depth, limit, retry_after,
+                runtime.shed_submissions,
+            )
+        self.rw.write(Overloaded(rifl, retry_after, depth, limit))
+        self._flush_needed.set()
 
     def _emit(self, cmd_result) -> None:
         if cmd_result is not None:
@@ -185,8 +220,23 @@ class _ClientSession:
                     self.pending.wait_for(msg.cmd)
                     self._emit(self.pending.drain_early(msg.cmd.rifl))
                     continue
+                if isinstance(msg, Unregister):
+                    # the client deadline-shed a multi-shard command the
+                    # target shard never admitted: drop our aggregation
+                    # entry or it leaks for the session's life
+                    self.pending.cancel(msg.rifl)
+                    continue
                 assert isinstance(msg, Submit)
                 cmd = msg.cmd
+                limit = self.runtime.config.admission_limit
+                if limit is not None:
+                    depth = self.runtime.admission_depth()
+                    if depth >= limit:
+                        # shed BEFORE wait_for: a rejected command must
+                        # leave no aggregation state (the retry re-runs
+                        # the full submit path)
+                        self._shed(cmd.rifl, depth, limit)
+                        continue
                 self.pending.wait_for(cmd)
                 self._emit(self.pending.drain_early(cmd.rifl))
                 dot = (
@@ -200,6 +250,13 @@ class _ClientSession:
                     else (0, 0)  # leader-based: submit handled by any worker
                 )
                 self.runtime.workers.forward(index, ("submit", dot, cmd))
+                if self.runtime.workers.gated:
+                    # cooperative backpressure at the client edge: stop
+                    # reading this client's socket until the worker pool
+                    # drains below its low watermark — the client's TCP
+                    # stream stalls instead of our heap growing
+                    self.runtime.backpressure_pauses += 1
+                    await self.runtime.workers.wait_for_credit()
         except (ConnectionError, OSError) as exc:
             # a lost client is the client's problem, not the cluster's:
             # unregister and keep serving everyone else
@@ -265,8 +322,27 @@ class ProcessRuntime:
                 "shard_count > 1 needs executors >= 2 (main + secondary "
                 "request-serving executor)"
             )
-        self.workers = ToPool("workers", workers)
-        self.executor_pool = ToPool("executors", executors)
+        # overload-control plane (run/backpressure.py): every run-layer
+        # queue is bounded with a watermark credit gate (None in the
+        # config = the built-in default; an explicit 0 = legacy
+        # unbounded warn-only queues), socket readers pause on closed
+        # gates, and the client edge sheds past Config.admission_limit
+        self.queue_capacity: Optional[int] = (
+            DEFAULT_QUEUE_CAPACITY
+            if config.queue_capacity is None
+            else (config.queue_capacity or None)
+        )
+        self.link_unacked_cap = (
+            DEFAULT_UNACKED_CAP
+            if config.link_unacked_cap is None
+            else config.link_unacked_cap
+        )
+        self.shed_submissions = 0
+        self.backpressure_pauses = 0
+        self.workers = ToPool("workers", workers, capacity=self.queue_capacity)
+        self.executor_pool = ToPool(
+            "executors", executors, capacity=self.queue_capacity
+        )
         if executors > 1:
             # batched array commit seams (Newt's TableVotesArrays) span
             # keys, but a multi-executor pool routes infos per key — fall
@@ -611,7 +687,10 @@ class ProcessRuntime:
                         self.incarnation,
                     )
                 )
-                link = LinkState(peer_id, addr, index, rw)
+                link = LinkState(
+                    peer_id, addr, index, rw,
+                    unacked_cap=self.link_unacked_cap,
+                )
                 self._chaos_rws[rw] = peer_id
                 delay_ms = self.peer_delays.get(peer_id)
                 if delay_ms:
@@ -621,13 +700,21 @@ class ProcessRuntime:
                     # still leaves one delay later, not serialized at one
                     # frame per delay)
                     queue = _StampingQueue(
-                        f"delay->p{peer_id}", asyncio.get_running_loop()
+                        f"delay->p{peer_id}[{index}]",
+                        asyncio.get_running_loop(),
+                        capacity=self.queue_capacity,
                     )
-                    delayed: asyncio.Queue = WarnQueue(f"writer->p{peer_id}")
+                    delayed: asyncio.Queue = WarnQueue(
+                        f"writer->p{peer_id}[{index}]",
+                        capacity=self.queue_capacity,
+                    )
                     self.spawn(self._delay_task(queue, delayed, delay_ms))
                     link.queue = delayed
                 else:
-                    queue = WarnQueue(f"writer->p{peer_id}")
+                    queue = WarnQueue(
+                        f"writer->p{peer_id}[{index}]",
+                        capacity=self.queue_capacity,
+                    )
                     link.queue = queue
                 link.writer_task = self.spawn(self._peer_writer_task(link))
                 self.spawn(self._ack_reader_task(link, rw))
@@ -824,6 +911,16 @@ class ProcessRuntime:
                 assert isinstance(msg, POEProtocol)
                 index = self.protocol_cls.message_index(msg.msg)
                 self.workers.forward(index, ("msg", from_, from_shard, msg.msg))
+            if self.workers.gated or self.executor_pool.gated:
+                # cooperative backpressure: a downstream queue crossed its
+                # high watermark — stop draining this peer's socket until
+                # it falls below the low one.  The pause propagates to
+                # the sending peer via TCP flow control (its writer task
+                # blocks on flush), which is how pressure crosses process
+                # boundaries without unbounded buffering on either side
+                self.backpressure_pauses += 1
+                await self.workers.wait_for_credit()
+                await self.executor_pool.wait_for_credit()
 
     @staticmethod
     async def _delay_task(
@@ -831,7 +928,9 @@ class ProcessRuntime:
     ) -> None:
         """FIFO delay line (delay.rs:6-39): each frame is released
         ``delay_ms`` after it *entered* the queue (entry time stamped by
-        the _StampingQueue at put), preserving order."""
+        the _StampingQueue at put), preserving order.  The delay task is
+        an asynchronous producer, so it CAN honor the sink's credit gate:
+        a backed-up writer pauses the line instead of growing the sink."""
         loop = asyncio.get_running_loop()
         while True:
             entered, frame = await source.get()
@@ -839,6 +938,8 @@ class ProcessRuntime:
             if remaining > 0:
                 await asyncio.sleep(remaining)
             sink.put_nowait(frame)
+            if getattr(sink, "gated", False):
+                await sink.wait_for_credit()
 
     async def _ping_sorted_processes(self) -> List[Tuple[ProcessId, ShardId]]:
         """Latency-sort same-shard peers by measured RTT (ping.rs:13-78,
@@ -912,14 +1013,32 @@ class ProcessRuntime:
                     continue
                 frame = await queue.get()
                 rw.write_link_frame(KIND_DATA, link.next_seq(), frame)
-                link.unacked.append((link.seq, frame))
+                link.note_sent(link.seq, frame)
                 # batch whatever accumulated while writing (flush
                 # coalescing, process.rs:329-385)
                 while not queue.empty():
                     frame = queue.get_nowait()
                     rw.write_link_frame(KIND_DATA, link.next_seq(), frame)
-                    link.unacked.append((link.seq, frame))
+                    link.note_sent(link.seq, frame)
                 await asyncio.wait_for(rw.flush(), self.send_timeout_s)
+                if link.over_unacked_cap():
+                    # the peer reads frames (TCP accepts them) but never
+                    # acks: a live-but-wedged consumer.  Buffering more
+                    # resend state only converts its overload into our
+                    # OOM — declare the peer lost through the existing
+                    # typed path (quorum check decides degrade vs fail)
+                    self._declare_peer_lost(
+                        link.peer_id,
+                        PeerLostError(
+                            link.peer_id,
+                            0,
+                            BufferError(
+                                f"unacked resend window overflow "
+                                f"({len(link.unacked)} > {link.unacked_cap})"
+                            ),
+                        ),
+                    )
+                    return
             except (ConnectionError, OSError, asyncio.TimeoutError):
                 # NB: a cancellation hitting inside wait_for can surface
                 # as TimeoutError (the classic asyncio footgun) — the
@@ -1272,6 +1391,64 @@ class ProcessRuntime:
                 executor.cleanup(self.time)
                 self._ship_executor_outputs(executor)
 
+    def admission_depth(self) -> int:
+        """The client edge's congestion signal: the deepest queue across
+        the worker and executor pools (the bottleneck queue is what
+        grows latency — a sum would hide one wedged consumer behind many
+        empty peers)."""
+        return max(self.workers.max_depth(), self.executor_pool.max_depth())
+
+    def queue_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-queue depth/high-watermark/pause gauges across every
+        run-layer queue this process owns: worker + executor pools, the
+        peer-writer queues, and each link's unacked resend window.  The
+        snapshot the metrics plane exports (ProcessMetrics.queues) —
+        what WarnQueue used to only *log* is now a gauge that survives
+        into ``bin/obs.py summarize``."""
+        stats: Dict[str, Dict[str, float]] = {}
+        stats.update(self.workers.stats())
+        stats.update(self.executor_pool.stats())
+        for peer_id, links in self._peer_writers.items():
+            for queue in links.queues:
+                if hasattr(queue, "stats"):
+                    stats[queue.name] = queue.stats()
+            for link in links.links:
+                # with a delay line, links.queues holds the pre-delay
+                # stamping queue and link.queue the post-delay writer
+                # queue — gauge both (same object without a delay line)
+                queue = link.queue
+                if queue is not None and hasattr(queue, "stats"):
+                    stats[queue.name] = queue.stats()
+                stats[f"unacked->p{peer_id}[{link.index}]"] = {
+                    "depth": len(link.unacked),
+                    "depth_hwm": link.unacked_hwm,
+                    "capacity": link.unacked_cap,
+                    "pauses": 0,
+                    "overflows": 0,
+                }
+        return stats
+
+    def overload_counters(
+        self, stats: Optional[Dict[str, Dict[str, float]]] = None
+    ) -> Dict[str, float]:
+        """Running totals of the overload-control plane's activity —
+        folded into metrics snapshots and (when tracing) the span log.
+        Pass a ``queue_stats()`` result to avoid a second walk (and to
+        keep one snapshot's ``.queues`` and ``.overload`` views of the
+        same instant)."""
+        if stats is None:
+            stats = self.queue_stats()
+        return {
+            "shed_submissions": self.shed_submissions,
+            "backpressure_pauses": self.backpressure_pauses,
+            "queue_depth_hwm": max(
+                (row["depth_hwm"] for row in stats.values()), default=0
+            ),
+            "queue_depth": max(
+                (row["depth"] for row in stats.values()), default=0
+            ),
+        }
+
     def _write_metrics_snapshot(self) -> None:
         from fantoch_tpu.run.observe import ProcessMetrics, write_metrics_snapshot
 
@@ -1288,12 +1465,23 @@ class ProcessRuntime:
                     name, value,
                     pid=None if name == "jax_recompiles" else self.process.id,
                 )
+        queues = self.queue_stats()
+        overload = self.overload_counters(queues)
+        if self.tracer.enabled:
+            # queue-depth gauges + shed/pause tallies ride the span log
+            # too (running totals, counters_total last-wins semantics),
+            # so `bin/obs.py summarize` shows the overload plane next to
+            # the latency breakdown it explains
+            for name, value in sorted(overload.items()):
+                self.tracer.counter(name, value, pid=self.process.id)
         write_metrics_snapshot(
             self.metrics_file,
             ProcessMetrics(
                 [self.process.metrics()],
                 [e.metrics() for e in self.executors],
                 device,
+                queues,
+                overload,
             ),
         )
 

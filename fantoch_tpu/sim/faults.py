@@ -36,6 +36,10 @@ Fault model (see README "Fault model" for the contract):
 * **Pause** — a transient freeze ``[at_ms, until_ms)``: inbound traffic
   and periodic events are deferred and replayed at resume, modelling a
   stop-the-world (GC pause, VM migration) rather than a crash.
+* **SlowProcess** — a degraded consumer: deliveries into the process pick
+  up a per-message handling delay inside a window, modelling an executor
+  draining at a fraction of line rate (the overload plane's seeded
+  slow-executor scenario) without being dead or paused.
 * **Bounded wait** — ``max_sim_time_ms`` turns a stalled run (e.g. more
   than ``f`` members of an in-flight command's quorum crashed, so even the
   per-dot recovery consensus of ``protocol/recovery.py`` cannot gather an
@@ -164,6 +168,29 @@ class Pause:
 
 
 @dataclass(frozen=True)
+class SlowProcess:
+    """Degraded-consumer nemesis (the overload plane's seeded scenario):
+    while active, every message INTO ``process_id`` picks up ``slow_ms``
+    of extra delivery delay (plus ``jitter_ms`` drawn from the nemesis
+    RNG) — modelling an executor that drains its queues at a fraction of
+    line rate (a wedged device, a GC-thrashing host) without being dead.
+    Applied once per message at send time, so liveness is preserved and
+    the slowdown is deterministic under the plan seed.  ``until_ms=None``
+    never recovers."""
+
+    process_id: int
+    slow_ms: int
+    from_ms: int = 0
+    until_ms: Optional[int] = None
+    jitter_ms: int = 0
+
+    def active(self, now: int) -> bool:
+        return now >= self.from_ms and (
+            self.until_ms is None or now < self.until_ms
+        )
+
+
+@dataclass(frozen=True)
 class FaultPlan:
     """Declarative, immutable fault schedule (builder-style constructors).
 
@@ -178,6 +205,7 @@ class FaultPlan:
     partitions: Tuple[Partition, ...] = ()
     crashes: Tuple[Crash, ...] = ()
     pauses: Tuple[Pause, ...] = ()
+    slow_processes: Tuple[SlowProcess, ...] = ()
     # base RTO for the collapsed retransmission sequence
     retransmit_base_ms: int = 25
     # bounded wait: virtual-time budget before a stalled run raises
@@ -207,6 +235,23 @@ class FaultPlan:
         assert until_ms > at_ms
         return dataclasses.replace(
             self, pauses=self.pauses + (Pause(process_id, at_ms, until_ms),)
+        )
+
+    def with_slow_process(
+        self,
+        process_id: int,
+        slow_ms: int,
+        from_ms: int = 0,
+        until_ms: Optional[int] = None,
+        jitter_ms: int = 0,
+    ) -> "FaultPlan":
+        """Degraded-consumer window: the seeded slow-executor scenario
+        the overload chaos rows are built on (see :class:`SlowProcess`)."""
+        assert slow_ms > 0
+        return dataclasses.replace(
+            self,
+            slow_processes=self.slow_processes
+            + (SlowProcess(process_id, slow_ms, from_ms, until_ms, jitter_ms),),
         )
 
     def with_partition(
@@ -310,6 +355,17 @@ class Nemesis:
         for pause in self.plan.pauses:
             out.append((pause.at_ms, NemesisMark("pause", f"p{pause.process_id}")))
             out.append((pause.until_ms, NemesisMark("resume", f"p{pause.process_id}")))
+        for slow in self.plan.slow_processes:
+            out.append(
+                (
+                    slow.from_ms,
+                    NemesisMark("slow", f"p{slow.process_id} +{slow.slow_ms}ms"),
+                )
+            )
+            if slow.until_ms is not None:
+                out.append(
+                    (slow.until_ms, NemesisMark("slow-end", f"p{slow.process_id}"))
+                )
         for part in self.plan.partitions:
             groups = "|".join(",".join(map(str, g)) for g in part.groups)
             out.append((part.start_ms, NemesisMark("partition", groups)))
@@ -368,6 +424,17 @@ class Nemesis:
                         + self.rng.randint(1, self.plan.retransmit_base_ms)
                     )
                     self.record(now, "defer-partition", f"{label} +{delay}ms")
+                    break
+        if dst is not None:
+            # degraded-consumer nemesis: deliveries into a slowed process
+            # pick up its handling delay (once, at send time — liveness
+            # preserved, determinism via the plan RNG)
+            for slow in self.plan.slow_processes:
+                if slow.process_id == dst and slow.active(now):
+                    extra = slow.slow_ms
+                    if slow.jitter_ms:
+                        extra += self.rng.randint(0, slow.jitter_ms)
+                    delay += extra
                     break
         fault = next(
             (f for f in self.plan.link_faults if f.matches(now, src, dst, msg)), None

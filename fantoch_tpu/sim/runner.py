@@ -67,6 +67,19 @@ class PeriodicExecutedNotification:
 
 
 @dataclass
+class OpenLoopArrival:
+    """One open-loop client's next arrival tick: at handling time the
+    client generates its next command (submitted regardless of
+    completions) and the following arrival is scheduled at a seeded
+    exponential gap — the virtual-time Poisson analog of the run layer's
+    ``arrival_rate_per_s`` pacing (run/backpressure.OpenLoopPacer).  The
+    overload plane's load instrument: closed-loop sim clients
+    self-throttle and can never push the system past saturation."""
+
+    client_id: ClientId
+
+
+@dataclass
 class PeriodicExecutorWatchdog:
     """Bounded-wait liveness check: under a fault plan, every executor's
     ``monitor_pending`` runs on this tick so a command stuck on
@@ -90,9 +103,14 @@ class Runner:
         seed: Optional[int] = None,
         fault_plan: Optional[FaultPlan] = None,
         trace_path: Optional[str] = None,
+        open_loop_rate_per_s: Optional[float] = None,
     ):
         assert len(process_regions) == config.n, "one region per process"
         assert config.gc_interval_ms is not None, "sim requires gc running"
+        assert open_loop_rate_per_s is None or open_loop_rate_per_s > 0
+        # open-loop mode: seeded Poisson arrivals at this per-client rate
+        # drive submissions (closed loop submits on completion otherwise)
+        self._open_loop_rate = open_loop_rate_per_s
         self._protocol_cls = protocol_cls
         self._planet = planet
         self._config = config
@@ -217,10 +235,16 @@ class Runner:
         """Run to completion; returns (process metrics, executor monitors,
         per-region (issued commands, latency histogram ms))."""
         tracer = self._tracer
-        for client_id, process_id, cmd in self._simulation.start_clients():
-            if tracer.enabled:
-                tracer.span("submit", cmd.rifl, cid=client_id)
-            self._schedule_submit(("client", client_id), process_id, cmd)
+        if self._open_loop_rate is not None:
+            # open loop: arrivals drive submissions; the first arrival of
+            # each client is itself an exponential gap from t=0
+            for client_id in sorted(self._client_to_region):
+                self._schedule_arrival(client_id)
+        else:
+            for client_id, process_id, cmd in self._simulation.start_clients():
+                if tracer.enabled:
+                    tracer.span("submit", cmd.rifl, cid=client_id)
+                self._schedule_submit(("client", client_id), process_id, cmd)
         try:
             self._simulation_loop(extra_sim_time_ms)
         finally:
@@ -268,6 +292,8 @@ class Runner:
                 self._handle_submit_to_proc(action.process_id, action.cmd)
             elif isinstance(action, SendToProc):
                 self._handle_send_to_proc(action.from_, action.from_shard_id, action.to, action.msg)
+            elif isinstance(action, OpenLoopArrival):
+                self._handle_open_loop_arrival(action.client_id)
             elif isinstance(action, SendToClient):
                 if action.client_id not in self._active_clients:
                     continue  # abandoned (attached to a crashed process)
@@ -275,6 +301,12 @@ class Runner:
                     self._tracer.span(
                         "reply", action.cmd_result.rifl, cid=action.client_id
                     )
+                if self._open_loop_rate is not None:
+                    # open loop: record the completion only — arrivals,
+                    # not completions, drive submissions
+                    if self._simulation.record_result(action.cmd_result):
+                        self._active_clients.discard(action.client_id)
+                    continue
                 submit = self._simulation.forward_to_client(action.cmd_result)
                 if submit is not None:
                     process_id, cmd = submit
@@ -431,6 +463,38 @@ class Runner:
         if missing:
             process.nudge_recovery(missing, self._simulation.time)
         self._schedule.schedule(self._simulation.time, ev.delay_ms, ev)
+
+    def _schedule_arrival(self, client_id: ClientId) -> None:
+        """Schedule the client's next open-loop arrival at a seeded
+        exponential gap (Poisson at ``open_loop_rate_per_s``); draws come
+        from the runner RNG, so same-seed runs arrive identically.
+        Gaps are rounded (not truncated) to the sim's ms granularity so
+        the realized rate matches the configured one; the 1ms floor caps
+        a single client at 1000 arrivals/s — spread higher offered rates
+        over more clients."""
+        gap_ms = max(1, round(self._rng.expovariate(self._open_loop_rate) * 1000))
+        self._schedule.schedule(
+            self._simulation.time, gap_ms, OpenLoopArrival(client_id)
+        )
+
+    def _handle_open_loop_arrival(self, client_id: ClientId) -> None:
+        if client_id not in self._active_clients:
+            return  # abandoned (attached to a crashed process)
+        client = self._simulation.get_client(client_id)
+        nxt = client.next_cmd(self._simulation.time)
+        if nxt is None:
+            # workload exhausted: no further arrivals; done once the
+            # in-flight tail drains (record_result discards it then)
+            if client.done:
+                self._active_clients.discard(client_id)
+            return
+        target_shard, cmd = nxt
+        if self._tracer.enabled:
+            self._tracer.span("submit", cmd.rifl, cid=client_id)
+        self._schedule_submit(
+            ("client", client_id), client.shard_process(target_shard), cmd
+        )
+        self._schedule_arrival(client_id)
 
     def _handle_submit_to_proc(self, process_id: ProcessId, cmd: Command) -> None:
         process, _, pending = self._simulation.get_process(process_id)

@@ -82,6 +82,14 @@ class Simulation:
         target_shard, cmd = nxt
         return client.shard_process(target_shard), cmd
 
+    def record_result(self, cmd_result: CommandResult) -> bool:
+        """Open-loop result delivery: record the completion WITHOUT
+        generating the next submission (arrivals are driven by the
+        open-loop schedule, sim/runner.py); returns True once the client
+        is done (workload generated and nothing in flight)."""
+        client = self._clients[cmd_result.rifl.source]
+        return client.handle([cmd_result], self.time)
+
     def get_process(self, process_id: ProcessId) -> Tuple[Protocol, Executor, AggregatePending]:
         return self._processes[process_id]
 

@@ -1,0 +1,116 @@
+"""CI overload smoke (``make overload-smoke``): a tiny CPU open-loop row
+proving the overload-control plane end to end, per push.
+
+Three phases against localhost EPaxos n=3 TCP clusters, all driven by
+the shared phase runner (``run/harness.run_overload_phase`` — one
+accounting implementation for this gate and ``bench.py bench_overload``):
+
+1. closed-loop baseline (pre-burst p50 + saturation estimate);
+2. an open-loop Poisson burst at ~2x the measured saturation into a
+   tight admission limit — asserts bounded queue depths (no queue past
+   2x its pause watermark; the watermark is a credit gate, not a hard
+   cap — see run_overload_phase), typed sheds reaching clients as
+   backoff retries, and nonzero goodput while shedding;
+3. closed-loop again — asserts post-burst p50 drained back to within 2x
+   of the pre-burst baseline (+ absolute slack: CI hosts are slow and
+   shared).
+
+Pure asyncio (no device): the gate covers run/backpressure.py,
+run/process_runner.py admission + reader pauses, and the client plane's
+backoff — the seams ``make bench-smoke`` / ``make trace-smoke`` don't.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    from fantoch_tpu.client import ConflictRateKeyGen, Workload
+    from fantoch_tpu.core import Config
+    from fantoch_tpu.protocol import EPaxos
+    from fantoch_tpu.run.harness import run_overload_phase
+
+    def workload(commands_per_client):
+        return Workload(
+            shard_count=1,
+            key_gen=ConflictRateKeyGen(30),
+            keys_per_command=1,
+            commands_per_client=commands_per_client,
+            payload_size=1,
+        )
+
+    # admission_limit=1: any nonzero edge depth at a submit instant
+    # sheds — the tightest setting, so the shed gate below stays robust
+    # across CI hosts of very different speeds
+    config = Config(
+        n=3, f=1,
+        gc_interval_ms=50,
+        executor_executed_notification_interval_ms=50,
+        admission_limit=1,
+        queue_capacity=128,
+        overload_retry_after_ms=5,
+    )
+
+    def run(rate=None):
+        return run_overload_phase(
+            EPaxos, config, workload(8), 3,
+            arrival_rate_per_s=rate, arrival_seed=2,
+        )
+
+    # phase 1: closed-loop baseline + saturation estimate
+    pre = run()
+    saturation = pre["goodput_cmds_per_s"]
+
+    # phase 2: open-loop Poisson burst at ~2x saturation (9 clients)
+    rate_per_client = max(5.0, 2.0 * saturation / 9)
+    burst = run(rate=rate_per_client)
+
+    # phase 3: post-burst closed loop (fresh cluster state is fine: the
+    # drain-back-within-one-cluster row lives in tests/test_overload.py;
+    # the smoke asserts the latency regime, not a warm-state transition)
+    post = run()
+
+    out = {
+        "metric": "overload_smoke",
+        "overload_saturation_cmds_per_s": saturation,
+        "overload_offered_cmds_per_s": int(rate_per_client * 9),
+        "overload_goodput_cmds_per_s": burst["goodput_cmds_per_s"],
+        "overload_sheds": burst["sheds"],
+        "overload_client_retries": burst["client_retries"],
+        "overload_backpressure_pauses": burst["backpressure_pauses"],
+        "overload_queue_depth_hwm": burst["queue_depth_hwm"],
+        "overload_unacked_depth_hwm": burst["unacked_depth_hwm"],
+        "overload_pre_p50_ms": pre["p50_ms"],
+        "overload_post_p50_ms": post["p50_ms"],
+    }
+    print(json.dumps(out))
+
+    # the gates (loose where CI timing varies, strict where semantics do)
+    assert burst["completed"] == 9 * 8, (
+        f"backoff-retrying clients must complete everything: "
+        f"{burst['completed']}/72"
+    )
+    assert burst["shed_commands"] == 0, "no deadline was set: nothing sheds"
+    assert burst["sheds"] > 0, "a 2x-saturation burst must trip admission"
+    assert burst["client_retries"] >= burst["sheds"], (
+        "every server shed surfaces as a client retry"
+    )
+    assert burst["goodput_cmds_per_s"] > 0, "nonzero goodput while shedding"
+    assert not burst["bound_violations"], (
+        f"queues grew past 2x their pause watermark: "
+        f"{burst['bound_violations']}"
+    )
+    assert post["p50_ms"] <= 2 * pre["p50_ms"] + 15.0, (
+        f"post-burst p50 {post['p50_ms']}ms vs pre-burst {pre['p50_ms']}ms: "
+        "system did not drain back to baseline"
+    )
+    print("overload-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,6 +130,42 @@ def test_device_runtime_tcp_serving():
     assert driver.fast_paths + driver.slow_paths >= driver.executed
 
 
+@pytest.mark.overload
+def test_device_runtime_bounded_submit_ring_sheds_and_serves():
+    """Overload plane at the device serving edge (run/pipeline.py
+    BoundedSubmitRing): an open-loop Poisson burst into a tiny admission
+    bound sheds with typed Overloaded replies, backoff-retrying clients
+    still complete everything, and the ring's depth high-watermark never
+    passes its capacity."""
+    config = Config(
+        3, 1, shard_count=1, admission_limit=4, overload_retry_after_ms=5,
+    )
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=1,
+        commands_per_client=COMMANDS_PER_CLIENT,
+        payload_size=1,
+    )
+    runtime, clients = asyncio.run(
+        run_device_server(
+            config, workload, client_count=4, batch_size=8,
+            arrival_rate_per_s=500.0, arrival_seed=1,
+        )
+    )
+    for client in clients.values():
+        assert len(list(client.data().latency_data())) == COMMANDS_PER_CLIENT
+        assert client.shed_commands == 0  # no deadline: retries finish it
+    ring = runtime._submit_queue
+    assert ring.depth_hwm <= 4
+    assert ring.sheds > 0, "the burst must trip the submit-ring bound"
+    assert sum(c.overload_retries for c in clients.values()) >= ring.sheds
+    # the overload gauges ride the serving tallies
+    assert runtime._tallies["queue_capacity"] == 4
+    assert runtime._tallies["shed_submissions"] == ring.sheds
+    assert runtime.driver.executed == 4 * COMMANDS_PER_CLIENT
+
+
 def test_device_runtime_multi_key_tcp():
     """keys_per_command=2 over TCP: the general resolver serves."""
     config = Config(3, 1, shard_count=1)
