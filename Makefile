@@ -1,7 +1,7 @@
 # Test/bench entry points (the reference pins quality with Makefile:3-7 —
 # fmt + clippy + `cargo test` under a quickcheck budget; here the suite +
 # dryrun + bench are the equivalent gates).
-.PHONY: test test-fast test-chaos test-recovery test-restart test-overload test-device-stripped dryrun bench bench-smoke trace-smoke overload-smoke
+.PHONY: test test-fast test-chaos test-recovery test-restart test-overload test-fuzz test-device-stripped dryrun bench bench-smoke trace-smoke overload-smoke fuzz-smoke
 
 test:
 	python -m pytest tests/ -x -q
@@ -33,6 +33,13 @@ test-restart:
 # SlowProcess nemesis, and the queue-gauge metrics export
 test-overload:
 	python -m pytest tests/ -x -q -m overload
+
+# the chaos-fuzzing + consistency-audit slice: auditor verdicts on
+# hand-built histories, digest divergence detection (incl. the TCP
+# forked-replica row), fuzzer determinism, shrinker minimality, and the
+# GC-straggler mutation self-test
+test-fuzz:
+	python -m pytest tests/ -x -q -m fuzz
 
 # close the tier-1 coverage hole on the pinned jax: run
 # tests/test_device_runner.py from a guard-stripped copy (the module
@@ -67,3 +74,11 @@ trace-smoke:
 # baseline — the per-push CI slice runs this next to bench/trace-smoke
 overload-smoke:
 	python scripts/overload_smoke.py
+
+# chaos-fuzz gate: seeded fault-schedule sweep with composed nemeses
+# over EVERY protocol (fixed seed set), auditor-clean + byte-identical
+# determinism (same seed => same plan/trace/verdict).  Set
+# FANTOCH_FUZZ_BUDGET_S for a longer soak (nightly) — the per-push CI
+# slice runs the fixed set next to bench/trace/overload-smoke
+fuzz-smoke:
+	python scripts/fuzz_smoke.py

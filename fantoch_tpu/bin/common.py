@@ -102,6 +102,18 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
                         help="cap on a peer link's unacked resend window "
                         "(run/links.py): past it the link is declared lost "
                         "via the typed path; default 32768, 0 = uncapped")
+    parser.add_argument("--execution-digests", action="store_true",
+                        help="consistency-audit plane (core/audit.py): "
+                        "per-key hash chains over executed writes, "
+                        "exchanged on the heartbeat path — a forked "
+                        "replica surfaces a typed DivergenceError naming "
+                        "the first diverging key+command")
+    parser.add_argument("--audit-commits", action="store_true",
+                        help="record every commit decision (dot/slot -> "
+                        "(rifl, value), surviving GC) so divergence "
+                        "errors resolve dots and the auditor can check "
+                        "commit-value agreement (audit/test only: the "
+                        "log grows with the run)")
 
 
 def config_from_args(args: argparse.Namespace):
@@ -129,6 +141,8 @@ def config_from_args(args: argparse.Namespace):
         admission_limit=args.admission_limit,
         overload_retry_after_ms=args.overload_retry_after,
         link_unacked_cap=args.link_unacked_cap,
+        execution_digests=args.execution_digests,
+        audit_log_commits=args.audit_commits,
     )
 
 

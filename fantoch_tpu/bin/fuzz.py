@@ -1,0 +1,142 @@
+"""Chaos-fuzzing CLI: seeded sweeps and byte-identical repro replay.
+
+    # sweep seeded cases across the protocol matrix (exit 1 on violation;
+    # each finding is shrunk and written as a JSON repro artifact)
+    python -m fantoch_tpu.bin.fuzz run --seed 0 --cases 50 --out-dir repros/
+
+    # replay a repro artifact byte-identically (exit 0 iff the recorded
+    # verdict digest reproduces: same plan, same trace, same violations)
+    python -m fantoch_tpu.bin.fuzz repro repros/fuzz-000031.json
+
+``run`` honors ``FANTOCH_FUZZ_BUDGET_S`` (or ``--budget-s``) as a wall
+budget for longer soak runs: the sweep keeps drawing cases past
+``--cases`` until the budget elapses.  ``make fuzz-smoke`` drives the
+same machinery with a fixed seed set and asserts auditor-clean runs per
+protocol (scripts/fuzz_smoke.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def cmd_run(args) -> int:
+    from fantoch_tpu.sim.fuzz import (
+        PROTOCOL_SPECS,
+        FaultPlanFuzzer,
+        repro_artifact,
+        run_case,
+        shrink_case,
+        write_repro,
+    )
+
+    budget_s = args.budget_s
+    if budget_s is None:
+        env = os.environ.get("FANTOCH_FUZZ_BUDGET_S")
+        budget_s = float(env) if env else None
+    protocols = args.protocols.split(",") if args.protocols else None
+    if protocols:
+        unknown = set(protocols) - set(PROTOCOL_SPECS)
+        assert not unknown, f"unknown protocols {sorted(unknown)}"
+    fuzzer = FaultPlanFuzzer(seed=args.seed)
+    started = time.monotonic()
+    tallies = {"ok": 0, "violation": 0, "stall": 0, "incomplete": 0}
+    clean_per_protocol: dict = {}
+    findings = []
+    index = 0
+    while True:
+        past_cases = index >= args.cases
+        past_budget = budget_s is not None and time.monotonic() - started >= budget_s
+        # no budget: stop at --cases; with one: the budget is the stop
+        if (budget_s is None and past_cases) or past_budget:
+            break
+        protocol = protocols[index % len(protocols)] if protocols else None
+        case = fuzzer.case(index, protocol=protocol)
+        result = run_case(case)
+        tallies[result.verdict] += 1
+        if result.ok:
+            clean_per_protocol[case.protocol] = (
+                clean_per_protocol.get(case.protocol, 0) + 1
+            )
+        elif result.verdict == "violation":
+            print(
+                f"VIOLATION at case {index} ({case.protocol} n={case.n} "
+                f"f={case.f}): {result.violations[:1]}"
+            )
+            shrunk, runs = shrink_case(case)
+            shrunk_result = run_case(shrunk)
+            artifact = repro_artifact(shrunk_result, shrink_runs=runs)
+            path = os.path.join(args.out_dir, f"fuzz-{index:06d}.json")
+            os.makedirs(args.out_dir, exist_ok=True)
+            write_repro(path, artifact)
+            findings.append(path)
+            print(f"  shrunk in {runs} runs -> {path}")
+        index += 1
+    elapsed = time.monotonic() - started
+    print(
+        f"{index} cases in {elapsed:.1f}s: "
+        + "  ".join(f"{k}={v}" for k, v in tallies.items())
+    )
+    print(
+        "clean runs per protocol: "
+        + ", ".join(f"{p}={c}" for p, c in sorted(clean_per_protocol.items()))
+    )
+    if findings:
+        print(f"{len(findings)} repro artifact(s) written")
+        return 1
+    return 0
+
+
+def cmd_repro(args) -> int:
+    from fantoch_tpu.sim.fuzz import load_repro, replay_repro
+
+    artifact = load_repro(args.file)
+    result, identical = replay_repro(artifact)
+    print(f"recorded verdict: {artifact['verdict']}  replay: {result.verdict}")
+    for violation in result.violations:
+        print(f"  {violation}")
+    if artifact.get("issue"):
+        print(f"issue: {artifact['issue']}")
+    if identical:
+        print("byte-identical: plan/trace/verdict digests match the artifact")
+        return 0
+    print("MISMATCH: replay diverged from the recorded digests")
+    print(f"  recorded verdict_digest {artifact['verdict_digest']}")
+    print(f"  replayed verdict_digest {result.verdict_digest}")
+    return 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="fuzz", description="chaos fuzzing over the deterministic sim"
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("run", help="seeded fuzz sweep")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--cases", type=int, default=50)
+    p.add_argument(
+        "--budget-s", type=float, default=None,
+        help="wall budget; keeps sweeping past --cases "
+        "(default: FANTOCH_FUZZ_BUDGET_S)",
+    )
+    p.add_argument(
+        "--protocols", default=None,
+        help="comma-separated subset (default: all, sampled)",
+    )
+    p.add_argument("--out-dir", default="fuzz-repros")
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("repro", help="replay a JSON repro artifact")
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_repro)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,25 @@ def cmd_summarize(args) -> int:
         print(f"counter {name} = {value}")
     _print_overlap(counters)
     _print_overload(counters)
+    _print_audit(counters)
+    return 0
+
+
+def _print_audit(counters) -> int:
+    """One-line consistency-audit readout from the digest-exchange
+    counters (Config.execution_digests): how many peer summaries were
+    cross-checked, over how many keys, and whether any mismatch (a
+    replica fork -> typed DivergenceError) was ever observed."""
+    names = ("digest_checks", "digest_mismatches", "digest_keys")
+    if not any(name in counters for name in names):
+        return 0
+    mismatches = int(counters.get("digest_mismatches", 0))
+    parts = [
+        f"digest checks {int(counters.get('digest_checks', 0))}",
+        f"keys {int(counters.get('digest_keys', 0))}",
+        f"mismatches {mismatches}" + (" (DIVERGED)" if mismatches else ""),
+    ]
+    print("audit: " + "  ".join(parts))
     return 0
 
 

@@ -148,6 +148,20 @@ class Config:
     # buffering unboundedly.  None = the built-in default
     # (backpressure.DEFAULT_UNACKED_CAP); 0 = uncapped legacy
     link_unacked_cap: Optional[int] = None
+    # consistency-audit plane (core/audit.py).  execution_digests keeps a
+    # per-key hash chain over executed writes inside every executor's
+    # KVStore; the run layer piggybacks chain summaries on the heartbeat
+    # path and surfaces a typed DivergenceError naming the first
+    # diverging key + entry when replicas fork (run/process_runner.py).
+    # Audit/chaos instrumentation, off by default (new knob; the
+    # reference has no online safety checking)
+    execution_digests: bool = False
+    # record every commit decision (dot/slot -> (rifl, value)) in a log
+    # that survives GC, so the ConsistencyAuditor can check commit-value
+    # agreement (Newt timestamps, graph deps, FPaxos slots) and classify
+    # committed-then-lost commands.  Audit/test only: the log grows with
+    # the run (like executor_monitor_execution_order)
+    audit_log_commits: bool = False
     # per-dot lifecycle tracing (fantoch_tpu/observability): fraction of
     # commands traced, selected by a deterministic hash of the command id
     # (same seed => same sampled dot set).  0.0 disables tracing entirely

@@ -14,6 +14,7 @@ from enum import Enum
 from typing import Dict, Optional, TYPE_CHECKING
 
 if TYPE_CHECKING:
+    from fantoch_tpu.core.audit import ExecutionDigest
     from fantoch_tpu.executor.monitor import ExecutionOrderMonitor
     from fantoch_tpu.core.ids import Rifl
 
@@ -55,17 +56,33 @@ class KVOp:
 class KVStore:
     """In-memory string KV store (fantoch/src/kvs.rs:21-69)."""
 
-    def __init__(self, monitor_execution_order: bool = False):
+    def __init__(
+        self,
+        monitor_execution_order: bool = False,
+        execution_digests: bool = False,
+    ):
         self._store: Dict[Key, Value] = {}
         self._monitor: Optional["ExecutionOrderMonitor"] = None
         if monitor_execution_order:
             from fantoch_tpu.executor.monitor import ExecutionOrderMonitor
 
             self._monitor = ExecutionOrderMonitor()
+        # consistency-audit plane (core/audit.py): per-key hash chain
+        # over executed writes, exchanged by the run layer for online
+        # divergence detection (Config.execution_digests)
+        self._digest: Optional["ExecutionDigest"] = None
+        if execution_digests:
+            from fantoch_tpu.core.audit import ExecutionDigest
+
+            self._digest = ExecutionDigest()
 
     @property
     def monitor(self) -> Optional["ExecutionOrderMonitor"]:
         return self._monitor
+
+    @property
+    def digest(self) -> Optional["ExecutionDigest"]:
+        return self._digest
 
     def execute(self, key: Key, op: KVOp, rifl: "Rifl") -> KVOpResult:
         """Execute op on key, recording it in the monitor if enabled.
@@ -74,6 +91,11 @@ class KVStore:
         """
         if self._monitor is not None:
             self._monitor.add(key, rifl, read=op.is_read)
+        if self._digest is not None and not op.is_read:
+            # writes only: reads commute, so their relative order is
+            # legitimately unordered across replicas (the monitor's
+            # write-order rule)
+            self._digest.record(key, rifl, op.kind.value, op.value)
         return self._do_execute(key, op)
 
     def _do_execute(self, key: Key, op: KVOp) -> KVOpResult:

@@ -124,6 +124,43 @@ class DeadlineExceededError(FaultToleranceError):
         )
 
 
+class DivergenceError(FaultToleranceError):
+    """Two replicas executed different writes for the same key position —
+    a safety violation, not a fault to tolerate.  Raised by the run
+    layer's digest-exchange plane (``Config.execution_digests``:
+    per-key chained digests piggybacked on the heartbeat path) naming the
+    first diverging key + entry, and by audit tooling replaying histories.
+
+    ``mine``/``theirs`` are the (source, sequence) command ids (rifls) the
+    two replicas executed at ``position``; ``dot`` is the diverging
+    command's proposal id when the protocol's audit commit log can resolve
+    it (``Config.audit_log_commits``)."""
+
+    def __init__(
+        self,
+        key: str,
+        position: int,
+        mine,
+        theirs,
+        process_id: int,
+        peer_id: int,
+        dot=None,
+    ):
+        self.key = key
+        self.position = position
+        self.mine = mine
+        self.theirs = theirs
+        self.process_id = process_id
+        self.peer_id = peer_id
+        self.dot = dot
+        dot_note = f" (dot {dot})" if dot is not None else ""
+        super().__init__(
+            f"execution divergence on key {key!r} at write #{position}: "
+            f"p{process_id} executed {mine}{dot_note} where p{peer_id} "
+            f"executed {theirs}"
+        )
+
+
 class SimStalledError(FaultToleranceError):
     """The simulation passed its virtual-time bound with clients still
     waiting — the whole-system analog of :class:`StalledExecutionError`

@@ -166,6 +166,14 @@ class Executor(ABC, Generic[Info]):
         """Execution-order monitor (tests only)."""
         return None
 
+    def digest(self):
+        """Per-key chained execution digest (core/audit.ExecutionDigest)
+        when ``Config.execution_digests`` is on; None otherwise.  Every
+        concrete executor funnels execution through a KVStore, so the
+        shared lookup here covers them all."""
+        store = getattr(self, "_store", None)
+        return store.digest if store is not None else None
+
 
 class MessageKey:
     """Key-based worker routing for execution infos

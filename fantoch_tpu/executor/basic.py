@@ -30,7 +30,10 @@ class BasicExecutionInfo:
 
 class BasicExecutor(Executor):
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
-        self._store = KVStore(config.executor_monitor_execution_order)
+        self._store = KVStore(
+            config.executor_monitor_execution_order,
+            config.execution_digests,
+        )
         self._metrics: Metrics = Metrics()
         self._to_clients: deque = deque()
 

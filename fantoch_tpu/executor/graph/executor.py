@@ -98,7 +98,10 @@ class GraphExecutor(Executor):
             else:
                 graph_cls = DependencyGraph
         self.graph = graph_cls(process_id, shard_id, config)
-        self._store = KVStore(config.executor_monitor_execution_order)
+        self._store = KVStore(
+            config.executor_monitor_execution_order,
+            config.execution_digests,
+        )
         self._to_clients: Deque[ExecutorResult] = deque()
         self._to_executors: List[Tuple[ShardId, GraphExecutionInfo]] = []
         # tracing: which handle_batch drain resolved each traced command

@@ -265,7 +265,10 @@ class PredecessorsExecutor(Executor):
         self._execute_at_commit = config.execute_at_commit
         self._batched = config.batched_pred_executor
         self._graph = PredecessorsGraph(process_id, config)
-        self._store = KVStore(config.executor_monitor_execution_order)
+        self._store = KVStore(
+            config.executor_monitor_execution_order,
+            config.execution_digests,
+        )
         self._to_clients: Deque[ExecutorResult] = deque()
 
     def handle(self, info: PredecessorsExecutionInfo, time) -> None:

@@ -34,7 +34,10 @@ class SlotExecutor(Executor):
         # carry-forward); without it a duplicate delivery is a protocol
         # bug the original asserts must keep catching loudly
         self._failover = config.fpaxos_leader_timeout_ms is not None
-        self._store = KVStore(config.executor_monitor_execution_order)
+        self._store = KVStore(
+            config.executor_monitor_execution_order,
+            config.execution_digests,
+        )
         self._next_slot = 1
         self._to_execute: Dict[int, Command] = {}
         self._to_clients: Deque[ExecutorResult] = deque()

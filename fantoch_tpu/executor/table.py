@@ -346,7 +346,10 @@ class TableExecutor(Executor):
         # tracing: which batch drain stabilized each traced command
         self._trace_batch = 0
         self._table = MultiVotesTable(process_id, shard_id, config.n, stability_threshold)
-        self._store = KVStore(config.executor_monitor_execution_order)
+        self._store = KVStore(
+            config.executor_monitor_execution_order,
+            config.execution_digests,
+        )
         self._to_clients: Deque[ExecutorResult] = deque()
         self._batched = config.batched_table_executor
         self._n = config.n
@@ -755,7 +758,7 @@ class TableExecutor(Executor):
         themselves remain per-row work.  Anything else falls back to
         per-op execution."""
         store = self._store
-        if store.monitor is None:
+        if store.monitor is None and store.digest is None:
             # single pass doubles as the fast-path check and the value
             # extraction; bail to per-op execution on the first non-put
             vals = []

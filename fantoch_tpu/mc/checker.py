@@ -496,11 +496,17 @@ class ModelChecker:
         MPrepare/MPromise inside the closure."""
         succ = self._copy_state(st)
         outer_time = self._time
-        self._time = SimTime(1_000_000_000)
         try:
             prev_fp = self._fingerprint(succ)
             converged = False
-            for _ in range(max_rounds):
+            for round_index in range(max_rounds):
+                # the clock ADVANCES by a full far-future stride per round:
+                # time-gated retry ladders (the per-dot recovery scan's
+                # owner-first stagger and the free-choice full-quorum hold's
+                # round release, protocol/recovery.py) re-arm on elapsed
+                # time, so a frozen clock would fire each of them exactly
+                # once and a held recovery could never fall back to n - f
+                self._time = SimTime(1_000_000_000 * (round_index + 1))
                 for pid in sorted(succ.protocols):
                     if pid not in succ.crashed:
                         self._apply_to(succ, ("events", pid))

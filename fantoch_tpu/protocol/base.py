@@ -181,6 +181,13 @@ class Protocol(ABC):
         )
         return process
 
+    def audit_commit_log(self) -> Optional[Dict[Any, Tuple[Any, Any]]]:
+        """The commit log the consistency auditor consumes
+        (``Config.audit_log_commits``): ident -> (rifl, value).  Default
+        reads the shared BaseProcess log; None when auditing is off."""
+        bp = getattr(self, "bp", None)
+        return bp.audit_commits if bp is not None else None
+
     def nudge_recovery(self, dots, time: SysTime) -> None:
         """Executor-watchdog hint: these dots are missing dependencies of
         committed commands.  Default no-op; recovery-capable protocols
@@ -266,6 +273,14 @@ class BaseProcess:
         # lifecycle tracer (observability plane); runners swap in a real
         # Tracer via Protocol.set_tracer when Config.trace_sample_rate > 0
         self.tracer = NOOP_TRACER
+        # consistency-audit commit log (core/audit.py): every commit
+        # decision as ident -> (rifl, value), surviving GC so the
+        # post-run auditor can check commit-value agreement across
+        # replicas.  None unless Config.audit_log_commits (audit/fuzz
+        # instrumentation — the log grows with the run)
+        self.audit_commits: Optional[Dict[Any, Tuple[Any, Any]]] = (
+            {} if config.audit_log_commits else None
+        )
 
     def discover(self, all_processes: List[Tuple[ProcessId, ShardId]]) -> bool:
         """Learn the (distance-sorted) process list; quorums are the closest
@@ -333,6 +348,16 @@ class BaseProcess:
 
     def stable(self, count: int) -> None:
         self._metrics.aggregate(ProtocolMetricsKind.STABLE, count)
+
+    def audit_commit(self, ident, rifl, value) -> None:
+        """Record one commit decision for the consistency auditor:
+        ``ident`` is the dot (leaderless) or slot (FPaxos), ``rifl`` the
+        committed command's id (None for recovered noops), ``value`` the
+        protocol's agreed payload (Newt clock, graph deps, Caesar
+        (clock, deps), None where the ident alone carries the order).
+        No-op unless ``Config.audit_log_commits``."""
+        if self.audit_commits is not None:
+            self.audit_commits[ident] = (rifl, value)
 
     def trace_span(self, stage: str, rifl, dot: Optional[Dot] = None,
                    meta=None) -> None:

@@ -156,6 +156,7 @@ class Basic(CommitGCMixin, Protocol):
     def _handle_mcommit(self, _from: ProcessId, dot: Dot, cmd: Command) -> None:
         info = self._cmds.get(dot)
         info.cmd = cmd
+        self.bp.audit_commit(dot, cmd.rifl, None)
         # one execution info per key: lets the basic executor run key-parallel
         rifl = cmd.rifl
         for key, ops in cmd.iter_ops(self.bp.shard_id):

@@ -248,6 +248,13 @@ class Caesar(Protocol):
         assert dot.source == from_, "the coordinator is the dot source"
         self.key_clocks.clock_join(remote_clock)
 
+        if self._gc_track.contains(dot):
+            # straggler (late duplicate) for a dot already committed
+            # everywhere and GC'd: `_cmds.get` would resurrect a fresh
+            # START info, and a trailing MCommit duplicate could then
+            # RE-feed the executor (its exactly-once assert catches the
+            # replay) — the PR 7 GC-straggler class, Caesar edition
+            return
         info = self._cmds.get(dot)
         if info.status != Status.START:
             return
@@ -344,6 +351,8 @@ class Caesar(Protocol):
 
     def _handle_mcommit(self, from_, dot, clock: Clock, deps, time) -> None:
         self.key_clocks.clock_join(clock)
+        if self._gc_track.contains(dot):
+            return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         if info.status == Status.START:
             self._buffered_commits[dot] = (from_, clock, deps)
@@ -358,6 +367,8 @@ class Caesar(Protocol):
         )
 
         info.status = Status.COMMIT
+        # audit plane: agreement = same dot, same (clock, predecessors)
+        self.bp.audit_commit(dot, cmd.rifl, (clock, tuple(sorted(deps))))
         info.deps = set(deps)
         self._update_clock(dot, info, clock)
 
@@ -366,6 +377,8 @@ class Caesar(Protocol):
 
     def _handle_mretry(self, from_, dot, clock: Clock, deps, time) -> None:
         self.key_clocks.clock_join(clock)
+        if self._gc_track.contains(dot):
+            return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         if info.status == Status.START:
             self._buffered_retries[dot] = (from_, clock, deps)

@@ -152,7 +152,15 @@ class QuorumDeps:
         self._participants: Set[ProcessId] = set()
         self._threshold_deps: Dict[Dependency, int] = {}
 
+    def contains(self, process_id: ProcessId) -> bool:
+        """Already counted?  Handlers drop duplicate acks BEFORE add: a
+        duplicated delivery (the sim's at-least-once nemesis) would
+        double-count threshold reports — and a spuriously-met Atlas
+        threshold is an unsound fast-path commit (fuzzer-found)."""
+        return process_id in self._participants
+
     def add(self, process_id: ProcessId, deps: Set[Dependency]) -> None:
+        assert process_id not in self._participants, "duplicate ack"
         assert len(self._participants) < self._fast_quorum_size
         self._participants.add(process_id)
         for dep in deps:

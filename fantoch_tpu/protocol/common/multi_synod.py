@@ -156,6 +156,17 @@ class MultiSynod(Generic[V]):
     def is_leader(self) -> bool:
         return self._leader.is_leader
 
+    def inflight(self):
+        """(ballot, slot, value) of every allocated-but-unchosen slot —
+        the accept rounds a leader must RE-DRIVE (broadcast) when a
+        write-quorum member dies: the original f+1-sized accept fan-out
+        may have included the corpse, and nothing else retries phase 2
+        (fuzzer-found FPaxos stall)."""
+        return sorted(
+            (commander.ballot, slot, commander.value)
+            for slot, commander in self._commanders.items()
+        )
+
     def submit(self, value: V):
         """MSpawnCommander if we're the leader, else MForwardSubmit."""
         allocated = self._leader.try_submit()
@@ -274,6 +285,14 @@ class SlotGCTrack:
         slot_range = (self._previous_stable + 1, new_stable)
         self._previous_stable = new_stable
         return slot_range
+
+    @property
+    def stable_floor(self) -> int:
+        """Highest slot already handed to GC: a chosen/duplicate message
+        at or below it is a straggler for pruned state and must not
+        re-enter the pipeline (the FPaxos analog of the dot protocols'
+        GC-straggler guards)."""
+        return self._previous_stable
 
     def _stable_slot(self) -> int:
         if len(self._all_but_me) != self.n - 1:

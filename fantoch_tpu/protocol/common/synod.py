@@ -91,14 +91,34 @@ class Synod(Generic[V]):
         > n once a recovery prepare ran (ballot = id + n * round)."""
         return self._proposer._ballot
 
-    def handle(self, from_: ProcessId, msg, free_choice_adjust=None) -> Optional[SynodMessage]:
+    def handle(
+        self,
+        from_: ProcessId,
+        msg,
+        free_choice_adjust=None,
+        free_choice_hold=None,
+    ) -> Optional[SynodMessage]:
         """``free_choice_adjust`` (optional, transient — callers pass it
         per call so nothing unpicklable sticks to consensus state) maps
         the proposal-generator's value right before it is proposed.  It
         applies ONLY on the free-choice path (no promise carried an
         accepted ballot); a value bound by a prior accept is never
         touched.  The recovery plane uses it to lift recovered clocks
-        above the promise quorum's stability floor."""
+        above the promise quorum's stability floor.
+
+        ``free_choice_hold`` (optional, transient like the adjuster) is
+        consulted when the free-choice path has its n-f promises but not
+        yet all n: ``hold(promisers)`` returning True keeps the proposer
+        collecting instead of firing, so ballot-0 reports from live
+        stragglers still join the union.  The recovery plane holds until
+        every *known fast-quorum member* has reported: firing at the
+        first n-f can drop the one report carrying a conflict edge (the
+        fuzzer-found Atlas divergence — a dep known only to the second
+        fast-quorum member, whose promise arrived 29ms after the quorum),
+        and a dep/clock union missing such a report commits a value that
+        orders the dot against nothing.  Holding is bounded by the
+        caller (recovery releases after FREE_CHOICE_HOLD_ROUNDS rounds)
+        so a genuinely dead member cannot block liveness."""
         if isinstance(msg, MChosen):
             self._chosen = True
             self._acceptor.set_value(msg.value)
@@ -108,10 +128,22 @@ class Synod(Generic[V]):
         if isinstance(msg, MAccept):
             return self._chosen_msg() or self._acceptor.handle_accept(msg.ballot, msg.value)
         if isinstance(msg, MPromise):
+            if self._chosen:
+                # post-decision latch: a duplicated promise (at-least-once
+                # delivery) must not re-run the selection — a second
+                # free choice could adjust to a NEWER clock floor and
+                # emit a conflicting MAccept at the same ballot
+                return None
             return self._proposer.handle_promise(
-                from_, msg.ballot, msg.accepted, free_choice_adjust
+                from_, msg.ballot, msg.accepted, free_choice_adjust,
+                free_choice_hold,
             )
         if isinstance(msg, MAccepted):
+            if self._chosen:
+                # duplicated accepteds after the choice would refill the
+                # accept set from its post-choice reset and re-fire with
+                # no proposal (the first-ballot-shortcut assert)
+                return None
             return self._proposer.handle_accepted(from_, msg.ballot, self._acceptor)
         raise AssertionError(f"unknown synod message {msg}")
 
@@ -152,25 +184,44 @@ class _Proposer(Generic[V]):
         proposal, self._proposal = self._proposal, None
         return promises, proposal
 
-    def handle_promise(self, from_, ballot, accepted, free_choice_adjust=None) -> Optional[MAccept]:
+    def handle_promise(
+        self, from_, ballot, accepted, free_choice_adjust=None,
+        free_choice_hold=None,
+    ) -> Optional[MAccept]:
         if ballot != self._ballot:
             return None
-        self._promises[from_] = accepted
-        if len(self._promises) != self._n - self._f:
+        if self._proposal is not None:
+            # already proposed at this ballot: a late promise must not
+            # re-run the selection (a second MAccept with a different
+            # union would race the first)
             return None
-        promises, _ = self._reset_state()
+        self._promises[from_] = accepted
+        if len(self._promises) < self._n - self._f:
+            return None
         # pick the value accepted at the highest ballot; if none was accepted
         # (all ballot 0), ask the proposal generator — the one point where
         # the value is a free (therefore adjustable) choice
+        promises = self._promises
         highest_from = max(promises, key=lambda p: promises[p][0])
         highest_ballot = promises[highest_from][0]
         if highest_ballot == 0:
+            if (
+                free_choice_hold is not None
+                and len(promises) < self._n
+                and free_choice_hold(frozenset(promises))
+            ):
+                # keep collecting ballot-0 reports (see Synod.handle):
+                # promises accumulate until the hold releases — by the
+                # awaited report arriving (this path re-runs with >= n-f
+                # promises) or by the caller's round bound
+                return None
             values = {p: v for p, (_b, v) in promises.items()}
             proposal = self._proposal_gen(values)
             if free_choice_adjust is not None:
                 proposal = free_choice_adjust(proposal)
         else:
             proposal = promises[highest_from][1]
+        self._reset_state()
         self._proposal = proposal
         return MAccept(ballot, proposal)
 

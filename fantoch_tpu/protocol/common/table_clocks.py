@@ -63,9 +63,12 @@ class Votes:
         self._votes: Dict[Key, List[VoteRange]] = {}
 
     def add(self, key: Key, vote: VoteRange) -> None:
-        """Append, compressing with the last range when contiguous."""
+        """Append, compressing with the last range when contiguous and by
+        the same voter (a detached accumulator can interleave voters: a
+        recovered noop's carried votes merge foreign ranges in before the
+        next own-clock bump appends)."""
         current = self._votes.setdefault(key, [])
-        if current and current[-1].try_compress(vote):
+        if current and current[-1].by == vote.by and current[-1].try_compress(vote):
             return
         current.append(vote)
 
@@ -184,7 +187,15 @@ class QuorumClocks:
         self.max_clock = 0
         self.max_clock_count = 0
 
+    def contains(self, process_id: ProcessId) -> bool:
+        """Already counted?  Handlers drop duplicate acks BEFORE add: a
+        duplicated delivery (the sim's at-least-once nemesis) would
+        double-count ``max_clock_count`` — and a spuriously-met ``>= f``
+        max-count is an unsound fast-path commit (fuzzer-found)."""
+        return process_id in self._participants
+
     def add(self, process_id: ProcessId, clock: int) -> Tuple[int, int]:
+        assert process_id not in self._participants, "duplicate ack"
         assert len(self._participants) < self.fast_quorum_size
         self._participants.add(process_id)
         if clock > self.max_clock:

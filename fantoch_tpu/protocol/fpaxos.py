@@ -265,9 +265,11 @@ class FPaxos(Protocol):
 
     def _handle_submit(self, cmd: Command) -> None:
         if self._multi_synod.is_leader and cmd.rifl in self._seen_rifls:
-            # a follower re-forwarded after failover but the command is
-            # already allocated (carried forward) or chosen: executing it
-            # twice would break linearizability — drop the duplicate
+            # already allocated (carried forward) or chosen: a follower's
+            # post-failover re-forward OR a plain duplicated
+            # MForwardSubmit delivery (at-least-once links) — allocating
+            # a second slot would execute the command twice (fuzzer-found
+            # without failover), so the rifl dedup always runs
             return
         out = self._multi_synod.submit(cmd)
         if isinstance(out, SynodMSpawnCommander):
@@ -277,8 +279,7 @@ class FPaxos(Protocol):
             # of the coordinator's payload stage
             if self.bp.tracer.enabled:
                 self.bp.trace_span("payload", cmd.rifl, meta={"slot": out.slot})
-            if self._failover:
-                self._register_allocation(out.value.rifl, out.slot)
+            self._register_allocation(out.value.rifl, out.slot)
             self._to_processes.append(
                 ToForward(MSpawnCommander(out.ballot, out.slot, out.value))
             )
@@ -288,6 +289,20 @@ class FPaxos(Protocol):
             self._to_processes.append(ToSend({self._leader}, MForwardSubmit(out.value)))
         else:
             raise AssertionError(f"can't handle {out} in submit")
+
+    # without GC, the delivery-dedup sets are pruned to this many recent
+    # slots (with GC, global stability prunes them exactly)
+    _DEDUP_WINDOW = 4096
+
+    def _prune_dedup_window(self) -> None:
+        if len(self._chosen_slots) <= 2 * self._DEDUP_WINDOW:
+            return
+        floor = max(self._chosen_slots) - self._DEDUP_WINDOW
+        self._chosen_slots = {s for s in self._chosen_slots if s > floor}
+        for rifl, slot in list(self._rifl_slot.items()):
+            if slot <= floor:
+                self._rifl_slot.pop(rifl, None)
+                self._seen_rifls.discard(rifl)
 
     def _register_allocation(self, rifl: Rifl, slot: int) -> None:
         self._seen_rifls.add(rifl)
@@ -325,14 +340,31 @@ class FPaxos(Protocol):
         self._to_processes.append(ToSend(self.bp.all(), MChosen(out.slot, out.value)))
 
     def _handle_mchosen(self, slot: int, cmd: Command) -> None:
+        # exactly-once per slot under at-least-once delivery: a duplicated
+        # MChosen (the sim's duplication nemesis; a resend tail in the run
+        # layer) must not reach the executor twice — the takeover
+        # carry-forward dedup doubles as the delivery dedup, so it runs
+        # with or without failover (fuzzer-found: a duplicated MChosen
+        # without failover tripped the slot executor's exactly-once
+        # assert).  Pruned by GC at the global stability horizon; a
+        # duplicate trailing even THAT is caught by the stable floor (the
+        # GC-straggler guard: its slot executed everywhere long ago)
+        if slot in self._chosen_slots or slot <= self._gc_track.stable_floor:
+            return
+        self._chosen_slots.add(slot)
+        if self.bp.config.gc_interval_ms is None:
+            # without GC nothing ever prunes the dedup state — keep a
+            # bounded recent-slot window instead of growing forever (a
+            # duplicate older than the window is ancient history; the
+            # slot executor's next_slot floor also rejects it)
+            self._prune_dedup_window()
         if self._failover:
-            if slot in self._chosen_slots:
-                return  # re-chosen via takeover carry-forward: exactly once
-            self._chosen_slots.add(slot)
             self._seen_rifls.add(cmd.rifl)
             self._pending_forwards.pop(cmd.rifl, None)
         if self.bp.tracer.enabled:
             self.bp.trace_span("commit", cmd.rifl, meta={"slot": slot})
+        # audit plane: slot-order agreement = same slot, same command
+        self.bp.audit_commit(slot, cmd.rifl, None)
         self._to_executors.append(SlotExecutionInfo(slot, cmd))
         if self.bp.config.gc_interval_ms is not None:
             self._gc_track.commit(slot)
@@ -344,14 +376,21 @@ class FPaxos(Protocol):
         start, end = self._gc_track.stable()
         if start <= end:
             self.bp.stable(self._multi_synod.gc(start, end))
-            if self._failover:
-                # stable slots can never be re-proposed (no acceptor still
-                # holds them): prune the exactly-once bookkeeping
-                self._chosen_slots -= set(range(start, end + 1))
-                for rifl, slot in list(self._rifl_slot.items()):
-                    if slot <= end:
-                        self._rifl_slot.pop(rifl, None)
-                        self._seen_rifls.discard(rifl)
+            # stable slots can never be re-proposed (no acceptor still
+            # holds them): prune the exactly-once bookkeeping — which now
+            # runs with or without failover (delivery dedup).  Pruning
+            # LAGS stability by the dedup window: a late duplicate of an
+            # already-stable MChosen is caught by the stable floor, but a
+            # late duplicate MForwardSubmit carries only a rifl — with
+            # its entry pruned exactly at stability, the leader would
+            # allocate a SECOND slot for an executed command
+            # (fuzzer-found duplicate execution)
+            cut = end - self._DEDUP_WINDOW
+            self._chosen_slots = {s for s in self._chosen_slots if s > cut}
+            for rifl, slot in list(self._rifl_slot.items()):
+                if slot <= cut:
+                    self._rifl_slot.pop(rifl, None)
+                    self._seen_rifls.discard(rifl)
 
     # --- leader failover ---
 
@@ -442,6 +481,18 @@ class FPaxos(Protocol):
         if not self._failover:
             return
         self._down.add(peer_id)
+        if self._multi_synod.is_leader and peer_id != self.id:
+            # re-drive phase 2 for every in-flight slot: the original
+            # accept fan-out was the f+1 write quorum and may have
+            # included the dead peer — nothing else retries those rounds,
+            # so their slots (and everything ordered after them) would
+            # stall forever.  Broadcast: acceptors re-accepting the same
+            # (ballot, slot, value) are idempotent and the chosen-slot
+            # dedup swallows a re-chosen duplicate
+            for ballot, slot, cmd in self._multi_synod.inflight():
+                self._to_processes.append(
+                    ToSend(self.bp.all(), MAccept(ballot, slot, cmd))
+                )
         if peer_id != self._leader or self._leader == self.id:
             return
         candidates = sorted(

@@ -161,6 +161,13 @@ class ConsensusValue:
 class MCommit:
     dot: Dot
     value: ConsensusValue
+    # payload piggyback on recovery chosen-replies: a rejoined replica can
+    # hold a buffered commit for a dot whose MCollect it missed while
+    # down AND that was still in flight when the MSync records were cut —
+    # without the payload here, the prepare/chosen exchange would loop
+    # payload-less forever and the dot's (subtracted-from-backfill) votes
+    # would never fold (fuzzer-found rejoin stall)
+    cmd: Optional[Command] = None
 
 
 @dataclass
@@ -328,7 +335,9 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin,
         elif isinstance(msg, MCollectAck):
             self._handle_mcollectack(from_, msg.dot, msg.deps)
         elif isinstance(msg, MCommit):
-            self._handle_mcommit(from_, msg.dot, msg.value, time)
+            self._handle_mcommit(
+                from_, msg.dot, msg.value, time, getattr(msg, "cmd", None)
+            )
         elif isinstance(msg, MConsensus):
             self._handle_mconsensus(from_, msg.dot, msg.ballot, msg.value, msg.cmd, time)
         elif isinstance(msg, MConsensusAck):
@@ -446,6 +455,12 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin,
         info = self._cmds.get(dot)
         if info.status != Status.COLLECT:
             return
+        if info.quorum_deps.contains(from_):
+            # duplicate ack (at-least-once delivery): re-counting reports
+            # would inflate the Atlas fast-path threshold unsoundly, and a
+            # late duplicate after quorum completion (slow path / recovery
+            # join keep status COLLECT) would trip the size assert
+            return
         info.quorum_deps.add(from_, deps)
         if not info.quorum_deps.all():
             return
@@ -457,7 +472,9 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin,
             # sound anymore — join recovery with a full prepare instead
             prepare = info.synod.new_prepare()
             self._to_processes.append(
-                ToSend(self.bp.all(), MRecoveryPrepare(dot, prepare.ballot))
+                ToSend(
+                    self.bp.all(), MRecoveryPrepare(dot, prepare.ballot, info.cmd)
+                )
             )
             return
         if fast_path:
@@ -470,12 +487,21 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin,
                 ToSend(self.bp.write_quorum(), MConsensus(dot, ballot, value))
             )
 
-    def _handle_mcommit(self, from_, dot, value, time) -> None:
+    def _handle_mcommit(self, from_, dot, value, time, cmd=None) -> None:
         if self._gc_track.contains(dot):
             return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         if info.status == Status.COMMIT:
             return
+        if cmd is not None and info.cmd is None:
+            # recovery chosen-reply piggyback: adopt so the commit below
+            # can proceed instead of buffering payload-less.  A commit
+            # buffered earlier for this dot is superseded by this one
+            # (consensus decided the same value) — pop it or it leaks
+            self._buffered_commits.pop(dot, None)
+            info.cmd = cmd
+            if info.status == Status.START:
+                info.status = Status.PAYLOAD
         if value.is_noop:
             # recovered noop (the dot was never payloaded anywhere the
             # promise quorum could see): nothing executes — the executor's
@@ -484,8 +510,13 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin,
             self._commit_bookkeeping(info, from_, dot, value)
             return
         if info.status == Status.START:
-            # MCollect may arrive after MCommit (multiplexing): buffer
+            # MCollect may arrive after MCommit (multiplexing): buffer —
+            # and track for recovery: if the MCollect never comes (it was
+            # broadcast while this replica was down, and the commit was
+            # still in flight when the rejoin records were cut), only the
+            # recovery exchange can fetch the payload
             self._buffered_commits[dot] = (from_, value)
+            self._recovery_track(dot, time)
             return
         cmd = info.cmd
         assert cmd is not None, "there should be a command payload"
@@ -497,6 +528,19 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin,
 
     def _commit_bookkeeping(self, info, from_, dot, value) -> None:
         info.status = Status.COMMIT
+        if self.bp.audit_commits is not None:
+            # audit plane: the agreed value is the dep set (noop commits
+            # carry no command — record rifl None so the auditor never
+            # counts them as a lost command)
+            self.bp.audit_commit(
+                dot,
+                None if value.is_noop else (
+                    info.cmd.rifl if info.cmd is not None else None
+                ),
+                "noop" if value.is_noop else tuple(
+                    sorted(dep.dot for dep in value.deps)
+                ),
+            )
         if info.cmd is not None:
             self.bp.trace_span(
                 "commit", info.cmd.rifl, dot=dot,
@@ -527,7 +571,9 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin,
             # carry the cross-shard aggregate, which travels through
             # MShardAggregatedCommit (the coordinator's ack path)
             if info.cmd is None or info.cmd.shard_count == 1:
-                self._to_processes.append(ToSend({from_}, MCommit(dot, out.value)))
+                self._to_processes.append(
+                    ToSend({from_}, MCommit(dot, out.value, cmd=info.cmd))
+                )
         else:
             raise AssertionError(f"unexpected synod output {out}")
 
@@ -562,9 +608,12 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin,
 
     def _recovery_chosen_reply(self, to, dot, info, value) -> None:
         # same single-shard guard as the late-MConsensus reply: multi-shard
-        # commits must carry the cross-shard aggregate
+        # commits must carry the cross-shard aggregate.  The payload rides
+        # along: the asker may hold a payload-less buffered commit
         if info.cmd is None or info.cmd.shard_count == 1:
-            self._to_processes.append(ToSend({to}, MCommit(dot, value)))
+            self._to_processes.append(
+                ToSend({to}, MCommit(dot, value, cmd=info.cmd))
+            )
 
     # --- rejoin sync hooks (protocol/sync.py) ---
 

@@ -123,6 +123,13 @@ class MCommit:
     # copy commit-coupled (see _handle_mcommit) so vote frontiers heal
     # without ever overtaking the ops they stabilize
     recovered: bool = False
+    # payload piggyback on recovery chosen-replies: a rejoined replica can
+    # hold a buffered commit for a dot whose MCollect it missed while
+    # down AND that was still in flight when the MSync records were cut —
+    # without the payload here the prepare/chosen exchange loops
+    # payload-less forever and the dot's (subtracted-from-backfill) votes
+    # never fold (fuzzer-found rejoin stall)
+    cmd: Optional[Command] = None
 
 
 @dataclass
@@ -224,7 +231,10 @@ def _newt_info_factory(pid, _sid, cfg, fq, _wq) -> "NewtInfo":
 class NewtInfo:
     """Per-dot lifecycle info (newt.rs:1117-1170)."""
 
-    __slots__ = ("status", "quorum", "synod", "cmd", "votes", "quorum_clocks")
+    __slots__ = (
+        "status", "quorum", "synod", "cmd", "votes", "quorum_clocks",
+        "recovery_consumed",
+    )
 
     def __init__(self, process_id: ProcessId, n: int, f: int, fast_quorum_size: int):
         self.status = Status.START
@@ -234,6 +244,32 @@ class NewtInfo:
         # coordinator-side aggregation of fast-quorum votes
         self.votes = Votes()
         self.quorum_clocks = QuorumClocks(fast_quorum_size)
+        # True once a recovery PROMISE consumed votes for this dot
+        # (_recovery_promise_floor): those ranges exist nowhere else, so
+        # the commit handler must re-broadcast the held votes
+        # commit-coupled even when the commit was decided by the normal
+        # (non-recovery) path racing the prepare
+        self.recovery_consumed = False
+
+
+# --- mutation self-test hook (tests/test_fuzz.py) ---
+# When True, every GC-straggler guard below is bypassed, reintroducing the
+# PR 7 latent bug: a late retransmit for a dot that already went stable
+# and was GC'd resurrects a fresh START info via `_cmds.get`, and a later
+# payload adoption can REPLAY the commit — double-adding the ops to the
+# vote table (same-(clock,dot) collision, duplicate execution).  The
+# chaos fuzzer's mutation self-test flips this to prove the
+# auditor+fuzzer detect the real historical violation, not just
+# synthetic ones.  Never set outside tests.
+_GC_STRAGGLER_GUARD_DISABLED = False
+
+
+def _set_gc_straggler_guard(enabled: bool) -> None:
+    """Test hook: disable (enabled=False) or restore the GC-straggler
+    guards.  Pair with try/finally — a leaked disable corrupts every
+    subsequent Newt run in the process."""
+    global _GC_STRAGGLER_GUARD_DISABLED
+    _GC_STRAGGLER_GUARD_DISABLED = not enabled
 
 
 # the clock-bump worker owns all key clocks under worker parallelism
@@ -367,7 +403,10 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
         elif isinstance(msg, MCollectAck):
             self._handle_mcollectack(from_, msg.dot, msg.clock, msg.process_votes)
         elif isinstance(msg, MCommit):
-            self._handle_mcommit(from_, msg.dot, msg.clock, msg.votes, msg.recovered)
+            self._handle_mcommit(
+                from_, msg.dot, msg.clock, msg.votes, msg.recovered,
+                getattr(msg, "cmd", None), time,
+            )
         elif isinstance(msg, MCommitClock):
             assert from_ == self.bp.process_id
             self._max_commit_clock = max(self._max_commit_clock, msg.clock)
@@ -434,6 +473,14 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
 
     # --- handlers ---
 
+    def _gc_straggler(self, dot: Dot) -> bool:
+        """True when ``dot``'s commit already went stable-everywhere and
+        was GC'd here, so the message is a straggler that must not
+        resurrect a fresh info (PR 7 safety fix).  The mutation self-test
+        bypasses this via the module flag to prove the fuzzer catches
+        the historical commit-replay bug."""
+        return (not _GC_STRAGGLER_GUARD_DISABLED) and self._gc_track.contains(dot)
+
     def _handle_submit(
         self, dot: Optional[Dot], cmd: Command, target_shard: bool
     ) -> Dot:
@@ -459,7 +506,7 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
         self._to_processes.append(ToSend(self.bp.all(), mcollect))
 
     def _handle_mcollect(self, from_, dot, cmd, quorum, remote_clock, votes, time) -> None:
-        if self._gc_track.contains(dot):
+        if self._gc_straggler(dot):
             return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         if info.status != Status.START:
@@ -548,10 +595,18 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
             self._handle_mcommit(buf_from, dot, buf_clock, buf_votes, buf_recovered)
 
     def _handle_mcollectack(self, from_, dot, clock, remote_votes) -> None:
-        if self._gc_track.contains(dot):
+        if self._gc_straggler(dot):
             return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         if info.status != Status.COLLECT:
+            return
+        if info.quorum_clocks.contains(from_):
+            # duplicate ack (at-least-once delivery): counting it again
+            # would double-count max_clock_count — an unsound fast path —
+            # and a late duplicate after the quorum completed (slow path /
+            # recovery join keep status COLLECT) would trip the size
+            # assert.  Votes were merged on the first copy; ranges dedup
+            # anyway, so dropping the whole message is safe
             return
         info.votes.merge(remote_votes)
         max_clock, max_count = info.quorum_clocks.add(from_, clock)
@@ -574,7 +629,9 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
             # aggregated votes stay in info.votes for the eventual commit
             prepare = info.synod.new_prepare()
             self._to_processes.append(
-                ToSend(self.bp.all(), MRecoveryPrepare(dot, prepare.ballot))
+                ToSend(
+                    self.bp.all(), MRecoveryPrepare(dot, prepare.ballot, info.cmd)
+                )
             )
             return
         if max_count >= self.bp.config.f:
@@ -638,27 +695,50 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
         return MConsensus(dot, ballot, value, cmd)
 
     def _recovery_promise_floor(self, info) -> int:
-        # current max key clock over the dot's keys: upper-bounds every
-        # vote this acceptor has issued for them, and therefore any
-        # stability its column contributed to
-        if info.cmd is None:
+        # Tempo-style promise: CONSUME votes through clock+1 (a full
+        # proposal) and hold them with the dot, reporting the consumed
+        # clock as the floor.  A floor merely *sampled* from the key
+        # clocks is only an upper bound at promise time — between the
+        # promise and the recovery commit, other commands keep voting and
+        # stability can pass the recovered timestamp, so the late commit
+        # executes out of (clock, dot) order (divergence; the fuzzer's
+        # restart+hold schedules hit exactly this).  Consuming instead
+        # leaves a GAP in this acceptor's vote column that only the
+        # commit-coupled release fills: any stability set intersects the
+        # promise quorum (stability threshold + n-f > n), so no
+        # timestamp at or below the recovered clock can stabilize before
+        # the dot's ops arrive.  Held votes for a dot that recovers as a
+        # noop flush detached (the noop commit branch), so nothing leaks.
+        if info.cmd is None or info.status == Status.COMMIT:
             return 0
-        return self.key_clocks._cmd_clock(info.cmd)
+        clock, votes = self.key_clocks.proposal(info.cmd, 0)
+        info.votes.merge(votes)
+        info.recovery_consumed = True
+        return clock
 
     def _recovery_adjust_value(self, info, value, floor: int):
-        # free-choice clocks lift STRICTLY above the quorum's floor: at
-        # the floor itself a smaller dot would still sort before an
-        # already-executed equal-clock command.  Noop (0) stays noop.
+        # free-choice clocks lift to the quorum's max consumed floor: the
+        # floor reporter consumed votes through it, so the lifted clock is
+        # covered by held ranges (no +1 — a clock above the consumed
+        # region would reopen the stability-overtakes-commit gap).  Equal-
+        # clock ties with already-executed commands are safe because the
+        # floor is a *consumed* clock+1 proposal, strictly above every
+        # vote its reporter ever issued.  Noop (0) stays noop.
         if value == 0:
             return value
-        return max(value, floor + 1)
+        return max(value, floor)
 
     def _recovery_chosen_reply(self, to, dot, info, value) -> None:
         # same single-shard guard as the late-MConsensus reply; recovered
-        # so the receiver re-broadcasts any votes it still holds
+        # so the receiver re-broadcasts any votes it still holds.  The
+        # payload rides along: the asker may hold a payload-less
+        # buffered commit (rejoin gap)
         if info.cmd is None or info.cmd.shard_count == 1:
             self._to_processes.append(
-                ToSend({to}, MCommit(dot, value, info.votes, recovered=True))
+                ToSend(
+                    {to},
+                    MCommit(dot, value, info.votes, recovered=True, cmd=info.cmd),
+                )
             )
 
     # --- rejoin sync hooks (protocol/sync.py) ---
@@ -727,8 +807,11 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
     def _partial_final_mcommit(self, dot: Dot, data, local):
         return MCommit(dot, data, local if local is not None else Votes())
 
-    def _handle_mcommit(self, from_, dot, clock, votes: Votes, recovered=False) -> None:
-        if self._gc_track.contains(dot):
+    def _handle_mcommit(
+        self, from_, dot, clock, votes: Votes, recovered=False,
+        cmd=None, time=None,
+    ) -> None:
+        if self._gc_straggler(dot):
             # straggler for a dot already committed-everywhere and GC'd
             # (late retransmit, held-vote re-broadcast, rejoin traffic):
             # `_cmds.get` would resurrect a fresh START info and a later
@@ -744,6 +827,10 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
                         self._to_executors.append(TableDetachedVotes(key, key_votes))
             return
         info = self._cmds.get(dot)
+        if cmd is not None and info.cmd is None and info.status == Status.START:
+            # recovery chosen-reply piggyback: adopt so the commit below
+            # proceeds instead of buffering payload-less
+            self._adopt_recovered_payload(dot, info, cmd, time)
         if info.status == Status.COMMIT:
             # duplicate commit — typically a member re-broadcasting its
             # held votes after a recovered commit: the ops are already in
@@ -762,9 +849,18 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
             # recovered noop (the dot never got a clock proposal anywhere
             # the promise quorum could see): nothing executes and nothing
             # stabilizes — settle the synod and stop recovery.  Votes held
-            # for a noop dot couple to no ops, so they flush as detached
+            # for a noop dot couple to no ops, so they flush as detached —
+            # including the CARRIED votes: the recovery proposer's own
+            # held ranges (promise-consumed, or shipped-ack copies) ride
+            # the MCommit broadcast, and dropping them here would leave a
+            # permanent hole in that process's vote column at every
+            # receiver (frontiers stall below it forever)
             info.status = Status.COMMIT
+            # audit plane: a noop commit executes nothing — rifl None
+            self.bp.audit_commit(dot, None, 0)
             self._buffered_mbumps.pop(dot, None)
+            if not votes.is_empty():
+                self._detached.merge(votes)
             if not info.votes.is_empty():
                 held, info.votes = info.votes, Votes()
                 self._detached.merge(held)
@@ -785,6 +881,12 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
                 votes.merge(buf_votes)
                 recovered = recovered or buf_rec
             self._buffered_mcommits[dot] = (from_, clock, votes, recovered)
+            if time is not None:
+                # track for recovery: if the MCollect never comes (it was
+                # broadcast while this replica was down and the commit
+                # missed the rejoin records), only the recovery
+                # chosen-reply exchange can fetch the payload
+                self._recovery_track(dot, time)
             return
 
         cmd = info.cmd
@@ -797,10 +899,12 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
             # would let stability overtake the commit on slower replicas.
             # So: join them to the local table add below, and when the
             # commit was recovery-decided (its votes lack the quorum's
-            # consumed ranges) re-broadcast them commit-coupled; receivers
-            # fold them in post-ops via the duplicate-commit branch above
+            # consumed ranges) — or a recovery PROMISE consumed ranges
+            # here that no aggregation ever saw — re-broadcast them
+            # commit-coupled; receivers fold them in post-ops via the
+            # duplicate-commit branch above
             held, info.votes = info.votes, Votes()
-            if recovered:
+            if recovered or info.recovery_consumed:
                 self._to_processes.append(
                     ToSend(
                         self.bp.all_but_me(),
@@ -822,6 +926,8 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
                 )
 
         info.status = Status.COMMIT
+        # audit plane: timestamp-order agreement = same dot, same clock
+        self.bp.audit_commit(dot, cmd.rifl, clock)
         self.bp.trace_span(
             "commit", cmd.rifl, dot=dot,
             meta={"recovered": True} if recovered else None,
@@ -855,7 +961,7 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
             self._to_executors.append(TableDetachedVotes(key, key_votes))
 
     def _handle_mconsensus(self, from_, dot, ballot, clock, cmd=None, time=None) -> None:
-        if self._gc_track.contains(dot):
+        if self._gc_straggler(dot):
             return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         if cmd is not None and info.cmd is None:
@@ -873,13 +979,19 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
             # only travels via MShardAggregatedCommit
             if info.cmd is None or info.cmd.shard_count == 1:
                 self._to_processes.append(
-                    ToSend({from_}, MCommit(dot, out.value, info.votes, recovered=True))
+                    ToSend(
+                        {from_},
+                        MCommit(
+                            dot, out.value, info.votes,
+                            recovered=True, cmd=info.cmd,
+                        ),
+                    )
                 )
         else:
             raise AssertionError(f"unexpected synod output {out}")
 
     def _handle_mconsensusack(self, from_, dot, ballot) -> None:
-        if self._gc_track.contains(dot):
+        if self._gc_straggler(dot):
             return  # straggler for a GC'd dot: do not resurrect its info
         info = self._cmds.get(dot)
         out = info.synod.handle(from_, SynodMAccepted(ballot))

@@ -79,6 +79,14 @@ from fantoch_tpu.protocol.common.synod import (
 class MRecoveryPrepare:
     dot: Dot
     ballot: int
+    # payload piggyback (symmetric to MRecoveryPromise.cmd): an acceptor
+    # that never saw the MCollect adopts it so its promise can CONSUME
+    # key-clock votes (Newt's _recovery_promise_floor).  Without it, a
+    # payload-less promiser reports floor 0 and its vote column keeps
+    # advancing — a stability set avoiding the consuming promisers can
+    # then pass the recovered clock before the commit lands (the
+    # fuzzer-found crash-restart order divergence)
+    cmd: Optional[Command] = None
 
 
 @dataclass
@@ -103,6 +111,21 @@ class MRecoveryPromise:
 @dataclass
 class RecoveryEvent:
     """Periodic overdue-dot scan (interval = Config.recovery_delay_ms)."""
+
+
+# free-choice selections wait for ALL n promises during the first
+# this-many recovery rounds (ballot = id + n * round); later rounds fire
+# at n - f so a crashed process cannot block recovery forever — by then
+# its silence has outlived several recovery_delay_ms intervals, which
+# the knob's contract already sizes well above any delivery delay.
+# Waiting for every live report matters because the one ballot-0 report
+# carrying a conflict edge may live ANYWHERE: at a fast-quorum member
+# whose promise trails the first n - f (the fuzzer-found Atlas
+# divergence — a dep known only to the straggling member), or at a
+# NON-member whose late report (staged when the MCollect reached it) is
+# the only place the edge was ever recorded.  A dep/clock union missing
+# that report commits a value that orders the dot against nothing.
+FREE_CHOICE_HOLD_ROUNDS = 2
 
 
 class RecoveryMixin:
@@ -142,8 +165,17 @@ class RecoveryMixin:
         return []
 
     def _recovery_track(self, dot: Dot, time: SysTime) -> None:
-        if self._recovery_enabled() and dot not in self._pending_since:
-            self._pending_since[dot] = time.millis()
+        if not self._recovery_enabled() or dot in self._pending_since:
+            return
+        gc_track = getattr(self, "_gc_track", None)
+        if gc_track is not None and gc_track.contains(dot):
+            # straggler for a dot already committed everywhere and GC'd
+            # (a late duplicate prepare/commit): enrolling it would pin a
+            # resurrected info in permanent recovery churn — its noop
+            # commit is dropped by every receiver's own straggler guard,
+            # so the round ladder would never terminate
+            return
+        self._pending_since[dot] = time.millis()
 
     def _recovery_untrack(self, dot: Dot) -> None:
         if self._recovery_enabled():
@@ -171,7 +203,15 @@ class RecoveryMixin:
         delay = self.bp.config.recovery_delay_ms
         n = self.bp.config.n
         me = self.bp.process_id
+        gc_track = getattr(self, "_gc_track", None)
         for dot in list(self._pending_since):
+            if gc_track is not None and gc_track.contains(dot):
+                # committed everywhere and GC'd since it was tracked:
+                # done — `_cmds.get` below would resurrect a fresh info
+                # and re-run recovery for a dead dot forever
+                self._pending_since.pop(dot, None)
+                self._promise_floors.pop(dot, None)
+                continue
             # get (not get_existing): a nudged dot may have no info yet —
             # recovery then runs on the fresh bottom synod and, with no
             # payload anywhere, commits it as a noop
@@ -180,14 +220,17 @@ class RecoveryMixin:
                 self._pending_since.pop(dot, None)
                 continue
             # stagger: the owner retries after one delay, its ring
-            # successor after two, and so on — exactly one new proposer
-            # joins per interval while earlier ones retry
+            # successor after two, and so on — one new proposer per
+            # interval
             wait = delay * (1 + (me - dot.source) % n)
             if now - self._pending_since[dot] < wait:
                 continue
-            # rebase the clock so, once joined, this proposer retries once
-            # per interval (next eligibility lands at now + delay)
-            self._pending_since[dot] = now - delay * ((me - dot.source) % n)
+            # rebase so this proposer retries once per n*delay, keeping
+            # its ring phase: proposers sharing one retry cadence duel
+            # forever (each prepare preempts the other's accept phase —
+            # deterministically so in the sim), so the ring offsets must
+            # stay disjoint across retries, not just on the first join
+            self._pending_since[dot] = now + delay * n - wait
             prepare = info.synod.new_prepare()
             # trace: the dot entered recovery consensus (out-of-chain
             # stage when the payload is known here, else a counter — a
@@ -206,7 +249,10 @@ class RecoveryMixin:
                         self._unpayloaded_prepares, pid=me,
                     )
             self._to_processes.append(
-                ToSend(self.bp.all(), MRecoveryPrepare(dot, prepare.ballot))
+                ToSend(
+                    self.bp.all(),
+                    MRecoveryPrepare(dot, prepare.ballot, info.cmd),
+                )
             )
 
     # --- wire handlers ---
@@ -215,7 +261,9 @@ class RecoveryMixin:
         """Dispatch a recovery message; returns False if ``msg`` is not
         one."""
         if isinstance(msg, MRecoveryPrepare):
-            self._handle_recovery_prepare(from_, msg.dot, msg.ballot)
+            self._handle_recovery_prepare(
+                from_, msg.dot, msg.ballot, getattr(msg, "cmd", None), time
+            )
         elif isinstance(msg, MRecoveryPromise):
             self._handle_recovery_promise(
                 from_, msg.dot, msg.ballot, msg.accepted, msg.cmd, time,
@@ -225,8 +273,27 @@ class RecoveryMixin:
             return False
         return True
 
-    def _handle_recovery_prepare(self, from_: ProcessId, dot: Dot, ballot: int) -> None:
+    def _handle_recovery_prepare(
+        self,
+        from_: ProcessId,
+        dot: Dot,
+        ballot: int,
+        cmd: Optional[Command] = None,
+        time: Optional[SysTime] = None,
+    ) -> None:
         info = self._cmds.get(dot)
+        if cmd is not None and info.cmd is None:
+            # adopt the piggybacked payload BEFORE promising: the promise
+            # floor consumes key-clock votes, which needs the keys
+            self._adopt_recovered_payload(dot, info, cmd, time)
+        if time is not None and info.status != self._STATUS_COMMIT:
+            # a prepare names a dot someone considers overdue — track it
+            # HERE too: the promise may adopt a payload and consume votes
+            # (state that must eventually release commit-coupled), and if
+            # the proposer dies mid-round, this process must be able to
+            # finish the recovery itself (ring stagger) instead of
+            # holding a permanent gap in its vote column
+            self._recovery_track(dot, time)
         out = info.synod.handle(from_, SynodMPrepare(ballot))
         if out is None:
             return  # stale ballot
@@ -275,8 +342,23 @@ class RecoveryMixin:
         def adjust(value):
             return self._recovery_adjust_value(info, value, floor)
 
+        # free-choice hold (see FREE_CHOICE_HOLD_ROUNDS): during the
+        # first rounds, wait for ALL n ballot-0 reports — the one report
+        # carrying a conflict edge (or the highest consumed clock floor)
+        # can live at ANY process, quorum member or not, and a union
+        # missing it commits a value that orders the dot against nothing.
+        # The synod only consults the hold below n promises, so an
+        # all-alive mesh fires after one delivery round-trip; a crashed
+        # process blocks only until the round cap
+        hold = None
+        round_ = (ballot - 1) // self.bp.config.n
+        if round_ <= FREE_CHOICE_HOLD_ROUNDS:
+            def hold(_promisers):
+                return True
+
         out = info.synod.handle(
-            from_, SynodMPromise(ballot, accepted), free_choice_adjust=adjust
+            from_, SynodMPromise(ballot, accepted),
+            free_choice_adjust=adjust, free_choice_hold=hold,
         )
         if out is None:
             return  # not this ballot, or still below n - f promises
@@ -301,12 +383,13 @@ class RecoveryMixin:
         return 0
 
     def _recovery_adjust_value(self, info, value, floor: int):
-        """Lift a FREE-choice recovered value above the promise quorum's
-        max clock floor.  Default identity; Newt lifts non-noop clocks to
-        ``max(value, floor + 1)`` so a recovered timestamp can never land
-        at or below a timestamp the survivors may already have executed
-        past (which would make live execution order diverge from the
-        canonical (clock, dot) order a restarted replica reconstructs)."""
+        """Lift a FREE-choice recovered value to the promise quorum's max
+        clock floor.  Default identity; Newt lifts non-noop clocks to
+        ``max(value, floor)`` — the floor is a clock the reporting
+        acceptor CONSUMED votes through (see ``_recovery_promise_floor``),
+        so the lifted clock is covered by held ranges released
+        commit-coupled; lifting ABOVE it (a +1) would land on a clock
+        nobody consumed and reopen the stability-overtakes-commit gap."""
         return value
 
     def _adopt_recovered_payload(self, dot: Dot, info, cmd: Command, time: SysTime) -> None:

@@ -190,6 +190,20 @@ async def run_localhost_cluster(
             failed = next(t for t in done if t in failure_tasks)
             pid = failure_tasks[failed]
             client_task.cancel()
+            # reap the cancelled gather BEFORE raising: an un-awaited
+            # cancellation can resurface as CancelledError during the
+            # AssertionError's unwind and replace it out of asyncio.run
+            try:
+                await client_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            # a typed failure must also stop the survivors: their tasks
+            # would otherwise outlive this coroutine and be cancelled by
+            # the loop teardown mid-write
+            await asyncio.gather(
+                *(runtime.stop() for runtime in runtimes.values()),
+                return_exceptions=True,
+            )
             raise AssertionError(
                 f"runtime p{pid} failed mid-run: {runtimes[pid].failure!r}"
             )

@@ -133,11 +133,38 @@ class Overloaded:
 
 
 @dataclass
+class DigestKeyRequest:
+    """Divergence drill-down (Config.execution_digests): a peer's
+    heartbeat digest summary mismatched ours on ``key`` — send back the
+    full hash chain so the FIRST diverging write can be named (the typed
+    DivergenceError carries key + position + both commands)."""
+
+    key: str
+
+
+@dataclass
+class DigestKeyReply:
+    """One key's full executed-write hash chain:
+    [(rifl_src, rifl_seq, digest), ...] (core/audit.DigestEntry rows)."""
+
+    key: str
+    entries: List[Any]
+
+
+@dataclass
 class PingReq:
     """Peer RTT probe (the localhost analog of the reference's `ping -c 1`
-    shell-out, fantoch/src/run/task/ping.rs:71-78)."""
+    shell-out, fantoch/src/run/task/ping.rs:71-78).
+
+    ``digest`` piggybacks the sender's per-key execution-digest summary
+    ({key: (write count, chain digest at that count)}) when
+    ``Config.execution_digests`` is on: the receiver verifies every key
+    where it is at least as far along — replicas cross-audit each other
+    on the heartbeat cadence, and a fork surfaces as a typed
+    DivergenceError instead of silently serving diverged reads."""
 
     nonce: int
+    digest: Optional[Dict[str, Any]] = None
 
 
 @dataclass

@@ -55,6 +55,8 @@ from fantoch_tpu.run.backpressure import (
 from fantoch_tpu.run.prelude import (
     ClientHi,
     ClientHiAck,
+    DigestKeyReply,
+    DigestKeyRequest,
     Overloaded,
     PingReply,
     PingReq,
@@ -339,6 +341,12 @@ class ProcessRuntime:
         )
         self.shed_submissions = 0
         self.backpressure_pauses = 0
+        # consistency-audit plane (core/audit.py): per-key chained
+        # execution digests live in the executors' KVStores when
+        # Config.execution_digests is on; the heartbeat piggybacks
+        # summaries so replicas cross-audit each other online
+        self.digest_checks = 0
+        self.digest_mismatches = 0
         self.workers = ToPool("workers", workers, capacity=self.queue_capacity)
         self.executor_pool = ToPool(
             "executors", executors, capacity=self.queue_capacity
@@ -900,6 +908,17 @@ class ProcessRuntime:
                 while from_ not in self._peer_writers:
                     await asyncio.sleep(0.01)
                 self._peer_writers[from_].put_nowait(serialize(PingReply(msg.nonce)))
+                digest = getattr(msg, "digest", None)
+                if digest is not None:
+                    self._check_peer_digest(from_, digest)
+            elif isinstance(msg, DigestKeyRequest):
+                while from_ not in self._peer_writers:
+                    await asyncio.sleep(0.01)
+                self._peer_writers[from_].put_nowait(
+                    serialize(DigestKeyReply(msg.key, self._digest_entries(msg.key)))
+                )
+            elif isinstance(msg, DigestKeyReply):
+                self._resolve_divergence(from_, msg.key, msg.entries)
             elif isinstance(msg, PingReply):
                 waiter = self._ping_waiters.pop(msg.nonce, None)
                 if waiter is not None and not waiter.done():
@@ -1134,6 +1153,14 @@ class ProcessRuntime:
             await asyncio.sleep(self.heartbeat_interval_s)
             if self._stopping:
                 return
+            # divergence detection rides the heartbeat: piggyback our
+            # per-key digest summary so every peer cross-audits us at
+            # detector cadence (serialized once per tick, not per peer)
+            digest = (
+                self._digest_summary()
+                if self.config.execution_digests
+                else None
+            )
             for peer_id in self.peers:
                 if peer_id in self.dead_peers:
                     continue
@@ -1141,7 +1168,7 @@ class ProcessRuntime:
                 # refreshes _last_heard via the reader
                 self._ping_nonce += 1
                 self._peer_writers[peer_id].put_nowait(
-                    serialize(PingReq(self._ping_nonce))
+                    serialize(PingReq(self._ping_nonce, digest))
                 )
                 silent_for = loop.time() - self._last_heard[peer_id]
                 if silent_for > silence_window:
@@ -1153,6 +1180,89 @@ class ProcessRuntime:
                             TimeoutError(f"silent for {silent_for:.1f}s"),
                         ),
                     )
+
+    # --- online divergence detection (core/audit.py digests) ---
+
+    def _digest_summary(self) -> Optional[Dict[str, Any]]:
+        """Merged per-key (count, chain digest) summary across the
+        executor pool (executors own disjoint key sets); None when
+        digests are off or nothing executed yet."""
+        merged: Dict[str, Any] = {}
+        for executor in self.executors:
+            digest = executor.digest()
+            if digest is not None:
+                digest.merge_summary_into(merged)
+        return merged or None
+
+    def _digest_entries(self, key: str):
+        for executor in self.executors:
+            digest = executor.digest()
+            if digest is not None:
+                entries = digest.entries(key)
+                if entries:
+                    return entries
+        return []
+
+    def _check_peer_digest(self, peer_id: ProcessId, summary: Dict[str, Any]) -> None:
+        """Verify a peer's heartbeat digest summary against our chains:
+        for every key where we reach the peer's write count, our digest
+        at that position must match (a hash chain authenticates the whole
+        prefix).  On mismatch, request the peer's full chain so the
+        DivergenceError can name the FIRST diverging write."""
+        self.digest_checks += 1
+        mismatched = []
+        for executor in self.executors:
+            digest = executor.digest()
+            if digest is not None:
+                mismatched.extend(digest.mismatched_keys(summary))
+        for key in mismatched:
+            self.digest_mismatches += 1
+            logger.error(
+                "p%s: execution digest mismatch with p%s on key %r — "
+                "requesting its chain to locate the fork",
+                self.process.id, peer_id, key,
+            )
+            self._peer_writers[peer_id].put_nowait(
+                serialize(DigestKeyRequest(key))
+            )
+
+    def _resolve_divergence(self, peer_id: ProcessId, key: str, entries) -> None:
+        """A peer answered our drill-down with its full chain: find the
+        first diverging write and fail with the typed error.  A clean
+        prefix means the mismatch healed (e.g. we advanced past a stale
+        summary) — nothing to report then."""
+        from fantoch_tpu.core.audit import DigestEntry, ExecutionDigest
+        from fantoch_tpu.core.ids import Rifl
+        from fantoch_tpu.errors import DivergenceError
+
+        theirs = [DigestEntry(*entry) for entry in entries]
+        divergence = ExecutionDigest.first_divergence(
+            self._digest_entries(key), theirs
+        )
+        if divergence is None:
+            return
+        position, mine, other = divergence
+        mine_rifl = Rifl(mine.src, mine.seq) if mine is not None else None
+        theirs_rifl = Rifl(other.src, other.seq) if other is not None else None
+        # name the diverging command's dot when the audit commit log can
+        # resolve it (Config.audit_log_commits)
+        dot = None
+        log = self.process.audit_commit_log()
+        if log is not None:
+            dot = next(
+                (
+                    ident
+                    for ident, (rifl, _value) in log.items()
+                    if rifl == mine_rifl
+                ),
+                None,
+            )
+        self._fail(
+            DivergenceError(
+                key, position, mine_rifl, theirs_rifl,
+                self.process.id, peer_id, dot=dot,
+            )
+        )
 
     def _declare_peer_lost(self, peer_id: ProcessId, cause: BaseException) -> None:
         """Graceful degradation: a lost peer stops the cluster only when
@@ -1438,7 +1548,7 @@ class ProcessRuntime:
         same instant)."""
         if stats is None:
             stats = self.queue_stats()
-        return {
+        out = {
             "shed_submissions": self.shed_submissions,
             "backpressure_pauses": self.backpressure_pauses,
             "queue_depth_hwm": max(
@@ -1448,6 +1558,14 @@ class ProcessRuntime:
                 (row["depth"] for row in stats.values()), default=0
             ),
         }
+        if self.config.execution_digests:
+            # divergence-detection gauges ride the same snapshot/tracer
+            # pipeline (bin/obs.py summarize prints the audit line)
+            out["digest_checks"] = self.digest_checks
+            out["digest_mismatches"] = self.digest_mismatches
+            summary = self._digest_summary() or {}
+            out["digest_keys"] = len(summary)
+        return out
 
     def _write_metrics_snapshot(self) -> None:
         from fantoch_tpu.run.observe import ProcessMetrics, write_metrics_snapshot

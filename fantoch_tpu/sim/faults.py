@@ -78,6 +78,13 @@ class LinkFault:
     drop: float = 0.0
     duplicate: float = 0.0
     extra_delay_ms: int = 0
+    # extra delay budget for the DUPLICATED copy only: a large value
+    # models a late retransmit — the sender re-sent after losing the ack,
+    # and the copy lands long after the original (possibly after the
+    # commit went stable-everywhere and was GC'd: the straggler schedules
+    # the GC-straggler guards exist for, and the one that reaches the
+    # PR 7 commit-replay bug when those guards are off)
+    duplicate_delay_ms: int = 0
     from_ms: int = 0
     until_ms: Optional[int] = None
     retransmit: bool = True
@@ -191,6 +198,28 @@ class SlowProcess:
 
 
 @dataclass(frozen=True)
+class ReorderJitter:
+    """Seeded message-reorder nemesis: while active, every scheduled
+    delivery's latency is multiplied by U(0, ``factor``) drawn from the
+    nemesis RNG — the adversity the reference's sim applies globally
+    (runner.rs:192-198, delivery delay x U(0, 10)), promoted from the
+    runner's ad-hoc ``reorder_messages()`` knob to a first-class,
+    windowable member of the fault plan so the chaos fuzzer can compose
+    it with every other nemesis.  ``factor`` below 1 never happens for
+    the whole window (a draw of 0 collapses latency to 0, maximally
+    reordering against in-flight messages)."""
+
+    factor: float = 10.0
+    from_ms: int = 0
+    until_ms: Optional[int] = None
+
+    def active(self, now: int) -> bool:
+        return now >= self.from_ms and (
+            self.until_ms is None or now < self.until_ms
+        )
+
+
+@dataclass(frozen=True)
 class FaultPlan:
     """Declarative, immutable fault schedule (builder-style constructors).
 
@@ -206,6 +235,15 @@ class FaultPlan:
     crashes: Tuple[Crash, ...] = ()
     pauses: Tuple[Pause, ...] = ()
     slow_processes: Tuple[SlowProcess, ...] = ()
+    reorder: Optional[ReorderJitter] = None
+    # failure-detector model: when set, every crash-FOREVER is announced
+    # to all live processes ``detector_delay_ms`` after the crash via
+    # ``Protocol.on_peer_down`` — the sim analog of the run layer's
+    # silence-based heartbeat detector (run/process_runner.py).  FPaxos
+    # needs it to route accept rounds around a dead write-quorum member;
+    # the leaderless protocols' hook is a no-op.  None (the default)
+    # keeps the detector-less legacy model and byte-identical old traces
+    detector_delay_ms: Optional[int] = None
     # base RTO for the collapsed retransmission sequence
     retransmit_base_ms: int = 25
     # bounded wait: virtual-time budget before a stalled run raises
@@ -260,8 +298,65 @@ class FaultPlan:
         part = Partition(tuple(tuple(g) for g in groups), start_ms, heal_ms)
         return dataclasses.replace(self, partitions=self.partitions + (part,))
 
+    def with_reorder(
+        self,
+        factor: float = 10.0,
+        from_ms: int = 0,
+        until_ms: Optional[int] = None,
+    ) -> "FaultPlan":
+        """Seeded delivery-reorder jitter (see :class:`ReorderJitter`)."""
+        assert factor > 0
+        return dataclasses.replace(
+            self, reorder=ReorderJitter(factor, from_ms, until_ms)
+        )
+
     def crashed_ids(self) -> Tuple[int, ...]:
         return tuple(sorted({c.process_id for c in self.crashes}))
+
+    # --- repro serialization (sim/fuzz.py artifacts) ---
+
+    def to_dict(self) -> dict:
+        """JSON-safe representation; round-trips via :meth:`from_dict`
+        (the fuzzer's repro artifacts serialize plans this way)."""
+        out = dataclasses.asdict(self)
+        # asdict turns nested dataclasses into dicts but leaves tuples;
+        # JSON round-trips tuples as lists, so from_dict re-tuples
+        return out
+
+    @staticmethod
+    def from_dict(data: dict) -> "FaultPlan":
+        return FaultPlan(
+            seed=data.get("seed", 0),
+            link_faults=tuple(
+                LinkFault(**{**f, "msg_types": (
+                    tuple(f["msg_types"]) if f.get("msg_types") else None
+                )})
+                for f in data.get("link_faults", ())
+            ),
+            partitions=tuple(
+                Partition(
+                    tuple(tuple(g) for g in p["groups"]),
+                    p["start_ms"],
+                    p.get("heal_ms"),
+                )
+                for p in data.get("partitions", ())
+            ),
+            crashes=tuple(
+                Crash(**c) for c in data.get("crashes", ())
+            ),
+            pauses=tuple(Pause(**p) for p in data.get("pauses", ())),
+            slow_processes=tuple(
+                SlowProcess(**s) for s in data.get("slow_processes", ())
+            ),
+            reorder=(
+                ReorderJitter(**data["reorder"])
+                if data.get("reorder") is not None
+                else None
+            ),
+            detector_delay_ms=data.get("detector_delay_ms"),
+            retransmit_base_ms=data.get("retransmit_base_ms", 25),
+            max_sim_time_ms=data.get("max_sim_time_ms"),
+        )
 
 
 @dataclass
@@ -371,6 +466,13 @@ class Nemesis:
             out.append((part.start_ms, NemesisMark("partition", groups)))
             if part.heal_ms is not None:
                 out.append((part.heal_ms, NemesisMark("heal", groups)))
+        reorder = self.plan.reorder
+        if reorder is not None:
+            out.append(
+                (reorder.from_ms, NemesisMark("reorder", f"x{reorder.factor}"))
+            )
+            if reorder.until_ms is not None:
+                out.append((reorder.until_ms, NemesisMark("reorder-end", "")))
         return out
 
     # --- send path ---
@@ -392,6 +494,14 @@ class Nemesis:
         one entry = normal (possibly retransmission-delayed) delivery,
         two entries = delivered + duplicated."""
         src, dst = self._pid(from_key), self._pid(to_key)
+        reorder = self.plan.reorder
+        if reorder is not None and reorder.active(now):
+            # seeded reorder jitter: scale the base latency by U(0, factor)
+            # BEFORE any fault branch, so deferred/retransmitted deliveries
+            # compound on the reordered latency like real adversity would
+            base_delay_ms = int(
+                base_delay_ms * self.rng.uniform(0.0, reorder.factor)
+            )
         label = f"{from_key[0]}{from_key[1]}->{to_key[0]}{to_key[1]} {type(msg).__name__}"
         if dst is not None and self.is_dead(dst, now):
             restart = self.restart_pending(dst, now)
@@ -436,46 +546,60 @@ class Nemesis:
                         extra += self.rng.randint(0, slow.jitter_ms)
                     delay += extra
                     break
-        fault = next(
-            (f for f in self.plan.link_faults if f.matches(now, src, dst, msg)), None
-        )
-        if fault is None:
+        # EVERY matching fault composes (drop-with-retransmit delays,
+        # extra delays, then duplication).  First-match-only semantics —
+        # the original behavior — silently disabled a plan's targeted
+        # dup/delay faults whenever a catch-all loss fault preceded them,
+        # which is exactly how fuzzed schedules compose them
+        matching = [
+            f for f in self.plan.link_faults if f.matches(now, src, dst, msg)
+        ]
+        if not matching:
             return [delay]
-        if fault.drop and self.rng.random() < fault.drop:
-            if not fault.retransmit:
-                self.record(now, "drop", label)
-                return []
-            # collapse the geometric retry sequence (exponential backoff,
-            # full jitter, capped) into one deterministic extra delay
-            rto = self.plan.retransmit_base_ms
-            extra = 0
-            attempts = 1
-            while attempts < _MAX_RETRANSMITS:
-                extra += rto + self.rng.randint(0, rto)
-                rto = min(rto * 2, 8 * self.plan.retransmit_base_ms)
-                attempts += 1
-                if self.rng.random() >= fault.drop:
-                    break
-            delay += extra
-            self.record(now, "retransmit", f"{label} x{attempts} +{extra}ms")
-        if fault.extra_delay_ms:
-            jitter = self.rng.randint(0, fault.extra_delay_ms)
-            delay += jitter
-            if jitter:
-                self.record(now, "delay", f"{label} +{jitter}ms")
+        for fault in matching:
+            if fault.drop and self.rng.random() < fault.drop:
+                if not fault.retransmit:
+                    self.record(now, "drop", label)
+                    return []
+                # collapse the geometric retry sequence (exponential
+                # backoff, full jitter, capped) into one deterministic
+                # extra delay
+                rto = self.plan.retransmit_base_ms
+                extra = 0
+                attempts = 1
+                while attempts < _MAX_RETRANSMITS:
+                    extra += rto + self.rng.randint(0, rto)
+                    rto = min(rto * 2, 8 * self.plan.retransmit_base_ms)
+                    attempts += 1
+                    if self.rng.random() >= fault.drop:
+                        break
+                delay += extra
+                self.record(now, "retransmit", f"{label} x{attempts} +{extra}ms")
+            if fault.extra_delay_ms:
+                jitter = self.rng.randint(0, fault.extra_delay_ms)
+                delay += jitter
+                if jitter:
+                    self.record(now, "delay", f"{label} +{jitter}ms")
         delays = [delay]
         # duplication only applies between processes: client channels carry
         # submit/result frames the client layer does not dedup (the run
-        # layer's seq-numbered peer links are the real-world analog)
-        if (
-            fault.duplicate
-            and src is not None
-            and dst is not None
-            and self.rng.random() < fault.duplicate
-        ):
-            dup = delay + self.rng.randint(1, max(1, self.plan.retransmit_base_ms))
-            delays.append(dup)
-            self.record(now, "duplicate", f"{label} +{dup}ms")
+        # layer's seq-numbered peer links are the real-world analog).  At
+        # most one duplicate copy is produced (the first fault to roll it)
+        for fault in matching:
+            if (
+                fault.duplicate
+                and src is not None
+                and dst is not None
+                and self.rng.random() < fault.duplicate
+            ):
+                dup = delay + self.rng.randint(
+                    1,
+                    max(1, self.plan.retransmit_base_ms)
+                    + fault.duplicate_delay_ms,
+                )
+                delays.append(dup)
+                self.record(now, "duplicate", f"{label} +{dup}ms")
+                break
         return delays
 
     # --- delivery path ---

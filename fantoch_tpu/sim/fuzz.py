@@ -1,0 +1,610 @@
+"""Chaos fuzzing over the deterministic simulator: randomized fault
+schedules, whole-system consistency auditing, and repro shrinking.
+
+PRs 2/3/7/8 built every fault mechanism individually — link nemeses,
+per-dot recovery, crash-restart + rejoin, overload shedding — but nothing
+exercised their *cross-product*, and the chaos rows assert completion, not
+safety.  The reference leans on stateright + quickcheck for that
+assurance; our exhaustive checker (mc/checker.py) is capped at n=3/f=1 and
+cannot reach WAL/overload/SlowProcess interleavings.  This module is the
+scalable replacement: a seeded :class:`FaultPlanFuzzer` samples schedules
+composing ALL existing nemeses (drop/dup/delay, partition+heal,
+crash-forever, crash-restart, pause, slow-process, reorder jitter,
+open-loop Poisson load) across protocol x n/f x conflict-rate configs,
+drives the deterministic sim, and audits every run with the
+:class:`~fantoch_tpu.core.audit.ConsistencyAuditor` — per-key write-order
+agreement, exactly-once execution, committed-then-lost, commit-value
+(timestamp/deps/slot) agreement.
+
+Determinism contract: a :class:`FuzzCase` is a pure value; running it
+twice yields byte-identical fault traces, monitors, and verdict digests
+(``same seed => same plan => same trace => same verdict``), so every
+finding is replayable from its JSON repro artifact
+(``python -m fantoch_tpu.bin.fuzz repro <file>``).
+
+When a case fails, :func:`shrink_case` minimizes it: greedy event removal
+over the plan's components to a fixpoint (removing any remaining nemesis
+makes the failure vanish), numeric halving of the workload, and time
+bisection of the surviving fault windows — the quickcheck-shrinking idiom
+ported to whole-system schedules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.errors import (
+    QuorumLostError,
+    SimStalledError,
+    StalledExecutionError,
+)
+from fantoch_tpu.sim.faults import FaultPlan
+
+# verdicts
+OK = "ok"
+VIOLATION = "violation"
+STALL = "stall"
+INCOMPLETE = "incomplete"
+
+REPRO_FORMAT = "fantoch-fuzz-repro-v1"
+
+# the reference's own TODO flags Caesar's no-GC shortcut unsafe
+# (caesar.rs:840-842); we run it with the wait condition + mandatory GC,
+# but any violation the fuzzer finds in that region is FILED, not skipped
+CAESAR_ISSUE = (
+    "caesar wait-condition region (protocol/caesar.py:169 _handle_mpropose "
+    "blocking): the reference's own TODO (caesar.rs:840-842) flags the "
+    "commit-time key-clock removal unsafe; our port requires "
+    "executed-everywhere GC instead, and this artifact is a fuzzer-found "
+    "counterexample in that region — file it as an issue rather than "
+    "silently skipping the protocol."
+)
+
+
+@dataclass(frozen=True)
+class ProtocolSpec:
+    """How the fuzzer may exercise one protocol."""
+
+    name: str
+    # crash nemeses allowed?  Requires a recovery story: per-dot recovery
+    # (EPaxos/Atlas/Newt), leader failover (FPaxos).  Caesar has neither
+    # (the reference's todo!()), so its configs compose every *non-crash*
+    # nemesis instead — the wait-condition region still gets pauses,
+    # partitions, reorder, and loss
+    crash_ok: bool
+    # (n, f) pool the sampler draws from
+    nf_pool: Tuple[Tuple[int, int], ...]
+    # crash-RESTART allowed?  The sim's crash-restart model drops peer
+    # traffic while the process is down; FPaxos has no MSync catch-up for
+    # slots chosen in that window (its SlotExecutor then waits forever on
+    # the hole), so sim restarts are out of its model — the run layer
+    # covers FPaxos restarts via the links' unacked resend windows
+    restart_ok: bool = True
+
+
+PROTOCOL_SPECS: Dict[str, ProtocolSpec] = {
+    "epaxos": ProtocolSpec("epaxos", True, ((3, 1), (5, 1), (5, 2))),
+    "atlas": ProtocolSpec("atlas", True, ((3, 1), (5, 1), (5, 2))),
+    "newt": ProtocolSpec("newt", True, ((3, 1), (5, 1), (5, 2))),
+    "fpaxos": ProtocolSpec(
+        "fpaxos", True, ((3, 1), (5, 1), (5, 2)), restart_ok=False
+    ),
+    "caesar": ProtocolSpec("caesar", False, ((3, 1), (5, 1))),
+}
+
+
+def _protocol_cls(name: str):
+    from fantoch_tpu import protocol as protocols
+
+    return {
+        "epaxos": protocols.EPaxos,
+        "atlas": protocols.Atlas,
+        "newt": protocols.Newt,
+        "fpaxos": protocols.FPaxos,
+        "caesar": protocols.Caesar,
+    }[name]
+
+
+@dataclass(frozen=True)
+class FuzzCase:
+    """One replayable fuzz input: protocol + scale + workload + plan.
+    A pure value — :func:`run_case` on the same case is byte-identical."""
+
+    protocol: str
+    n: int
+    f: int
+    plan: FaultPlan
+    sim_seed: int = 0
+    conflict_rate: int = 50
+    keys_per_command: int = 2
+    commands_per_client: int = 6
+    clients_per_process: int = 2
+    open_loop_rate_per_s: Optional[float] = None
+    extra_sim_time_ms: int = 2000
+
+    def to_dict(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["plan"] = self.plan.to_dict()
+        return out
+
+    @staticmethod
+    def from_dict(data: dict) -> "FuzzCase":
+        data = dict(data)
+        data["plan"] = FaultPlan.from_dict(data["plan"])
+        return FuzzCase(**data)
+
+    def digest(self) -> str:
+        blob = json.dumps(self.to_dict(), sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+
+@dataclass
+class FuzzResult:
+    """Verdict of one case run.  ``verdict_digest`` covers the verdict,
+    the violations, and the committed/executed histories — the
+    byte-identity anchor repro replay asserts against."""
+
+    case: FuzzCase
+    verdict: str
+    violations: List[str] = field(default_factory=list)
+    error: Optional[str] = None
+    plan_digest: str = ""
+    trace_digest: str = ""
+    verdict_digest: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.verdict == OK
+
+
+class FaultPlanFuzzer:
+    """Seeded sampler of fuzz cases.  ``case(index)`` is a pure function
+    of (fuzzer seed, index): the per-case RNG is seeded with the string
+    ``"{seed}:{index}"`` (string seeding is hash-randomization-free), so
+    a sweep is reproducible from (seed, index range) alone."""
+
+    # virtual-time horizon fault events are sampled inside
+    HORIZON_MS = 1500
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def case(self, index: int, protocol: Optional[str] = None) -> FuzzCase:
+        rng = random.Random(f"{self.seed}:{index}")
+        name = protocol or rng.choice(sorted(PROTOCOL_SPECS))
+        spec = PROTOCOL_SPECS[name]
+        n, f = rng.choice(spec.nf_pool)
+        conflict_rate = rng.choice((20, 50, 100))
+        keys_per_command = 1 if conflict_rate == 100 else rng.choice((1, 2))
+        plan = self._sample_plan(rng, n, f, spec.crash_ok, spec.restart_ok)
+        open_loop = None
+        if rng.random() < 0.25:
+            # open-loop Poisson arrivals (the overload plane's sim
+            # instrument): load keeps arriving regardless of completions
+            open_loop = float(rng.choice((20, 50, 100)))
+        return FuzzCase(
+            protocol=name,
+            n=n,
+            f=f,
+            plan=plan,
+            sim_seed=rng.randrange(1 << 30),
+            conflict_rate=conflict_rate,
+            keys_per_command=keys_per_command,
+            commands_per_client=rng.choice((4, 6, 8)),
+            clients_per_process=2,
+            open_loop_rate_per_s=open_loop,
+        )
+
+    def _sample_plan(
+        self,
+        rng: random.Random,
+        n: int,
+        f: int,
+        crash_ok: bool,
+        restart_ok: bool = True,
+    ) -> FaultPlan:
+        horizon = self.HORIZON_MS
+        plan = FaultPlan(seed=rng.randrange(1 << 30), max_sim_time_ms=600_000)
+        if rng.random() < 0.6:
+            plan = plan.with_loss(round(rng.uniform(0.05, 0.3), 2))
+        if rng.random() < 0.4:
+            kwargs = {}
+            if rng.random() < 0.5:
+                kwargs["msg_types"] = rng.choice(
+                    (("MCollect",), ("MCommit",), ("MCollect", "MCommit"))
+                )
+            if rng.random() < 0.5:
+                # LATE duplicates: the copy lands long after the original
+                # — past GC, where only the straggler guards keep it from
+                # resurrecting pruned state (the PR 7 bug's trigger)
+                kwargs["duplicate_delay_ms"] = rng.randrange(300, 900)
+            plan = plan.with_link_fault(
+                duplicate=round(rng.uniform(0.1, 0.3), 2), **kwargs
+            )
+        if rng.random() < 0.4:
+            plan = plan.with_link_fault(extra_delay_ms=rng.randrange(10, 60))
+        if rng.random() < 0.4:
+            plan = plan.with_reorder(
+                factor=round(rng.uniform(2.0, 8.0), 1),
+                from_ms=rng.randrange(0, 200),
+            )
+        if rng.random() < 0.3:
+            # symmetric cut between a minority group and the rest; always
+            # heals (an unhealed partition is indistinguishable from > f
+            # crashes — a liveness non-goal)
+            cut = rng.sample(range(1, n + 1), max(1, n // 2 - 1))
+            rest = [p for p in range(1, n + 1) if p not in cut]
+            start = rng.randrange(100, 600)
+            plan = plan.with_partition(
+                [tuple(cut), tuple(rest)], start_ms=start,
+                heal_ms=start + rng.randrange(100, 400),
+            )
+        if crash_ok and rng.random() < 0.5:
+            # crash plans run with the sim failure detector on: FPaxos
+            # must learn about a dead write-quorum member to reroute its
+            # accept rounds (the run layer's heartbeat detector analog);
+            # the leaderless protocols' hook is a no-op
+            plan = dataclasses.replace(plan, detector_delay_ms=1000)
+            # at most f crashed-at-once: every crash burns tolerance
+            # budget while down; restarts return it, but overlapping
+            # downtime windows must stay within f
+            count = rng.randrange(1, f + 1)
+            victims = rng.sample(range(1, n + 1), count)
+            for victim in victims:
+                at = rng.randrange(100, horizon // 2)
+                restart = None
+                if restart_ok and rng.random() < 0.5:
+                    restart = at + rng.randrange(300, 800)
+                plan = plan.with_crash(victim, at_ms=at, restart_at_ms=restart)
+        if rng.random() < 0.3:
+            victim = rng.randrange(1, n + 1)
+            at = rng.randrange(100, horizon)
+            plan = plan.with_pause(
+                victim, at_ms=at, until_ms=at + rng.randrange(200, 600)
+            )
+        if rng.random() < 0.3:
+            start = rng.randrange(0, horizon // 2)
+            plan = plan.with_slow_process(
+                rng.randrange(1, n + 1),
+                slow_ms=rng.randrange(20, 80),
+                from_ms=start,
+                until_ms=start + rng.randrange(300, 900),
+                jitter_ms=rng.randrange(0, 10),
+            )
+        return plan
+
+
+# --- case execution ---
+
+
+def _fuzz_config(case: FuzzCase) -> Config:
+    """Audit-instrumented config for one case: execution-order monitors +
+    commit logs always on; recovery wired whenever the plan crashes
+    anyone (per-dot consensus for the leaderless protocols, leader
+    failover for FPaxos)."""
+    kwargs = dict(
+        shard_count=1,
+        executor_monitor_execution_order=True,
+        audit_log_commits=True,
+        gc_interval_ms=100,
+        executor_executed_notification_interval_ms=100,
+    )
+    if case.protocol == "newt":
+        kwargs["newt_detached_send_interval_ms"] = 100
+    if case.protocol == "fpaxos":
+        kwargs["leader"] = 1
+    if case.plan.crashes:
+        kwargs["recovery_delay_ms"] = 1000
+        kwargs["executor_monitor_pending_interval_ms"] = 500
+        if case.protocol == "fpaxos":
+            kwargs["fpaxos_leader_timeout_ms"] = 2000
+    return Config(case.n, case.f, **kwargs)
+
+
+def _fuzz_planet(n: int):
+    """Uniform ~10ms planet: every process sits inside live fast quorums,
+    so crashes always bite (the recovery-row topology of
+    tests/test_faults.py, far=0)."""
+    from fantoch_tpu.core.planet import Planet, Region
+
+    regions = [Region(f"r{i}") for i in range(n)]
+    latencies = {}
+    for i, a in enumerate(regions):
+        latencies[a] = {
+            b: (0 if i == j else 10 + abs(i - j))
+            for j, b in enumerate(regions)
+        }
+    return regions, Planet.from_latencies(latencies)
+
+
+def run_case(case: FuzzCase) -> FuzzResult:
+    """Drive one case through the deterministic sim and audit the
+    outcome.  Never raises for in-model failures: typed stalls become
+    ``stall`` verdicts, safety violations (auditor findings OR internal
+    protocol assertions) become ``violation``."""
+    from fantoch_tpu.client import ConflictRateKeyGen, Workload
+    from fantoch_tpu.core.audit import ConsistencyAuditor
+    from fantoch_tpu.sim import Runner
+
+    protocol_cls = _protocol_cls(case.protocol)
+    config = _fuzz_config(case)
+    regions, planet = _fuzz_planet(case.n)
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(case.conflict_rate),
+        keys_per_command=case.keys_per_command,
+        commands_per_client=case.commands_per_client,
+        payload_size=1,
+    )
+    runner = Runner(
+        protocol_cls,
+        planet,
+        config,
+        workload,
+        case.clients_per_process,
+        process_regions=list(regions),
+        client_regions=list(regions),
+        seed=case.sim_seed,
+        fault_plan=case.plan,
+        open_loop_rate_per_s=case.open_loop_rate_per_s,
+    )
+    result = FuzzResult(case, OK, plan_digest=_plan_digest(case.plan))
+    try:
+        _metrics, monitors, _latencies = runner.run(
+            extra_sim_time_ms=case.extra_sim_time_ms
+        )
+    except (SimStalledError, StalledExecutionError, QuorumLostError) as exc:
+        result.verdict = STALL
+        result.error = f"{type(exc).__name__}: {exc}"
+        _finalize_digests(result, runner, committed=None)
+        return result
+    except AssertionError as exc:
+        # an internal safety assertion (e.g. the slot executor's
+        # exactly-once guard, the vote table's collision check) IS a
+        # consistency violation surfaced early
+        result.verdict = VIOLATION
+        result.violations = [f"internal-assertion: {exc}"]
+        result.error = f"AssertionError: {exc}"
+        _finalize_digests(result, runner, committed=None)
+        return result
+
+    crashed_forever = {
+        crash.process_id
+        for crash in case.plan.crashes
+        if crash.restart_at_ms is None
+    }
+    # liveness: every client not attached to a crashed-forever replica
+    # must have finished its whole workload
+    unfinished = []
+    for client_id, client in runner._simulation.clients():
+        if client.targets() & crashed_forever:
+            continue
+        if client.issued_commands != case.commands_per_client:
+            unfinished.append(client_id)
+    if unfinished:
+        result.verdict = INCOMPLETE
+        result.error = f"clients {unfinished} did not finish"
+
+    survivors = {
+        pid: monitor
+        for pid, monitor in monitors.items()
+        if pid not in crashed_forever and monitor is not None
+    }
+    commit_logs = {
+        pid: log
+        for pid, (process, _e, _p) in runner._simulation.processes()
+        if pid not in crashed_forever
+        and (log := process.audit_commit_log()) is not None
+    }
+    verdict = ConsistencyAuditor().audit(survivors, commit_logs)
+    if not verdict.ok:
+        result.verdict = VIOLATION
+        result.violations = [str(v) for v in verdict.violations]
+    _finalize_digests(result, runner, committed=survivors)
+    return result
+
+
+def _plan_digest(plan: FaultPlan) -> str:
+    blob = json.dumps(plan.to_dict(), sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _finalize_digests(result: FuzzResult, runner, committed) -> None:
+    result.trace_digest = (
+        runner.nemesis.trace_digest() if runner.nemesis is not None else ""
+    )
+    digest = hashlib.sha256()
+    digest.update(result.verdict.encode())
+    digest.update(result.trace_digest.encode())
+    for violation in result.violations:
+        digest.update(violation.encode())
+    if result.error:
+        digest.update(result.error.encode())
+    if committed:
+        for pid, monitor in sorted(committed.items()):
+            digest.update(f"p{pid}:{monitor!r}".encode())
+    result.verdict_digest = digest.hexdigest()
+
+
+# --- shrinking ---
+
+
+def shrink_case(
+    case: FuzzCase,
+    still_fails: Optional[Callable[[FuzzCase], bool]] = None,
+    max_runs: int = 150,
+) -> Tuple[FuzzCase, int]:
+    """Minimize a failing case: greedy removal of whole fault components
+    to a fixpoint (after which removing ANY remaining nemesis makes the
+    failure vanish — the minimality the self-test asserts), numeric
+    halving of the workload, then time bisection of the surviving
+    windows.  ``still_fails`` defaults to "run_case reports a violation";
+    tests inject synthetic predicates to check the shrinker itself.
+    Returns (shrunk case, verification runs spent)."""
+    if still_fails is None:
+        still_fails = lambda c: run_case(c).verdict == VIOLATION  # noqa: E731
+    runs = 0
+
+    def attempt(candidate: FuzzCase) -> bool:
+        nonlocal runs
+        if runs >= max_runs:
+            return False
+        runs += 1
+        return still_fails(candidate)
+
+    assert attempt(case), "shrink_case requires a failing case"
+
+    component_fields = (
+        "link_faults", "partitions", "crashes", "pauses", "slow_processes",
+    )
+    changed = True
+    while changed and runs < max_runs:
+        changed = False
+        # pass 1: drop whole components
+        for field_name in component_fields:
+            index = 0
+            while index < len(getattr(case.plan, field_name)):
+                items = getattr(case.plan, field_name)
+                candidate = dataclasses.replace(
+                    case,
+                    plan=dataclasses.replace(
+                        case.plan,
+                        **{field_name: items[:index] + items[index + 1:]},
+                    ),
+                )
+                if attempt(candidate):
+                    case = candidate
+                    changed = True
+                else:
+                    index += 1
+        if case.plan.reorder is not None:
+            candidate = dataclasses.replace(
+                case, plan=dataclasses.replace(case.plan, reorder=None)
+            )
+            if attempt(candidate):
+                case = candidate
+                changed = True
+        if case.open_loop_rate_per_s is not None:
+            candidate = dataclasses.replace(case, open_loop_rate_per_s=None)
+            if attempt(candidate):
+                case = candidate
+                changed = True
+        # pass 2: halve the workload toward 1
+        for attr in ("commands_per_client", "clients_per_process", "keys_per_command"):
+            while getattr(case, attr) > 1 and runs < max_runs:
+                candidate = dataclasses.replace(
+                    case, **{attr: getattr(case, attr) // 2}
+                )
+                if attempt(candidate):
+                    case = candidate
+                    changed = True
+                else:
+                    break
+    # pass 3: time bisection over the surviving fault windows (bounded:
+    # each window halves at most ~log2(horizon) times)
+    case = _bisect_windows(case, attempt)
+    return case, runs
+
+
+def _bisect_windows(case: FuzzCase, attempt) -> FuzzCase:
+    def try_replace(field_name, index, **changes):
+        nonlocal case
+        items = list(getattr(case.plan, field_name))
+        items[index] = dataclasses.replace(items[index], **changes)
+        candidate = dataclasses.replace(
+            case,
+            plan=dataclasses.replace(case.plan, **{field_name: tuple(items)}),
+        )
+        if attempt(candidate):
+            case = candidate
+            return True
+        return False
+
+    for index in range(len(case.plan.crashes)):
+        while True:
+            crash = case.plan.crashes[index]
+            if crash.at_ms > 100 and try_replace(
+                "crashes", index,
+                at_ms=crash.at_ms // 2,
+                restart_at_ms=(
+                    None if crash.restart_at_ms is None
+                    else crash.restart_at_ms - (crash.at_ms - crash.at_ms // 2)
+                ),
+            ):
+                continue
+            break
+    for index in range(len(case.plan.pauses)):
+        while True:
+            pause = case.plan.pauses[index]
+            span = pause.until_ms - pause.at_ms
+            if span > 100 and try_replace(
+                "pauses", index, until_ms=pause.at_ms + span // 2
+            ):
+                continue
+            break
+    for index in range(len(case.plan.partitions)):
+        while True:
+            part = case.plan.partitions[index]
+            if part.heal_ms is None:
+                break
+            span = part.heal_ms - part.start_ms
+            if span > 100 and try_replace(
+                "partitions", index, heal_ms=part.start_ms + span // 2
+            ):
+                continue
+            break
+    return case
+
+
+# --- repro artifacts ---
+
+
+def repro_artifact(
+    result: FuzzResult, shrink_runs: int = 0, issue: Optional[str] = None
+) -> dict:
+    """The JSON repro artifact for a finding.  Caesar findings carry the
+    wait-condition issue text (the reference's own TODO region) so the
+    violation is *filed*, never silently skipped."""
+    if issue is None and result.case.protocol == "caesar":
+        issue = CAESAR_ISSUE
+    return {
+        "format": REPRO_FORMAT,
+        "case": result.case.to_dict(),
+        "verdict": result.verdict,
+        "violations": result.violations,
+        "error": result.error,
+        "plan_digest": result.plan_digest,
+        "trace_digest": result.trace_digest,
+        "verdict_digest": result.verdict_digest,
+        "shrink_runs": shrink_runs,
+        "issue": issue,
+    }
+
+
+def write_repro(path: str, artifact: dict) -> None:
+    with open(path, "w") as fh:
+        json.dump(artifact, fh, sort_keys=True, indent=2)
+        fh.write("\n")
+
+
+def load_repro(path: str) -> dict:
+    with open(path) as fh:
+        artifact = json.load(fh)
+    assert artifact.get("format") == REPRO_FORMAT, (
+        f"not a fuzz repro artifact: {path}"
+    )
+    return artifact
+
+
+def replay_repro(artifact: dict) -> Tuple[FuzzResult, bool]:
+    """Re-run an artifact's case; returns (result, byte-identical) where
+    byte-identical means the verdict digest matches the recorded one —
+    same plan, same trace, same violations, same histories."""
+    result = run_case(FuzzCase.from_dict(artifact["case"]))
+    return result, result.verdict_digest == artifact["verdict_digest"]

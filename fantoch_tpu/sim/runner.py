@@ -80,6 +80,17 @@ class OpenLoopArrival:
 
 
 @dataclass
+class PeerDownNotification:
+    """Failure-detector tick (FaultPlan.detector_delay_ms): announce a
+    crashed-forever process to every live protocol via
+    ``Protocol.on_peer_down`` — the sim analog of the run layer's
+    heartbeat detector (FPaxos reroutes accept rounds around dead
+    write-quorum members on it; leaderless protocols no-op)."""
+
+    dead: ProcessId
+
+
+@dataclass
 class PeriodicExecutorWatchdog:
     """Bounded-wait liveness check: under a fault plan, every executor's
     ``monitor_pending`` runs on this tick so a command stuck on
@@ -294,6 +305,8 @@ class Runner:
                 self._handle_send_to_proc(action.from_, action.from_shard_id, action.to, action.msg)
             elif isinstance(action, OpenLoopArrival):
                 self._handle_open_loop_arrival(action.client_id)
+            elif isinstance(action, PeerDownNotification):
+                self._handle_peer_down_notification(action.dead)
             elif isinstance(action, SendToClient):
                 if action.client_id not in self._active_clients:
                     continue  # abandoned (attached to a crashed process)
@@ -336,6 +349,8 @@ class Runner:
         if isinstance(action, NemesisMark):
             self._handle_nemesis_mark(action, now)
             return None
+        if isinstance(action, PeerDownNotification):
+            return action  # fans out to every live process itself
         process_id = None
         periodic = False
         if isinstance(
@@ -402,6 +417,14 @@ class Runner:
                 )
                 self._nemesis.record(now, "durable-image", mark.detail)
                 return
+            # failure-detector model: announce the crash-forever to the
+            # survivors after the detection delay (FaultPlan knob)
+            if self._nemesis.plan.detector_delay_ms is not None:
+                self._schedule.schedule(
+                    self._simulation.time,
+                    self._nemesis.plan.detector_delay_ms,
+                    PeerDownNotification(mark.process_id),
+                )
             # abandon clients attached to the dead process: their commands
             # can no longer complete, so the loop must not wait for them
             doomed = {
@@ -434,6 +457,19 @@ class Runner:
         self._send_to_processes_and_executors(process_id)
 
     # --- handlers ---
+
+    def _handle_peer_down_notification(self, dead: ProcessId) -> None:
+        self._nemesis.record(
+            self._simulation.time.millis(), "detect-down", f"p{dead}"
+        )
+        for pid in sorted(self._process_to_region):
+            if pid == dead or self._nemesis.is_dead(
+                pid, self._simulation.time.millis()
+            ):
+                continue
+            process, _, _ = self._simulation.get_process(pid)
+            process.on_peer_down(dead, self._simulation.time)
+            self._send_to_processes_and_executors(pid)
 
     def _handle_periodic_process_event(self, ev: PeriodicProcessEvent) -> None:
         process, _, _ = self._simulation.get_process(ev.process_id)

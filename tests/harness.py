@@ -75,33 +75,22 @@ def sim_test(
 
 
 def check_monitors(monitors: Dict) -> None:
+    """Cross-replica safety via the shared invariant engine
+    (core/audit.ConsistencyAuditor): per-key write-order agreement
+    (reads commute — the KeyDeps read/write split leaves read-read order
+    unforced), executed-multiset agreement, key-set agreement, and
+    exactly-once execution.  One engine for every sim test AND the chaos
+    fuzzer, so an invariant tightened once protects both."""
+    from fantoch_tpu.core.audit import ConsistencyAuditor
+
     monitors = dict(monitors)
     assert monitors, "there should be monitors"
-    items = list(monitors.items())
-    pid_a, monitor_a = items[0]
-    assert monitor_a is not None, "processes should be monitoring execution order"
-    for pid_b, monitor_b in items[1:]:
-        assert monitor_b is not None
-        assert len(monitor_a) == len(monitor_b), (
-            f"p{pid_a} and p{pid_b} monitors have different key counts"
+    for pid, monitor in monitors.items():
+        assert monitor is not None, (
+            f"p{pid} should be monitoring execution order"
         )
-        for key in monitor_a.keys():
-            # full-order agreement for writes; reads commute (the KeyDeps
-            # read/write split leaves read-read order unforced), so they
-            # only need to execute everywhere — counts checked below
-            order_a = monitor_a.get_write_order(key)
-            order_b = monitor_b.get_write_order(key)
-            assert order_a == order_b, (
-                f"different write execution orders on key {key!r}:\n"
-                f"  p{pid_a}: {order_a}\n  p{pid_b}: {order_b}"
-            )
-            from collections import Counter
-
-            full_a = monitor_a.get_order(key)
-            full_b = monitor_b.get_order(key)
-            assert Counter(full_a) == Counter(full_b), (
-                f"different executed-command multisets on key {key!r}"
-            )
+    verdict = ConsistencyAuditor().audit(monitors)
+    assert verdict.ok, verdict.describe()
 
 
 def check_metrics(

@@ -1,0 +1,380 @@
+"""Consistency-audit plane: auditor verdicts on hand-built histories,
+execution-digest chains, device-plane digest parity, and run-layer
+divergence detection over TCP (a deliberately forked replica surfaces a
+typed DivergenceError naming the first diverging key + command).
+"""
+
+import asyncio
+
+import pytest
+
+from fantoch_tpu.client import ConflictRateKeyGen, Workload
+from fantoch_tpu.core import Config
+from fantoch_tpu.core.audit import (
+    COMMIT_DIVERGENCE,
+    COMMITTED_LOST,
+    DUPLICATE_EXECUTION,
+    KEYSET_DIVERGENCE,
+    MULTISET_DIVERGENCE,
+    ORDER_DIVERGENCE,
+    ConsistencyAuditor,
+    DigestEntry,
+    ExecutionDigest,
+)
+from fantoch_tpu.core.ids import Dot, Rifl
+from fantoch_tpu.core.kvs import KVOp, KVStore
+from fantoch_tpu.errors import DivergenceError
+from fantoch_tpu.executor.monitor import ExecutionOrderMonitor
+
+pytestmark = pytest.mark.fuzz
+
+
+def _monitor(orders, reads=()):
+    """Build an ExecutionOrderMonitor from {key: [rifl, ...]}."""
+    monitor = ExecutionOrderMonitor()
+    for key, rifls in orders.items():
+        for rifl in rifls:
+            monitor.add(key, rifl, read=(key, rifl) in reads)
+    return monitor
+
+
+R = [Rifl(1, i) for i in range(10)]
+
+
+# --- auditor verdicts on hand-built histories ---
+
+
+def test_audit_clean():
+    monitors = {
+        1: _monitor({"k": [R[1], R[2], R[3]]}),
+        2: _monitor({"k": [R[1], R[2], R[3]]}),
+    }
+    verdict = ConsistencyAuditor().audit(monitors)
+    assert verdict.ok
+    assert verdict.counterexample() is None
+
+
+def test_audit_order_divergence_names_first_position():
+    monitors = {
+        1: _monitor({"k": [R[1], R[2], R[3]]}),
+        2: _monitor({"k": [R[1], R[3], R[2]]}),
+    }
+    verdict = ConsistencyAuditor().audit(monitors)
+    assert not verdict.ok
+    violation = next(
+        v for v in verdict.violations if v.kind == ORDER_DIVERGENCE
+    )
+    # minimal counterexample: first diverging position + the two rifls
+    assert violation.key == "k"
+    assert violation.entries == (1, R[2], R[3])
+    assert violation.pids == (1, 2)
+
+
+def test_audit_reads_commute():
+    """Read-order differences are NOT violations (reads commute)."""
+    monitors = {
+        1: _monitor({"k": [R[1], R[2], R[3]]}, reads={("k", R[2]), ("k", R[3])}),
+        2: _monitor({"k": [R[1], R[3], R[2]]}, reads={("k", R[2]), ("k", R[3])}),
+    }
+    assert ConsistencyAuditor().audit(monitors).ok
+
+
+def test_audit_duplicate_execution():
+    monitors = {
+        1: _monitor({"k": [R[1], R[2], R[2]]}),
+        2: _monitor({"k": [R[1], R[2], R[2]]}),
+    }
+    verdict = ConsistencyAuditor().audit(monitors)
+    kinds = {v.kind for v in verdict.violations}
+    assert DUPLICATE_EXECUTION in kinds
+    # disabling the multiplicity assumption drops the absolute check
+    verdict = ConsistencyAuditor(expected_ops_per_key=None).audit(monitors)
+    assert verdict.ok
+
+
+def test_audit_multiset_vs_committed_then_lost():
+    """A rifl executed at one replica but missing at another is plain
+    multiset divergence — unless the missing replica's own commit log
+    proves it committed the command, which upgrades it to
+    committed-then-lost."""
+    monitors = {
+        1: _monitor({"k": [R[1], R[2]]}),
+        2: _monitor({"k": [R[1]]}),
+    }
+    verdict = ConsistencyAuditor().audit(monitors)
+    kinds = {v.kind for v in verdict.violations}
+    assert MULTISET_DIVERGENCE in kinds and COMMITTED_LOST not in kinds
+
+    logs = {
+        1: {Dot(1, 1): (R[1], 5), Dot(1, 2): (R[2], 7)},
+        2: {Dot(1, 1): (R[1], 5), Dot(1, 2): (R[2], 7)},  # p2 committed R2!
+    }
+    verdict = ConsistencyAuditor().audit(monitors, logs)
+    lost = [v for v in verdict.violations if v.kind == COMMITTED_LOST]
+    assert lost and lost[0].entries == (R[2],)
+
+
+def test_audit_keyset_divergence():
+    monitors = {
+        1: _monitor({"k": [R[1]], "extra": [R[2]]}),
+        2: _monitor({"k": [R[1]]}),
+    }
+    verdict = ConsistencyAuditor().audit(monitors)
+    assert any(
+        v.kind == KEYSET_DIVERGENCE and v.key == "extra"
+        for v in verdict.violations
+    )
+
+
+def test_audit_commit_value_divergence():
+    """Same ident (dot / slot), different agreed value — Newt timestamp,
+    graph deps, and FPaxos slot->command agreement as one check."""
+    monitors = {1: _monitor({"k": [R[1]]}), 2: _monitor({"k": [R[1]]})}
+    logs = {
+        1: {Dot(1, 1): (R[1], 5)},
+        2: {Dot(1, 1): (R[1], 9)},  # same dot, different clock
+    }
+    verdict = ConsistencyAuditor().audit(monitors, logs)
+    diverged = [v for v in verdict.violations if v.kind == COMMIT_DIVERGENCE]
+    assert diverged and diverged[0].entries[0] == Dot(1, 1)
+    # noop records (rifl None) participate in agreement too
+    logs = {1: {Dot(1, 1): (None, 0)}, 2: {Dot(1, 1): (None, 0)}}
+    assert ConsistencyAuditor().audit(monitors, logs).ok
+
+
+# --- execution digests ---
+
+
+def test_digest_chains_writes_only_and_deterministically():
+    a, b = KVStore(execution_digests=True), KVStore(execution_digests=True)
+    for store in (a, b):
+        store.execute("k", KVOp.put("x"), R[1])
+        store.execute("k", KVOp.get(), R[2])  # read: not chained
+        store.execute("k", KVOp.put("y"), R[3])
+    assert a.digest.summary() == b.digest.summary()
+    entries = a.digest.entries("k")
+    assert [(e.src, e.seq) for e in entries] == [(1, 1), (1, 3)]
+    assert a.digest.summary()["k"][0] == 2
+
+
+def test_digest_prefix_verification_and_first_divergence():
+    ahead, behind, forked = (ExecutionDigest() for _ in range(3))
+    for digest, values in (
+        (ahead, ["a", "b", "c"]),
+        (behind, ["a", "b"]),
+        (forked, ["a", "X", "c"]),
+    ):
+        for index, value in enumerate(values):
+            digest.record("k", Rifl(1, index + 1), "Put", value)
+    # the replica that is at least as far along verifies the whole prefix
+    assert ahead.mismatched_keys(behind.summary()) == []
+    # a behind replica cannot check an ahead summary (skip, not report)
+    assert behind.mismatched_keys(ahead.summary()) == []
+    # a fork is visible to anyone who reaches its count
+    assert ahead.mismatched_keys(forked.summary()) == ["k"]
+    position, mine, theirs = ExecutionDigest.first_divergence(
+        ahead.entries("k"), forked.entries("k")
+    )
+    assert position == 1
+    assert (mine.src, mine.seq) == (1, 2) and (theirs.src, theirs.seq) == (1, 2)
+    # identical chains (or a clean prefix) have no divergence
+    assert ExecutionDigest.first_divergence(
+        ahead.entries("k"), behind.entries("k")
+    ) is None
+
+
+def test_digest_summary_merge_disjoint_executors():
+    a, b = ExecutionDigest(), ExecutionDigest()
+    a.record("k1", R[1], "Put", "x")
+    b.record("k2", R[2], "Put", "y")
+    merged = {}
+    a.merge_summary_into(merged)
+    b.merge_summary_into(merged)
+    assert set(merged) == {"k1", "k2"}
+
+
+# --- device-table-plane digest parity ---
+
+
+def test_device_plane_digest_parity():
+    """The device table plane executes stable rows through the same
+    KVStore seam, so its per-key digest chains are bit-for-bit the host
+    path's — the guard that a device-resident executor can still be
+    cross-audited (the run layer exchanges these digests over TCP).
+    Runs on every jax pin (the plane itself is pin-safe; only the
+    drivers' scan tracing is guarded, see make test-device-stripped)."""
+    import random
+
+    from fantoch_tpu.core.timing import RunTime
+    from fantoch_tpu.executor.table import TableExecutor, TableVotes
+    from fantoch_tpu.protocol.common.table_clocks import VoteRange
+
+    def build(plane):
+        return TableExecutor(
+            1, 0,
+            Config(
+                3, 1,
+                batched_table_executor=plane,
+                device_table_plane=plane,
+                execution_digests=True,
+            ),
+        )
+
+    rng = random.Random(7)
+    time = RunTime()
+    host, device = build(False), build(True)
+    clock = 0
+    infos = []
+    for index in range(40):
+        clock += rng.randrange(1, 3)
+        key = rng.choice(("a", "b"))
+        key_votes = [
+            VoteRange(by, 1 if index == 0 else clock - 1, clock)
+            for by in (1, 2, 3)
+        ]
+        infos.append(
+            TableVotes(
+                Dot(1, index + 1), clock, Rifl(9, index + 1), key,
+                (KVOp.put(f"v{index}"),), key_votes,
+            )
+        )
+    for executor in (host, device):
+        executor.handle_batch(list(infos), time)
+        list(executor.to_clients_iter())
+    assert host.digest() is not None and device.digest() is not None
+    assert host.digest().summary() == device.digest().summary()
+    for key in ("a", "b"):
+        assert host.digest().entries(key) == device.digest().entries(key)
+
+
+# --- run-layer divergence detection over TCP ---
+
+
+def test_tcp_forked_replica_raises_divergence_error():
+    """A replica that executes a write nobody agreed on (the fork) is
+    detected by the digest exchange on the heartbeat path: a typed
+    DivergenceError naming the key and the first diverging command
+    surfaces through the runtime failure seam, and the digest gauges
+    show the mismatch."""
+    from fantoch_tpu.protocol import EPaxos
+    from fantoch_tpu.run.harness import run_localhost_cluster
+
+    config = Config(
+        n=3, f=1,
+        gc_interval_ms=50,
+        executor_executed_notification_interval_ms=50,
+        execution_digests=True,
+        audit_log_commits=True,
+    )
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(100),
+        keys_per_command=1,
+        commands_per_client=200,
+        payload_size=1,
+    )
+    captured = {}
+
+    async def fork_one_replica(runtimes):
+        captured.update(runtimes)
+        # wait for real executions, then fork p2: execute a rogue write
+        # the mesh never agreed on.  Peers catch up past the fork point
+        # on the hot key and the next heartbeat summary mismatches.
+        target = runtimes[2]
+        for _ in range(200):
+            summary = target._digest_summary()
+            if summary and summary.get("CONFLICT", (0, ""))[0] >= 3:
+                break
+            await asyncio.sleep(0.02)
+        else:
+            raise AssertionError("no executions to fork")
+        target.executors[0]._store.execute(
+            "CONFLICT", KVOp.put("forked"), Rifl(999, 1)
+        )
+
+    async def drive():
+        await run_localhost_cluster(
+            EPaxos,
+            config,
+            workload,
+            clients_per_process=2,
+            open_loop_interval_ms=10,
+            runtime_kwargs=dict(
+                heartbeat_interval_s=0.05, heartbeat_misses=200
+            ),
+            chaos=fork_one_replica,
+        )
+
+    with pytest.raises(AssertionError, match="failed mid-run"):
+        asyncio.run(drive())
+
+    failures = [
+        runtime.failure
+        for runtime in captured.values()
+        if runtime.failure is not None
+    ]
+    diverged = [f for f in failures if isinstance(f, DivergenceError)]
+    assert diverged, f"expected a DivergenceError, got {failures!r}"
+    error = diverged[0]
+    assert error.key == "CONFLICT"
+    assert error.position >= 3
+    assert error.mine is not None and error.theirs is not None
+    assert Rifl(999, 1) in (error.mine, error.theirs)
+    assert "divergence" in str(error)
+    # the gauges surface the mismatch (metrics snapshots + obs summarize)
+    detector = next(
+        runtime
+        for runtime in captured.values()
+        if isinstance(runtime.failure, DivergenceError)
+    )
+    counters = detector.overload_counters()
+    assert counters["digest_mismatches"] >= 1
+    assert counters["digest_checks"] >= 1
+    assert counters["digest_keys"] >= 1
+
+
+def test_tcp_healthy_cluster_digests_stay_clean():
+    """Digest exchange on a healthy cluster: checks happen, zero
+    mismatches, workload completes."""
+    from fantoch_tpu.protocol import Newt
+    from fantoch_tpu.run.harness import run_localhost_cluster
+
+    config = Config(
+        n=3, f=1,
+        gc_interval_ms=50,
+        executor_executed_notification_interval_ms=50,
+        newt_detached_send_interval_ms=50,
+        execution_digests=True,
+    )
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=2,
+        commands_per_client=10,
+        payload_size=1,
+    )
+    captured = {}
+
+    async def capture(runtimes):
+        captured.update(runtimes)
+
+    async def drive():
+        return await run_localhost_cluster(
+            Newt,
+            config,
+            workload,
+            clients_per_process=2,
+            extra_run_time_ms=400,
+            runtime_kwargs=dict(
+                heartbeat_interval_s=0.05, heartbeat_misses=200
+            ),
+            chaos=capture,
+        )
+
+    runtimes, clients = asyncio.run(drive())
+    for client in clients.values():
+        assert client.issued_commands == 10
+    checks = sum(r.digest_checks for r in runtimes.values())
+    mismatches = sum(r.digest_mismatches for r in runtimes.values())
+    assert checks > 0, "heartbeats should have cross-audited digests"
+    assert mismatches == 0

@@ -1,0 +1,257 @@
+"""Chaos fuzzer: same-seed determinism, shrinker minimality, repro
+artifacts, the per-protocol clean rows (FPaxos and Caesar included), and
+the mutation self-test — the PR 7 GC-straggler commit-replay bug is
+reintroduced under its private flag and must be caught by the fuzzer
+within the smoke budget, shrunk, and replayed byte-identically.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from fantoch_tpu.sim.faults import FaultPlan
+from fantoch_tpu.sim.fuzz import (
+    CAESAR_ISSUE,
+    OK,
+    PROTOCOL_SPECS,
+    VIOLATION,
+    FaultPlanFuzzer,
+    FuzzCase,
+    FuzzResult,
+    load_repro,
+    replay_repro,
+    repro_artifact,
+    run_case,
+    shrink_case,
+    write_repro,
+)
+
+pytestmark = pytest.mark.fuzz
+
+# the smoke seed set (scripts/fuzz_smoke.py uses the same): fuzzer seed 0,
+# the first SMOKE_CASES indices forced per protocol
+SMOKE_SEED = 0
+SMOKE_CASES = 6
+
+
+# --- determinism: same seed => byte-identical plan, trace, verdict ---
+
+
+def test_same_seed_case_and_run_identical():
+    fuzzer = FaultPlanFuzzer(seed=3)
+    case_a, case_b = fuzzer.case(1), fuzzer.case(1)
+    assert case_a == case_b
+    assert case_a.digest() == case_b.digest()
+    result_a, result_b = run_case(case_a), run_case(case_b)
+    assert result_a.verdict == result_b.verdict
+    assert result_a.plan_digest == result_b.plan_digest
+    assert result_a.trace_digest == result_b.trace_digest
+    assert result_a.verdict_digest == result_b.verdict_digest
+    # non-vacuous: the plan injected something and the digests are real
+    assert result_a.trace_digest and result_a.plan_digest
+
+
+def test_case_json_roundtrip_replays_identically():
+    fuzzer = FaultPlanFuzzer(seed=5)
+    case = fuzzer.case(2)
+    blob = json.dumps(case.to_dict(), sort_keys=True)
+    restored = FuzzCase.from_dict(json.loads(blob))
+    assert restored == case
+    assert run_case(restored).verdict_digest == run_case(case).verdict_digest
+
+
+def test_different_seeds_differ():
+    a = FaultPlanFuzzer(seed=0).case(0)
+    b = FaultPlanFuzzer(seed=1).case(0)
+    assert a.digest() != b.digest()
+
+
+# --- the smoke rows: every protocol gets composed nemeses and stays
+# auditor-clean (FPaxos and Caesar included — the satellite closing the
+# EPaxos/Atlas/Newt-only chaos coverage) ---
+
+
+@pytest.mark.parametrize("protocol", sorted(PROTOCOL_SPECS))
+def test_protocol_smoke_rows_auditor_clean(protocol):
+    fuzzer = FaultPlanFuzzer(seed=SMOKE_SEED)
+    verdicts = []
+    for index in range(3):
+        case = fuzzer.case(index, protocol=protocol)
+        result = run_case(case)
+        assert result.verdict == OK, (
+            f"{protocol} case {index}: {result.verdict} "
+            f"{result.violations or result.error}"
+        )
+        verdicts.append(result.verdict)
+    assert verdicts.count(OK) >= 1
+
+
+def test_caesar_wait_condition_targeted_config():
+    """Caesar's wait-condition region (the reference's own unsafe-TODO
+    area) under its targeted stress: max conflict + reorder + pause —
+    the nemeses that reorder MPropose/MRetry around the blocking check.
+    A violation here would be FILED via the repro artifact's issue text,
+    never silently skipped."""
+    fuzzer = FaultPlanFuzzer(seed=SMOKE_SEED)
+    base = fuzzer.case(1, protocol="caesar")
+    case = dataclasses.replace(
+        base,
+        conflict_rate=100,
+        keys_per_command=1,
+        plan=base.plan.with_reorder(6.0).with_pause(
+            1, at_ms=200, until_ms=700
+        ),
+    )
+    result = run_case(case)
+    if result.verdict == VIOLATION:
+        artifact = repro_artifact(result)
+        assert artifact["issue"] == CAESAR_ISSUE
+        pytest.fail(
+            f"caesar wait-condition violation (file the artifact): "
+            f"{result.violations}"
+        )
+    assert result.verdict == OK, result.error
+
+
+def test_caesar_violation_artifact_carries_issue_text():
+    """Any Caesar finding is filed, not skipped: the artifact's issue
+    field names the wait-condition region."""
+    case = FaultPlanFuzzer(seed=0).case(0, protocol="caesar")
+    fake = FuzzResult(case, VIOLATION, violations=["[order-divergence] x"])
+    assert repro_artifact(fake)["issue"] == CAESAR_ISSUE
+    other = dataclasses.replace(case, protocol="newt")
+    assert repro_artifact(FuzzResult(other, VIOLATION))["issue"] is None
+
+
+# --- reorder nemesis (FaultPlan.with_reorder) ---
+
+
+def test_reorder_nemesis_seeded_and_trace_visible():
+    fuzzer = FaultPlanFuzzer(seed=SMOKE_SEED)
+    base = fuzzer.case(0, protocol="epaxos")
+    plain = dataclasses.replace(base, plan=dataclasses.replace(base.plan, reorder=None))
+    reordered = dataclasses.replace(
+        base, plan=plain.plan.with_reorder(factor=9.0)
+    )
+    result_plain = run_case(plain)
+    result_a, result_b = run_case(reordered), run_case(reordered)
+    # same seed + reorder => byte-identical; reorder on vs off => different
+    assert result_a.trace_digest == result_b.trace_digest
+    assert result_a.verdict_digest == result_b.verdict_digest
+    assert result_a.trace_digest != result_plain.trace_digest
+    assert result_a.verdict == OK
+
+
+# --- shrinker ---
+
+
+def test_shrinker_minimality_synthetic():
+    """Greedy removal reaches a fixpoint where every remaining component
+    is load-bearing: the synthetic failure needs the crash AND a loss
+    fault; everything else must be stripped."""
+    plan = (
+        FaultPlan(seed=9, max_sim_time_ms=10_000)
+        .with_loss(0.2)
+        .with_link_fault(duplicate=0.2)
+        .with_link_fault(extra_delay_ms=30)
+        .with_crash(2, at_ms=400)
+        .with_pause(3, at_ms=100, until_ms=600)
+        .with_slow_process(1, 40, until_ms=500)
+        .with_partition([(1,), (2, 3)], start_ms=100, heal_ms=900)
+        .with_reorder(4.0)
+    )
+    case = FuzzCase(
+        protocol="epaxos", n=3, f=1, plan=plan,
+        commands_per_client=8, open_loop_rate_per_s=50.0,
+    )
+
+    def fails(candidate: FuzzCase) -> bool:
+        has_crash = any(
+            c.process_id == 2 for c in candidate.plan.crashes
+        )
+        has_loss = any(f.drop > 0 for f in candidate.plan.link_faults)
+        return has_crash and has_loss
+
+    shrunk, runs = shrink_case(case, still_fails=fails)
+    assert fails(shrunk)
+    assert len(shrunk.plan.crashes) == 1
+    assert len(shrunk.plan.link_faults) == 1
+    assert shrunk.plan.link_faults[0].drop > 0
+    assert not shrunk.plan.pauses
+    assert not shrunk.plan.partitions
+    assert not shrunk.plan.slow_processes
+    assert shrunk.plan.reorder is None
+    assert shrunk.open_loop_rate_per_s is None
+    # numeric halving reached the floor
+    assert shrunk.commands_per_client == 1
+    # minimality: removing EITHER remaining component kills the failure
+    no_crash = dataclasses.replace(
+        shrunk, plan=dataclasses.replace(shrunk.plan, crashes=())
+    )
+    no_loss = dataclasses.replace(
+        shrunk, plan=dataclasses.replace(shrunk.plan, link_faults=())
+    )
+    assert not fails(no_crash) and not fails(no_loss)
+    assert runs > 0
+
+
+def test_shrinker_requires_failing_case():
+    case = FaultPlanFuzzer(seed=0).case(0)
+    with pytest.raises(AssertionError, match="failing case"):
+        shrink_case(case, still_fails=lambda _c: False)
+
+
+# --- the mutation self-test: the PR 7 GC-straggler bug, reintroduced ---
+
+
+def test_mutation_gc_straggler_bug_caught_and_shrunk(tmp_path):
+    """Disable Newt's GC-straggler guards (the historical commit-replay
+    bug, reintroduced under its private flag): the fuzzer must catch it
+    within the smoke budget, the shrinker must minimize it, the repro
+    artifact must replay byte-identically under the mutation, and the
+    SAME case must run clean with the guard restored — proving the
+    instrument detects real historical violations, not just synthetic
+    ones."""
+    import fantoch_tpu.protocol.newt as newt_module
+
+    fuzzer = FaultPlanFuzzer(seed=SMOKE_SEED)
+    newt_module._set_gc_straggler_guard(False)
+    try:
+        finding = None
+        for index in range(SMOKE_CASES):
+            case = fuzzer.case(index, protocol="newt")
+            result = run_case(case)
+            if result.verdict == VIOLATION:
+                finding = (index, case, result)
+                break
+        assert finding is not None, (
+            "the reintroduced GC-straggler bug escaped the smoke budget"
+        )
+        index, case, result = finding
+        shrunk, runs = shrink_case(case, max_runs=60)
+        shrunk_result = run_case(shrunk)
+        assert shrunk_result.verdict == VIOLATION
+        artifact = repro_artifact(shrunk_result, shrink_runs=runs)
+        path = str(tmp_path / "gc-straggler-repro.json")
+        write_repro(path, artifact)
+        replayed, identical = replay_repro(load_repro(path))
+        assert replayed.verdict == VIOLATION
+        assert identical, "repro replay must be byte-identical"
+    finally:
+        newt_module._set_gc_straggler_guard(True)
+    # guard restored: the exact shrunk schedule is clean again
+    healthy = run_case(shrunk)
+    assert healthy.verdict == OK, (
+        f"guard on, still failing: {healthy.violations or healthy.error}"
+    )
+
+
+def test_repro_artifact_roundtrip_on_clean_case(tmp_path):
+    case = FaultPlanFuzzer(seed=SMOKE_SEED).case(0, protocol="atlas")
+    result = run_case(case)
+    artifact = repro_artifact(result)
+    path = str(tmp_path / "clean.json")
+    write_repro(path, artifact)
+    replayed, identical = replay_repro(load_repro(path))
+    assert identical and replayed.verdict == result.verdict
