@@ -1,7 +1,7 @@
 # Test/bench entry points (the reference pins quality with Makefile:3-7 —
 # fmt + clippy + `cargo test` under a quickcheck budget; here the suite +
 # dryrun + bench are the equivalent gates).
-.PHONY: test test-fast test-chaos test-recovery test-restart test-overload test-fuzz test-device-stripped dryrun bench bench-smoke trace-smoke overload-smoke fuzz-smoke
+.PHONY: test test-fast test-chaos test-recovery test-restart test-overload test-fuzz test-device-stripped dryrun bench bench-smoke trace-smoke overload-smoke fuzz-smoke telemetry-smoke
 
 test:
 	python -m pytest tests/ -x -q
@@ -74,6 +74,15 @@ trace-smoke:
 # baseline — the per-push CI slice runs this next to bench/trace-smoke
 overload-smoke:
 	python scripts/overload_smoke.py
+
+# live-telemetry gate: localhost EPaxos cluster with the /metrics
+# exposition endpoints live — scrape twice mid-run (well-formed, required
+# key set, monotonic counters), windowed series files parse, `obs watch`
+# renders, and the perf-regression gate trips on an injected 2x latency
+# (plus a report-only `bench.py --regress` over the smoke row when
+# bench-smoke left one behind) — the per-push CI slice runs this
+telemetry-smoke:
+	python scripts/telemetry_smoke.py
 
 # chaos-fuzz gate: seeded fault-schedule sweep with composed nemeses
 # over EVERY protocol (fixed seed set), auditor-clean + byte-identical

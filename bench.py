@@ -1416,6 +1416,198 @@ def bench_overload(
     return out
 
 
+# --- perf-regression gate (bench.py --regress) ---
+#
+# Compare a fresh bench row against the BENCH trajectory with per-key
+# tolerance bands, so a perf regression fails CI instead of being
+# discovered by the next human reading BENCH_DEV.md.  Keys are
+# classified by direction (throughput keys must not fall, latency keys
+# must not grow); keys whose family carries a `*_definition` stamp are
+# REFUSED (skipped + reported, never ratioed) when the stamps differ —
+# the r06/r07 redefinitions made cross-definition ratios a category
+# error — and records from different platforms refuse wholesale.
+
+# tolerance bands: (key prefix, allowed degradation ratio); first match
+# wins, "" is the default.  Noisy families (host scheduling, shared-CI
+# latency-under-load) get wider bands; the default 1.5x is tight enough
+# that an injected 2x latency regression trips the gate.
+REGRESS_BANDS = (
+    ("pool_", 3.0),
+    ("overload_", 3.0),
+    ("general_fallback_", 2.5),
+    ("", 1.5),
+)
+
+# families whose definition changed across rounds carry a stamp; both
+# records must agree on it before any key of the family is compared
+DEFINITION_STAMPS = (
+    ("serving_", "serving_newt_definition"),
+    ("table_", "table_arrays_definition"),
+    ("overload_", "overload_definition"),
+)
+
+
+def _regress_direction(key: str):
+    """"higher" = throughput-like (must not fall), "lower" =
+    latency-like (must not grow), None = not a perf key (counts,
+    fractions, configuration — informational only)."""
+    if "cmds_per_s" in key or "goodput" in key:
+        return "higher"
+    if key.endswith(("_ms", "_p50", "_p95", "_p99")) or "_ms_" in key:
+        return "lower"
+    return None
+
+
+def load_bench_record(path: str) -> dict:
+    """Load a bench row: a raw JSON record, BENCH_TPU_LATEST.json, or a
+    driver-written BENCH_r0N.json wrapper (``{"parsed": record, ...}``;
+    some rounds nest the wrapper).  The headline ``value`` is re-keyed
+    under its ``metric`` name so it participates like any other key."""
+    with open(path) as fh:
+        rec = json.load(fh)
+    for _ in range(5):
+        if isinstance(rec, dict) and "metric" in rec:
+            break
+        inner = rec.get("parsed") if isinstance(rec, dict) else None
+        if not isinstance(inner, dict):
+            break
+        rec = inner
+    if not isinstance(rec, dict) or "metric" not in rec:
+        raise ValueError(f"{path} holds no usable bench record")
+    if isinstance(rec.get("value"), (int, float)):
+        rec = dict(rec)
+        rec[rec["metric"]] = rec["value"]
+    return rec
+
+
+def regress_check(new: dict, old: dict, bands=REGRESS_BANDS) -> dict:
+    """One gate evaluation: ``{"compared", "violations", "refused"}``
+    (each a list of per-key tuples/messages)."""
+    refused = []
+    violations = []
+    compared = []
+    if new.get("platform") != old.get("platform"):
+        refused.append((
+            "*",
+            f"platform mismatch: {old.get('platform')!r} vs "
+            f"{new.get('platform')!r} — cross-platform ratios are "
+            "meaningless; rerun on the same rig",
+        ))
+        return {"compared": compared, "violations": violations,
+                "refused": refused}
+    for key in sorted(set(new) & set(old)):
+        new_v, old_v = new[key], old[key]
+        if (
+            not isinstance(new_v, (int, float))
+            or not isinstance(old_v, (int, float))
+            or isinstance(new_v, bool)
+            or isinstance(old_v, bool)
+        ):
+            continue
+        direction = _regress_direction(key)
+        if direction is None or old_v <= 0:
+            continue
+        stamp = next(
+            (s for prefix, s in DEFINITION_STAMPS if key.startswith(prefix)),
+            None,
+        )
+        if stamp is not None and new.get(stamp) != old.get(stamp):
+            refused.append((
+                key,
+                f"{stamp} mismatch: {old.get(stamp)!r} vs "
+                f"{new.get(stamp)!r} — the family was redefined; "
+                "see BENCH_DEV.md",
+            ))
+            continue
+        band = next(b for prefix, b in bands if key.startswith(prefix))
+        ratio = new_v / old_v
+        row = (key, old_v, new_v, round(ratio, 3), band, direction)
+        compared.append(row)
+        if (direction == "lower" and ratio > band) or (
+            direction == "higher" and ratio < 1.0 / band
+        ):
+            violations.append(row)
+    return {"compared": compared, "violations": violations,
+            "refused": refused}
+
+
+def _default_against(new: dict) -> Tuple[str, dict]:
+    """The most recent usable trajectory record matching the fresh row's
+    platform: BENCH_r0N.json descending, then BENCH_TPU_LATEST.json."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = sorted(
+        glob.glob(os.path.join(here, "BENCH_r*.json")), reverse=True
+    ) + [os.path.join(here, "BENCH_TPU_LATEST.json")]
+    fallback = None
+    for path in candidates:
+        if not os.path.exists(path):
+            continue
+        try:
+            rec = load_bench_record(path)
+        except (ValueError, json.JSONDecodeError):
+            continue
+        if rec.get("platform") == new.get("platform"):
+            return path, rec
+        if fallback is None:
+            fallback = (path, rec)
+    if fallback is None:
+        raise SystemExit("--regress: no usable trajectory record found; "
+                         "pass --against explicitly")
+    return fallback
+
+
+def cmd_regress(argv) -> int:
+    """``bench.py --regress NEW.json [--against OLD.json] [--gate]``:
+    report (default) or gate (exit 1 on violation) a fresh row against
+    the trajectory."""
+    args = list(argv)
+    gate = "--gate" in args
+    if gate:
+        args.remove("--gate")
+    against = None
+    if "--against" in args:
+        index = args.index("--against")
+        against = args[index + 1]
+        del args[index:index + 2]
+    index = args.index("--regress")
+    new_path = args[index + 1]
+    new = load_bench_record(new_path)
+    if against is None:
+        against, old = _default_against(new)
+    else:
+        old = load_bench_record(against)
+    result = regress_check(new, old)
+    print(f"# regress: {new_path} vs {against} "
+          f"({'gate' if gate else 'report-only'})")
+    for key, reason in result["refused"]:
+        print(f"REFUSED {key}: {reason}")
+    for key, old_v, new_v, ratio, band, direction in result["compared"]:
+        verdict = "ok"
+        if (key, old_v, new_v, ratio, band, direction) in result["violations"]:
+            verdict = f"REGRESSION (band {band}x, {direction}-is-better)"
+        print(f"{key}: {old_v} -> {new_v} (x{ratio}) {verdict}")
+    print(
+        f"# {len(result['compared'])} compared, "
+        f"{len(result['violations'])} violation(s), "
+        f"{len(result['refused'])} refused"
+    )
+    if gate and result["violations"]:
+        return 1
+    return 0
+
+
+# where `--smoke` persists its row, so CI can run the regression gate
+# (report-only) over the smoke seams right after measuring them
+_SMOKE_ROW_PATH = os.environ.get(
+    "FANTOCH_SMOKE_ROW",
+    os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_SMOKE_LATEST.json"
+    ),
+)
+
+
 def smoke_main() -> None:
     """CI bench-smoke (``make bench-smoke``): tiny CPU-sized table +
     serving rows, in-process — catches import breaks and
@@ -1457,6 +1649,15 @@ def smoke_main() -> None:
     ), out
     assert 0.0 <= out["serving_newt_idle_frac"] <= 1.0, out
     assert 0.0 <= out["serving_newt_sync_idle_frac"] <= 1.0, out
+    # persist the row for the telemetry smoke's report-only regression
+    # pass (bench.py --regress BENCH_SMOKE_LATEST.json); bookkeeping
+    # must never fail the smoke itself
+    try:
+        with open(_SMOKE_ROW_PATH, "w") as fh:
+            json.dump(out, fh)
+            fh.write("\n")
+    except OSError as exc:
+        print(f"# could not persist smoke row: {exc!r}", file=sys.stderr)
     print(json.dumps(out))
 
 
@@ -1504,6 +1705,8 @@ def compare_records(path_a: str, path_b: str) -> int:
 
 
 def main() -> None:
+    if "--regress" in sys.argv[1:]:
+        sys.exit(cmd_regress(sys.argv))
     if "--compare" in sys.argv[1:]:
         index = sys.argv.index("--compare")
         compare_records(sys.argv[index + 1], sys.argv[index + 2])

@@ -62,6 +62,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="defaults to the number of --addresses entries")
     parser.add_argument("--metrics-file", default=None,
                         help="pickle the per-client latency data here")
+    parser.add_argument("--telemetry-file", default=None,
+                        help="client-plane windowed telemetry series "
+                        "(observability/timeseries.py): submit/reply "
+                        "rates, retry/shed tallies, latency windows")
+    parser.add_argument("--telemetry-interval", type=int, default=None,
+                        metavar="MS", help="telemetry window cadence "
+                        "(default 1000)")
     parser.add_argument("--status-frequency", type=int, default=None)
     parser.add_argument("--log-file", default=None)
     return parser
@@ -108,6 +115,8 @@ async def drive(args: argparse.Namespace) -> None:
         arrival_seed=args.arrival_seed,
         deadline_ms=args.deadline,
         status_frequency=args.status_frequency,
+        telemetry_file=args.telemetry_file,
+        telemetry_interval_ms=args.telemetry_interval,
     )
     elapsed_s = time.perf_counter() - t0
 

@@ -102,6 +102,13 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
                         help="cap on a peer link's unacked resend window "
                         "(run/links.py): past it the link is declared lost "
                         "via the typed path; default 32768, 0 = uncapped")
+    parser.add_argument("--telemetry-interval", type=int, default=None,
+                        metavar="MS",
+                        help="live-telemetry window cadence "
+                        "(observability/timeseries.py): one knob for the "
+                        "windowed series emit AND the legacy metrics "
+                        "snapshot; default = the runtime's "
+                        "--metrics-interval (run) or 1000ms (sim)")
     parser.add_argument("--execution-digests", action="store_true",
                         help="consistency-audit plane (core/audit.py): "
                         "per-key hash chains over executed writes, "
@@ -143,6 +150,7 @@ def config_from_args(args: argparse.Namespace):
         link_unacked_cap=args.link_unacked_cap,
         execution_digests=args.execution_digests,
         audit_log_commits=args.audit_commits,
+        telemetry_interval_ms=args.telemetry_interval,
     )
 
 

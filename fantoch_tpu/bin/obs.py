@@ -1,7 +1,9 @@
-"""Trace tooling CLI: summarize / convert / diff span logs.
+"""Observability CLI: summarize / convert / diff span logs, watch and
+scrape live telemetry.
 
 The lifecycle tracing plane (fantoch_tpu/observability) writes JSONL
-span logs; this CLI turns them into answers:
+span logs and the telemetry plane windowed series; this CLI turns them
+into answers:
 
     # per-stage latency breakdown (p50/p95/p99 per segment, end-to-end)
     python -m fantoch_tpu.bin.obs summarize trace.jsonl [more.jsonl ...]
@@ -12,17 +14,27 @@ span logs; this CLI turns them into answers:
     # structural diff of two traces (same-seed sim runs must be empty)
     python -m fantoch_tpu.bin.obs diff a.jsonl b.jsonl
 
+    # live terminal view of a cluster's telemetry (series files, an obs
+    # dir, or /metrics endpoints; --once renders a single frame)
+    python -m fantoch_tpu.bin.obs watch obs_dir/ 127.0.0.1:9090
+
+    # one exposition scrape (raw Prometheus text, or parsed --json)
+    python -m fantoch_tpu.bin.obs scrape 127.0.0.1:9090 --json
+
 ``summarize`` accepts several logs at once (a localhost cluster writes
 one per process plus the client plane) and assembles spans across them.
 No reference counterpart: fantoch's metrics_logger/tracer only ship
-aggregates; this is the per-command attribution layer on top.
+aggregates; this is the per-command attribution + live-telemetry layer
+on top.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 from typing import Any, Dict, List
 
 
@@ -39,6 +51,14 @@ def cmd_summarize(args) -> int:
     from fantoch_tpu.observability.report import summarize
 
     out = summarize(_load(args.trace))
+    counters = out.get("device_counters")
+    if counters and "device_busy_ms" in counters:
+        # derived overlap metrics ride the machine-readable payload too,
+        # so --json consumers get exactly what the human lines print
+        # (CI smokes key on this instead of regex-scraping the text)
+        from fantoch_tpu.observability.device import derive_idle_frac
+
+        out["device_counters"] = counters = derive_idle_frac(dict(counters))
     if args.json:
         print(json.dumps(out, sort_keys=True))
         return 0
@@ -139,6 +159,150 @@ def _print_overlap(counters) -> int:
     return 0
 
 
+def _scrape_url(target: str, timeout: float = 5.0) -> str:
+    """Fetch one exposition endpoint.  ``host:port`` expands to
+    ``http://host:port/metrics``."""
+    import urllib.request
+
+    url = target
+    if "://" not in url:
+        url = f"http://{url}"
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def cmd_scrape(args) -> int:
+    """One scrape per target: raw Prometheus text, or parsed ``--json``
+    (``{metric: {"label=value,...": value}}``) for scripts."""
+    from fantoch_tpu.observability.exposition import parse_prometheus
+
+    out: Dict[str, Any] = {}
+    for target in args.target:
+        text = _scrape_url(target)
+        if not args.json:
+            print(text, end="")
+            continue
+        parsed = parse_prometheus(text)
+        out[target] = {
+            name: {
+                ",".join(f"{k}={v}" for k, v in labels): value
+                for labels, value in samples.items()
+            }
+            for name, samples in parsed.items()
+        }
+    if args.json:
+        print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+def _watch_sources(targets: List[str]) -> Dict[str, Dict[str, Any]]:
+    """Latest telemetry window per source across every target: series
+    files, obs directories (globbing ``telemetry_*.jsonl``), or live
+    ``/metrics`` endpoints (parsed back into a window-shaped row)."""
+    import glob
+
+    from fantoch_tpu.observability.exposition import parse_prometheus
+    from fantoch_tpu.observability.timeseries import latest_windows, read_series
+
+    latest: Dict[str, Dict[str, Any]] = {}
+    for target in targets:
+        if os.path.isdir(target):
+            paths = sorted(glob.glob(os.path.join(target, "telemetry_*.jsonl")))
+        elif os.path.exists(target):
+            paths = [target]
+        else:
+            # an endpoint: synthesize one window row from the live
+            # sample.  A failed scrape (server restarting, typo'd path
+            # falling through to the URL branch) degrades to an error
+            # row — the live view must keep rendering, not die with a
+            # traceback mid-watch
+            try:
+                parsed = parse_prometheus(_scrape_url(target))
+            except Exception as exc:  # noqa: BLE001 — any scrape failure degrades
+                latest[target] = {"src": target, "ctr": {}, "g": {},
+                                  "rate": {}, "h": {}, "t": 0, "seq": -1,
+                                  "err": str(exc)}
+                continue
+            ctr: Dict[str, float] = {}
+            gauges: Dict[str, float] = {}
+            for name, samples in parsed.items():
+                value = next(iter(samples.values()))
+                if name.startswith("fantoch_") and name.endswith("_total"):
+                    ctr[name[len("fantoch_"):-len("_total")]] = value
+                elif name.startswith("fantoch_") and not name.endswith(
+                    ("_bucket", "_sum", "_count")
+                ):
+                    gauges[name[len("fantoch_"):]] = value
+            latest[target] = {"src": target, "ctr": ctr, "g": gauges,
+                              "rate": {}, "h": {}, "t": 0, "seq": -1}
+            continue
+        for path in paths:
+            for src, window in latest_windows(read_series(path)).items():
+                # several files may carry the same source name (one
+                # client plane per pool): fall back to the file stem
+                key = (
+                    src
+                    if src not in latest
+                    else os.path.splitext(os.path.basename(path))[0]
+                )
+                latest[key] = window
+    return latest
+
+
+def _render_watch(latest: Dict[str, Dict[str, Any]]) -> str:
+    """One table frame: per source, submit/reply rates, the client or
+    end-to-end latency window, queue depth, sheds, device idle."""
+    lines = [
+        f"{'source':<12}{'submit/s':>10}{'reply/s':>10}{'p50ms':>8}"
+        f"{'p95ms':>8}{'p99ms':>8}{'queue':>7}{'sheds':>7}{'idle':>6}"
+    ]
+    for src in sorted(latest):
+        window = latest[src]
+        rate = window.get("rate", {})
+        ctr = window.get("ctr", {})
+        gauges = window.get("g", {})
+        hist = window.get("h", {}).get("latency_ms")
+
+        def _num(value, fmt="{:.1f}"):
+            return "-" if value is None else fmt.format(value)
+
+        lines.append(
+            f"{src:<12}"
+            f"{_num(rate.get('submitted')):>10}"
+            f"{_num(rate.get('replied')):>10}"
+            f"{_num(hist and hist.get('p50'), '{:.0f}'):>8}"
+            f"{_num(hist and hist.get('p95'), '{:.0f}'):>8}"
+            f"{_num(hist and hist.get('p99'), '{:.0f}'):>8}"
+            f"{_num(gauges.get('queue_depth'), '{:.0f}'):>7}"
+            f"{_num(ctr.get('shed_submissions'), '{:.0f}'):>7}"
+            f"{_num(gauges.get('device_idle_frac'), '{:.2f}'):>6}"
+        )
+    errors = [
+        f"! {src}: {window['err']}"
+        for src, window in sorted(latest.items())
+        if "err" in window
+    ]
+    return "\n".join(lines + errors)
+
+
+def cmd_watch(args) -> int:
+    """Live terminal view of a cluster's telemetry: re-render the latest
+    window per source every ``--interval`` seconds (``--once`` renders a
+    single frame — the CI spelling)."""
+    while True:
+        latest = _watch_sources(args.target)
+        frame = _render_watch(latest)
+        if args.once:
+            print(frame)
+            return 0 if latest else 1
+        # full-frame repaint (clear + home), like watch(1)
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
 def cmd_to_perfetto(args) -> int:
     from fantoch_tpu.observability.perfetto import write_perfetto
 
@@ -170,6 +334,23 @@ def main(argv=None) -> int:
     p.add_argument("trace", nargs="+", help="JSONL span log(s)")
     p.add_argument("--json", action="store_true", help="machine-readable output")
     p.set_defaults(fn=cmd_summarize)
+
+    p = sub.add_parser("scrape", help="fetch /metrics exposition endpoint(s)")
+    p.add_argument("target", nargs="+",
+                   help="endpoint (host:port or full URL)")
+    p.add_argument("--json", action="store_true",
+                   help="parse the exposition into JSON per target")
+    p.set_defaults(fn=cmd_scrape)
+
+    p = sub.add_parser(
+        "watch", help="live terminal view of telemetry series/endpoints"
+    )
+    p.add_argument("target", nargs="+",
+                   help="series file, obs dir, or endpoint (host:port)")
+    p.add_argument("--interval", type=float, default=1.0, metavar="S")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (CI smoke)")
+    p.set_defaults(fn=cmd_watch)
 
     p = sub.add_parser("to-perfetto", help="convert to trace-event JSON")
     p.add_argument("trace", nargs="+", help="JSONL span log(s)")

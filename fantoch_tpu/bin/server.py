@@ -98,6 +98,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "ProcessMetrics normally, JSON round/path tallies "
                         "under --device-step")
     parser.add_argument("--metrics-interval", type=int, default=5000, metavar="MS")
+    parser.add_argument("--telemetry-file", default=None,
+                        help="live windowed telemetry series "
+                        "(observability/timeseries.py): one JSONL ring of "
+                        "per-window rates + histogram snapshots; `obs "
+                        "watch` renders it live")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="Prometheus-text exposition endpoint "
+                        "(observability/exposition.py): GET /metrics "
+                        "scrapes the live sample, GET /profile?ms=N "
+                        "captures an on-demand jax.profiler device trace "
+                        "next to the telemetry file (SIGUSR2 triggers the "
+                        "same capture); 0 = OS-assigned")
     parser.add_argument(
         "--heartbeat-interval", type=float, default=1.0, metavar="S",
         help="peer failure-detector probe interval (seconds)")
@@ -156,11 +168,19 @@ async def serve_device_step(args: argparse.Namespace) -> None:
         pipeline=None if args.device_pipeline == "auto"
         else args.device_pipeline == "on",
         mesh=mesh,
+        telemetry_file=args.telemetry_file,
+        metrics_port=args.metrics_port,
     )
     await runtime.start()
+    _arm_profile_signal(args)
     print(
         f"p{process_id} (device-step, n={config.n}) serving clients on "
-        f"{args.ip}:{args.client_port}",
+        f"{args.ip}:{args.client_port}"
+        + (
+            f"; /metrics on :{runtime.metrics_port}"
+            if runtime.metrics_port is not None
+            else ""
+        ),
         flush=True,
     )
     try:
@@ -169,8 +189,21 @@ async def serve_device_step(args: argparse.Namespace) -> None:
     finally:
         # runs under task cancellation too (Ctrl-C through asyncio.run):
         # short serves must still leave a final metrics snapshot
-        if runtime.metrics_file is not None:
-            runtime._write_metrics_snapshot()
+        if runtime.metrics_file is not None or runtime.telemetry is not None:
+            runtime._emit_telemetry()
+
+
+def _arm_profile_signal(args: argparse.Namespace) -> None:
+    """SIGUSR2 = capture a 1s jax.profiler device trace next to the
+    telemetry/metrics file (the no-port spelling of ``/profile?ms=N``)."""
+    from fantoch_tpu.observability.exposition import (
+        install_profile_signal,
+        profile_output_dir,
+    )
+
+    install_profile_signal(
+        profile_output_dir(args.telemetry_file, args.metrics_file)
+    )
 
 
 async def serve(args: argparse.Namespace) -> None:
@@ -234,9 +267,20 @@ async def serve(args: argparse.Namespace) -> None:
         heartbeat_misses=args.heartbeat_misses,
         wal_dir=args.wal_dir,
         wal_snapshot_interval_ms=args.wal_snapshot_interval,
+        telemetry_file=args.telemetry_file,
+        metrics_port=args.metrics_port,
     )
     await runtime.start()
-    print(f"p{args.id} ({args.protocol}) up on {args.ip}:{args.port}", flush=True)
+    _arm_profile_signal(args)
+    print(
+        f"p{args.id} ({args.protocol}) up on {args.ip}:{args.port}"
+        + (
+            f"; /metrics on :{runtime.metrics_port}"
+            if runtime.metrics_port is not None
+            else ""
+        ),
+        flush=True,
+    )
     await runtime.failed.wait()
     raise SystemExit(f"p{args.id} failed: {runtime.failure!r}")
 

@@ -43,6 +43,12 @@ class Client:
         # budget — goodput accounting for the latency-under-load plots
         self.overload_retries = 0
         self.shed_commands = 0
+        # live-telemetry seam (observability/timeseries.py): an optional
+        # per-completion callback fed each latency sample (µs) as it is
+        # recorded, so the telemetry writers maintain their cumulative
+        # latency histogram at O(1) per reply instead of re-walking
+        # every recorded sample per window
+        self._latency_observer = None
 
     @property
     def id(self) -> ClientId:
@@ -75,6 +81,8 @@ class Client:
         rifl = rifls.pop()
         latency, end_time = self._pending.end(rifl, time)
         self._data.record(latency, end_time)
+        if self._latency_observer is not None:
+            self._latency_observer(latency)
         if self._status_frequency and self._workload.issued_commands % self._status_frequency == 0:
             logger.info(
                 "c%s: %s of %s",
@@ -97,6 +105,11 @@ class Client:
         """Workload fully generated and nothing in flight (completed or
         shed) — the drivers' shared termination predicate."""
         return self._workload.finished() and self._pending.is_empty()
+
+    def set_latency_observer(self, observer) -> None:
+        """``observer(latency_micros)`` fires on every completion
+        (telemetry's incremental latency fold); None disables."""
+        self._latency_observer = observer
 
     def data(self) -> ClientData:
         return self._data

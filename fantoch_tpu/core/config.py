@@ -162,6 +162,14 @@ class Config:
     # committed-then-lost commands.  Audit/test only: the log grows with
     # the run (like executor_monitor_execution_order)
     audit_log_commits: bool = False
+    # live telemetry plane (observability/timeseries.py): the ONE window
+    # cadence every telemetry writer in a process runs at — the windowed
+    # series emit, the legacy metrics snapshot, and the sim runner's
+    # virtual-time telemetry tick all share it.  None = the runtime's
+    # metrics_interval_ms argument (run layer) or the built-in 1s window
+    # (sim).  Milliseconds, >= 1 (new knob; no reference counterpart —
+    # fantoch_prof only ships post-hoc aggregates)
+    telemetry_interval_ms: Optional[int] = None
     # per-dot lifecycle tracing (fantoch_tpu/observability): fraction of
     # commands traced, selected by a deterministic hash of the command id
     # (same seed => same sampled dot set).  0.0 disables tracing entirely
@@ -211,6 +219,11 @@ class Config:
             raise ValueError(
                 f"link_unacked_cap = {self.link_unacked_cap} must be >= 0 "
                 "(0 = uncapped)"
+            )
+        if self.telemetry_interval_ms is not None and self.telemetry_interval_ms < 1:
+            raise ValueError(
+                f"telemetry_interval_ms = {self.telemetry_interval_ms} "
+                "must be >= 1"
             )
         if self.device_table_plane and self.newt_clock_bump_interval_ms is not None:
             # real-time clock bumps vote wall-clock micros, which overflow

@@ -13,7 +13,8 @@ a results directory::
         metrics_p*.gz        — per-process metrics snapshots (pulled)
         execution_p*.log     — per-process execution logs (pulled)
         server_p*.log        — server stdout/stderr
-        resources.csv        — driver-machine resource samples (dstat)
+        resources.jsonl      — driver-machine resource series (dstat analog,
+                               telemetry-window JSONL schema)
 
 which fantoch_tpu.plot's ResultsDB indexes.  One driver body serves every
 testbed: the testbed object owns addressing, launch transport, and
@@ -103,7 +104,7 @@ def _run_experiment_testbed(
     run_mode: str = "release",
 ) -> Dict:
     from fantoch_tpu.core.ids import process_ids
-    from fantoch_tpu.exp.monitor import ResourceMonitor
+    from fantoch_tpu.exp.monitor import RESOURCES_FILE, ResourceMonitor
 
     exp_dir = os.path.join(output_dir, config.name())
     os.makedirs(exp_dir, exist_ok=True)
@@ -127,7 +128,7 @@ def _run_experiment_testbed(
     servers = []
     logs = []
     # dstat analog: driver-machine resource CSV for the plot layer's tables
-    monitor = ResourceMonitor(os.path.join(exp_dir, "resources.csv"))
+    monitor = ResourceMonitor(os.path.join(exp_dir, RESOURCES_FILE))
     monitor.start()
     try:
         for pid, shard in all_pids:

@@ -5,15 +5,26 @@ into the experiment directory (fantoch_exp/src/bench.rs:22,203-258); the
 plot layer renders them as resource tables (fantoch_plot/src/lib.rs
 dstat tables).  No dstat binary here: sample ``/proc`` directly — cpu
 jiffies from /proc/stat, memory from /proc/meminfo, network byte counts
-from /proc/net/dev — into the same kind of per-interval CSV.
+from /proc/net/dev.
+
+Since the live-telemetry plane landed, host resources are just another
+*series source*: the monitor emits the same windowed JSONL schema
+(observability/timeseries.py, ``src="host"``) every other telemetry
+writer uses — cumulative jiffy/byte counters (the writer rates them per
+window) plus memory gauges — so ``obs watch`` renders host load next to
+a cluster's submit/reply rates, and the bespoke CSV format is gone.
+``load_samples`` still returns the dstat-shaped row dicts the plot layer
+tables (and one release of old ``resources.csv`` files) expect.
 """
 
 from __future__ import annotations
 
 import os
 import threading
-import time
 from typing import Dict, List, Optional
+
+# the experiment artifact name (exp/bench.py writes it per run dir)
+RESOURCES_FILE = "resources.jsonl"
 
 _CSV_HEADER = "epoch_s,cpu_pct,mem_used_mb,mem_total_mb,net_rx_kbps,net_tx_kbps"
 
@@ -55,7 +66,8 @@ def _read_net() -> tuple:
 
 
 class ResourceMonitor:
-    """Samples cpu/mem/net into ``path`` every ``interval_s`` until stopped.
+    """Samples cpu/mem/net into ``path`` (telemetry-series JSONL,
+    ``src="host"``) every ``interval_s`` until stopped.
 
     Thread-based (the experiment driver is synchronous subprocess
     orchestration); sampling reads three procfs files per tick.
@@ -91,45 +103,80 @@ class ResourceMonitor:
         except Exception:  # noqa: BLE001 — sampling is best-effort by design
             # no procfs (non-Linux host) or an unexpected /proc line format:
             # stop sampling quietly rather than killing the daemon thread
-            # with a traceback mid-run.  Samples flushed so far stay on
-            # disk; only write the header when nothing was ever written
-            # (so resource_table always finds a parsable CSV).
-            import os
-
+            # with a traceback mid-run.  Windows flushed so far stay on
+            # disk; ensure an (empty but parsable) file always exists so
+            # resource_table finds one.
             try:
-                if not os.path.exists(self._path) or os.path.getsize(self._path) == 0:
-                    with open(self._path, "w") as fh:
-                        fh.write(_CSV_HEADER + "\n")
+                if not os.path.exists(self._path):
+                    with open(self._path, "w"):
+                        pass
             except OSError:
                 pass
 
     def _run_inner(self) -> None:
-        busy0, total0 = _read_cpu()
-        rx0, tx0 = _read_net()
-        t0 = time.time()
-        with open(self._path, "w") as fh:
-            fh.write(_CSV_HEADER + "\n")
+        from fantoch_tpu.core.timing import RunTime
+        from fantoch_tpu.observability.timeseries import SeriesWriter
+
+        writer = SeriesWriter(
+            self._path,
+            RunTime(),
+            window_ms=max(1, int(self._interval_s * 1000)),
+        )
+        try:
             while not self._stop.wait(self._interval_s):
-                busy1, total1 = _read_cpu()
-                rx1, tx1 = _read_net()
-                t1 = time.time()
-                dt = max(t1 - t0, 1e-6)
-                cpu = 100.0 * (busy1 - busy0) / max(total1 - total0, 1)
+                busy, total = _read_cpu()
+                rx, tx = _read_net()
                 used_mb, total_mb = _read_mem()
-                fh.write(
-                    f"{t1:.3f},{cpu:.1f},{used_mb:.1f},{total_mb:.1f},"
-                    f"{(rx1 - rx0) / dt / 1024.0:.1f},"
-                    f"{(tx1 - tx0) / dt / 1024.0:.1f}\n"
+                # cumulative counters in, per-second rates out (the
+                # writer owns the delta arithmetic); memory is a gauge
+                writer.emit(
+                    "host",
+                    counters={
+                        "cpu_busy_jiffies": busy,
+                        "cpu_total_jiffies": total,
+                        "net_rx_bytes": rx,
+                        "net_tx_bytes": tx,
+                    },
+                    gauges={
+                        "mem_used_mb": round(used_mb, 1),
+                        "mem_total_mb": round(total_mb, 1),
+                    },
                 )
-                fh.flush()
-                busy0, total0, rx0, tx0, t0 = busy1, total1, rx1, tx1, t1
+                writer.flush()
+        finally:
+            writer.close()
 
 
-def load_samples(path: str) -> List[Dict[str, float]]:
-    """Parse a monitor CSV back into row dicts."""
+def _rows_from_windows(windows: List[dict]) -> List[Dict[str, float]]:
+    """Telemetry windows -> the dstat-shaped rows the plot tables eat."""
     out: List[Dict[str, float]] = []
-    if not os.path.exists(path):
-        return out
+    for window in windows:
+        if window.get("k") != "win" or window.get("src") != "host":
+            continue
+        if window.get("seq", 0) == 0:
+            # the first window rates against the writer's construction
+            # instant, before the first /proc sample — skip it like the
+            # CSV sampler skipped its baseline read
+            continue
+        rate = window.get("rate", {})
+        gauges = window.get("g", {})
+        total_rate = rate.get("cpu_total_jiffies", 0.0)
+        out.append({
+            "epoch_s": window["t"] / 1e6,
+            "cpu_pct": round(
+                100.0 * rate.get("cpu_busy_jiffies", 0.0) / total_rate, 1
+            ) if total_rate else 0.0,
+            "mem_used_mb": gauges.get("mem_used_mb", 0.0),
+            "mem_total_mb": gauges.get("mem_total_mb", 0.0),
+            "net_rx_kbps": round(rate.get("net_rx_bytes", 0.0) / 1024.0, 1),
+            "net_tx_kbps": round(rate.get("net_tx_bytes", 0.0) / 1024.0, 1),
+        })
+    return out
+
+
+def _rows_from_csv(path: str) -> List[Dict[str, float]]:
+    """Pre-telemetry ``resources.csv`` compatibility (one release)."""
+    out: List[Dict[str, float]] = []
     with open(path) as fh:
         header = fh.readline().strip().split(",")
         for line in fh:
@@ -137,3 +184,22 @@ def load_samples(path: str) -> List[Dict[str, float]]:
             if len(vals) == len(header):
                 out.append({k: float(v) for k, v in zip(header, vals)})
     return out
+
+
+def load_samples(path: str) -> List[Dict[str, float]]:
+    """Parse a monitor artifact back into dstat-shaped row dicts.
+
+    Reads the telemetry-series JSONL (``resources.jsonl``); old
+    experiment dirs holding the retired CSV format (or a ``.jsonl`` path
+    whose sibling ``resources.csv`` exists) still load for one release.
+    """
+    if os.path.exists(path):
+        if path.endswith(".csv"):
+            return _rows_from_csv(path)
+        from fantoch_tpu.observability.timeseries import read_series
+
+        return _rows_from_windows(read_series(path))
+    legacy = os.path.join(os.path.dirname(path), "resources.csv")
+    if os.path.exists(legacy):
+        return _rows_from_csv(legacy)
+    return []

@@ -8,7 +8,13 @@ the per-command attribution layer those counters cannot answer).
   stage over :class:`fantoch_tpu.core.metrics.Histogram`), trace diff;
 - :mod:`perfetto` — Chrome/Perfetto trace-event JSON conversion;
 - :mod:`device` — device-plane counters (dispatches, occupancy,
-  recompiles via jax.monitoring) folded into metrics snapshots.
+  recompiles via jax.monitoring) folded into metrics snapshots;
+- :mod:`timeseries` — live windowed telemetry (per-window rates +
+  histogram snapshots) as torn-tail-tolerant JSONL rings, on both
+  timelines (sim virtual time / run wall time);
+- :mod:`exposition` — Prometheus-text ``/metrics`` endpoint plus the
+  on-demand ``jax.profiler`` capture trigger (HTTP ``/profile?ms=N`` or
+  SIGUSR2).
 """
 
 from fantoch_tpu.observability.tracer import (
@@ -23,8 +29,16 @@ from fantoch_tpu.observability.device import (
     recompile_count,
     subscribe_recompiles,
 )
+from fantoch_tpu.observability.timeseries import (
+    SeriesWriter,
+    latest_windows,
+    read_series,
+)
 
 __all__ = [
+    "SeriesWriter",
+    "latest_windows",
+    "read_series",
     "EXTRA_STAGES",
     "NOOP_TRACER",
     "STAGES",

@@ -128,19 +128,20 @@ def metrics_table(results: List[ExperimentResult]) -> str:
 
 
 def resource_table(results: List[ExperimentResult]) -> str:
-    """Machine resource table from the experiment's dstat-analog CSV
-    (fantoch_plot dstat tables; fantoch_exp/src/bench.rs:203-258):
-    mean/max cpu and mean mem/net over the run."""
+    """Machine resource table from the experiment's dstat-analog series
+    (telemetry-window JSONL; fantoch_plot dstat tables,
+    fantoch_exp/src/bench.rs:203-258): mean/max cpu and mean mem/net
+    over the run."""
     import os
 
-    from fantoch_tpu.exp.monitor import load_samples
+    from fantoch_tpu.exp.monitor import load_samples  # CSV fallback inside
 
     lines = [
         f"{'experiment':<34} {'cpu% avg':>9} {'cpu% max':>9} "
         f"{'mem MB avg':>11} {'net rx KB/s':>12} {'net tx KB/s':>12}"
     ]
     for result in results:
-        rows = load_samples(os.path.join(result.path, "resources.csv"))
+        rows = load_samples(os.path.join(result.path, "resources.jsonl"))
         if not rows:
             lines.append(
                 f"{result.name:<34} {'-':>9} {'-':>9} {'-':>11} {'-':>12} "

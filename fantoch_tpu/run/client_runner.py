@@ -64,6 +64,8 @@ async def run_clients(
     raise_on_shed: bool = False,
     status_frequency: Optional[int] = None,
     tracer=NOOP_TRACER,
+    telemetry_file: Optional[str] = None,
+    telemetry_interval_ms: Optional[int] = None,
 ) -> Dict[ClientId, Client]:
     """Drive `client_ids` against the cluster; returns the finished clients
     (latency data + overload tallies inside).
@@ -76,6 +78,11 @@ async def run_clients(
     ``raise_on_shed``, the typed ``DeadlineExceededError`` (chained to
     the server's ``OverloadedError``) propagates instead, for drivers
     that treat any shed as failure.
+
+    ``telemetry_file`` emits the client plane's windowed series
+    (observability/timeseries.py): submit/reply rates, retry/shed
+    tallies, and a per-window client-latency histogram (ms) — the
+    wall-time twin of the sim runner's ``clients`` source.
     """
     assert open_loop_interval_ms is None or arrival_rate_per_s is None, (
         "pick one open-loop pacing mode: interval or arrival rate"
@@ -100,6 +107,56 @@ async def run_clients(
     }
     for client in clients.values():
         client.connect({shard_id: 0 for shard_id in rws})
+
+    # client-plane telemetry (observability/timeseries.py): windowed
+    # submit/reply rates + a per-window latency histogram, one source
+    # ("clients") mirroring the sim runner's
+    telemetry = None
+    telemetry_window_ms = telemetry_interval_ms
+    latency_hist = None
+    if telemetry_file is not None:
+        from fantoch_tpu.core.metrics import Histogram
+        from fantoch_tpu.observability.timeseries import (
+            DEFAULT_WINDOW_MS,
+            SeriesWriter,
+        )
+
+        telemetry_window_ms = telemetry_interval_ms or DEFAULT_WINDOW_MS
+        telemetry = SeriesWriter(
+            telemetry_file, time, window_ms=telemetry_window_ms
+        )
+        # cumulative latency histogram maintained at O(1) per reply (the
+        # observer seam): a window emit snapshots it instead of
+        # re-walking every recorded sample — per-tick cost stays flat
+        # however long the run gets
+        latency_hist = Histogram()
+        for client in clients.values():
+            client.set_latency_observer(
+                lambda latency_us: latency_hist.increment(latency_us // 1000)
+            )
+
+    def _emit_telemetry() -> None:
+        submitted = retries = sheds = 0
+        for client in clients.values():
+            submitted += client.issued_commands
+            retries += client.overload_retries
+            sheds += client.shed_commands
+        telemetry.emit(
+            "clients",
+            {
+                "submitted": submitted,
+                "replied": latency_hist.count,
+                "overload_retries": retries,
+                "shed_commands": sheds,
+            },
+            hists={"latency_ms": latency_hist},
+        )
+        telemetry.flush()
+
+    async def _telemetry_task() -> None:
+        while True:
+            await asyncio.sleep(telemetry_window_ms / 1000)
+            _emit_telemetry()
 
     # reply queues ride the bounded/instrumented plane too: the demux is
     # a socket reader, so a client that stops collecting pauses its
@@ -355,6 +412,11 @@ async def run_clients(
     driver_tasks = [
         asyncio.ensure_future(driver(client)) for client in clients.values()
     ]
+    telemetry_task = (
+        asyncio.ensure_future(_telemetry_task())
+        if telemetry is not None
+        else None
+    )
     try:
         await asyncio.gather(*driver_tasks)
     finally:
@@ -365,6 +427,12 @@ async def run_clients(
             task.cancel()
         for task in demux_tasks:
             task.cancel()
+        if telemetry_task is not None:
+            telemetry_task.cancel()
+        if telemetry is not None:
+            # final window so short runs leave at least one behind
+            _emit_telemetry()
+            telemetry.close()
         for rw in rws.values():
             rw.close()
     return clients

@@ -1477,6 +1477,8 @@ class DeviceRuntime:
         pipeline: Optional[bool] = None,
         pipeline_depth: Optional[int] = None,
         mesh=None,
+        telemetry_file: Optional[str] = None,
+        metrics_port: Optional[int] = None,
     ):
         from fantoch_tpu.core.ids import AtomicIdGen
 
@@ -1568,6 +1570,28 @@ class DeviceRuntime:
         self.dot_gen = AtomicIdGen(process_id)
         self.metrics_file = metrics_file
         self.metrics_interval_ms = metrics_interval_ms
+        # live telemetry plane (observability/timeseries.py): one writer,
+        # one cadence (Config.telemetry_interval_ms beats the argument)
+        # for the windowed series AND the legacy JSON tallies snapshot
+        self.telemetry_interval_ms = (
+            config.telemetry_interval_ms
+            if config.telemetry_interval_ms is not None
+            else metrics_interval_ms
+        )
+        self.telemetry = None
+        if telemetry_file is not None:
+            from fantoch_tpu.core.timing import RunTime
+            from fantoch_tpu.observability.timeseries import SeriesWriter
+
+            self.telemetry = SeriesWriter(
+                telemetry_file, RunTime(),
+                window_ms=self.telemetry_interval_ms,
+            )
+        self.metrics_port = metrics_port
+        self.metrics_server = None
+        # serving-edge throughput tallies (the submit/reply rate series)
+        self.submitted = 0
+        self.replied = 0
         # results route to the session that submitted the rifl (a client
         # holds one connection per shard; only the target shard's carries
         # the Submit)
@@ -1634,8 +1658,24 @@ class DeviceRuntime:
         server = await asyncio.start_server(self._on_client, *self.client_addr)
         self._servers = [server]
         self.spawn(self._driver_task())
-        if self.metrics_file is not None:
-            self.spawn(self._metrics_task())
+        if self.metrics_file is not None or self.telemetry is not None:
+            self.spawn(self._telemetry_task())
+        if self.metrics_port is not None:
+            from fantoch_tpu.observability.exposition import (
+                MetricsServer,
+                profile_output_dir,
+            )
+
+            self.metrics_server = MetricsServer(
+                self.telemetry_sample,
+                self.metrics_port,
+                labels={"pid": str(self.process_id)},
+                profile_dir=profile_output_dir(
+                    self.telemetry and self.telemetry.path, self.metrics_file
+                ),
+            )
+            await self.metrics_server.start()
+            self.metrics_port = self.metrics_server.port
 
     def _publish_tallies(self) -> None:
         """Called on the event-loop thread between device rounds (never
@@ -1646,6 +1686,8 @@ class DeviceRuntime:
 
         d = self.driver
         self._tallies = {
+            "submitted": self.submitted,
+            "replied": self.replied,
             "rounds": d.rounds,
             "executed": d.executed,
             "fast_paths": d.fast_paths,
@@ -1672,17 +1714,48 @@ class DeviceRuntime:
 
         write_json_snapshot(self.metrics_file, dict(self._tallies))
 
-    async def _metrics_task(self) -> None:
-        while True:
-            await asyncio.sleep(self.metrics_interval_ms / 1000)
+    # gauge-natured tally keys: instantaneous values, not monotone
+    # counters — the series and the exposition type them accordingly
+    _GAUGE_TALLIES = frozenset({
+        "in_flight", "stable_watermark", "queued", "queued_hwm",
+        "queue_capacity", "device_idle_frac", "device_pipeline_depth",
+    })
+
+    def telemetry_sample(self):
+        """The (counters, gauges, hists) triple for the series writer and
+        the ``/metrics`` exposition, split out of the published tallies
+        (names stay the bench/tally keys)."""
+        counters: Dict[str, float] = {}
+        gauges: Dict[str, float] = {}
+        for name, value in self._tallies.items():
+            (gauges if name in self._GAUGE_TALLIES else counters)[name] = value
+        return counters, gauges, {}
+
+    def _emit_telemetry(self) -> None:
+        if self.telemetry is not None:
+            counters, gauges, hists = self.telemetry_sample()
+            self.telemetry.emit(
+                f"p{self.process_id}", counters, gauges, hists
+            )
+            self.telemetry.flush()
+        if self.metrics_file is not None:
             self._write_metrics_snapshot()
 
+    async def _telemetry_task(self) -> None:
+        while True:
+            await asyncio.sleep(self.telemetry_interval_ms / 1000)
+            self._emit_telemetry()
+
     async def stop(self) -> None:
+        if self.metrics_server is not None:
+            await self.metrics_server.stop()
         tasks = list(self._tasks)
         self._teardown()
         await asyncio.gather(*tasks, return_exceptions=True)
-        if self.metrics_file is not None:
-            self._write_metrics_snapshot()
+        if self.metrics_file is not None or self.telemetry is not None:
+            self._emit_telemetry()
+        if self.telemetry is not None:
+            self.telemetry.close()
 
     # --- client plane ---
 
@@ -1708,6 +1781,7 @@ class DeviceRuntime:
         return base * max(1, len(ring) // max(1, self.driver.batch_size))
 
     def submit(self, dot: Dot, cmd: Command) -> None:
+        self.submitted += 1
         if not self._submit_queue.try_push((dot, cmd)):
             # unreachable via sessions (has_capacity() is checked on the
             # same cooperative tick, with no await between check and
@@ -1740,6 +1814,7 @@ class DeviceRuntime:
                 continue  # session closed mid-flight
             try:
                 if session.deliver(result):
+                    self.replied += 1
                     del self.rifl_sessions[result.rifl]
             except (ConnectionError, OSError) as exc:
                 # runs on the (fatal) driver task: a half-closed client

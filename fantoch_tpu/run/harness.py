@@ -60,6 +60,7 @@ async def run_localhost_cluster(
     peer_delays: Optional[Dict[ProcessId, Dict[ProcessId, int]]] = None,
     ping_sort: bool = False,
     observe_dir: Optional[str] = None,
+    metrics_ports: Optional[Dict[ProcessId, int]] = None,
     runtime_kwargs: Optional[dict] = None,
     chaos=None,
 ) -> Tuple[Dict[ProcessId, ProcessRuntime], Dict[ClientId, Client]]:
@@ -139,6 +140,14 @@ async def run_localhost_cluster(
             trace_file=(
                 f"{observe_dir}/trace_p{pid}.jsonl" if tracing else None
             ),
+            # live telemetry: windowed series per process (plus the
+            # client plane's below), and an optional exposition endpoint
+            # per pid (metrics_ports={pid: port}; 0 = OS-assigned, read
+            # the real one back from runtime.metrics_port)
+            telemetry_file=(
+                f"{observe_dir}/telemetry_p{pid}.jsonl" if observe_dir else None
+            ),
+            metrics_port=(metrics_ports or {}).get(pid),
             **(runtime_kwargs or {}),
         )
 
@@ -172,6 +181,16 @@ async def run_localhost_cluster(
                 arrival_seed=arrival_seed,
                 deadline_ms=deadline_ms,
                 **({"tracer": client_tracer} if client_tracer is not None else {}),
+                **(
+                    {
+                        "telemetry_file": (
+                            f"{observe_dir}/telemetry_clients_p{pid}.jsonl"
+                        ),
+                        "telemetry_interval_ms": config.telemetry_interval_ms,
+                    }
+                    if observe_dir is not None
+                    else {}
+                ),
             )
             for group, pid in client_groups
         )
@@ -338,6 +357,8 @@ async def run_device_server(
     monitor_execution_order: bool = True,
     pipeline: Optional[bool] = None,
     pipeline_depth: Optional[int] = None,
+    telemetry_file: Optional[str] = None,
+    metrics_port: Optional[int] = None,
 ):
     """Boot the TPU serving path (run/device_runner.py) on a localhost
     port and drive real TCP clients against it; returns
@@ -357,6 +378,8 @@ async def run_device_server(
         monitor_execution_order=monitor_execution_order,
         pipeline=pipeline,
         pipeline_depth=pipeline_depth,
+        telemetry_file=telemetry_file,
+        metrics_port=metrics_port,
     )
     await runtime.start()
     client_task = asyncio.ensure_future(

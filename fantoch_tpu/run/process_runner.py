@@ -183,6 +183,7 @@ class _ClientSession:
 
     def _emit(self, cmd_result) -> None:
         if cmd_result is not None:
+            self.runtime.replied += 1
             self.rw.write(ToClient(cmd_result))
             self._flush_needed.set()  # single per-session flusher picks it up
 
@@ -230,6 +231,7 @@ class _ClientSession:
                     continue
                 assert isinstance(msg, Submit)
                 cmd = msg.cmd
+                self.runtime.submitted += 1
                 limit = self.runtime.config.admission_limit
                 if limit is not None:
                     depth = self.runtime.admission_depth()
@@ -299,6 +301,8 @@ class ProcessRuntime:
         trace_file: Optional[str] = None,
         wal_dir: Optional[str] = None,
         wal_snapshot_interval_ms: int = 2000,
+        telemetry_file: Optional[str] = None,
+        metrics_port: Optional[int] = None,
     ):
         self.protocol_cls = protocol_cls
         self.config = config
@@ -423,6 +427,30 @@ class ProcessRuntime:
         # observability (metrics_logger.rs / execution_logger.rs / tracer.rs)
         self.metrics_file = metrics_file
         self.metrics_interval_ms = metrics_interval_ms
+        # live telemetry plane (observability/timeseries.py): ONE periodic
+        # writer covers both the windowed series and the legacy pickle
+        # snapshot, on ONE cadence — Config.telemetry_interval_ms when
+        # set, else the metrics_interval_ms argument
+        self.telemetry_interval_ms = (
+            config.telemetry_interval_ms
+            if config.telemetry_interval_ms is not None
+            else metrics_interval_ms
+        )
+        self.telemetry = None
+        if telemetry_file is not None:
+            from fantoch_tpu.observability.timeseries import SeriesWriter
+
+            self.telemetry = SeriesWriter(
+                telemetry_file, self.time, window_ms=self.telemetry_interval_ms
+            )
+        # Prometheus-text exposition endpoint + on-demand profile trigger
+        # (observability/exposition.py); started in start()
+        self.metrics_port = metrics_port
+        self.metrics_server = None
+        # client-edge throughput tallies: submissions seen (pre-shed) and
+        # command results streamed back — the submit/reply rate series
+        self.submitted = 0
+        self.replied = 0
         self.tracer_show_interval_ms = tracer_show_interval_ms
         self.execution_logger = None
         if execution_log is not None:
@@ -751,8 +779,21 @@ class ProcessRuntime:
             self.spawn(self._executor_cleanup_task(cleanup))
         if self.heartbeat_interval_s is not None and self.peers:
             self.spawn(self._heartbeat_task())
-        if self.metrics_file is not None:
-            self.spawn(self._metrics_logger_task())
+        if self.metrics_file is not None or self.telemetry is not None:
+            # one telemetry writer, one cadence: the windowed series and
+            # the legacy pickle snapshot share the periodic task
+            self.spawn(self._telemetry_task())
+        if self.metrics_port is not None:
+            from fantoch_tpu.observability.exposition import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                self.telemetry_sample,
+                self.metrics_port,
+                labels={"pid": str(self.process.id)},
+                profile_dir=self._obs_dir(),
+            )
+            await self.metrics_server.start()
+            self.metrics_port = self.metrics_server.port
         if self.execution_logger is not None:
             self.spawn(self._execution_log_flush_task())
         if self.tracer.enabled:
@@ -789,11 +830,15 @@ class ProcessRuntime:
             for task in pending:
                 task.cancel()
             tasks = list(pending)
+        if self.metrics_server is not None:
+            await self.metrics_server.stop()
         if self.execution_logger is not None:
             self.execution_logger.close()
-        if self.metrics_file is not None:
-            # final snapshot so short runs always leave one behind
-            self._write_metrics_snapshot()
+        if self.metrics_file is not None or self.telemetry is not None:
+            # final window + snapshot so short runs always leave one behind
+            self._emit_telemetry()
+        if self.telemetry is not None:
+            self.telemetry.close()
         if self.wal is not None:
             # flush, no final snapshot: every recovery is crash-shaped
             # (last periodic snapshot + tail), so the restart path the
@@ -1567,10 +1612,16 @@ class ProcessRuntime:
             out["digest_keys"] = len(summary)
         return out
 
-    def _write_metrics_snapshot(self) -> None:
+    def _write_metrics_snapshot(self, queues=None, overload=None, device=None) -> None:
+        """The legacy crash-consistent pickle snapshot.  ``_emit_telemetry``
+        passes its already-collected sources so one tick walks the
+        queues/device counters exactly once (and the series window and
+        the snapshot's ``.queues``/``.overload`` views agree on the same
+        instant); absent args are collected here."""
         from fantoch_tpu.run.observe import ProcessMetrics, write_metrics_snapshot
 
-        device = self._device_counters()
+        if device is None:
+            device = self._device_counters()
         if device is not None and self.tracer.enabled:
             # counters ride the trace too, next to the spans of the
             # batches they carried.  jax_recompiles is host-process-global
@@ -1583,8 +1634,10 @@ class ProcessRuntime:
                     name, value,
                     pid=None if name == "jax_recompiles" else self.process.id,
                 )
-        queues = self.queue_stats()
-        overload = self.overload_counters(queues)
+        if queues is None:
+            queues = self.queue_stats()
+        if overload is None:
+            overload = self.overload_counters(queues)
         if self.tracer.enabled:
             # queue-depth gauges + shed/pause tallies ride the span log
             # too (running totals, counters_total last-wins semantics),
@@ -1627,12 +1680,94 @@ class ProcessRuntime:
             return device
         return None
 
-    async def _metrics_logger_task(self) -> None:
-        """Periodic crash-consistent metrics snapshots
-        (metrics_logger.rs:75-87)."""
+    def _obs_dir(self) -> str:
+        """Directory profiling artifacts land in (one rule for every
+        trigger spelling: observability/exposition.profile_output_dir)."""
+        from fantoch_tpu.observability.exposition import profile_output_dir
+
+        return profile_output_dir(
+            self.telemetry and self.telemetry.path, self.metrics_file
+        )
+
+    def telemetry_sample(self, stats=None, overload=None, device=None):
+        """One consistent (counters, gauges, histograms) sample — the
+        shared source of the windowed series, the legacy snapshot's
+        tracer counters, and the ``/metrics`` exposition.  Counter and
+        gauge names match the bench/tally keys so a dashboard query and
+        a BENCH row key agree.  ``_emit_telemetry`` passes precollected
+        sources so one tick never walks them twice; the exposition
+        endpoint calls with no args and collects fresh."""
+        from fantoch_tpu.core.metrics import Metrics as _Metrics
+
+        counters: Dict[str, float] = {
+            "submitted": self.submitted,
+            "replied": self.replied,
+        }
+        if stats is None:
+            stats = self.queue_stats()
+        # copy: the snapshot writer consumes the same overload dict, and
+        # the gauge re-typing below pops keys out of it
+        overload = dict(
+            self.overload_counters(stats) if overload is None else overload
+        )
+        gauges: Dict[str, float] = {
+            "queue_depth": overload.pop("queue_depth", 0),
+            "queue_depth_hwm": overload.pop("queue_depth_hwm", 0),
+        }
+        if "digest_keys" in overload:
+            gauges["digest_keys"] = overload.pop("digest_keys")
+        counters.update(overload)
+        if device is None:
+            device = self._device_counters()
+        if device:
+            for name, value in device.items():
+                if name in ("device_idle_frac", "device_pipeline_depth"):
+                    gauges[name] = value
+                else:
+                    counters[name] = value
+        hists: Dict[str, Any] = {}
+        executor_metrics = _Metrics()
+        for executor in self.executors:
+            executor_metrics.merge(executor.metrics())
+        for prefix, metrics in (
+            ("protocol", self.process.metrics()),
+            ("executor", executor_metrics),
+        ):
+            for kind, value in metrics.aggregated.items():
+                counters[f"{prefix}_{getattr(kind, 'value', kind)}"] = value
+            for kind, hist in metrics.collected.items():
+                hists[f"{prefix}_{getattr(kind, 'value', kind)}"] = hist
+        return counters, gauges, hists
+
+    def _emit_telemetry(self) -> None:
+        """One telemetry tick: a window line into the series (flushed, so
+        a live ``obs watch`` sees it) and — when configured — the legacy
+        crash-consistent pickle snapshot, from ONE walk of the queue /
+        overload / device sources (so both views describe one instant)."""
+        stats = self.queue_stats()
+        overload = self.overload_counters(stats)
+        device = self._device_counters()
+        if self.telemetry is not None:
+            counters, gauges, hists = self.telemetry_sample(
+                stats, overload, device
+            )
+            self.telemetry.emit(
+                f"p{self.process.id}", counters, gauges, hists
+            )
+            self.telemetry.flush()
+        if self.metrics_file is not None:
+            self._write_metrics_snapshot(
+                queues=stats, overload=overload, device=device
+            )
+
+    async def _telemetry_task(self) -> None:
+        """Periodic telemetry cadence (one knob:
+        ``Config.telemetry_interval_ms``): windowed series emit + the
+        crash-consistent metrics snapshot (metrics_logger.rs:75-87),
+        unified on one writer."""
         while True:
-            await asyncio.sleep(self.metrics_interval_ms / 1000)
-            self._write_metrics_snapshot()
+            await asyncio.sleep(self.telemetry_interval_ms / 1000)
+            self._emit_telemetry()
 
     async def _execution_log_flush_task(self) -> None:
         """1s execution-log flush (execution_logger.rs:8-29)."""

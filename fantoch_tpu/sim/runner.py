@@ -80,6 +80,19 @@ class OpenLoopArrival:
 
 
 @dataclass
+class TelemetryTick:
+    """Virtual-time telemetry window boundary: every
+    ``Config.telemetry_interval_ms`` (default 1 s) the runner emits one
+    window line per process plus one for the client plane into the
+    telemetry series (observability/timeseries.py).  Ticks only *read*
+    state and their schedule is seed-independent, so same-seed runs emit
+    byte-identical series — the determinism contract extended from
+    traces to telemetry."""
+
+    delay_ms: int
+
+
+@dataclass
 class PeerDownNotification:
     """Failure-detector tick (FaultPlan.detector_delay_ms): announce a
     crashed-forever process to every live protocol via
@@ -115,6 +128,7 @@ class Runner:
         fault_plan: Optional[FaultPlan] = None,
         trace_path: Optional[str] = None,
         open_loop_rate_per_s: Optional[float] = None,
+        telemetry_path: Optional[str] = None,
     ):
         assert len(process_regions) == config.n, "one region per process"
         assert config.gc_interval_ms is not None, "sim requires gc running"
@@ -141,6 +155,33 @@ class Runner:
             self._tracer = Tracer(
                 self._simulation.time, trace_path, config.trace_sample_rate
             )
+        # live telemetry (observability/timeseries.py): windowed series on
+        # the virtual timeline — one window line per process + one for the
+        # client plane per tick, byte-identical for same-seed runs
+        self._telemetry = None
+        self._telemetry_interval_ms = 0
+        if telemetry_path is not None:
+            from fantoch_tpu.observability.timeseries import (
+                DEFAULT_WINDOW_MS,
+                SeriesWriter,
+            )
+
+            self._telemetry_interval_ms = (
+                config.telemetry_interval_ms or DEFAULT_WINDOW_MS
+            )
+            self._telemetry = SeriesWriter(
+                telemetry_path,
+                self._simulation.time,
+                window_ms=self._telemetry_interval_ms,
+            )
+        # telemetry tallies: client submissions/replies (cluster level)
+        # and per-process submit deliveries; the latency histogram is
+        # maintained incrementally via the client observer seam (O(1)
+        # per completion — never re-walked per window)
+        self._client_submits = 0
+        self._client_replies = 0
+        self._submit_counts: Dict[ProcessId, int] = {}
+        self._client_latency = Histogram()
 
         # a single shard in simulation
         shard_id = 0
@@ -187,6 +228,12 @@ class Runner:
                 client = Client(client_id, workload, rng=random.Random(self._rng.random()))
                 closest = closest_process_per_shard(region, planet, to_discover)
                 client.connect(closest)
+                if self._telemetry is not None:
+                    client.set_latency_observer(
+                        lambda latency_us: self._client_latency.increment(
+                            latency_us // 1000
+                        )
+                    )
                 self._simulation.register_client(client)
                 self._client_to_region[client_id] = region
         self._client_count = client_id
@@ -202,6 +249,14 @@ class Runner:
         for process_id, delay in periodic_executed:
             self._schedule.schedule(
                 self._simulation.time, delay, PeriodicExecutedNotification(process_id, delay)
+            )
+
+        # telemetry windows ride the schedule like any periodic stream
+        if self._telemetry is not None:
+            self._schedule.schedule(
+                self._simulation.time,
+                self._telemetry_interval_ms,
+                TelemetryTick(self._telemetry_interval_ms),
             )
 
         # fault plan: schedule state-transition marks at their virtual
@@ -262,6 +317,8 @@ class Runner:
             # flush+close so the span log is complete (and readable) even
             # when the loop raises a typed stall error
             tracer.close()
+            if self._telemetry is not None:
+                self._telemetry.close()
         return (
             {pid: p.metrics() for pid, (p, _, _) in self._simulation.processes()},
             {pid: e.monitor() for pid, (_, e, _) in self._simulation.processes()},
@@ -293,7 +350,9 @@ class Runner:
                 action = self._apply_faults(action, now)
                 if action is None:
                     continue
-            if isinstance(action, PeriodicProcessEvent):
+            if isinstance(action, TelemetryTick):
+                self._handle_telemetry_tick(action)
+            elif isinstance(action, PeriodicProcessEvent):
                 self._handle_periodic_process_event(action)
             elif isinstance(action, PeriodicExecutedNotification):
                 self._handle_periodic_executed_notification(action)
@@ -310,6 +369,7 @@ class Runner:
             elif isinstance(action, SendToClient):
                 if action.client_id not in self._active_clients:
                     continue  # abandoned (attached to a crashed process)
+                self._client_replies += 1
                 if self._tracer.enabled:
                     self._tracer.span(
                         "reply", action.cmd_result.rifl, cid=action.client_id
@@ -485,6 +545,53 @@ class Runner:
             self._send_to_processes_and_executors(ev.process_id)
         self._schedule.schedule(self._simulation.time, ev.delay_ms, ev)
 
+    def _handle_telemetry_tick(self, ev: TelemetryTick) -> None:
+        """Emit one telemetry window per process + one for the client
+        plane, then reschedule — unless the tick is the only pending
+        stream left (everything else crashed/drained), in which case it
+        stands down so the loop's empty-schedule logic (clean exit, or a
+        typed SimStalledError when clients are still owed) keeps working
+        exactly as it does without telemetry."""
+        self._emit_telemetry()
+        if any(
+            not isinstance(action, TelemetryTick)
+            for action in self._schedule.actions()
+        ):
+            self._schedule.schedule(self._simulation.time, ev.delay_ms, ev)
+
+    def _emit_telemetry(self) -> None:
+        """One window line per source, in deterministic (sorted) order:
+        per-process protocol/executor counters + histograms, then the
+        cluster-level client plane (submit/reply totals + a windowed
+        client-latency histogram in ms)."""
+        writer = self._telemetry
+        for pid in sorted(self._process_to_region):
+            process, executor, _ = self._simulation.get_process(pid)
+            counters: Dict[str, float] = {
+                "submitted": self._submit_counts.get(pid, 0),
+            }
+            hists: Dict[str, Histogram] = {}
+            for prefix, metrics in (
+                ("protocol", process.metrics()),
+                ("executor", executor.metrics()),
+            ):
+                for kind, value in metrics.aggregated.items():
+                    name = getattr(kind, "value", str(kind))
+                    counters[f"{prefix}_{name}"] = value
+                for kind, hist in metrics.collected.items():
+                    name = getattr(kind, "value", str(kind))
+                    hists[f"{prefix}_{name}"] = hist
+            writer.emit(f"p{pid}", counters, hists=hists)
+        latency = self._client_latency
+        writer.emit(
+            "clients",
+            {
+                "submitted": self._client_submits,
+                "replied": self._client_replies,
+            },
+            hists={"latency_ms": latency},
+        )
+
     def _handle_executor_watchdog(self, ev: PeriodicExecutorWatchdog) -> None:
         """Bounded-wait check: raises a typed StalledExecutionError (via
         Config.executor_pending_fail_ms) when a committed command has been
@@ -533,6 +640,9 @@ class Runner:
         self._schedule_arrival(client_id)
 
     def _handle_submit_to_proc(self, process_id: ProcessId, cmd: Command) -> None:
+        self._submit_counts[process_id] = (
+            self._submit_counts.get(process_id, 0) + 1
+        )
         process, _, pending = self._simulation.get_process(process_id)
         pending.wait_for(cmd)
         process.submit(None, cmd, self._simulation.time)
@@ -597,6 +707,7 @@ class Runner:
                 raise AssertionError(f"unknown action {action}")
 
     def _schedule_submit(self, from_region_key, process_id: ProcessId, cmd: Command) -> None:
+        self._client_submits += 1
         self._schedule_message(
             from_region_key, ("process", process_id), SubmitToProc(process_id, cmd)
         )

@@ -35,3 +35,10 @@ class Schedule(Generic[A]):
 
     def __len__(self) -> int:
         return len(self._heap)
+
+    def actions(self):
+        """Iterate pending actions (heap order, not delivery order) —
+        the telemetry tick uses this to detect that it is the only thing
+        left alive and stand down instead of spinning the loop forever."""
+        for _time, _tie, action in self._heap:
+            yield action

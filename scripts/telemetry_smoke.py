@@ -1,0 +1,233 @@
+"""Telemetry smoke gate (``make telemetry-smoke``): the live-telemetry
+plane end to end against a localhost EPaxos n=3 TCP cluster —
+
+- every process serves a Prometheus-text ``/metrics`` endpoint; it is
+  scraped twice *while the cluster serves*, both scrapes parse with the
+  strict exposition parser, carry the required key set, and the second
+  scrape's counters are monotonically >= the first's;
+- the windowed series files (telemetry_p<pid>.jsonl + the client plane)
+  exist, parse, and carry the submit/reply counters and latency windows;
+- ``obs watch --once`` renders a frame over the obs dir (the live view
+  the operator runs);
+- the perf-regression gate works: an injected 2x ``graph_resolve``
+  latency regression exits nonzero in ``--gate`` mode, a definition-
+  stamp mismatch refuses the comparison, and — when ``bench-smoke`` ran
+  earlier in the job — the fresh smoke row passes a report-only
+  ``bench.py --regress`` against the committed baseline.
+
+CPU-only and tiny; the per-push CI step runs it next to the other
+smokes.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REQUIRED_METRICS = {
+    "fantoch_submitted_total",
+    "fantoch_replied_total",
+    "fantoch_shed_submissions_total",
+    "fantoch_backpressure_pauses_total",
+    "fantoch_queue_depth",
+    "fantoch_queue_depth_hwm",
+}
+
+
+def run_cluster(obs_dir: str):
+    """One localhost EPaxos run with telemetry + endpoints live; scrapes
+    every process twice mid-run (via the harness chaos hook, which runs
+    alongside the clients).  Returns the scrape texts per round."""
+    from fantoch_tpu.client import ConflictRateKeyGen, Workload
+    from fantoch_tpu.core import Config
+    from fantoch_tpu.protocol import EPaxos
+    from fantoch_tpu.run.harness import run_localhost_cluster
+
+    scrapes = [[], []]
+
+    async def scraper(runtimes):
+        loop = asyncio.get_running_loop()
+        for round_ in range(2):
+            await asyncio.sleep(0.25)
+            for pid in sorted(runtimes):
+                port = runtimes[pid].metrics_port
+                url = f"http://127.0.0.1:{port}/metrics"
+                text = await loop.run_in_executor(
+                    None,
+                    lambda u=url: urllib.request.urlopen(u, timeout=5)
+                    .read()
+                    .decode(),
+                )
+                scrapes[round_].append((pid, text))
+
+    config = Config(
+        n=3,
+        f=1,
+        gc_interval_ms=50,
+        executor_executed_notification_interval_ms=50,
+        telemetry_interval_ms=100,
+    )
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=1,
+        commands_per_client=60,
+        payload_size=8,
+    )
+    asyncio.run(
+        run_localhost_cluster(
+            EPaxos,
+            config,
+            workload,
+            clients_per_process=3,
+            observe_dir=obs_dir,
+            metrics_ports={pid: 0 for pid in (1, 2, 3)},  # OS-assigned
+            chaos=scraper,
+        )
+    )
+    return scrapes
+
+
+def check_scrapes(scrapes) -> None:
+    from fantoch_tpu.observability.exposition import parse_prometheus
+
+    assert len(scrapes[0]) == 3 and len(scrapes[1]) == 3, (
+        f"expected both scrape rounds to cover 3 processes: "
+        f"{[len(s) for s in scrapes]}"
+    )
+    for round_ in (0, 1):
+        for _pid, text in scrapes[round_]:
+            parsed = parse_prometheus(text)  # strict: raises on malformed
+            missing = REQUIRED_METRICS - set(parsed)
+            assert not missing, f"scrape missing required keys: {missing}"
+    # counters are monotone between the two live scrapes, per process
+    for (pid_a, text_a), (pid_b, text_b) in zip(scrapes[0], scrapes[1]):
+        assert pid_a == pid_b
+        first = parse_prometheus(text_a)
+        second = parse_prometheus(text_b)
+        for name in first:
+            if not name.endswith("_total"):
+                continue
+            for labels, value in first[name].items():
+                later = second.get(name, {}).get(labels)
+                assert later is not None and later >= value, (
+                    f"p{pid_a} {name}{labels} not monotonic: "
+                    f"{value} -> {later}"
+                )
+
+
+def check_series(obs_dir: str) -> None:
+    from fantoch_tpu.observability.timeseries import (
+        latest_windows,
+        read_series,
+    )
+
+    for pid in (1, 2, 3):
+        path = f"{obs_dir}/telemetry_p{pid}.jsonl"
+        windows = read_series(path)
+        assert windows, f"no telemetry windows in {path}"
+        last = latest_windows(windows)[f"p{pid}"]
+        for key in ("submitted", "replied", "shed_submissions"):
+            assert key in last["ctr"], f"{path} missing counter {key}"
+        assert "queue_depth" in last["g"], path
+    client_windows = []
+    for pid in (1, 2, 3):
+        client_windows += read_series(
+            f"{obs_dir}/telemetry_clients_p{pid}.jsonl"
+        )
+    last = latest_windows(client_windows)["clients"]
+    assert last["ctr"]["replied"] > 0, last
+    assert any(
+        "latency_ms" in w.get("h", {}) for w in client_windows
+    ), "no client latency window emitted"
+
+
+def check_watch(obs_dir: str) -> None:
+    proc = subprocess.run(
+        [sys.executable, "-m", "fantoch_tpu.bin.obs", "watch", "--once",
+         obs_dir],
+        capture_output=True,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "submit/s" in proc.stdout and "clients" in proc.stdout, proc.stdout
+
+
+def check_regress(tmp: str) -> None:
+    """The regression gate's acceptance rows, against synthetic records,
+    plus a report-only pass over the real smoke row when bench-smoke
+    left one behind earlier in the CI job."""
+    old = {
+        "metric": "epaxos_1m_cmds_50pct_conflict_graph_resolve_p50",
+        "value": 3.0,
+        "platform": "cpu",
+        "serving_newt_cmds_per_s": 40_000,
+        "serving_newt_definition": "depth-2 pipelined (r07)",
+    }
+    doubled = dict(old, value=6.0)
+    redefined = dict(
+        old, serving_newt_cmds_per_s=5, serving_newt_definition="resync"
+    )
+    paths = {}
+    for name, rec in (("old", old), ("doubled", doubled),
+                      ("redefined", redefined)):
+        paths[name] = os.path.join(tmp, f"{name}.json")
+        with open(paths[name], "w") as fh:
+            json.dump(rec, fh)
+
+    def regress(*argv):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--regress",
+             *argv],
+            capture_output=True, text=True,
+        )
+
+    # injected 2x graph_resolve latency must trip the gate (exit 1)
+    proc = regress(paths["doubled"], "--against", paths["old"], "--gate")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "REGRESSION" in proc.stdout, proc.stdout
+    # a definition-stamp mismatch must REFUSE the family, not ratio it
+    proc = regress(paths["redefined"], "--against", paths["old"], "--gate")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "REFUSED serving_newt_cmds_per_s" in proc.stdout, proc.stdout
+    # refused means refused: no ratio line for the family's key
+    assert "serving_newt_cmds_per_s: 40000" not in proc.stdout, proc.stdout
+    # report-only over the real smoke row (bench-smoke writes it
+    # earlier in the CI job; BENCH_SMOKE_BASE.json is the committed
+    # same-seams baseline) — report-only never fails the build
+    smoke_row = os.path.join(REPO, "BENCH_SMOKE_LATEST.json")
+    base = os.path.join(REPO, "BENCH_SMOKE_BASE.json")
+    if os.path.exists(smoke_row) and os.path.exists(base):
+        proc = regress(smoke_row, "--against", base)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "compared" in proc.stdout, proc.stdout
+        print("# regress report-only over the smoke row:")
+        print(proc.stdout.rstrip())
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as tmp:
+        obs_dir = os.path.join(tmp, "obs")
+        scrapes = run_cluster(obs_dir)
+        check_scrapes(scrapes)
+        check_series(obs_dir)
+        check_watch(obs_dir)
+        check_regress(tmp)
+    print(json.dumps({
+        "metric": "telemetry_smoke",
+        "scraped_processes": 3,
+        "ok": True,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -186,9 +186,9 @@ def test_run_experiments_db_and_plots(tmp_path):
     resources = plots.resource_table(db.results)
     assert "cpu% avg" in resources
     assert len(resources.splitlines()) == 1 + len(db.results)
-    # the monitor wrote at least the header during the run
+    # the monitor created the series file during the run
     for result in db.results:
-        assert os.path.exists(os.path.join(result.path, "resources.csv"))
+        assert os.path.exists(os.path.join(result.path, "resources.jsonl"))
 
 
 def test_scalability_and_heatmap_plots(tmp_path):

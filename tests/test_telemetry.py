@@ -1,0 +1,447 @@
+"""Live telemetry plane: windowed series (observability/timeseries.py),
+Prometheus exposition + the profile trigger (observability/exposition.py),
+the sim/run emit wiring, and the bench.py --regress perf gate.
+
+Key properties pinned here:
+- same-seed sim runs emit byte-identical telemetry series, with and
+  without a FaultPlan (the PR-2 determinism contract);
+- the series reader tolerates torn tails and ring rotation; empty
+  windows emit no stale histogram percentiles;
+- exposition text round-trips through the strict parser (well-formed
+  # TYPE lines, cumulative buckets ending at +Inf);
+- the regression gate trips on an injected 2x latency and REFUSES
+  cross-definition comparisons instead of ratioing them.
+"""
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from fantoch_tpu.client import ConflictRateKeyGen, Workload
+from fantoch_tpu.core import Config, Planet
+from fantoch_tpu.core.metrics import Histogram
+from fantoch_tpu.core.timing import SimTime
+from fantoch_tpu.observability.exposition import (
+    MetricsServer,
+    parse_prometheus,
+    render_prometheus,
+)
+from fantoch_tpu.observability.timeseries import (
+    SeriesWriter,
+    latest_windows,
+    read_series,
+)
+from fantoch_tpu.protocol import EPaxos
+from fantoch_tpu.sim import Runner
+from fantoch_tpu.sim.faults import FaultPlan
+
+
+# --- SeriesWriter / reader units ---
+
+
+def test_series_rates_and_hist_windows(tmp_path):
+    """Counters rate over the realized window; histograms snapshot only
+    the window's delta samples."""
+    path = str(tmp_path / "s.jsonl")
+    clock = SimTime()
+    writer = SeriesWriter(path, clock, window_ms=1000)
+    hist = Histogram()
+    hist.increment(10, 4)
+    clock.add_millis(1000)
+    first = writer.emit("p1", {"submitted": 100}, hists={"lat": hist})
+    assert first["rate"]["submitted"] == 100.0
+    assert first["h"]["lat"]["count"] == 4 and first["h"]["lat"]["p50"] == 10
+    # second window: 60 more submissions over 2s => 30/s; 2 new samples
+    # at value 50 => the window p50 is 50, not the cumulative 10
+    hist.increment(50, 2)
+    clock.add_millis(2000)
+    second = writer.emit("p1", {"submitted": 160}, hists={"lat": hist})
+    assert second["rate"]["submitted"] == 30.0
+    assert second["h"]["lat"]["count"] == 2 and second["h"]["lat"]["p50"] == 50
+    writer.close()
+    windows = read_series(path)
+    assert [w["seq"] for w in windows] == [0, 1]
+    assert windows == [first, second]
+
+
+def test_series_empty_window_emits_no_stale_hist(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    clock = SimTime()
+    writer = SeriesWriter(path, clock, window_ms=1000)
+    hist = Histogram()
+    hist.increment(5)
+    clock.add_millis(1000)
+    writer.emit("p1", {"submitted": 1}, hists={"lat": hist})
+    # nothing happened this window: no samples, zero rate, empty "h"
+    clock.add_millis(1000)
+    quiet = writer.emit("p1", {"submitted": 1}, hists={"lat": hist})
+    assert quiet["h"] == {}
+    assert quiet["rate"]["submitted"] == 0.0
+    writer.close()
+    assert len(read_series(path)) == 2
+
+
+def test_series_reader_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    clock = SimTime()
+    writer = SeriesWriter(path, clock, window_ms=1000)
+    for i in range(3):
+        clock.add_millis(1000)
+        writer.emit("p1", {"submitted": i})
+    writer.close()
+    whole = read_series(path)
+    assert len(whole) == 3
+    # crash mid-write: truncate the final line — the prefix still parses
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: raw.rfind(b'{"ctr"') + 17])
+    torn = read_series(path)
+    assert torn == whole[:2]
+    # an empty live file (crash right after rotation) reads cleanly too
+    open(path, "wb").close()
+    assert read_series(path) == []
+
+
+def test_series_ring_rotation(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    clock = SimTime()
+    writer = SeriesWriter(path, clock, window_ms=1000, ring_windows=4)
+    for i in range(10):
+        clock.add_millis(1000)
+        writer.emit("p1", {"submitted": i})
+    writer.close()
+    windows = read_series(path)
+    # two generations: at most 2*ring on disk, nothing misparses, the
+    # latest window survived with cumulative counters intact
+    assert 4 <= len(windows) <= 8
+    last = latest_windows(windows)["p1"]
+    assert last["ctr"]["submitted"] == 9
+    assert last["seq"] == 9
+
+
+def test_series_fresh_writer_drops_stale_generation(tmp_path):
+    """A restarted writer on the same path must not let a previous
+    run's rotated generation (higher seqs) shadow the new run's windows
+    in latest_windows."""
+    path = str(tmp_path / "s.jsonl")
+    clock = SimTime()
+    writer = SeriesWriter(path, clock, window_ms=1000, ring_windows=3)
+    for i in range(7):
+        clock.add_millis(1000)
+        writer.emit("p1", {"submitted": i})
+    writer.close()
+    assert (tmp_path / "s.jsonl.1").exists()
+    fresh_clock = SimTime()
+    fresh = SeriesWriter(path, fresh_clock, window_ms=1000, ring_windows=3)
+    fresh_clock.add_millis(1000)
+    fresh.emit("p1", {"submitted": 0})
+    fresh.close()
+    last = latest_windows(read_series(path))["p1"]
+    assert last["seq"] == 0 and last["ctr"]["submitted"] == 0
+
+
+# --- sim timeline determinism ---
+
+
+def _sim_run(path, seed=7, fault_plan=None, commands=4, reorder=False):
+    config = Config(
+        n=3,
+        f=1,
+        gc_interval_ms=100,
+        executor_executed_notification_interval_ms=100,
+        telemetry_interval_ms=500,
+    )
+    planet = Planet.new("gcp")
+    regions = sorted(planet.regions())[:3]
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=2,
+        commands_per_client=commands,
+        payload_size=1,
+    )
+    runner = Runner(
+        EPaxos,
+        planet,
+        config,
+        workload,
+        clients_per_process=2,
+        process_regions=list(regions),
+        client_regions=list(regions),
+        seed=seed,
+        fault_plan=fault_plan,
+        telemetry_path=str(path),
+    )
+    if reorder:
+        runner.reorder_messages()
+    runner.run(extra_sim_time_ms=1000)
+
+
+def test_sim_same_seed_series_byte_identical(tmp_path):
+    _sim_run(tmp_path / "a.jsonl")
+    _sim_run(tmp_path / "b.jsonl")
+    a = (tmp_path / "a.jsonl").read_bytes()
+    assert a == (tmp_path / "b.jsonl").read_bytes()
+    assert a, "series must not be empty"
+    windows = read_series(str(tmp_path / "a.jsonl"))
+    assert {w["src"] for w in windows} == {"p1", "p2", "p3", "clients"}
+    clients = latest_windows(windows)["clients"]
+    assert clients["ctr"]["replied"] == 3 * 2 * 4
+    # non-vacuous: a telemetry-visible perturbation changes the bytes.
+    # (A bare seed change is NOT guaranteed visible — the PR-5 lesson:
+    # in the closed-loop sim it only picks which keys conflict — so
+    # perturb with reorder jitter, which shifts the latency windows.)
+    _sim_run(tmp_path / "c.jsonl", reorder=True)
+    assert a != (tmp_path / "c.jsonl").read_bytes()
+
+
+def test_sim_same_seed_series_byte_identical_under_faults(tmp_path):
+    plan = FaultPlan(seed=3, max_sim_time_ms=300_000).with_loss(0.1)
+    _sim_run(tmp_path / "a.jsonl", fault_plan=plan, commands=3)
+    _sim_run(tmp_path / "b.jsonl", fault_plan=plan, commands=3)
+    a = (tmp_path / "a.jsonl").read_bytes()
+    assert a == (tmp_path / "b.jsonl").read_bytes()
+    assert read_series(str(tmp_path / "a.jsonl")), "faulted run still emits"
+
+
+# --- exposition ---
+
+
+def test_prometheus_roundtrip_and_wellformedness():
+    hist = Histogram()
+    for value, count in ((1, 3), (7, 2), (900, 1)):
+        hist.increment(value, count)
+    text = render_prometheus(
+        {"submitted": 42, "device_busy_ms": 1.5},
+        {"queue_depth": 3},
+        {"latency_ms": hist},
+        labels={"pid": "1"},
+    )
+    parsed = parse_prometheus(text)  # strict: raises on malformation
+    labels = (("pid", "1"),)
+    assert parsed["fantoch_submitted_total"][labels] == 42
+    assert parsed["fantoch_device_busy_ms_total"][labels] == 1.5
+    assert parsed["fantoch_queue_depth"][labels] == 3
+    assert parsed["fantoch_latency_ms_count"][labels] == 6
+    assert parsed["fantoch_latency_ms_sum"][labels] == 3 + 14 + 900
+    buckets = parsed["fantoch_latency_ms_bucket"]
+    inf = next(v for k, v in buckets.items() if dict(k)["le"] == "+Inf")
+    assert inf == 6
+    le1 = next(v for k, v in buckets.items() if dict(k)["le"] == "1")
+    assert le1 == 3
+    # cumulative monotonicity across the bucket ladder
+    ordered = sorted(
+        (float(dict(k)["le"].replace("+Inf", "inf")), v)
+        for k, v in buckets.items()
+    )
+    assert all(a[1] <= b[1] for a, b in zip(ordered, ordered[1:]))
+
+
+def test_prometheus_parser_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_prometheus("fantoch_x_total 1\n")  # no # TYPE line
+    with pytest.raises(ValueError):
+        parse_prometheus(
+            "# TYPE fantoch_h histogram\n"
+            'fantoch_h_bucket{le="1"} 5\n'
+            'fantoch_h_bucket{le="2"} 3\n'  # non-cumulative
+        )
+    with pytest.raises(ValueError):
+        parse_prometheus(
+            "# TYPE fantoch_h histogram\n"
+            'fantoch_h_bucket{le="1"} 1\n'  # no +Inf bucket
+        )
+
+
+def test_metrics_server_scrape_roundtrip():
+    """A live endpoint serves the sample; the scrape parses strictly."""
+
+    def sample():
+        hist = Histogram()
+        hist.increment(4, 2)
+        return {"submitted": 9}, {"queue_depth": 1}, {"lat": hist}
+
+    async def scenario():
+        server = MetricsServer(sample, 0, labels={"pid": "7"})
+        await server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            loop = asyncio.get_running_loop()
+            text = await loop.run_in_executor(
+                None,
+                lambda: urllib.request.urlopen(url, timeout=5).read().decode(),
+            )
+            # unknown paths 404 without killing the server
+            status = await loop.run_in_executor(
+                None, lambda: _status(f"http://127.0.0.1:{server.port}/nope")
+            )
+            return text, status
+        finally:
+            await server.stop()
+
+    def _status(url):
+        try:
+            urllib.request.urlopen(url, timeout=5)
+            return 200
+        except urllib.error.HTTPError as exc:
+            return exc.code
+
+    text, status = asyncio.run(scenario())
+    parsed = parse_prometheus(text)
+    assert parsed["fantoch_submitted_total"][(("pid", "7"),)] == 9
+    assert status == 404
+
+
+# --- run-layer wiring (fast localhost row) ---
+
+
+def test_localhost_cluster_emits_series_and_exposition(tmp_path):
+    from fantoch_tpu.run.harness import run_localhost_cluster
+
+    scraped = {}
+
+    async def scraper(runtimes):
+        await asyncio.sleep(0.2)
+        port = runtimes[1].metrics_port
+        loop = asyncio.get_running_loop()
+        scraped["text"] = await loop.run_in_executor(
+            None,
+            lambda: urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode(),
+        )
+
+    config = Config(
+        n=3,
+        f=1,
+        gc_interval_ms=50,
+        executor_executed_notification_interval_ms=50,
+        telemetry_interval_ms=100,
+    )
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=1,
+        commands_per_client=15,
+        payload_size=1,
+    )
+    asyncio.run(
+        run_localhost_cluster(
+            EPaxos,
+            config,
+            workload,
+            clients_per_process=2,
+            observe_dir=str(tmp_path),
+            metrics_ports={1: 0},
+            chaos=scraper,
+        )
+    )
+    parsed = parse_prometheus(scraped["text"])
+    assert "fantoch_submitted_total" in parsed
+    assert "fantoch_replied_total" in parsed
+    total_replied = 0
+    for pid in (1, 2, 3):
+        windows = read_series(str(tmp_path / f"telemetry_p{pid}.jsonl"))
+        assert windows, f"p{pid} emitted no windows"
+        last = latest_windows(windows)[f"p{pid}"]
+        assert {"submitted", "replied", "shed_submissions"} <= set(last["ctr"])
+        assert "queue_depth" in last["g"]
+        total_replied += last["ctr"]["replied"]
+    assert total_replied == 3 * 2 * 15
+    client_last = latest_windows(
+        read_series(str(tmp_path / "telemetry_clients_p1.jsonl"))
+    )["clients"]
+    assert client_last["ctr"]["replied"] == 2 * 15
+    # the legacy pickle snapshot still rides the same cadence (one
+    # writer) and still reads back
+    from fantoch_tpu.run.observe import read_metrics_snapshot
+
+    snap = read_metrics_snapshot(str(tmp_path / "metrics_p1.gz"))
+    assert snap.workers, "unified writer stopped writing the snapshot"
+
+
+# --- the perf-regression gate ---
+
+
+def _bench():
+    import bench
+
+    return bench
+
+
+def test_regress_trips_on_2x_latency():
+    bench = _bench()
+    old = {
+        "metric": "epaxos_1m_cmds_50pct_conflict_graph_resolve_p50",
+        "value": 3.0,
+        "platform": "cpu",
+        "serving_newt_cmds_per_s": 40_000,
+        "serving_newt_definition": "d",
+    }
+    new = dict(old, value=6.0)
+    result = bench.regress_check(
+        bench_record(bench, new), bench_record(bench, old)
+    )
+    assert [v[0] for v in result["violations"]] == [old["metric"]]
+    assert not result["refused"]
+    # within the band: no violation
+    ok = bench.regress_check(
+        bench_record(bench, dict(old, value=4.0)), bench_record(bench, old)
+    )
+    assert not ok["violations"]
+
+
+def bench_record(bench, rec):
+    """Re-key the headline value the way load_bench_record does."""
+    rec = dict(rec)
+    rec[rec["metric"]] = rec["value"]
+    return rec
+
+
+def test_regress_throughput_direction():
+    bench = _bench()
+    old = {"metric": "m", "platform": "cpu", "serving_newt_cmds_per_s": 40_000,
+           "serving_newt_definition": "d"}
+    dropped = dict(old, serving_newt_cmds_per_s=20_000)
+    result = bench.regress_check(dropped, old)
+    assert [v[0] for v in result["violations"]] == ["serving_newt_cmds_per_s"]
+
+
+def test_regress_refuses_definition_mismatch():
+    bench = _bench()
+    old = {"metric": "m", "platform": "cpu", "serving_newt_cmds_per_s": 40_000,
+           "serving_newt_definition": "pipelined (r07)"}
+    new = dict(old, serving_newt_cmds_per_s=5,
+               serving_newt_definition="sync (r05)")
+    result = bench.regress_check(new, old)
+    assert not result["violations"], "refused keys must never be ratioed"
+    assert any(key == "serving_newt_cmds_per_s" for key, _r in result["refused"])
+
+
+def test_regress_refuses_platform_mismatch():
+    bench = _bench()
+    old = {"metric": "m", "platform": "tpu", "serving_newt_cmds_per_s": 1,
+           "serving_newt_definition": "d"}
+    new = dict(old, platform="cpu")
+    result = bench.regress_check(new, old)
+    assert not result["compared"] and not result["violations"]
+    assert result["refused"] and "platform" in result["refused"][0][1]
+
+
+def test_regress_loads_wrapped_trajectory_records(tmp_path):
+    """BENCH_r0N.json wrappers ({"parsed": record}) and raw records both
+    load; the headline value is re-keyed under its metric name."""
+    bench = _bench()
+    record = {"metric": "graph_resolve_p50", "value": 3.0, "platform": "cpu"}
+    raw = tmp_path / "raw.json"
+    raw.write_text(json.dumps(record))
+    wrapped = tmp_path / "wrapped.json"
+    wrapped.write_text(json.dumps({"n": 4, "rc": 0, "parsed": record}))
+    for path in (raw, wrapped):
+        loaded = bench.load_bench_record(str(path))
+        assert loaded["graph_resolve_p50"] == 3.0
+    with pytest.raises(ValueError):
+        empty = tmp_path / "empty.json"
+        empty.write_text(json.dumps({"n": 1, "rc": 1, "tail": "boom"}))
+        bench.load_bench_record(str(empty))
