@@ -16,15 +16,18 @@ test-fast:
 test-chaos:
 	python -m pytest tests/test_faults.py -x -q -m chaos
 
-# the recovery slice: per-dot MPrepare/MPromise recovery, noop commits,
-# FPaxos leader failover (sim + TCP), and the crashed-coordinator model
-# checker rows
+# the recovery slice: per-dot MPrepare/MPromise recovery (EPaxos/Atlas/
+# Newt AND Caesar's (clock, preds) synod), noop commits, FPaxos leader
+# failover (sim + TCP), and the crashed-coordinator model checker rows
+# (Caesar included, n=3/f=1 exhaustive)
 test-recovery:
 	python -m pytest tests/ -x -q -m recovery
 
 # the restart-and-rejoin slice: WAL durability edges, snapshot/restore,
-# crash-restart chaos rows (restored tolerance), TCP WAL recovery +
-# on_peer_up revival
+# crash-restart chaos rows (restored tolerance, all five protocols —
+# Caesar MSync records + FPaxos MSlotSync slot catch-up included), WAL
+# tail-replay rows, TCP WAL recovery + on_peer_up revival, the FPaxos
+# leader-kill 3-phase TCP row
 test-restart:
 	python -m pytest tests/ -x -q -m restart
 
@@ -85,9 +88,12 @@ telemetry-smoke:
 	python scripts/telemetry_smoke.py
 
 # chaos-fuzz gate: seeded fault-schedule sweep with composed nemeses
-# over EVERY protocol (fixed seed set), auditor-clean + byte-identical
-# determinism (same seed => same plan/trace/verdict).  Set
-# FANTOCH_FUZZ_BUDGET_S for a longer soak (nightly) — the per-push CI
-# slice runs the fixed set next to bench/trace/overload-smoke
+# over EVERY protocol x EVERY nemesis class (fixed seed set + targeted
+# Caesar-crash and FPaxos-restart rows, budget-checked), auditor-clean +
+# byte-identical determinism (same seed => same plan/trace/verdict).
+# Set FANTOCH_FUZZ_BUDGET_S for a longer soak (nightly — the soak
+# samples all five protocols with crash AND restart nemeses un-gated) —
+# the per-push CI slice runs the fixed set next to
+# bench/trace/overload-smoke
 fuzz-smoke:
 	python scripts/fuzz_smoke.py

@@ -66,6 +66,12 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--executor-monitor-execution-order", action="store_true")
     parser.add_argument("--gc-interval", type=int, default=50, metavar="MS")
     parser.add_argument("--leader", type=int, default=None, help="leader process (FPaxos)")
+    parser.add_argument(
+        "--fpaxos-leader-timeout", type=int, default=None, metavar="MS",
+        help="FPaxos leader failover: heartbeat at a quarter of this, "
+        "followers elect after ring-staggered silence (also unlocks the "
+        "crash-restart rejoin via MSlotSync; requires --gc-interval)",
+    )
     parser.add_argument("--newt-tiny-quorums", action="store_true")
     parser.add_argument("--newt-clock-bump-interval", type=int, default=None, metavar="MS")
     parser.add_argument("--newt-detached-send-interval", type=int, default=None, metavar="MS")
@@ -136,6 +142,7 @@ def config_from_args(args: argparse.Namespace):
         executor_monitor_execution_order=args.executor_monitor_execution_order,
         gc_interval_ms=args.gc_interval,
         leader=args.leader,
+        fpaxos_leader_timeout_ms=args.fpaxos_leader_timeout,
         newt_tiny_quorums=args.newt_tiny_quorums,
         newt_clock_bump_interval_ms=args.newt_clock_bump_interval,
         newt_detached_send_interval_ms=args.newt_detached_send_interval,

@@ -1,7 +1,9 @@
 """Chaos-fuzzing CLI: seeded sweeps and byte-identical repro replay.
 
-    # sweep seeded cases across the protocol matrix (exit 1 on violation;
-    # each finding is shrunk and written as a JSON repro artifact)
+    # sweep seeded cases across the full protocol x nemesis matrix
+    # (all five protocols, crash AND restart classes; exit 1 whenever
+    # ANY case files a repro artifact — each finding is shrunk, written
+    # as JSON, and named in its failure line)
     python -m fantoch_tpu.bin.fuzz run --seed 0 --cases 50 --out-dir repros/
 
     # replay a repro artifact byte-identically (exit 0 iff the recorded
@@ -85,7 +87,12 @@ def cmd_run(args) -> int:
         + ", ".join(f"{p}={c}" for p, c in sorted(clean_per_protocol.items()))
     )
     if findings:
-        print(f"{len(findings)} repro artifact(s) written")
+        # any filed artifact fails the sweep — no protocol is exempt
+        # (the Caesar filed-not-fixed carve-out died with PR 12), and
+        # every failure line names its artifact so the repro is one
+        # copy-paste away
+        for path in findings:
+            print(f"FAILED: repro artifact {path}")
         return 1
     return 0
 

@@ -42,6 +42,18 @@ class PredecessorsExecutionInfo:
     deps: Set[Dot]
 
 
+@dataclass
+class PredecessorsNoop:
+    """A dot committed as a recovered noop (protocol/recovery.py): nothing
+    executes, but dependents waiting on the dot in either phase resolve —
+    the Caesar analog of the graph executor's GraphNoop seam."""
+
+    dot: Dot
+
+
+MONITOR_PENDING_THRESHOLD_MS = 1000
+
+
 class _Vertex:
     __slots__ = ("dot", "cmd", "clock", "deps", "missing_deps", "start_time_ms")
 
@@ -80,6 +92,7 @@ class PredecessorsGraph:
     def __init__(self, process_id: ProcessId, config: Config):
         ids = [pid for pid, _ in all_process_ids(config.shard_count, config.n)]
         self._process_id = process_id
+        self._config = config
         self._committed_clock: AEClock = AEClock(ids)
         self._executed_clock: AEClock = AEClock(ids)
         self._vertices: Dict[Dot, _Vertex] = {}
@@ -112,6 +125,91 @@ class PredecessorsGraph:
         # commands blocked on this dot at phase one may advance
         self._try_phase_one_pending(dot, time)
         self._move_to_phase_one(dot, time)
+
+    def handle_noop(self, dot: Dot, time: SysTime) -> None:
+        """A recovery-committed noop: mark the dot committed AND executed
+        (nothing runs) and wake everything waiting on it in either phase —
+        a phase-two waiter necessarily indexed the dot before it was known
+        to be a noop, so both indexes must drain."""
+        added = self._committed_clock.add(dot.source, dot.sequence)
+        assert added, "commands are committed exactly once"
+        added = self._executed_clock.add(dot.source, dot.sequence)
+        assert added
+        assert dot not in self._vertices, "a noop dot has no vertex"
+        self._try_phase_one_pending(dot, time)
+        self._try_phase_two_pending(dot, time)
+
+    def monitor_pending(self, time: SysTime):
+        """Liveness watchdog (the graph executor's VertexIndex contract):
+        log long-pending commands, panic on pending-with-no-missing-deps,
+        surface a typed StalledExecutionError when missing dependencies
+        stay uncommitted past ``Config.executor_pending_fail_ms``, and
+        return the missing dots so the runner can nudge the protocol's
+        recovery plane (``Protocol.nudge_recovery``)."""
+        fail_ms = self._config.executor_pending_fail_ms
+        threshold = (
+            MONITOR_PENDING_THRESHOLD_MS
+            if fail_ms is None
+            else min(MONITOR_PENDING_THRESHOLD_MS, fail_ms)
+        )
+        now = time.millis()
+        stuck_without_missing: Set[Dot] = set()
+        stalled_missing: Dict[Dot, Set[Dot]] = {}
+        stalled_for = 0
+        all_missing: Set[Dot] = set()
+        for vertex in self._vertices.values():
+            pending_for = now - vertex.start_time_ms
+            if pending_for < threshold:
+                continue
+            missing = self._missing_dependencies(vertex)
+            if not missing:
+                stuck_without_missing.add(vertex.dot)
+            else:
+                all_missing |= missing
+                if fail_ms is not None and pending_for >= fail_ms:
+                    stalled_missing[vertex.dot] = missing
+                    stalled_for = max(stalled_for, pending_for)
+        if stuck_without_missing:
+            raise AssertionError(
+                f"p{self._process_id}: commands pending without missing "
+                f"dependencies: {stuck_without_missing}"
+            )
+        if stalled_missing:
+            from fantoch_tpu.errors import StalledExecutionError
+
+            raise StalledExecutionError(
+                self._process_id,
+                stalled_missing,
+                stalled_for,
+                self._config.recovery_delay_ms,
+            )
+        return all_missing
+
+    def _missing_dependencies(self, vertex: _Vertex) -> Set[Dot]:
+        """Transitively uncommitted dependency dots blocking ``vertex``:
+        an uncommitted dep blocks phase one directly; a committed-but-
+        unexecuted lower-clock dep blocks phase two through ITS missing
+        deps.  Iterative with a visited set — conflict chains under high
+        contention fan out, and a naive recursion re-walks shared
+        subchains exponentially (fuzzer-found watchdog livelock)."""
+        missing: Set[Dot] = set()
+        visited: Set[Dot] = {vertex.dot}
+        stack = [vertex]
+        while stack:
+            current = stack.pop()
+            for dep in current.deps:
+                if dep in visited:
+                    continue
+                if self._executed_clock.contains(dep.source, dep.sequence):
+                    continue
+                if not self._committed_clock.contains(dep.source, dep.sequence):
+                    missing.add(dep)
+                    continue
+                visited.add(dep)
+                dep_vertex = self._vertices.get(dep)
+                if dep_vertex is not None and dep_vertex.clock < current.clock:
+                    stack.append(dep_vertex)
+        return missing
 
     def _move_to_phase_one(self, dot: Dot, time: SysTime) -> None:
         vertex = self._vertices[dot]
@@ -271,7 +369,13 @@ class PredecessorsExecutor(Executor):
         )
         self._to_clients: Deque[ExecutorResult] = deque()
 
-    def handle(self, info: PredecessorsExecutionInfo, time) -> None:
+    def handle(self, info, time) -> None:
+        if isinstance(info, PredecessorsNoop):
+            # execute-at-commit has no ordering state to resolve
+            if not self._execute_at_commit:
+                self._graph.handle_noop(info.dot, time)
+                self._drain()
+            return
         if self._execute_at_commit:
             self._execute(info.cmd)
             return
@@ -281,13 +385,26 @@ class PredecessorsExecutor(Executor):
     def handle_batch(self, infos, time) -> None:
         """Batched seam: with ``Config.batched_pred_executor`` the whole
         batch's two-phase countdown resolves as one device kernel
-        (ops/pred_resolve.py); otherwise per-info."""
+        (ops/pred_resolve.py); otherwise per-info.  Noops take the
+        per-info path either way (they carry no clock for the kernel)."""
         if not self._batched or self._execute_at_commit:
             for info in infos:
                 self.handle(info, time)
             return
-        self._graph.add_batch(infos, time)
+        adds = [i for i in infos if not isinstance(i, PredecessorsNoop)]
+        for info in infos:
+            if isinstance(info, PredecessorsNoop):
+                self._graph.handle_noop(info.dot, time)
+        if adds:
+            self._graph.add_batch(adds, time)
         self._drain()
+
+    def monitor_pending(self, time):
+        """Liveness watchdog; returns the missing dependency dots (if any)
+        so the runner can nudge the protocol's recovery plane."""
+        if self._execute_at_commit:
+            return None
+        return self._graph.monitor_pending(time)
 
     def _drain(self) -> None:
         while True:

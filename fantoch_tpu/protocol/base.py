@@ -146,6 +146,12 @@ class Protocol(ABC):
         committed horizon so live peers stream the commits this process
         missed while down."""
 
+    def note_durable_chosen(self, records) -> None:
+        """Restart-replay hook for slot-ordered protocols: ``(slot, cmd)``
+        records whose effects the WAL tail replay already applied.
+        Default no-op; FPaxos folds them into its chosen log + committed
+        watermark so the rejoin MSlotSync floor covers them."""
+
     def note_durable_commits(self, dots) -> None:
         """Restart-replay hook: commit dots whose effects the WAL tail
         replay already applied to the executors.  Default no-op;

@@ -167,6 +167,36 @@ class MultiSynod(Generic[V]):
             for slot, commander in self._commanders.items()
         )
 
+    def resume_above(self, slot: Slot) -> None:
+        """Floor the slot allocator: a freshly-elected leader resumes
+        above every slot it can PROVE allocated — the promise carry map
+        alone is not enough once GC pruned globally-stable slots from the
+        acceptor maps (the winner would re-allocate stable slots, whose
+        re-chosen events every replica's stable-floor guard then drops:
+        the command is lost and its client hangs forever)."""
+        self._leader.last_slot = max(self._leader.last_slot, slot)
+
+    def demote_if_superseded(self, ballot: Ballot):
+        """A higher-ballot leadership proof arrived (an election heartbeat
+        this process never voted in — e.g. it was crashed during the
+        campaign and restored a stale ``is_leader``): stop allocating and
+        pop every commander at a superseded ballot.  Those rounds can
+        never complete (n - f acceptors joined the higher ballot, so at
+        most f could still accept — below the f + 1 choose threshold);
+        the protocol re-forwards their values to the real leader.
+        Returns the popped (ballot, slot, value) triples, sorted."""
+        if not self._leader.is_leader or ballot <= self._leader.ballot:
+            return []
+        self._leader.is_leader = False
+        stale = sorted(
+            (commander.ballot, slot, commander.value)
+            for slot, commander in self._commanders.items()
+            if commander.ballot < ballot
+        )
+        for _b, slot, _v in stale:
+            del self._commanders[slot]
+        return stale
+
     def submit(self, value: V):
         """MSpawnCommander if we're the leader, else MForwardSubmit."""
         allocated = self._leader.try_submit()

@@ -96,6 +96,21 @@ class SequentialKeyClocks:
                     )
         return predecessors
 
+    def max_seq(self, cmd: Command, exclude: Optional[Dot] = None) -> int:
+        """Highest timestamp sequence indexed on any of the command's keys
+        (0 when none), excluding ``exclude`` (the dot under recovery must
+        not floor itself).  The recovery plane's clock floor: every
+        conflicting command this replica knows about — committed,
+        accepted, executed-but-not-yet-GC'd — sits at or below it, so a
+        free-choice recovered clock lifted strictly above the quorum max
+        can never land below a timestamp survivors executed past."""
+        floor = 0
+        for key in cmd.keys(self.shard_id):
+            for clock, dot in self._clocks.get(key, {}).items():
+                if dot != exclude and clock.seq > floor:
+                    floor = clock.seq
+        return floor
+
     @classmethod
     def parallel(cls) -> bool:
         return False
@@ -118,6 +133,12 @@ class QuorumClocks:
         self.clock = Clock.zero(process_id)
         self.deps: Set[Dot] = set()
         self.ok = True
+
+    def contains(self, process_id: ProcessId) -> bool:
+        """Duplicate-delivery dedup (the PR 9 mcollectack class): counting
+        one participant twice would complete the quorum with fewer
+        distinct reports — an unsound fast path."""
+        return process_id in self._participants
 
     def add(self, process_id: ProcessId, clock: Clock, deps: Set[Dot], ok: bool) -> None:
         assert len(self._participants) < self.fast_quorum_size
@@ -146,6 +167,10 @@ class QuorumRetries:
         self.write_quorum_size = write_quorum_size
         self._participants: Set[ProcessId] = set()
         self.deps: Set[Dot] = set()
+
+    def contains(self, process_id: ProcessId) -> bool:
+        """Duplicate-delivery dedup (see QuorumClocks.contains)."""
+        return process_id in self._participants
 
     def add(self, process_id: ProcessId, deps: Set[Dot]) -> None:
         assert len(self._participants) < self.write_quorum_size

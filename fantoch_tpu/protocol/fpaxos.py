@@ -52,6 +52,7 @@ from fantoch_tpu.protocol.common.multi_synod import (
     MultiSynod,
     SlotGCTrack,
 )
+from fantoch_tpu.protocol.sync import MSlotSync, MSlotSyncReply, SlotSyncMixin
 from fantoch_tpu.run.routing import (
     LEADER_WORKER_INDEX,
     worker_index_no_shift,
@@ -137,7 +138,7 @@ class LeaderCheckEvent:
     silence (interval = fpaxos_leader_timeout_ms // 4)."""
 
 
-class FPaxos(Protocol):
+class FPaxos(SlotSyncMixin, Protocol):
     Executor = SlotExecutor
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
@@ -181,9 +182,26 @@ class FPaxos(Protocol):
         # _handle_mgc keeps only the un-stable tail)
         self._seen_rifls: Set[Rifl] = set()
         self._rifl_slot: Dict[Rifl, int] = {}
-        # chosen slots not yet stable (guards re-chosen duplicates at
-        # takeover); pruned by GC
-        self._chosen_slots: Set[int] = set()
+        # chosen log: slot -> command for every chosen slot not yet
+        # globally stable.  Doubles as (a) the re-chosen/duplicate dedup
+        # set at takeover and under at-least-once delivery, and (b) the
+        # retained record stream a rejoining replica pulls via MSlotSync
+        # (retention argument: the dead replica's GC watermark froze, so
+        # stability — and this log's pruning — stalled at its last
+        # report).  Pruned by GC at the stability-minus-window horizon
+        self._chosen_slots: Dict[int, Command] = {}
+        # last virtual ms pending forwards were (re-)sent: lost forwards
+        # (message loss; a leader crash-restart window with no election)
+        # retry on a timeout cadence — the leader's rifl dedup makes
+        # re-forwards exactly-once
+        self._last_reforward_ms: Optional[int] = None
+        # last virtual ms the leader re-drove its in-flight accept
+        # rounds: an MAccept toward a crash-RESTARTING write-quorum
+        # member evaporates during the downtime (no detector fires for a
+        # restarting peer), and nothing else retries phase 2 — the slot,
+        # and everything ordered after it, would stall forever
+        # (fuzzer-found follower crash-restart stall)
+        self._last_redrive_ms: Optional[int] = None
         # peers the run layer's failure detector declared dead
         self._down: Set[ProcessId] = set()
 
@@ -232,6 +250,8 @@ class FPaxos(Protocol):
             self._handle_mpromise(from_, msg.ballot, msg.accepted, time)
         elif isinstance(msg, MLeaderHeartbeat):
             self._handle_leader_heartbeat(from_, msg.ballot, time)
+        elif self.handle_slot_sync_message(from_, msg, time):
+            pass
         else:
             raise AssertionError(f"unknown message {msg}")
 
@@ -298,7 +318,9 @@ class FPaxos(Protocol):
         if len(self._chosen_slots) <= 2 * self._DEDUP_WINDOW:
             return
         floor = max(self._chosen_slots) - self._DEDUP_WINDOW
-        self._chosen_slots = {s for s in self._chosen_slots if s > floor}
+        self._chosen_slots = {
+            s: cmd for s, cmd in self._chosen_slots.items() if s > floor
+        }
         for rifl, slot in list(self._rifl_slot.items()):
             if slot <= floor:
                 self._rifl_slot.pop(rifl, None)
@@ -351,7 +373,7 @@ class FPaxos(Protocol):
         # GC-straggler guard: its slot executed everywhere long ago)
         if slot in self._chosen_slots or slot <= self._gc_track.stable_floor:
             return
-        self._chosen_slots.add(slot)
+        self._chosen_slots[slot] = cmd
         if self.bp.config.gc_interval_ms is None:
             # without GC nothing ever prunes the dedup state — keep a
             # bounded recent-slot window instead of growing forever (a
@@ -386,11 +408,57 @@ class FPaxos(Protocol):
             # allocate a SECOND slot for an executed command
             # (fuzzer-found duplicate execution)
             cut = end - self._DEDUP_WINDOW
-            self._chosen_slots = {s for s in self._chosen_slots if s > cut}
+            self._chosen_slots = {
+                s: cmd for s, cmd in self._chosen_slots.items() if s > cut
+            }
             for rifl, slot in list(self._rifl_slot.items()):
                 if slot <= cut:
                     self._rifl_slot.pop(rifl, None)
                     self._seen_rifls.discard(rifl)
+
+    # --- rejoin catch-up (protocol/sync.py SlotSyncMixin) ---
+
+    def rejoin(self, time: SysTime) -> None:
+        """Restart hook: pull the chosen slots this replica missed while
+        down, and restart the leader-silence clock (the restored
+        ``_leader_heard`` is a pre-crash timestamp — judging the current
+        leader by it would fire a spurious election on the first tick)."""
+        if self._failover:
+            self._leader_heard = time.millis()
+            self._last_reforward_ms = time.millis()
+        SlotSyncMixin.rejoin(self, time)
+
+    def _slot_sync_floor(self) -> int:
+        return self._gc_track.committed()
+
+    def _slot_sync_records(self, floor: int):
+        # sorted: chunk contents are a pure function of protocol state,
+        # so same-seed traces stay identical
+        return sorted(
+            (slot, cmd)
+            for slot, cmd in self._chosen_slots.items()
+            if slot > floor
+        )
+
+    def _apply_slot_sync_record(self, from_: ProcessId, record, time: SysTime) -> None:
+        slot, cmd = record
+        # the normal chosen path: chosen-slot dedup + the stable floor
+        # make overlapping peer replies exactly-once
+        self._handle_mchosen(slot, cmd)
+
+    def note_durable_chosen(self, records) -> None:
+        """Restart-replay hook (run/wal.py): fold WAL-tail ``(slot, cmd)``
+        records into the chosen log + committed watermark so the rejoin
+        MSlotSync floor covers them — peers must not re-stream slots whose
+        effects the executor tail replay already applied."""
+        for slot, cmd in records:
+            if slot in self._chosen_slots or slot <= self._gc_track.stable_floor:
+                continue
+            self._chosen_slots[slot] = cmd
+            self._gc_track.commit(slot)
+            if self._failover:
+                self._seen_rifls.add(cmd.rifl)
+                self._rifl_slot[cmd.rifl] = slot
 
     # --- leader failover ---
 
@@ -406,7 +474,48 @@ class FPaxos(Protocol):
                         self.bp.all_but_me(), MLeaderHeartbeat(self._leader_ballot)
                     )
                 )
+                # re-drive accept rounds stuck past a timeout: the
+                # original fan-out may have been lost to a write-quorum
+                # member's crash-restart window (frames to a down process
+                # evaporate; a RESTARTING peer never trips the failure
+                # detector, so on_peer_down's re-drive cannot cover this).
+                # Broadcast is idempotent: acceptors re-accepting the same
+                # (ballot, slot, value) are no-ops and the chosen-slot
+                # dedup swallows re-chosen duplicates
+                inflight = self._multi_synod.inflight()
+                if inflight:
+                    if self._last_redrive_ms is None:
+                        self._last_redrive_ms = now
+                    elif (
+                        now - self._last_redrive_ms
+                        >= self.bp.config.fpaxos_leader_timeout_ms
+                    ):
+                        self._last_redrive_ms = now
+                        for ballot, slot, cmd in inflight:
+                            self._to_processes.append(
+                                ToSend(self.bp.all(), MAccept(ballot, slot, cmd))
+                            )
+                else:
+                    self._last_redrive_ms = None
             return
+        # pending-forward retry: a forward toward the leader can be lost
+        # (message loss; a leader that crash-restarted inside the timeout
+        # window — no election, so no heartbeat-change re-forward fires).
+        # Retry on a timeout cadence; the leader's unconditional rifl
+        # dedup makes re-forwards exactly-once, and dedup entries are
+        # retained until global stability (which cannot pass a slot this
+        # follower never saw chosen)
+        if self._pending_forwards:
+            if self._last_reforward_ms is None:
+                self._last_reforward_ms = now
+            elif now - self._last_reforward_ms >= self.bp.config.fpaxos_leader_timeout_ms:
+                self._last_reforward_ms = now
+                for cmd in self._pending_forwards.values():
+                    self._to_processes.append(
+                        ToSend({self._leader}, MForwardSubmit(cmd))
+                    )
+        else:
+            self._last_reforward_ms = None
         if self._leader_heard is None:
             self._leader_heard = now  # start the clock at the first tick
             return
@@ -443,9 +552,21 @@ class FPaxos(Protocol):
         carry = self._multi_synod.handle_promise(from_, ballot, accepted)
         if carry is None:
             return
-        # won the election: adopt leadership, re-propose every
-        # possibly-chosen slot at our ballot, re-submit our own pending
-        # forwards, and announce
+        # won the election: adopt leadership, resume allocation above the
+        # chosen/stable horizon — the carry map covers accepted-but-
+        # unstable slots only, and once GC pruned the acceptor maps a
+        # winner trusting it alone re-allocates STABLE slots, whose
+        # re-chosen events every stable-floor guard drops (the command is
+        # lost, its client hangs; found by the FPaxos leader-kill WAL
+        # restart row).  Any chosen-but-unstable slot is in some
+        # promiser's accepted map (quorum intersection), and any stable
+        # slot is at or below our own committed frontier (stability is a
+        # min that includes us), so the max of the two is a sound floor
+        self._multi_synod.resume_above(
+            max(self._gc_track.committed(), max(self._chosen_slots, default=0))
+        )
+        # then re-propose every possibly-chosen slot at our ballot,
+        # re-submit our own pending forwards, and announce
         self._leader = self.id
         self._leader_ballot = ballot
         for slot, cmd in carry.items():
@@ -456,6 +577,23 @@ class FPaxos(Protocol):
             self._to_processes.append(ToForward(MSpawnCommander(ballot, slot, cmd)))
         pending, self._pending_forwards = self._pending_forwards, {}
         for cmd in pending.values():
+            slot = self._rifl_slot.get(cmd.rifl)
+            if slot is not None:
+                # a stale own allocation from a superseded leadership
+                # (pre-crash commander whose accept landed nowhere): the
+                # dedup entry must clear or the re-submission below is
+                # dropped and the command lost.  Stale means the slot is
+                # occupied by NOBODY in the n-f promise view (unchosen —
+                # it would have appeared in the carry map) OR by a
+                # DIFFERENT command (an intervening leader reused the
+                # slot number); only a same-rifl occupant proves our
+                # allocation survived and the dedup should hold
+                occupant = carry.get(slot)
+                if occupant is None:
+                    occupant = self._chosen_slots.get(slot)
+                if occupant is None or occupant.rifl != cmd.rifl:
+                    self._seen_rifls.discard(cmd.rifl)
+                    self._rifl_slot.pop(cmd.rifl, None)
             self._handle_submit(cmd)
         self._to_processes.append(
             ToSend(self.bp.all_but_me(), MLeaderHeartbeat(ballot))
@@ -464,11 +602,19 @@ class FPaxos(Protocol):
     def _handle_leader_heartbeat(self, from_: ProcessId, ballot: int, time) -> None:
         if ballot < self._leader_ballot:
             return  # stale leader
+        # a higher-ballot heartbeat proves an election this process never
+        # voted in (it was crashed during the campaign and restored a
+        # stale is_leader): stop allocating, and hand the values stranded
+        # in superseded commanders to the real leader — those rounds can
+        # never complete, and nothing else would retry them
+        stale = self._multi_synod.demote_if_superseded(ballot)
+        for _b, _slot, cmd in stale:
+            self._pending_forwards[cmd.rifl] = cmd
         changed = from_ != self._leader
         self._leader = from_
         self._leader_ballot = ballot
         self._leader_heard = time.millis()
-        if changed and self._pending_forwards:
+        if (changed or stale) and self._pending_forwards:
             # our earlier forwards may have died with the old leader:
             # re-forward everything not yet chosen (the leader dedups)
             for cmd in self._pending_forwards.values():
@@ -527,9 +673,13 @@ class FPaxos(Protocol):
             # leadership state (election, pending re-forwards) lives with
             # the submit path on the leader worker
             return worker_index_no_shift(LEADER_WORKER_INDEX)
-        if isinstance(msg, (MAccept, MChosen, MGarbageCollection, MPrepare)):
-            # the acceptor also learns chosen slots, runs gc tracking, and
-            # answers election prepares (its accepted map is the promise)
+        if isinstance(
+            msg, (MAccept, MChosen, MGarbageCollection, MPrepare, MSlotSync, MSlotSyncReply)
+        ):
+            # the acceptor also learns chosen slots, runs gc tracking,
+            # answers election prepares (its accepted map is the promise),
+            # and serves/applies the rejoin slot-sync stream (the chosen
+            # log lives with the MChosen handler)
             return worker_index_no_shift(ACCEPTOR_WORKER_INDEX)
         if isinstance(msg, (MSpawnCommander, MAccepted)):
             return worker_index_shift(msg.slot)

@@ -57,7 +57,12 @@ from fantoch_tpu.protocol.recovery import (
     RecoveryEvent,
     RecoveryMixin,
 )
-from fantoch_tpu.protocol.sync import MSync, MSyncReply, SyncMixin
+from fantoch_tpu.protocol.sync import (
+    MSync,
+    MSyncBackfill,
+    MSyncReply,
+    SyncMixin,
+)
 from fantoch_tpu.protocol.partial import (
     MForwardSubmit,
     MShardAggregatedCommit,
@@ -603,6 +608,9 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin,
             info.status = Status.PAYLOAD
             self._replay_buffered_commit(dot, time)
 
+    def _recovery_commit_known(self, dot) -> bool:
+        return dot in self._buffered_commits
+
     def _recovery_consensus_msg(self, dot, ballot, value, cmd):
         return MConsensus(dot, ballot, value, cmd)
 
@@ -667,7 +675,7 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin,
             ),
         ):
             return worker_dot_index_shift(msg.dot)
-        if isinstance(msg, (MSync, MSyncReply)):
+        if isinstance(msg, (MSync, MSyncReply, MSyncBackfill)):
             # dotless rejoin traffic: serialized on the GC worker (whose
             # committed clock it reads and whose retention it rides)
             return worker_index_no_shift(GC_WORKER_INDEX)

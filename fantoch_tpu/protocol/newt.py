@@ -86,7 +86,12 @@ from fantoch_tpu.protocol.recovery import (
     RecoveryEvent,
     RecoveryMixin,
 )
-from fantoch_tpu.protocol.sync import MSync, MSyncReply, SyncMixin
+from fantoch_tpu.protocol.sync import (
+    MSync,
+    MSyncBackfill,
+    MSyncReply,
+    SyncMixin,
+)
 from fantoch_tpu.run.routing import (
     worker_dot_index_shift,
     worker_index_no_shift,
@@ -691,10 +696,13 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
                 self.key_clocks.detached(cmd, buffered_bump, self._detached)
             self._replay_buffered_mcommit(dot)
 
+    def _recovery_commit_known(self, dot) -> bool:
+        return dot in self._buffered_mcommits
+
     def _recovery_consensus_msg(self, dot, ballot, value, cmd):
         return MConsensus(dot, ballot, value, cmd)
 
-    def _recovery_promise_floor(self, info) -> int:
+    def _recovery_promise_floor(self, dot, info) -> int:
         # Tempo-style promise: CONSUME votes through clock+1 (a full
         # proposal) and hold them with the dot, reporting the consumed
         # clock as the floor.  A floor merely *sampled* from the key
@@ -716,7 +724,7 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
         info.recovery_consumed = True
         return clock
 
-    def _recovery_adjust_value(self, info, value, floor: int):
+    def _recovery_adjust_value(self, dot, info, value, floor: int):
         # free-choice clocks lift to the quorum's max consumed floor: the
         # floor reporter consumed votes through it, so the lifted clock is
         # covered by held ranges (no +1 — a clock above the consumed
@@ -763,25 +771,21 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
         # commit-coupled so no peer's frontier keeps our gap
         self._handle_mcommit(from_, dot, clock, Votes(), recovered=True)
 
-    def _sync_backfill_actions(self, targets) -> None:
-        """Vote-frontier healing: our issued votes are exactly [1, clock]
-        per key (see KeyClocks.backfill_votes) — re-state them toward the
-        rejoin participants (ranges dedup in the vote tables), MINUS the
+    def _sync_backfill_votes(self) -> Optional[Votes]:
+        """Vote-frontier healing payload: our issued votes are exactly
+        [1, clock] per key (see KeyClocks.backfill_votes), MINUS the
         ranges consumed for still-pending dots.  Those must only ever
         travel commit-coupled: a table that sees them detached before
         the dot's ops would let stability overtake the commit and
         execute around it (the order-divergence hazard the commit
         handler's held-vote discipline exists to prevent).  The pending
         copies the recovery plane keeps (``info.votes``) are exactly
-        that exclusion set, so backfill requires recovery enabled.
-        Ordering note: the sync plane appends the backfill AFTER the
-        MSyncReply record chunks, so a receiver folds every missing
-        commit's ops in before the frontier re-statement arrives."""
+        that exclusion set, so backfill requires recovery enabled."""
         if not self._recovery_enabled():
-            return
+            return None
         votes = self.key_clocks.backfill_votes()
         if votes.is_empty():
-            return
+            return None
         me = self.bp.process_id
         pending: Dict[str, list] = {}
         for _dot, info in self._cmds.items():
@@ -793,8 +797,40 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
                         pending.setdefault(key, []).append((vote.start, vote.end))
         if pending:
             votes = _subtract_pending(votes, pending, me)
-        if not votes.is_empty():
-            self._to_processes.append(ToSend(set(targets), MDetached(votes)))
+        return None if votes.is_empty() else votes
+
+    def _sync_backfill_payload(self):
+        # the record-serving side: barrier-gated (MSyncBackfill) — the
+        # pending subtraction covers OUR unfinished dots, but ranges we
+        # consumed for commits the REQUESTER has not applied yet are only
+        # safe once it has folded every streamed record in, and delivery
+        # under fault plans can reorder a plain detached message ahead of
+        # the record chunks (fuzzer-found restart order divergence)
+        return self._sync_backfill_votes()
+
+    def _apply_sync_backfill(self, from_, votes, time) -> None:
+        self._handle_mdetached(votes)
+
+    def _sync_backfill_blocked(self) -> bool:
+        # a payload-less buffered commit here means some dot's ops are
+        # still in flight to us: an incoming backfill can carry the
+        # ranges its quorum consumed for exactly that dot, and applying
+        # them first lets stability overtake the commit (fuzzer-found:
+        # a rejoiner's column reached a live peer ahead of the peer's
+        # lost-behind-retransmits MCollect)
+        return bool(self._buffered_mcommits)
+
+    def _sync_backfill_actions(self, targets) -> None:
+        """The REJOINER's own frontier re-statement toward live peers —
+        sent through the same gated MSyncBackfill envelope (records=0:
+        there is no record stream in this direction, but the receiver's
+        buffered-commit gate must still hold it while any of its
+        in-flight commits could own the covered ranges)."""
+        votes = self._sync_backfill_votes()
+        if votes is not None:
+            self._to_processes.append(
+                ToSend(set(targets), MSyncBackfill(votes, 0))
+            )
 
     # --- partial-replication adapters (clock max; newt.rs:825-895) ---
 
@@ -1018,6 +1054,10 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
         if not self._detached.is_empty():
             detached, self._detached = self._detached, Votes()
             self._to_processes.append(ToSend(self.bp.all(), MDetached(detached)))
+        # held rejoin backfills re-check on this cadence: the
+        # buffered-commit gate clears as in-flight commits resolve, and
+        # no single message reliably anchors that release
+        self._sync_release_backfills(None)
 
     def _dot_in_my_shard(self, dot: Dot) -> bool:
         return dot.target_shard(self.bp.config.n) == self.bp.shard_id
@@ -1048,7 +1088,7 @@ class Newt(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin, Protocol
         if isinstance(msg, MDetached):
             # any worker may feed detached votes to the executors
             return worker_index_no_shift(0)
-        if isinstance(msg, (MSync, MSyncReply)):
+        if isinstance(msg, (MSync, MSyncReply, MSyncBackfill)):
             # dotless rejoin traffic: serialized on the GC worker (whose
             # committed clock it reads and whose retention it rides)
             return worker_index_no_shift(0)

@@ -26,15 +26,19 @@ Protocol flow (per overdue dot):
 4. **Select** — with ``n - f`` promises the synod proposer picks the
    highest-ballot accepted value; if nothing was ever accepted the
    protocol's ``proposal_gen`` runs over the ballot-0 reports: the union
-   of reported deps / the max reported clock, or the protocol's *noop*
-   bottom for dots never payloaded anywhere visible (owner crashed before
-   its MCollect got out).  On that free-choice path the value is also
-   passed through the protocol's ``_recovery_adjust_value`` with the max
-   ``clock_floor`` the promises carried: Newt lifts recovered clocks
-   strictly above the quorum's current key clocks, so a recovery-decided
-   timestamp can never land at or below timestamps the survivors may
-   already have executed past (the live-vs-reconstructed order
-   divergence a *restarted* replica would otherwise expose).
+   of reported deps (graph family) / the max reported clock (Newt) / the
+   max reported clock with the union of reported predecessor sets
+   (Caesar), or the protocol's *noop* bottom for dots never payloaded
+   anywhere visible (owner crashed before its MCollect got out).  On
+   that free-choice path the value is also passed through the protocol's
+   ``_recovery_adjust_value`` with the max ``clock_floor`` the promises
+   carried: Newt lifts recovered clocks strictly above the quorum's
+   current key clocks, and Caesar re-issues a fresh unique timestamp
+   above the quorum's max indexed sequence (re-extending the predecessor
+   union under it), so a recovery-decided timestamp can never land at or
+   below timestamps the survivors may already have executed past (the
+   live-vs-reconstructed order divergence a *restarted* replica would
+   otherwise expose).
 5. **Phase 2** — the chosen value flows through the protocols' existing
    MConsensus/MConsensusAck handlers (broadcast rather than
    write-quorum-only, since quorum members may be the dead ones) and
@@ -167,13 +171,13 @@ class RecoveryMixin:
     def _recovery_track(self, dot: Dot, time: SysTime) -> None:
         if not self._recovery_enabled() or dot in self._pending_since:
             return
-        gc_track = getattr(self, "_gc_track", None)
-        if gc_track is not None and gc_track.contains(dot):
+        if self._recovery_settled(dot):
             # straggler for a dot already committed everywhere and GC'd
-            # (a late duplicate prepare/commit): enrolling it would pin a
-            # resurrected info in permanent recovery churn — its noop
-            # commit is dropped by every receiver's own straggler guard,
-            # so the round ladder would never terminate
+            # (a late duplicate prepare/commit), or settled by a WAL-tail
+            # replay: enrolling it would pin a resurrected info in
+            # permanent recovery churn — its noop commit is dropped by
+            # every receiver's own straggler guard, so the round ladder
+            # would never terminate
             return
         self._pending_since[dot] = time.millis()
 
@@ -221,8 +225,18 @@ class RecoveryMixin:
                 continue
             # stagger: the owner retries after one delay, its ring
             # successor after two, and so on — one new proposer per
-            # interval
-            wait = delay * (1 + (me - dot.source) % n)
+            # interval.  For a dot whose DECISION this process already
+            # holds (a payload-less buffered commit: the rejoin-gap
+            # class), the full ring stagger only delays a heal that any
+            # committed peer answers with an instant chosen reply — so
+            # those dots compress the stagger to quarter-delay strides
+            # (still distinct per process, so concurrent recoverers stay
+            # phase-disjoint; fuzzer-found: a rejoiner's buffered commit
+            # at ring distance 3 healed delay*4 late, past the run tail)
+            stride = delay
+            if self._recovery_commit_known(dot):
+                stride = max(1, delay // 4)
+            wait = delay + stride * ((me - dot.source) % n)
             if now - self._pending_since[dot] < wait:
                 continue
             # rebase so this proposer retries once per n*delay, keeping
@@ -273,6 +287,25 @@ class RecoveryMixin:
             return False
         return True
 
+    def _recovery_gc_straggler(self, dot: Dot) -> bool:
+        """True when ``dot`` already committed here and its info was (or
+        can be) GC'd: a LATE DUPLICATE recovery message for it must be
+        dropped outright.  ``_cmds.get`` would resurrect a fresh info,
+        and the promise-floor hook would then CONSUME key-clock votes for
+        a dot whose commit — the only thing that ever releases them —
+        already happened: the consumed ranges leak forever, the
+        acceptor's vote column keeps a permanent hole, and timestamp
+        stability stalls mesh-wide (fuzzer-found under the
+        late-retransmit nemesis, soak seed 99).
+
+        Committed-but-still-live dots (info present) are NOT stragglers:
+        their synod short-circuits the prepare with a chosen reply — the
+        payload-fetch heal path rejoin-gap buffered commits depend on."""
+        return (
+            self._recovery_settled(dot)
+            and self._cmds.get_existing(dot) is None
+        )
+
     def _handle_recovery_prepare(
         self,
         from_: ProcessId,
@@ -281,6 +314,11 @@ class RecoveryMixin:
         cmd: Optional[Command] = None,
         time: Optional[SysTime] = None,
     ) -> None:
+        if self._recovery_gc_straggler(dot):
+            # committed here already: a live proposer cannot exist for a
+            # stable-everywhere dot (it would have committed it too), so
+            # this is a late duplicate — do not resurrect, do not consume
+            return
         info = self._cmds.get(dot)
         if cmd is not None and info.cmd is None:
             # adopt the piggybacked payload BEFORE promising: the promise
@@ -303,7 +341,7 @@ class RecoveryMixin:
                     {from_},
                     MRecoveryPromise(
                         dot, out.ballot, out.accepted, info.cmd,
-                        self._recovery_promise_floor(info),
+                        self._recovery_promise_floor(dot, info),
                     ),
                 )
             )
@@ -323,6 +361,8 @@ class RecoveryMixin:
         time: SysTime,
         clock_floor: int = 0,
     ) -> None:
+        if self._recovery_gc_straggler(dot):
+            return  # late duplicate for a GC'd dot: do not resurrect
         info = self._cmds.get(dot)
         if cmd is not None and info.cmd is None:
             # adopt the piggybacked payload so a later commit can execute
@@ -340,7 +380,7 @@ class RecoveryMixin:
         floor = state[1]
 
         def adjust(value):
-            return self._recovery_adjust_value(info, value, floor)
+            return self._recovery_adjust_value(dot, info, value, floor)
 
         # free-choice hold (see FREE_CHOICE_HOLD_ROUNDS): during the
         # first rounds, wait for ALL n ballot-0 reports — the one report
@@ -376,20 +416,39 @@ class RecoveryMixin:
 
     # --- hooks for the host protocol ---
 
-    def _recovery_promise_floor(self, info) -> int:
+    def _recovery_settled(self, dot) -> bool:
+        """Whether ``dot``'s commit is already settled at this process —
+        the shared guard behind straggler drops and scan eviction.
+        Default: the GC clock; Caesar adds its WAL-tail replay overlay
+        (its executed-driven clock cannot absorb durable folds)."""
+        gc_track = getattr(self, "_gc_track", None)
+        return gc_track is not None and gc_track.contains(dot)
+
+    def _recovery_commit_known(self, dot) -> bool:
+        """Whether this process already holds the dot's decided commit
+        (buffered payload-less — the rejoin-gap class): recovery then
+        only needs to fetch the payload via a chosen reply, so the scan
+        compresses its ring stagger.  Default False."""
+        return False
+
+    def _recovery_promise_floor(self, dot, info) -> int:
         """The acceptor's clock floor for the dot's keys (see
         MRecoveryPromise.clock_floor).  Default 0 — clockless protocols
-        (the graph family) never lift."""
+        (the graph family) never lift.  Newt CONSUMES votes through the
+        floor it reports; Caesar reports the max indexed timestamp
+        sequence on the dot's keys (excluding the dot itself)."""
         return 0
 
-    def _recovery_adjust_value(self, info, value, floor: int):
+    def _recovery_adjust_value(self, dot, info, value, floor: int):
         """Lift a FREE-choice recovered value to the promise quorum's max
         clock floor.  Default identity; Newt lifts non-noop clocks to
         ``max(value, floor)`` — the floor is a clock the reporting
         acceptor CONSUMED votes through (see ``_recovery_promise_floor``),
         so the lifted clock is covered by held ranges released
         commit-coupled; lifting ABOVE it (a +1) would land on a clock
-        nobody consumed and reopen the stability-overtakes-commit gap."""
+        nobody consumed and reopen the stability-overtakes-commit gap.
+        Caesar instead issues a FRESH unique timestamp above the floor
+        and re-extends the predecessor union under it."""
         return value
 
     def _adopt_recovered_payload(self, dot: Dot, info, cmd: Command, time: SysTime) -> None:

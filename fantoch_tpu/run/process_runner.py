@@ -545,6 +545,18 @@ class ProcessRuntime:
         )
         if tail_dots:
             self.process.note_durable_commits(tail_dots)
+        # slot-ordered protocols (FPaxos): the replayed infos carry slots,
+        # not dots — fold them so the rejoin MSlotSync floor covers the
+        # tail (re-streaming would execute the slots twice)
+        tail_slot_records: Dict[int, Any] = {}
+        for payload in (snap or {}).get("queued_infos", ()):
+            if hasattr(payload, "slot"):
+                tail_slot_records[payload.slot] = payload.cmd
+        for _kind, payload in state.tail:
+            if _kind == "info" and hasattr(payload, "slot"):
+                tail_slot_records[payload.slot] = payload.cmd
+        if tail_slot_records:
+            self.process.note_durable_chosen(sorted(tail_slot_records.items()))
         # the dot lease's unissued remainder: [last-committed-own-seq+1,
         # lease] sequences may never be issued again, and GC stability
         # is a meet of CONTIGUOUS frontiers — an unfilled gap would
@@ -573,7 +585,11 @@ class ProcessRuntime:
         if not self._dot_lease:
             return []
         clock = getattr(self.process, "_gc_track", None)
-        if clock is None or self.config.shard_count != 1:
+        if (
+            clock is None
+            or not hasattr(clock, "my_clock")  # slot-watermark GC (FPaxos)
+            or self.config.shard_count != 1
+        ):
             return []
         from fantoch_tpu.core.ids import Dot
 

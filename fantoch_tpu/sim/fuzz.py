@@ -54,48 +54,29 @@ INCOMPLETE = "incomplete"
 
 REPRO_FORMAT = "fantoch-fuzz-repro-v1"
 
-# the reference's own TODO flags Caesar's no-GC shortcut unsafe
-# (caesar.rs:840-842); we run it with the wait condition + mandatory GC,
-# but any violation the fuzzer finds in that region is FILED, not skipped
-CAESAR_ISSUE = (
-    "caesar wait-condition region (protocol/caesar.py:169 _handle_mpropose "
-    "blocking): the reference's own TODO (caesar.rs:840-842) flags the "
-    "commit-time key-clock removal unsafe; our port requires "
-    "executed-everywhere GC instead, and this artifact is a fuzzer-found "
-    "counterexample in that region — file it as an issue rather than "
-    "silently skipping the protocol."
-)
-
-
 @dataclass(frozen=True)
 class ProtocolSpec:
-    """How the fuzzer may exercise one protocol."""
+    """How the fuzzer exercises one protocol.  Every protocol composes
+    EVERY nemesis class — crash-forever (per-dot recovery for the
+    leaderless protocols incl. Caesar's (clock, preds) synod, leader
+    failover for FPaxos), crash-restart (snapshot/restore + MSync /
+    MSlotSync rejoin catch-up), and all link/process faults.  The former
+    ``crash_ok``/``restart_ok`` escape hatches (Caesar had no recovery,
+    FPaxos no slot catch-up) died with PR 12: a spec now only names the
+    (n, f) pool the sampler draws from, and a skipped nemesis class would
+    be a silent cap this matrix no longer has."""
 
     name: str
-    # crash nemeses allowed?  Requires a recovery story: per-dot recovery
-    # (EPaxos/Atlas/Newt), leader failover (FPaxos).  Caesar has neither
-    # (the reference's todo!()), so its configs compose every *non-crash*
-    # nemesis instead — the wait-condition region still gets pauses,
-    # partitions, reorder, and loss
-    crash_ok: bool
     # (n, f) pool the sampler draws from
     nf_pool: Tuple[Tuple[int, int], ...]
-    # crash-RESTART allowed?  The sim's crash-restart model drops peer
-    # traffic while the process is down; FPaxos has no MSync catch-up for
-    # slots chosen in that window (its SlotExecutor then waits forever on
-    # the hole), so sim restarts are out of its model — the run layer
-    # covers FPaxos restarts via the links' unacked resend windows
-    restart_ok: bool = True
 
 
 PROTOCOL_SPECS: Dict[str, ProtocolSpec] = {
-    "epaxos": ProtocolSpec("epaxos", True, ((3, 1), (5, 1), (5, 2))),
-    "atlas": ProtocolSpec("atlas", True, ((3, 1), (5, 1), (5, 2))),
-    "newt": ProtocolSpec("newt", True, ((3, 1), (5, 1), (5, 2))),
-    "fpaxos": ProtocolSpec(
-        "fpaxos", True, ((3, 1), (5, 1), (5, 2)), restart_ok=False
-    ),
-    "caesar": ProtocolSpec("caesar", False, ((3, 1), (5, 1))),
+    "epaxos": ProtocolSpec("epaxos", ((3, 1), (5, 1), (5, 2))),
+    "atlas": ProtocolSpec("atlas", ((3, 1), (5, 1), (5, 2))),
+    "newt": ProtocolSpec("newt", ((3, 1), (5, 1), (5, 2))),
+    "fpaxos": ProtocolSpec("fpaxos", ((3, 1), (5, 1), (5, 2))),
+    "caesar": ProtocolSpec("caesar", ((3, 1), (5, 1), (5, 2))),
 }
 
 
@@ -182,7 +163,7 @@ class FaultPlanFuzzer:
         n, f = rng.choice(spec.nf_pool)
         conflict_rate = rng.choice((20, 50, 100))
         keys_per_command = 1 if conflict_rate == 100 else rng.choice((1, 2))
-        plan = self._sample_plan(rng, n, f, spec.crash_ok, spec.restart_ok)
+        plan = self._sample_plan(rng, n, f)
         open_loop = None
         if rng.random() < 0.25:
             # open-loop Poisson arrivals (the overload plane's sim
@@ -201,14 +182,7 @@ class FaultPlanFuzzer:
             open_loop_rate_per_s=open_loop,
         )
 
-    def _sample_plan(
-        self,
-        rng: random.Random,
-        n: int,
-        f: int,
-        crash_ok: bool,
-        restart_ok: bool = True,
-    ) -> FaultPlan:
+    def _sample_plan(self, rng: random.Random, n: int, f: int) -> FaultPlan:
         horizon = self.HORIZON_MS
         plan = FaultPlan(seed=rng.randrange(1 << 30), max_sim_time_ms=600_000)
         if rng.random() < 0.6:
@@ -245,7 +219,7 @@ class FaultPlanFuzzer:
                 [tuple(cut), tuple(rest)], start_ms=start,
                 heal_ms=start + rng.randrange(100, 400),
             )
-        if crash_ok and rng.random() < 0.5:
+        if rng.random() < 0.5:
             # crash plans run with the sim failure detector on: FPaxos
             # must learn about a dead write-quorum member to reroute its
             # accept rounds (the run layer's heartbeat detector analog);
@@ -259,7 +233,7 @@ class FaultPlanFuzzer:
             for victim in victims:
                 at = rng.randrange(100, horizon // 2)
                 restart = None
-                if restart_ok and rng.random() < 0.5:
+                if rng.random() < 0.5:
                     restart = at + rng.randrange(300, 800)
                 plan = plan.with_crash(victim, at_ms=at, restart_at_ms=restart)
         if rng.random() < 0.3:
@@ -568,11 +542,10 @@ def _bisect_windows(case: FuzzCase, attempt) -> FuzzCase:
 def repro_artifact(
     result: FuzzResult, shrink_runs: int = 0, issue: Optional[str] = None
 ) -> dict:
-    """The JSON repro artifact for a finding.  Caesar findings carry the
-    wait-condition issue text (the reference's own TODO region) so the
-    violation is *filed*, never silently skipped."""
-    if issue is None and result.case.protocol == "caesar":
-        issue = CAESAR_ISSUE
+    """The JSON repro artifact for a finding.  Every protocol's findings
+    fail the run the same way — the Caesar filed-as-issue special case
+    (PR 9's carve-out for the then-unrecoverable wait-condition region)
+    died with PR 12's Caesar recovery plane."""
     return {
         "format": REPRO_FORMAT,
         "case": result.case.to_dict(),

@@ -6,10 +6,13 @@ The gate:
 
 1. fixed seed set (fuzzer seed 0, the first ``SMOKE_CASES`` indices
    forced per protocol — the same set the mutation self-test in
-   tests/test_fuzz.py must catch the reintroduced PR 7 bug within):
-   every case must come back ``ok`` — the run completed, every surviving
-   client finished, and the ConsistencyAuditor found no write-order /
-   exactly-once / committed-then-lost / commit-value violation;
+   tests/test_fuzz.py must catch the reintroduced PR 7 bug within, plus
+   two targeted rows: the first sampled Caesar-crash plan and the first
+   sampled FPaxos crash-restart plan, the nemesis classes PR 12
+   un-gated): every case must come back ``ok`` — the run completed,
+   every surviving client finished, and the ConsistencyAuditor found no
+   write-order / exactly-once / committed-then-lost / commit-value
+   violation;
 2. determinism: one case re-run must produce byte-identical plan, fault
    trace, and verdict digests;
 3. soak: with ``FANTOCH_FUZZ_BUDGET_S`` set (nightly), keep sampling
@@ -62,10 +65,45 @@ def main() -> int:
                     f"FAIL {protocol} case {index}: {result.verdict} "
                     f"{result.violations or result.error}"
                 )
+    # targeted rows: the fixed per-protocol indices may not sample the
+    # nemesis classes PR 12 un-gated, so scan forward for the first
+    # Caesar plan WITH a crash and the first FPaxos plan WITH a
+    # crash-restart and pin those cases into the gate (budget-checked:
+    # the scan is over pure case values, only the two hits are run)
+    targeted = []
+    for protocol, wants in (("caesar", "crash"), ("fpaxos", "restart")):
+        for index in range(SMOKE_CASES, 64):
+            plan = fuzzer.case(index, protocol=protocol).plan
+            if wants == "crash" and plan.crashes:
+                targeted.append((protocol, index))
+                break
+            if wants == "restart" and any(
+                crash.restart_at_ms is not None for crash in plan.crashes
+            ):
+                targeted.append((protocol, index))
+                break
+        else:
+            raise AssertionError(f"no {wants} plan sampled for {protocol} in 64 cases")
+    for protocol, index in targeted:
+        result = run_case(fuzzer.case(index, protocol=protocol))
+        total += 1
+        if result.verdict == OK:
+            clean[protocol] = clean.get(protocol, 0) + 1
+        else:
+            failures.append((protocol, index, result))
+            print(
+                f"FAIL targeted {protocol} case {index}: {result.verdict} "
+                f"{result.violations or result.error}"
+            )
+    print(f"targeted rows: {targeted}")
     print(
         f"fixed set: {total} cases in {time.monotonic() - started:.1f}s; "
         "clean per protocol: "
         + ", ".join(f"{p}={c}" for p, c in sorted(clean.items()))
+    )
+    budget = float(os.environ.get("FANTOCH_FUZZ_SMOKE_BUDGET_S", "300"))
+    assert time.monotonic() - started < budget, (
+        f"fixed fuzz-smoke set blew its {budget:.0f}s wall budget"
     )
     assert not failures, f"{len(failures)} smoke case(s) failed"
     for protocol in PROTOCOL_SPECS:

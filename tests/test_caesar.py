@@ -102,6 +102,107 @@ def test_pred_executor_higher_clock_dep_not_waited():
     assert [r.rifl.sequence for r in ex.to_clients_iter()] == [2, 1]
 
 
+def test_pred_executor_noop_resolves_both_phases():
+    """A recovery-committed noop (PredecessorsNoop) executes nothing but
+    counts as committed AND executed, so dependents blocked on it in
+    phase 1 (commit unknown) or phase 2 (lower-clock execution) drain."""
+    from fantoch_tpu.executor.pred import PredecessorsNoop
+
+    config = Config(n=3, f=1)
+    ex = PredecessorsExecutor(1, SHARD, config)
+    # d2 depends on the never-payloaded d1 (phase 1 blocks on its commit)
+    ex.handle(
+        PredecessorsExecutionInfo(Dot(2, 1), cmd(2, ["K"]), Clock(5, 2), {Dot(1, 1)}),
+        None,
+    )
+    assert list(ex.to_clients_iter()) == []
+    ex.handle(PredecessorsNoop(Dot(1, 1)), None)
+    assert [r.rifl.sequence for r in ex.to_clients_iter()] == [2]
+    # the executed clock drives Caesar's executed-everywhere GC: the noop
+    # dot must be in it
+    assert ex.executed(None).contains(1, 1)
+
+
+def test_pred_executor_watchdog_reports_missing_and_fails_bounded():
+    """The liveness watchdog: missing (uncommitted) dependency dots are
+    reported for the recovery nudge below the bound, and a typed
+    StalledExecutionError fires past Config.executor_pending_fail_ms —
+    the bounded-wait contract extended to the predecessors executor."""
+    import pytest as _pytest
+
+    from fantoch_tpu.core.timing import SimTime
+    from fantoch_tpu.errors import StalledExecutionError
+
+    config = Config(n=3, f=1, executor_pending_fail_ms=5000)
+    ex = PredecessorsExecutor(1, SHARD, config)
+    ex.handle(
+        PredecessorsExecutionInfo(Dot(2, 1), cmd(2, ["K"]), Clock(5, 2), {Dot(1, 1)}),
+        SimTime(0),
+    )
+    # below the fail bound: the missing dep surfaces for nudge_recovery
+    assert ex.monitor_pending(SimTime(2000)) == {Dot(1, 1)}
+    with _pytest.raises(StalledExecutionError) as err:
+        ex.monitor_pending(SimTime(6000))
+    assert Dot(1, 1) in err.value.missing[Dot(2, 1)]
+
+
+def test_key_clocks_max_seq_excludes_the_recovering_dot():
+    """The recovery promise floor: max indexed timestamp sequence on the
+    command's keys, excluding the dot under recovery (every replica
+    indexes the dot itself at propose time — a floor including it would
+    lift unconditionally)."""
+    clocks = SequentialKeyClocks(1, SHARD)
+    a, b = Dot(1, 1), Dot(2, 1)
+    clocks.add(a, cmd(1, ["K"]), Clock(7, 1))
+    clocks.add(b, cmd(2, ["K"]), Clock(3, 2))
+    assert clocks.max_seq(cmd(1, ["K"])) == 7
+    assert clocks.max_seq(cmd(1, ["K"]), exclude=a) == 3
+    assert clocks.max_seq(cmd(3, ["OTHER"])) == 0
+
+
+def test_quorum_clocks_duplicate_ack_dedup():
+    """Duplicate MProposeAck deliveries (at-least-once links) must not
+    double-count a participant — the quorum would otherwise complete
+    with fewer distinct reports (the PR 9 mcollectack dedup class)."""
+    q = QuorumClocks(1, 3, 2)
+    q.add(1, Clock(1, 1), {Dot(1, 1)}, True)
+    assert q.contains(1) and not q.contains(2)
+    q.add(2, Clock(2, 2), set(), True)
+    assert not q.all(), "two DISTINCT reports are not the fq=3 quorum"
+
+
+def test_caesar_recovery_adjust_lifts_above_floor_with_fresh_clock():
+    """The free-choice lift: when the promise quorum's floor reaches the
+    chosen clock, Caesar issues a FRESH unique timestamp above it and
+    re-extends the predecessor union under it (a reused seq could
+    collide with a timestamp this process already issued)."""
+    from fantoch_tpu.protocol.caesar import CaesarConsensusValue
+
+    config = Config(
+        n=3, f=1, gc_interval_ms=100, recovery_delay_ms=500,
+    )
+    proto = Caesar(1, SHARD, config)
+    ok, _ = proto.discover([(1, SHARD), (2, SHARD), (3, SHARD)])
+    assert ok
+    # local knowledge: a conflicting command indexed at seq 9
+    conflict = Dot(2, 1)
+    proto.key_clocks.add(conflict, cmd(2, ["K"]), Clock(9, 2))
+    dot = Dot(3, 1)
+    info = proto._cmds.get(dot)
+    info.cmd = cmd(3, ["K"])
+    low = CaesarConsensusValue(Clock(4, 3), ())
+    lifted = proto._recovery_adjust_value(dot, info, low, floor=9)
+    assert lifted.clock.seq > 9
+    assert lifted.clock.process_id == 1, "a fresh clock is issued locally"
+    assert conflict in lifted.deps, "predecessors re-extend under the lift"
+    # below the floor: the chosen pair is untouched
+    high = CaesarConsensusValue(Clock(12, 3), (conflict,))
+    assert proto._recovery_adjust_value(dot, info, high, floor=9) == high
+    # noop stays noop
+    noop = CaesarConsensusValue.bottom()
+    assert proto._recovery_adjust_value(dot, info, noop, floor=9) is noop
+
+
 def caesar_config(n: int, f: int, wait: bool) -> Config:
     return Config(n=n, f=f, caesar_wait_condition=wait, gc_interval_ms=100)
 

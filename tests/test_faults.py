@@ -48,7 +48,7 @@ from fantoch_tpu.errors import (
     SimStalledError,
     StalledExecutionError,
 )
-from fantoch_tpu.protocol import Atlas, Basic, EPaxos, FPaxos, Newt
+from fantoch_tpu.protocol import Atlas, Basic, Caesar, EPaxos, FPaxos, Newt
 from fantoch_tpu.sim import Runner
 from fantoch_tpu.sim.faults import FaultPlan
 
@@ -330,8 +330,12 @@ RECOVERY_PLAN_33 = FaultPlan(seed=1, max_sim_time_ms=120_000).with_crash(2, at_m
         (Atlas, RECOVERY_33),
         (EPaxos, RECOVERY_33.with_(batched_graph_executor=True)),
         (Newt, RECOVERY_33.with_(newt_detached_send_interval_ms=100)),
+        # Caesar: the coordinator crash heals through the (clock, preds)
+        # recovery synod; the executor watchdog nudges dots stranded in
+        # the wait-condition region (PR 12 closed the carve-out)
+        (Caesar, RECOVERY_33.with_(executor_monitor_pending_interval_ms=500)),
     ],
-    ids=["epaxos", "atlas", "epaxos-batched", "newt"],
+    ids=["epaxos", "atlas", "epaxos-batched", "newt", "caesar"],
 )
 def test_recovery_quorum_member_crash_completes(protocol_cls, config):
     """The exact scenario that used to assert SimStalledError: a crashed
@@ -621,8 +625,15 @@ def test_recovery_fpaxos_tcp_leader_failover():
                 5, 2, recovery_delay_ms=1500, newt_detached_send_interval_ms=100
             ),
         ),
+        (
+            Caesar,
+            Config(
+                5, 2, recovery_delay_ms=1500,
+                executor_monitor_pending_interval_ms=500,
+            ),
+        ),
     ],
-    ids=["epaxos", "atlas", "newt"],
+    ids=["epaxos", "atlas", "newt", "caesar"],
 )
 def test_recovery_crash_matrix_5_2(protocol_cls, config, loss):
     """Acceptance matrix: n=5/f=2, two crashed processes inside live fast

@@ -12,7 +12,6 @@ import pytest
 
 from fantoch_tpu.sim.faults import FaultPlan
 from fantoch_tpu.sim.fuzz import (
-    CAESAR_ISSUE,
     OK,
     PROTOCOL_SPECS,
     VIOLATION,
@@ -91,8 +90,8 @@ def test_caesar_wait_condition_targeted_config():
     """Caesar's wait-condition region (the reference's own unsafe-TODO
     area) under its targeted stress: max conflict + reorder + pause —
     the nemeses that reorder MPropose/MRetry around the blocking check.
-    A violation here would be FILED via the repro artifact's issue text,
-    never silently skipped."""
+    A violation here fails the run like every other protocol's (PR 9's
+    file-as-issue carve-out died with the Caesar recovery plane)."""
     fuzzer = FaultPlanFuzzer(seed=SMOKE_SEED)
     base = fuzzer.case(1, protocol="caesar")
     case = dataclasses.replace(
@@ -104,24 +103,41 @@ def test_caesar_wait_condition_targeted_config():
         ),
     )
     result = run_case(case)
-    if result.verdict == VIOLATION:
-        artifact = repro_artifact(result)
-        assert artifact["issue"] == CAESAR_ISSUE
-        pytest.fail(
-            f"caesar wait-condition violation (file the artifact): "
-            f"{result.violations}"
-        )
-    assert result.verdict == OK, result.error
+    assert result.verdict == OK, (result.violations, result.error)
 
 
-def test_caesar_violation_artifact_carries_issue_text():
-    """Any Caesar finding is filed, not skipped: the artifact's issue
-    field names the wait-condition region."""
+def test_caesar_artifact_has_no_filing_special_case():
+    """The Caesar filed-as-issue escape hatch is gone: artifacts carry no
+    issue text unless the caller supplies one, for every protocol."""
     case = FaultPlanFuzzer(seed=0).case(0, protocol="caesar")
     fake = FuzzResult(case, VIOLATION, violations=["[order-divergence] x"])
-    assert repro_artifact(fake)["issue"] == CAESAR_ISSUE
+    assert repro_artifact(fake)["issue"] is None
+    assert repro_artifact(fake, issue="manual")["issue"] == "manual"
     other = dataclasses.replace(case, protocol="newt")
     assert repro_artifact(FuzzResult(other, VIOLATION))["issue"] is None
+
+
+def test_specs_compose_every_nemesis_class():
+    """No silent caps: the spec table has no crash/restart escape hatches
+    left, and the sampler demonstrably emits crash, crash-restart, and
+    non-crash plans for EVERY protocol (Caesar crash + FPaxos restart
+    were PR 9's carve-outs)."""
+    assert not hasattr(next(iter(PROTOCOL_SPECS.values())), "crash_ok")
+    assert not hasattr(next(iter(PROTOCOL_SPECS.values())), "restart_ok")
+    fuzzer = FaultPlanFuzzer(seed=SMOKE_SEED)
+    for protocol in sorted(PROTOCOL_SPECS):
+        kinds = set()
+        for index in range(40):
+            plan = fuzzer.case(index, protocol=protocol).plan
+            if not plan.crashes:
+                kinds.add("none")
+            elif any(c.restart_at_ms is not None for c in plan.crashes):
+                kinds.add("restart")
+            else:
+                kinds.add("crash")
+            if len(kinds) == 3:
+                break
+        assert kinds == {"none", "crash", "restart"}, (protocol, kinds)
 
 
 # --- reorder nemesis (FaultPlan.with_reorder) ---
@@ -245,6 +261,31 @@ def test_mutation_gc_straggler_bug_caught_and_shrunk(tmp_path):
     assert healthy.verdict == OK, (
         f"guard on, still failing: {healthy.violations or healthy.error}"
     )
+
+
+def test_bin_fuzz_run_exits_nonzero_on_filed_artifact(tmp_path, monkeypatch, capsys):
+    """``bin/fuzz.py run`` fails whenever ANY case files a repro
+    artifact — no protocol is exempt (PR 9's Caesar filed-not-fixed
+    special case left such sweeps green) — and the failure line names
+    the artifact path."""
+    import fantoch_tpu.sim.fuzz as fuzz_mod
+    from fantoch_tpu.bin import fuzz as bin_fuzz
+
+    def fake_run_case(case):
+        return FuzzResult(case, VIOLATION, violations=["[order-divergence] x"])
+
+    monkeypatch.setattr(fuzz_mod, "run_case", fake_run_case)
+    monkeypatch.setattr(fuzz_mod, "shrink_case", lambda case, **_k: (case, 0))
+    rc = bin_fuzz.main(
+        [
+            "run", "--seed", "0", "--cases", "1",
+            "--protocols", "caesar", "--out-dir", str(tmp_path),
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "FAILED: repro artifact" in out
+    assert str(tmp_path) in out
 
 
 def test_repro_artifact_roundtrip_on_clean_case(tmp_path):

@@ -183,6 +183,33 @@ def test_mc_epaxos_crashed_coordinator_recovery():
 
 
 @pytest.mark.recovery
+def test_mc_caesar_crashed_coordinator_recovery():
+    """Exhaustively explore a Caesar coordinator crash at n=3/f=1: the
+    crash of p1 branches at every state and the stabilization closure
+    drives the survivors' (clock, preds)-pair recovery consensus —
+    including its interaction with the wait condition (a blocked MPropose
+    must be unblocked, never deadlocked, by a recovery-decided or
+    noop'd blocker).  Every interleaving must keep agreement; crashed-
+    coordinator commands execute everywhere-or-nowhere."""
+    from fantoch_tpu.protocol.caesar import Caesar
+
+    mc = ModelChecker(
+        Caesar,
+        Config(
+            3, 1, gc_interval_ms=100, recovery_delay_ms=50,
+            caesar_wait_condition=True,
+        ),
+        [(1, put(1, 1, "A")), (2, put(2, 1, "A"))],
+        max_states=500_000,
+        crashes=[1],
+    )
+    result = mc.run()
+    assert result.complete, "state space must be exhausted"
+    assert result.ok, result.violations[:1]
+    assert result.terminals > 0
+
+
+@pytest.mark.recovery
 @pytest.mark.slow
 def test_mc_atlas_crashed_coordinator_recovery():
     from fantoch_tpu.protocol.graph_protocol import Atlas

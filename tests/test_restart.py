@@ -7,11 +7,13 @@ restart plane through the stronger claim:
 
 * **Restored tolerance** (the acceptance rows) — crash p_a with a
   scheduled restart, let it rejoin (durable image + MSync catch-up +
-  vote backfill), then crash p_b *forever*.  Without the restart the
-  combined failures exceed ``f`` and the run must stall; with it, every
-  client not attached to the dead-forever replica completes and the
-  execution-order monitors agree (exactly-once across the restart: a
-  re-executed command would break write-order agreement).
+  vote backfill; MSlotSync slot streaming for FPaxos), then crash p_b
+  *forever*.  Without the restart the combined failures exceed ``f``
+  and the run must stall; with it, every client not attached to the
+  dead-forever replica completes and the execution-order monitors agree
+  (exactly-once across the restart: a re-executed command would break
+  write-order agreement).  All five protocols run these rows — Caesar
+  and FPaxos joined in PR 12.
 * **Restart determinism** — same seed twice => byte-identical nemesis
   traces AND byte-identical span logs through crash, durable-image
   capture, restore, and rejoin.
@@ -37,7 +39,7 @@ from fantoch_tpu.client import ConflictRateKeyGen, Workload
 from fantoch_tpu.core import Command, Config, Dot, KVOp, Planet, Rifl
 from fantoch_tpu.core.planet import Region
 from fantoch_tpu.core.timing import SimTime
-from fantoch_tpu.protocol import Atlas, EPaxos, FPaxos, Newt
+from fantoch_tpu.protocol import Atlas, Caesar, EPaxos, FPaxos, Newt
 from fantoch_tpu.sim import Runner
 from fantoch_tpu.sim.faults import FaultPlan
 
@@ -137,13 +139,54 @@ PLAN_33 = (
         (EPaxos, RESTART_33),
         (Atlas, RESTART_33),
         (Newt, RESTART_33.with_(newt_detached_send_interval_ms=100)),
+        # Caesar: snapshot/restore + MSync rejoin over the (clock, preds)
+        # commit records (PR 12 closed the restart carve-out)
+        (Caesar, RESTART_33.with_(executor_monitor_pending_interval_ms=500)),
     ],
-    ids=["epaxos", "atlas", "newt"],
+    ids=["epaxos", "atlas", "newt", "caesar"],
 )
 def test_restart_restores_tolerance_33(protocol_cls, config):
     runner, monitors = restart_sim(protocol_cls, config, PLAN_33)
     assert_restored_tolerance(
         runner, monitors, restarted=[2], dead_forever=[3],
+        commands=COMMANDS_PER_CLIENT,
+    )
+
+
+def test_fpaxos_restart_restores_tolerance_33():
+    """FPaxos: the LEADER crash-restarts (followers elect, the stale
+    restored leader is demoted by the higher-ballot heartbeat and its
+    stranded commanders re-forward), MSlotSync pulls the chosen slots it
+    missed, and a follower then dies for good — survivable only because
+    the restarted replica is back in the write quorum."""
+    config = Config(3, 1, leader=1, fpaxos_leader_timeout_ms=400)
+    plan = (
+        FaultPlan(seed=1, max_sim_time_ms=300_000)
+        .with_loss(0.1)
+        .with_crash(1, at_ms=150, restart_at_ms=2500)
+        .with_crash(3, at_ms=3200)
+    )
+    runner, monitors = restart_sim(FPaxos, config, plan)
+    assert_restored_tolerance(
+        runner, monitors, restarted=[1], dead_forever=[3],
+        commands=COMMANDS_PER_CLIENT,
+    )
+
+
+def test_fpaxos_follower_restart_inflight_accepts_redriven():
+    """A write-quorum FOLLOWER crash-restarts: the MAccepts that
+    evaporated during its downtime are re-driven by the leader's
+    periodic in-flight sweep (no failure detector ever fires for a
+    restarting peer), so the stuck slots — and everything ordered after
+    them — complete (the fuzzer-found follower-restart stall)."""
+    config = Config(3, 1, leader=1, fpaxos_leader_timeout_ms=400)
+    plan = (
+        FaultPlan(seed=3, max_sim_time_ms=300_000)
+        .with_crash(2, at_ms=200, restart_at_ms=900)
+    )
+    runner, monitors = restart_sim(FPaxos, config, plan)
+    assert_restored_tolerance(
+        runner, monitors, restarted=[2], dead_forever=[],
         commands=COMMANDS_PER_CLIENT,
     )
 
@@ -176,8 +219,15 @@ def test_restart_restores_tolerance_52():
             Newt,
             Config(5, 2, recovery_delay_ms=1500, newt_detached_send_interval_ms=100),
         ),
+        (
+            Caesar,
+            Config(
+                5, 2, recovery_delay_ms=1500,
+                executor_monitor_pending_interval_ms=500,
+            ),
+        ),
     ],
-    ids=["epaxos", "atlas", "newt"],
+    ids=["epaxos", "atlas", "newt", "caesar"],
 )
 def test_restart_matrix_52(protocol_cls, config, loss):
     """Acceptance matrix: crash-restart + subsequent double crash at
@@ -239,6 +289,62 @@ def test_restart_determinism_and_trace_byte_identity(tmp_path):
     # depends on a client submit being in flight at the crash instant,
     # which this workload shape does not guarantee)
     assert {"durable-image", "restart"} <= kinds
+
+
+@pytest.mark.parametrize(
+    "protocol_cls,config,plan",
+    [
+        (
+            Caesar,
+            Config(
+                3, 1, recovery_delay_ms=1000,
+                executor_monitor_pending_interval_ms=500,
+                trace_sample_rate=1.0,
+            ),
+            PLAN_33,
+        ),
+        (
+            FPaxos,
+            Config(
+                3, 1, leader=1, fpaxos_leader_timeout_ms=400,
+                trace_sample_rate=1.0,
+            ),
+            FaultPlan(seed=1, max_sim_time_ms=300_000)
+            .with_loss(0.1)
+            .with_crash(1, at_ms=150, restart_at_ms=2500),
+        ),
+    ],
+    ids=["caesar", "fpaxos"],
+)
+def test_new_protocol_restart_byte_identity(tmp_path, protocol_cls, config, plan):
+    """The PR 7 determinism invariant, extended to the two protocols that
+    joined the restart matrix in PR 12: same seed twice through Caesar
+    crash + (clock, preds) recovery + restart, and FPaxos leader
+    crash-restart + MSlotSync catch-up => byte-identical nemesis traces,
+    committed orders, AND span logs."""
+
+    def one(tag):
+        path = str(tmp_path / f"trace_{protocol_cls.__name__}_{tag}.jsonl")
+        runner, monitors = restart_sim(
+            protocol_cls, config, plan, commands_per_client=10, trace_path=path
+        )
+        committed = {pid: repr(m) for pid, m in monitors.items()}
+        with open(path, "rb") as fh:
+            blob = fh.read()
+        return (
+            runner.nemesis.trace_digest(),
+            committed,
+            hashlib.sha256(blob).hexdigest(),
+            {kind for _t, kind, _d in runner.nemesis.trace},
+        )
+
+    digest_a, committed_a, trace_a, kinds = one("a")
+    digest_b, committed_b, trace_b, _ = one("b")
+    assert digest_a == digest_b
+    assert committed_a == committed_b
+    assert trace_a == trace_b
+    # non-vacuous: the restart machinery actually ran
+    assert {"crash", "durable-image", "restart"} <= kinds
 
 
 def test_fpaxos_on_peer_up_refreshes_targets():
@@ -439,6 +545,190 @@ def test_recovery_replay_advances_horizon_and_computes_lease_gap(tmp_path):
     assert runtime.next_dot().sequence == 2 + DOT_LEASE_BATCH + 1
 
 
+def test_sync_backfill_barrier_holds_until_records_applied():
+    """The rejoin backfill barrier (fuzzer-found, soak seed 99): a peer's
+    frontier backfill arriving BEFORE its own record chunks (delivery
+    reorders under fault plans) must be HELD — releasing the consumed
+    ranges before the records' ops land lets timestamp stability overtake
+    a commit at the rejoiner, which then executes a higher-clock command
+    around a lower-clock one and diverges from live history."""
+    from fantoch_tpu.core.timing import SimTime
+    from fantoch_tpu.protocol.sync import MSyncBackfill, MSyncReply
+    from fantoch_tpu.protocol.common.table_clocks import VoteRange, Votes
+    from fantoch_tpu.protocol.newt import MDetached, Newt
+
+    time = SimTime()
+    config = Config(
+        3, 1, gc_interval_ms=100, newt_detached_send_interval_ms=100,
+        recovery_delay_ms=1000,
+    )
+    rejoiner, _ = Newt.new(3, 0, config)
+    ok, _ = rejoiner.discover([(3, 0), (1, 0), (2, 0)])
+    assert ok
+    rejoiner.rejoin(time)
+    list(rejoiner.to_processes_iter())
+
+    backfill = Votes()
+    backfill.add("K", VoteRange(1, 1, 8))
+    # the backfill overtakes the records: it must be held, not applied
+    rejoiner.handle(1, 0, MSyncBackfill(backfill, records=2), time)
+    assert list(rejoiner.to_executors_iter()) == []
+    assert rejoiner._held_backfills[1][1] == 2
+
+    # one record applied (a committed noop — simplest valid record):
+    # still below the barrier — and a DUPLICATED delivery of the same
+    # chunk must not inflate the counter past it (distinct records, not
+    # chunk lengths)
+    rejoiner.handle(1, 0, MSyncReply([(Dot(1, 50), None, 0)]), time)
+    rejoiner.handle(1, 0, MSyncReply([(Dot(1, 50), None, 0)]), time)
+    drained = list(rejoiner.to_executors_iter())
+    assert rejoiner._held_backfills, "one of two records is not the barrier"
+
+    # the second record releases the backfill into the detached channel
+    rejoiner.handle(1, 0, MSyncReply([(Dot(1, 51), None, 0)]), time)
+    from fantoch_tpu.executor.table import TableDetachedVotes
+
+    released = [
+        info for info in rejoiner.to_executors_iter()
+        if isinstance(info, TableDetachedVotes)
+    ]
+    assert released and not rejoiner._held_backfills
+    assert any(
+        any(v.start == 1 and v.end == 8 for v in info.votes)
+        for info in released
+    )
+    # a fresh rejoin round resets the barrier state (a restored counter
+    # would release a NEW backfill early)
+    rejoiner.rejoin(time)
+    assert rejoiner._sync_records_seen == {} and rejoiner._held_backfills == {}
+    list(rejoiner.to_processes_iter())
+
+    # the buffered-commit gate (the live-peer variant): a backfill with
+    # no record stream (records=0) must still hold while a payload-less
+    # buffered commit could own the covered ranges, and release once it
+    # resolves (the periodic SendDetached sweep)
+    from fantoch_tpu.protocol.newt import MCommit as NewtMCommit, SendDetachedEvent
+
+    rejoiner.handle(1, 0, NewtMCommit(Dot(1, 60), 9, Votes()), time)
+    assert Dot(1, 60) in rejoiner._buffered_mcommits
+    rejoiner.handle(2, 0, MSyncBackfill(backfill, records=0), time)
+    assert rejoiner._held_backfills, "buffered commit must gate the backfill"
+    # the commit resolves (chosen-reply piggybacks the payload)
+    rejoiner.handle(
+        1, 0,
+        NewtMCommit(
+            Dot(1, 60), 9, Votes(), recovered=True,
+            cmd=Command.from_single(Rifl(9, 60), 0, "K", KVOp.put("v")),
+        ),
+        time,
+    )
+    rejoiner.handle_event(SendDetachedEvent(), time)
+    assert not rejoiner._held_backfills, "resolved commit must release it"
+
+
+def test_caesar_wal_tail_replay_advances_horizon(tmp_path):
+    """Caesar WAL tail replay: logged PredecessorsExecutionInfo records
+    re-apply to the executor and their dots fold into the restored
+    rejoin horizon (``note_durable_commits``) — MSync must not re-stream
+    them (a second application would execute twice)."""
+    from fantoch_tpu.executor.pred import PredecessorsExecutionInfo
+    from fantoch_tpu.protocol.common.pred_clocks import Clock
+    from fantoch_tpu.run.harness import free_port
+    from fantoch_tpu.run.process_runner import ProcessRuntime
+    from fantoch_tpu.run.wal import Wal
+
+    wal_dir = tmp_path / "p3"
+    wal = Wal(str(wal_dir), sync="always")
+    wal.recover()
+    for sequence in (1, 2):
+        cmd = Command.from_single(
+            Rifl(9, sequence), 0, f"k{sequence}", KVOp.put("v")
+        )
+        wal.append(
+            "info",
+            PredecessorsExecutionInfo(
+                Dot(3, sequence), cmd, Clock(sequence, 3), set()
+            ),
+        )
+    wal.close()
+
+    config = Config(3, 1, recovery_delay_ms=500, gc_interval_ms=50)
+    runtime = ProcessRuntime(
+        Caesar, 3, 0, config,
+        listen_addr=("127.0.0.1", free_port()),
+        client_addr=("127.0.0.1", free_port()),
+        peers={},
+        sorted_processes=[(3, 0), (1, 0), (2, 0)],
+        wal_dir=str(wal_dir),
+    )
+    assert runtime._recovered
+    assert runtime.wal_replayed_infos == 2
+    # the replayed dots settle through the durable-tail OVERLAY, not the
+    # GC clock: Caesar's handle_executed REPLACES that clock with the
+    # executor's executed clock, which would drop a replayed commit
+    # still pending on a dependency — the overlay keeps the straggler
+    # guards (and the rejoin record latch) covering them regardless
+    assert runtime.process._gc_straggler(Dot(3, 1))
+    assert runtime.process._gc_straggler(Dot(3, 2))
+    # the effects reached the restored executor (its executed clock is
+    # what drives Caesar's executed-everywhere GC after rejoin)
+    executed = runtime.executors[0].executed(None)
+    assert executed.contains(3, 1) and executed.contains(3, 2)
+    # once the executor reports, the overlay ages out into the GC clock
+    runtime.process.handle_executed(executed, None)
+    assert not runtime.process._durable_tail
+    assert runtime.process._gc_track.contains(Dot(3, 1))
+
+
+def test_fpaxos_wal_tail_replay_advances_slot_floor(tmp_path):
+    """FPaxos WAL tail replay: logged SlotExecutionInfo records fold into
+    the restored chosen log + committed watermark
+    (``note_durable_chosen``), so the rejoin MSlotSync floor covers them
+    — peers must not re-stream slots the executor replay already
+    applied.  Also pins the lease-gap guard: SlotGCTrack has no dot
+    clock, and recovery must not crash computing a dot lease gap."""
+    from fantoch_tpu.executor.slot import SlotExecutionInfo
+    from fantoch_tpu.run.harness import free_port
+    from fantoch_tpu.run.process_runner import ProcessRuntime
+    from fantoch_tpu.run.wal import Wal
+
+    wal_dir = tmp_path / "p2"
+    wal = Wal(str(wal_dir), sync="always")
+    wal.recover()
+    cmds = {}
+    for slot in (1, 2):
+        cmd = Command.from_single(Rifl(9, slot), 0, f"k{slot}", KVOp.put("v"))
+        cmds[slot] = cmd
+        wal.append("info", SlotExecutionInfo(slot, cmd))
+    wal.append_lease(10)  # a stale dot lease must not crash slot-GC recovery
+    wal.close()
+
+    config = Config(
+        3, 1, leader=1, fpaxos_leader_timeout_ms=2000, gc_interval_ms=50
+    )
+    runtime = ProcessRuntime(
+        FPaxos, 2, 0, config,
+        listen_addr=("127.0.0.1", free_port()),
+        client_addr=("127.0.0.1", free_port()),
+        peers={},
+        sorted_processes=[(2, 0), (1, 0), (3, 0)],
+        wal_dir=str(wal_dir),
+    )
+    assert runtime._recovered
+    assert runtime.wal_replayed_infos == 2
+    process = runtime.process
+    # the rejoin floor covers the replayed slots...
+    assert process._slot_sync_floor() >= 2
+    # ...and the chosen log can serve them to OTHER rejoiners
+    records = process._slot_sync_records(0)
+    assert [(slot, cmd.rifl) for slot, cmd in records] == [
+        (1, Rifl(9, 1)), (2, Rifl(9, 2))
+    ]
+    assert process._slot_sync_records(2) == []
+    # the executor replay advanced the slot frontier exactly once
+    assert runtime.executors[0]._next_slot == 3
+
+
 # --- run layer: WAL recovery + rejoin over real TCP ---
 
 
@@ -553,6 +843,129 @@ def test_run_restart_from_wal_and_rejoin(tmp_path, snapshot_interval_ms):
         # phase 3: the restarted replica serves again
         phase3 = await asyncio.wait_for(
             run_clients([5, 6], {0: ("127.0.0.1", client_ports[3])}, workload,
+                        open_loop_interval_ms=10),
+            60,
+        )
+        failures = {pid: runtimes[pid].failure for pid in (1, 2, 3)}
+        monitors = {pid: runtimes[pid].executors[0].monitor() for pid in (1, 2, 3)}
+        await asyncio.gather(*(r.stop() for r in runtimes.values()))
+        return phase1, phase2, phase3, failures, monitors
+
+    phase1, phase2, phase3, failures, monitors = asyncio.run(scenario())
+    for group in (phase1, phase2, phase3):
+        for client_id, client in group.items():
+            assert client.issued_commands == commands, (client_id, client.issued_commands)
+    assert failures == {1: None, 2: None, 3: None}
+    check_monitors(monitors)
+
+
+def test_fpaxos_run_leader_restart_from_wal_and_rejoin(tmp_path):
+    """FPaxos over real TCP, three phases: (1) the leader p1 serves (its
+    WAL logs chosen slots), then is killed; the failure detector fires
+    ``on_peer_down`` and the ring successor p2 elects itself; (2) clients
+    complete against the new leader while p1 is down; (3) p1 restarts
+    from its WAL, peers revive it, the higher-ballot heartbeat demotes
+    its stale leadership, MSlotSync streams the chosen slots it missed,
+    and it serves clients again (forwarding to p2).  Monitors across all
+    three lives agree — exactly-once across the restart."""
+    from fantoch_tpu.run.client_runner import run_clients
+    from fantoch_tpu.run.harness import free_port
+    from fantoch_tpu.run.links import ReconnectPolicy
+    from fantoch_tpu.run.process_runner import ProcessRuntime
+
+    commands = 10
+
+    def make_runtime(pid, peer_ports, client_ports, config):
+        return ProcessRuntime(
+            FPaxos,
+            pid,
+            0,
+            config,
+            listen_addr=("127.0.0.1", peer_ports[pid]),
+            client_addr=("127.0.0.1", client_ports[pid]),
+            peers={p: ("127.0.0.1", peer_ports[p]) for p in (1, 2, 3) if p != pid},
+            sorted_processes=[(pid, 0)] + [(p, 0) for p in (1, 2, 3) if p != pid],
+            reconnect_policy=ReconnectPolicy(attempts=10, base_s=0.02, cap_s=0.2),
+            heartbeat_interval_s=0.2,
+            heartbeat_misses=25,
+            wal_dir=str(tmp_path / f"p{pid}"),
+            wal_snapshot_interval_ms=500,
+        )
+
+    async def scenario():
+        config = Config(
+            3, 1, leader=1, fpaxos_leader_timeout_ms=2000,
+            executor_monitor_execution_order=True,
+            gc_interval_ms=50,
+        )
+        peer_ports = {pid: free_port() for pid in (1, 2, 3)}
+        client_ports = {pid: free_port() for pid in (1, 2, 3)}
+        runtimes = {
+            pid: make_runtime(pid, peer_ports, client_ports, config)
+            for pid in (1, 2, 3)
+        }
+        await asyncio.gather(*(r.start() for r in runtimes.values()))
+        workload = Workload(
+            shard_count=1, key_gen=ConflictRateKeyGen(50), keys_per_command=2,
+            commands_per_client=commands, payload_size=1,
+        )
+        loop = asyncio.get_running_loop()
+
+        # phase 1: the leader serves (its WAL sees chosen slots), then dies
+        phase1 = await asyncio.wait_for(
+            run_clients([1, 2], {0: ("127.0.0.1", client_ports[1])}, workload,
+                        open_loop_interval_ms=10),
+            60,
+        )
+        await asyncio.sleep(1.0)  # let a periodic snapshot land
+        await runtimes[1].stop()
+
+        # followers detect the dead leader; p2 (ring successor) elects
+        deadline = loop.time() + 30
+        while loop.time() < deadline:
+            if all(1 in runtimes[p].dead_peers for p in (2, 3)):
+                break
+            await asyncio.sleep(0.1)
+        assert all(1 in runtimes[p].dead_peers for p in (2, 3))
+
+        # phase 2: the new leader serves while p1 is down
+        phase2 = await asyncio.wait_for(
+            run_clients([3, 4], {0: ("127.0.0.1", client_ports[2])}, workload,
+                        open_loop_interval_ms=10),
+            60,
+        )
+        assert runtimes[2].process._multi_synod.is_leader
+
+        # restart p1 from its WAL
+        runtimes[1] = make_runtime(1, peer_ports, client_ports, config)
+        assert runtimes[1]._recovered, "the WAL dir must drive a recovery"
+        assert runtimes[1].incarnation == 2
+        await runtimes[1].start()
+
+        # revival + MSlotSync catch-up: p1's slot floor reaches every
+        # chosen slot (2 phases x 2 clients x `commands`), and the stale
+        # restored leadership is demoted by p2's higher-ballot heartbeat
+        total_slots = 4 * commands
+        caught_up = False
+        deadline = loop.time() + 30
+        while loop.time() < deadline:
+            if (
+                runtimes[1].process._slot_sync_floor() >= total_slots
+                and not runtimes[1].process._multi_synod.is_leader
+                and runtimes[1].process._leader == 2
+                and all(1 not in runtimes[p].dead_peers for p in (2, 3))
+            ):
+                caught_up = True
+                break
+            await asyncio.sleep(0.2)
+        assert caught_up, (
+            "MSlotSync catch-up timed out: floor "
+            f"{runtimes[1].process._slot_sync_floor()}/{total_slots}"
+        )
+
+        # phase 3: the restarted replica serves again (forwards to p2)
+        phase3 = await asyncio.wait_for(
+            run_clients([5, 6], {0: ("127.0.0.1", client_ports[1])}, workload,
                         open_loop_interval_ms=10),
             60,
         )
