@@ -44,12 +44,14 @@ test-overload:
 test-fuzz:
 	python -m pytest tests/ -x -q -m fuzz
 
-# close the tier-1 coverage hole on the pinned jax: run
-# tests/test_device_runner.py from a guard-stripped copy (the module
-# skips itself on jax < 0.5 because jaxlib 0.4.x segfaults flakily while
-# tracing the drivers' scan bodies) in its own pytest process, the way
-# PR 6 validated its changes.  On jax >= 0.5 the regular suite already
-# covers the module and this is a no-op
+# close the tier-1 coverage hole on the pinned jax: run every
+# jax-version-guarded device test module (discovered by guard scan —
+# tests/test_device_runner.py today; new guarded device suites ride
+# along automatically) from guard-stripped copies (the guard exists
+# because jaxlib 0.4.x segfaults flakily while tracing the drivers' scan
+# bodies) in their own pytest processes, the way PR 6 validated its
+# changes.  On jax >= 0.5 the regular suite already covers the modules
+# and this is a no-op
 test-device-stripped:
 	python scripts/run_device_stripped.py
 

@@ -80,6 +80,11 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--skip-fast-ack", action="store_true")
     parser.add_argument("--batched-graph-executor", action="store_true",
                         help="order committed commands with the batched device resolver")
+    parser.add_argument("--device-pred-plane", action="store_true",
+                        help="Caesar resident predecessors plane "
+                        "(executor/pred_plane.py): the pending window "
+                        "stays on device across batches; commits drain "
+                        "as column batches")
     parser.add_argument("--serving-pipeline-depth", type=int, default=None,
                         metavar="K",
                         help="device serving pipeline depth (run/pipeline.py): "
@@ -149,6 +154,7 @@ def config_from_args(args: argparse.Namespace):
         caesar_wait_condition=args.caesar_wait_condition,
         skip_fast_ack=args.skip_fast_ack,
         batched_graph_executor=args.batched_graph_executor,
+        device_pred_plane=args.device_pred_plane,
         serving_pipeline_depth=args.serving_pipeline_depth,
         wal_sync=args.wal_sync,
         queue_capacity=args.queue_capacity,

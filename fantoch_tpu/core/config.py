@@ -84,6 +84,17 @@ class Config:
     # as one device kernel per batch (fantoch_tpu/ops/pred_resolve.py at
     # the executor/pred.py seam)
     batched_pred_executor: bool = False
+    # device-resident predecessors plane for Caesar: the
+    # PredecessorsExecutor keeps the whole pending window (sparse
+    # predecessor sets as an int32[C, W] slot matrix + clock columns) on
+    # device across batches with donated in-place state, one fused
+    # dispatch per feed; missing-blocked rows stay resident and wake
+    # when their deps commit (executor/pred_plane.py over
+    # ops/pred_resolve.resolve_pred_plane_step).  Caesar additionally
+    # routes commits through a column builder (one PredExecutionArrays
+    # drain per to_executors sweep).  Requires timestamp sequences below
+    # 2^31 (guarded with a typed ClockOverflowError)
+    device_pred_plane: bool = False
     # resolver choice for the batched graph executor on *CPU* backends:
     # None = auto (the native C++ SCC resolver, fantoch_tpu/native, when
     # its toolchain is available — a single-threaded host loop beats CPU

@@ -1,0 +1,250 @@
+"""DevicePlane: the shared base of every device-resident executor plane.
+
+ROADMAP item 5 (the refactor items 1-4 are written on top of): the graph
+plane, the votes-table plane (executor/table_plane.py) and the Caesar
+predecessors plane (executor/pred_plane.py) all need the same machinery,
+and before this base each hand-rolled its own copy:
+
+* **donated resident buffers** — the plane's state lives ON DEVICE across
+  batches and every dispatch donates it back in.  Buffers fed to donated
+  argnums must be XLA-owned copies (``jnp.array``), never
+  ``jnp.asarray``/``device_put`` of host numpy: on CPU those zero-copy
+  alias the numpy memory, and donation then hands numpy-owned memory to
+  XLA — nondeterministic wrong results + glibc heap corruption under the
+  persistent compile cache (the PR 4 ownership rule, regression-tested by
+  ``test_resident_buffers_never_alias_host_numpy``).  :meth:`_upload`
+  is the ONE place resident buffers are created, so the rule cannot be
+  re-broken per plane.
+* **lazy host-mirror re-materialization** — pickling (the restart plane's
+  ``Executor.snapshot`` seam) fetches the resident state into a host
+  mirror; device state never survives a pickle, and the next dispatch
+  re-materializes from the mirror with exactly ONE counted upload
+  (``resident_uploads`` — the restart acceptance signal).
+* **residual re-feed** — work a dispatch could not finish comes back as
+  residual columns, buffered host-side and prepended to the next feed
+  (the table plane's beyond-gap runs), or stays resident on device until
+  a later feed unblocks it (the pred plane's missing-blocked rows); the
+  base owns the column-buffer variant.
+* **per-dispatch counters** — dispatches / occupancy / residual work /
+  kernel wall-ms, surfaced through ``Executor.device_counters()`` into
+  the metrics snapshot, the tracer, and the bench rows.
+* **kernel-threshold switches** — config > env > built-in default
+  resolution for the thresholds that route host-vs-kernel work
+  (:func:`resolve_threshold`).
+
+Capacity follows a pow2 schedule (``_grow`` doubles) so XLA compiles
+O(log) distinct programs as registries fill, and growth of a live
+resident state is one fetch + pad + counted re-upload.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fantoch_tpu.core.kvs import Key
+# one canonical pow2 helper (re-exported: the planes import it from here)
+from fantoch_tpu.ops.table_ops import next_pow2
+
+
+def resolve_threshold(
+    explicit: Optional[int], env_var: str, default: int
+) -> int:
+    """The shared threshold-knob resolution: an explicit config value
+    beats the environment variable beats the built-in default (the
+    ``Config.table_kernel_threshold`` precedence, extracted so every
+    plane's switches resolve the same way)."""
+    if explicit is not None:
+        return int(explicit)
+    env = os.environ.get(env_var)
+    if env:
+        return int(env)
+    return default
+
+
+class DevicePlane:
+    """Resident device state + fused dispatch per batch: the base class.
+
+    Subclasses define the state as a tuple of host numpy arrays via three
+    hooks and get buffer lifecycle, durability and counters for free:
+
+    * :meth:`_fresh_state` — zero state at the current capacity;
+    * :meth:`_pad_state` — existing host state re-padded to a (larger)
+      capacity (called by :meth:`_grow` and mirror re-materialization);
+    * the resident state itself is ``self._resident`` (a tuple of
+      XLA-owned device arrays, or None while unmaterialized) — dispatch
+      methods call :meth:`_materialize` first, read/donate the tuple,
+      and write the kernel's output state back.
+
+    The optional key registry (``bucket``) maps string keys to stable
+    device row ids with pow2 capacity; planes keyed by something else
+    (the pred plane's dot->slot map) drive ``_grow`` directly.
+    """
+
+    __slots__ = (
+        "_key_index",
+        "_keys",
+        "_cap",
+        "_resident",
+        "_host_mirror",
+        "_residuals",
+        "dispatches",
+        "grows",
+        "resident_uploads",
+        "stats",
+    )
+
+    def __init__(self, capacity: int, stats: Dict[str, float]):
+        self._key_index: Dict[Key, int] = {}
+        self._keys: List[Key] = []
+        self._cap = next_pow2(max(capacity, 2))
+        # tuple of device arrays; None = lazy (created on first dispatch)
+        self._resident = None
+        # host copy awaiting re-materialization (restart/unpickle path);
+        # None while the live state is device-resident
+        self._host_mirror: Optional[Tuple[np.ndarray, ...]] = None
+        # host-buffered residual columns re-fed with the next batch
+        self._residuals: Tuple[np.ndarray, ...] = ()
+        self.dispatches = 0
+        self.grows = 0
+        # host->device materializations: 1 for the lazy initial upload,
+        # +1 per restore-from-snapshot re-upload and per live grow (the
+        # recovery acceptance signal: restart costs ONE upload, not one
+        # per batch)
+        self.resident_uploads = 0
+        # per-dispatch observability tallies (observability/device.py)
+        self.stats: Dict[str, float] = dict(stats)
+
+    # --- state hooks (subclass responsibility) ---
+
+    def _fresh_state(self) -> Tuple[np.ndarray, ...]:
+        """Zero host state at the current capacity."""
+        raise NotImplementedError
+
+    def _pad_state(
+        self, state: Tuple[np.ndarray, ...], cap: int
+    ) -> Tuple[np.ndarray, ...]:
+        """``state`` re-embedded into fresh arrays at capacity ``cap``
+        (>= the state's own capacity)."""
+        raise NotImplementedError
+
+    # --- key registry (string keys -> stable device rows; optional) ---
+
+    def bucket(self, key: Key) -> int:
+        idx = self._key_index.get(key)
+        if idx is None:
+            idx = len(self._keys)
+            self._key_index[key] = idx
+            self._keys.append(key)
+            if idx >= self._cap:
+                self._grow()
+        return idx
+
+    @property
+    def key_count(self) -> int:
+        return len(self._keys)
+
+    # --- buffer lifecycle ---
+
+    def _upload(self, state: Tuple[np.ndarray, ...]) -> None:
+        """THE resident-buffer creation point: copies every array into an
+        XLA-owned buffer (``jnp.array`` — the donation-safety rule; see
+        the module docstring) and counts the upload."""
+        import jax.numpy as jnp
+
+        self._resident = tuple(jnp.array(a) for a in state)
+        self.resident_uploads += 1
+
+    def _fetch_state(self) -> Tuple[np.ndarray, ...]:
+        """One blocking transfer for the whole resident tuple."""
+        import jax
+
+        assert self._resident is not None
+        return tuple(np.asarray(a) for a in jax.device_get(self._resident))
+
+    def _materialize(self) -> None:
+        """Ensure the state is device-resident: lazy initial creation, or
+        the ONE re-upload from the host mirror after restore-from-snapshot
+        (the restart plane's lazy re-materialization seam)."""
+        if self._resident is not None:
+            return
+        if self._host_mirror is not None:
+            state = self._pad_state(self._host_mirror, self._cap)
+            self._host_mirror = None
+        else:
+            state = self._fresh_state()
+        self._upload(state)
+
+    def _grow(self) -> None:
+        """Double the capacity; pads the resident state when live (one
+        host round-trip — rare, amortized by the pow2 schedule)."""
+        new_cap = self._cap * 2
+        if self._resident is not None:
+            state = self._fetch_state()
+            self._upload(self._pad_state(state, new_cap))
+        self._cap = new_cap
+        self.grows += 1
+
+    # --- residual re-feed (column-buffer variant) ---
+
+    def _take_residuals(
+        self, columns: Tuple[np.ndarray, ...]
+    ) -> Tuple[np.ndarray, ...]:
+        """Prepend the buffered residual columns to this batch's columns
+        (so gap-filling batches coalesce with the runs they unblock) and
+        clear the buffer; ``_put_residuals`` re-buffers the dispatch's
+        leftover."""
+        if not self._residuals:
+            return columns
+        merged = tuple(
+            np.concatenate([r, c]) for r, c in zip(self._residuals, columns)
+        )
+        self._residuals = ()
+        return merged
+
+    def _put_residuals(self, columns: Tuple[np.ndarray, ...]) -> None:
+        self._residuals = columns
+
+    @property
+    def residual_count(self) -> int:
+        return len(self._residuals[0]) if self._residuals else 0
+
+    # --- per-dispatch counters ---
+
+    def _count_dispatch(self, t0: float, **adds: float) -> None:
+        """Tally one dispatch: wall time since ``t0`` into
+        ``stats["kernel_ms"]`` plus any per-plane increments."""
+        self.dispatches += 1
+        self.stats["kernel_ms"] += (time.perf_counter() - t0) * 1000.0
+        for name, value in adds.items():
+            self.stats[name] += value
+
+    # --- durability (Executor.snapshot pickles through here) ---
+
+    def _all_slots(self) -> List[str]:
+        slots: List[str] = []
+        for klass in type(self).__mro__:
+            slots.extend(getattr(klass, "__slots__", ()))
+        return slots
+
+    def __getstate__(self):
+        state = {
+            slot: getattr(self, slot)
+            for slot in self._all_slots()
+            if slot not in ("_resident", "_host_mirror")
+        }
+        mirror = self._host_mirror
+        if self._resident is not None:
+            mirror = self._fetch_state()
+        state["_host_mirror"] = mirror
+        return state
+
+    def __setstate__(self, state) -> None:
+        for slot, value in state.items():
+            setattr(self, slot, value)
+        # device state never survives a pickle: the next dispatch
+        # re-materializes from the host mirror (ONE counted upload)
+        self._resident = None

@@ -66,10 +66,23 @@ from fantoch_tpu.ops.graph_resolve import (
     MISSING,
     TERMINAL,
     resolve_general,
+    resolve_general_resident,
     resolve_general_staged,
     resolve_keyed_auto,
 )
 from fantoch_tpu.utils import key_hash as _framework_key_hash
+
+
+def _use_resident_general() -> bool:
+    """Route large multi-key batches through the device-resident
+    peel-and-compact resolver (ONE dispatch + one fetch) instead of the
+    host-orchestrated staged peeler (a state fetch + re-upload per
+    stage, CPU-pinned to survive remote-dispatch rigs).  Default on —
+    parity-tested bit-for-bit and faster on both rig shapes;
+    ``FANTOCH_GENERAL_RESIDENT=0`` keeps the host-staged escape hatch."""
+    import os
+
+    return os.environ.get("FANTOCH_GENERAL_RESIDENT", "1") != "0"
 
 _NO_DEP = np.int64(-1)  # packed-dep sentinel: no dependency in this slot
 # below this backlog size, ask the keyed kernel for full structure so
@@ -720,17 +733,46 @@ class BatchedDependencyGraph(DependencyGraph):
                 )
                 self._metrics.collect_many(ExecutorMetricsKind.CHAIN_SIZE, sizes)
         elif batch > _STRUCTURE_THRESHOLD:
-            # large multi-key batch: the staged frontier peeler's cost
+            # large multi-key batch: the peel-and-compact peeler's cost
             # tracks the per-level live set instead of B x depth, so deep
             # alternating chains don't fall off the fixed-budget cliff
             # (VERDICT r3 weak #3); structure metrics are skipped at this
-            # size, matching the keyed path's gating
-            res = resolve_general_staged(dep_rows, src32, seq32)
-            # staged results are host numpy already (see its return note)
-            order = res.order
-            emitted = order[res.resolved[order]]
-            n_res = len(emitted)
-            stuck_rows = np.nonzero(res.stuck)[0] if res.stuck.any() else None
+            # size, matching the keyed path's gating.  The resident
+            # variant runs the whole stage schedule as ONE dispatch with
+            # the state device-resident between stages (no per-stage
+            # host round-trips — the r13 fallback-cliff fix)
+            if _use_resident_general():
+                # pad to pow2 so XLA compiles O(log) distinct programs as
+                # backlog sizes vary; pad rows resolve as rank-0
+                # singletons and are dropped from the emitted prefix
+                padded_b = _pad_pow2(batch)
+                padded_w = _pad_pow2(max(dep_rows.shape[1], 1))
+                mat = np.full((padded_b, padded_w), TERMINAL, dtype=np.int32)
+                mat[:batch, : dep_rows.shape[1]] = dep_rows
+                ps = np.zeros(padded_b, np.int32)
+                pq = np.zeros(padded_b, np.int32)
+                ps[:batch] = src32
+                pq[:batch] = seq32
+                res = resolve_general_resident(
+                    jnp.asarray(mat), jnp.asarray(ps), jnp.asarray(pq)
+                )
+                # one blocking transfer for all result fields
+                res = jax.device_get(res)
+                order = res.order
+                order = order[order < batch]
+                emitted = order[res.resolved[order]]
+                n_res = len(emitted)
+                stuck = res.stuck[:batch]
+                stuck_rows = np.nonzero(stuck)[0] if stuck.any() else None
+            else:
+                # host-orchestrated escape hatch (results host-side)
+                res = resolve_general_staged(dep_rows, src32, seq32)
+                order = res.order
+                emitted = order[res.resolved[order]]
+                n_res = len(emitted)
+                stuck_rows = (
+                    np.nonzero(res.stuck)[0] if res.stuck.any() else None
+                )
         else:
             padded_b = _pad_pow2(batch)
             padded_w = _pad_pow2(max(dep_rows.shape[1], 1))

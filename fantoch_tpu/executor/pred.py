@@ -51,6 +51,114 @@ class PredecessorsNoop:
     dot: Dot
 
 
+@dataclass
+class PredExecutionArrays:
+    """Column-borne Caesar commit batch (the PR 4 ``TableVotesArrays``
+    move): B committed rows and E dependency edges as flat columns, built
+    by the protocol's :class:`PredArraysBuilder` and drained ONE batch
+    per ``to_executors`` sweep — no per-command
+    ``PredecessorsExecutionInfo`` objects on the plane path.  Noop rows
+    carry ``clock_seq == -1`` and no payload."""
+
+    dot_src: "np.ndarray"  # int64[B]
+    dot_seq: "np.ndarray"  # int64[B]
+    clock_seq: "np.ndarray"  # int64[B]; -1 == recovered-noop row
+    clock_src: "np.ndarray"  # int64[B]
+    cmds: list  # row -> Optional[Command] (None for noop rows)
+    dep_row: "np.ndarray"  # int64[E] -> row index
+    dep_src: "np.ndarray"  # int64[E]
+    dep_seq: "np.ndarray"  # int64[E]
+
+
+class PredArraysBuilder:
+    """Column accumulator for Caesar's commit seam: the protocol appends
+    committed ``(dot, cmd, clock, deps)`` rows / recovered noops and
+    flushes ONE :class:`PredExecutionArrays` per drain."""
+
+    __slots__ = (
+        "_dot_src", "_dot_seq", "_clock_seq", "_clock_src", "_cmds",
+        "_dep_row", "_dep_src", "_dep_seq",
+    )
+
+    def __init__(self) -> None:
+        self._dot_src = []
+        self._dot_seq = []
+        self._clock_seq = []
+        self._clock_src = []
+        self._cmds = []
+        self._dep_row = []
+        self._dep_src = []
+        self._dep_seq = []
+
+    def add_commit(self, dot: Dot, cmd: Command, clock, deps) -> None:
+        row = len(self._cmds)
+        self._dot_src.append(dot.source)
+        self._dot_seq.append(dot.sequence)
+        self._clock_seq.append(clock.seq)
+        self._clock_src.append(clock.process_id)
+        self._cmds.append(cmd)
+        for dep in deps:
+            self._dep_row.append(row)
+            self._dep_src.append(dep.source)
+            self._dep_seq.append(dep.sequence)
+
+    def add_noop(self, dot: Dot) -> None:
+        self._dot_src.append(dot.source)
+        self._dot_seq.append(dot.sequence)
+        self._clock_seq.append(-1)
+        self._clock_src.append(0)
+        self._cmds.append(None)
+
+    def __len__(self) -> int:
+        return len(self._cmds)
+
+    def take(self) -> Optional[PredExecutionArrays]:
+        """Build the accumulated batch and reset; None when empty."""
+        import numpy as np
+
+        if not self._cmds:
+            return None
+        batch = PredExecutionArrays(
+            dot_src=np.asarray(self._dot_src, dtype=np.int64),
+            dot_seq=np.asarray(self._dot_seq, dtype=np.int64),
+            clock_seq=np.asarray(self._clock_seq, dtype=np.int64),
+            clock_src=np.asarray(self._clock_src, dtype=np.int64),
+            cmds=self._cmds,
+            dep_row=np.asarray(self._dep_row, dtype=np.int64),
+            dep_src=np.asarray(self._dep_src, dtype=np.int64),
+            dep_seq=np.asarray(self._dep_seq, dtype=np.int64),
+        )
+        self.__init__()
+        return batch
+
+
+def _unpack_arrays(batch: PredExecutionArrays):
+    """Expand a column batch back into (infos, noop_dots) — the ONE
+    canonical consumption path (host twin and device plane both take
+    infos, so the oracle parity argument covers the arrays seam too)."""
+    deps_of = [set() for _ in batch.cmds]
+    for e in range(len(batch.dep_row)):
+        deps_of[int(batch.dep_row[e])].add(
+            Dot(int(batch.dep_src[e]), int(batch.dep_seq[e]))
+        )
+    infos = []
+    noops = []
+    for i, cmd in enumerate(batch.cmds):
+        dot = Dot(int(batch.dot_src[i]), int(batch.dot_seq[i]))
+        if int(batch.clock_seq[i]) < 0:
+            noops.append(PredecessorsNoop(dot))
+        else:
+            infos.append(
+                PredecessorsExecutionInfo(
+                    dot,
+                    cmd,
+                    Clock(int(batch.clock_seq[i]), int(batch.clock_src[i])),
+                    deps_of[i],
+                )
+            )
+    return infos, noops
+
+
 MONITOR_PENDING_THRESHOLD_MS = 1000
 
 
@@ -100,6 +208,13 @@ class PredecessorsGraph:
         self._phase_two_pending = _PendingIndex()
         self._metrics: Metrics = Metrics()
         self._to_execute: Deque[Command] = deque()
+        # watchdog memo: the transitive-missing map is recomputed only
+        # when commit/noop/execution state actually changed since the
+        # last tick (the _gen counter) — at 1M pending a re-walk per
+        # tick dominated the watchdog; see _missing_map
+        self._gen = 0
+        self._memo_gen = -1
+        self._memo: Dict[Dot, Set[Dot]] = {}
 
     def command_to_execute(self) -> Optional[Command]:
         return self._to_execute.popleft() if self._to_execute else None
@@ -120,6 +235,7 @@ class PredecessorsGraph:
         added = self._committed_clock.add(dot.source, dot.sequence)
         assert added, "commands are committed exactly once"
         assert dot not in self._vertices
+        self._gen += 1  # commit state changed: watchdog memo stale
         self._vertices[dot] = _Vertex(dot, cmd, clock, deps, time)
 
         # commands blocked on this dot at phase one may advance
@@ -136,6 +252,7 @@ class PredecessorsGraph:
         added = self._executed_clock.add(dot.source, dot.sequence)
         assert added
         assert dot not in self._vertices, "a noop dot has no vertex"
+        self._gen += 1  # commit state changed: watchdog memo stale
         self._try_phase_one_pending(dot, time)
         self._try_phase_two_pending(dot, time)
 
@@ -157,11 +274,17 @@ class PredecessorsGraph:
         stalled_missing: Dict[Dot, Set[Dot]] = {}
         stalled_for = 0
         all_missing: Set[Dot] = set()
+        # lazily built: a healthy tick (no vertex past the threshold)
+        # must cost no graph walk at all — the common case in an active
+        # system, where commits bump _gen and the memo never carries over
+        missing_map = None
         for vertex in self._vertices.values():
             pending_for = now - vertex.start_time_ms
             if pending_for < threshold:
                 continue
-            missing = self._missing_dependencies(vertex)
+            if missing_map is None:
+                missing_map = self._missing_map()
+            missing = missing_map[vertex.dot]
             if not missing:
                 stuck_without_missing.add(vertex.dot)
             else:
@@ -185,31 +308,59 @@ class PredecessorsGraph:
             )
         return all_missing
 
-    def _missing_dependencies(self, vertex: _Vertex) -> Set[Dot]:
-        """Transitively uncommitted dependency dots blocking ``vertex``:
-        an uncommitted dep blocks phase one directly; a committed-but-
+    def _missing_map(self) -> Dict[Dot, Set[Dot]]:
+        """Transitively-missing dependency dots per pending vertex: an
+        uncommitted dep blocks phase one directly; a committed-but-
         unexecuted lower-clock dep blocks phase two through ITS missing
-        deps.  Iterative with a visited set — conflict chains under high
-        contention fan out, and a naive recursion re-walks shared
-        subchains exponentially (fuzzer-found watchdog livelock)."""
-        missing: Set[Dot] = set()
-        visited: Set[Dot] = {vertex.dot}
-        stack = [vertex]
-        while stack:
-            current = stack.pop()
-            for dep in current.deps:
-                if dep in visited:
-                    continue
-                if self._executed_clock.contains(dep.source, dep.sequence):
-                    continue
-                if not self._committed_clock.contains(dep.source, dep.sequence):
-                    missing.add(dep)
-                    continue
-                visited.add(dep)
-                dep_vertex = self._vertices.get(dep)
-                if dep_vertex is not None and dep_vertex.clock < current.clock:
-                    stack.append(dep_vertex)
-        return missing
+        deps.  Computed as ONE bottom-up pass over the pending graph
+        (blocking chains strictly decrease in clock, so the recursion is
+        acyclic and shared subchains are computed once — the naive
+        per-vertex re-walk was a fuzzer-found watchdog livelock), and
+        MEMOIZED across watchdog ticks: the map only changes when a
+        commit/noop/execution lands (``_gen``), so an idle tick at 1M
+        pending is a dict read, not a graph walk."""
+        if self._memo_gen == self._gen:
+            return self._memo
+        memo: Dict[Dot, Set[Dot]] = {}
+        executed = self._executed_clock
+        committed = self._committed_clock
+        vertices = self._vertices
+        for root in vertices.values():
+            if root.dot in memo:
+                continue
+            # iterative post-order: children (lower-clock pending deps)
+            # resolve before their dependents fold them in
+            stack = [(root, None)]
+            while stack:
+                vertex, state = stack.pop()
+                if state is None:
+                    if vertex.dot in memo:
+                        continue
+                    missing: Set[Dot] = set()
+                    pending_deps = []
+                    for dep in vertex.deps:
+                        if executed.contains(dep.source, dep.sequence):
+                            continue
+                        if not committed.contains(dep.source, dep.sequence):
+                            missing.add(dep)
+                            continue
+                        dep_vertex = vertices.get(dep)
+                        if dep_vertex is not None and dep_vertex.clock < vertex.clock:
+                            pending_deps.append(dep_vertex)
+                    stack.append((vertex, (missing, pending_deps)))
+                    for dep_vertex in pending_deps:
+                        if dep_vertex.dot not in memo:
+                            stack.append((dep_vertex, None))
+                else:
+                    missing, pending_deps = state
+                    for dep_vertex in pending_deps:
+                        # computed by the post-order (acyclic: clocks
+                        # strictly decrease along blocking edges)
+                        missing |= memo.get(dep_vertex.dot, set())
+                    memo[vertex.dot] = missing
+        self._memo = memo
+        self._memo_gen = self._gen
+        return memo
 
     def _move_to_phase_one(self, dot: Dot, time: SysTime) -> None:
         vertex = self._vertices[dot]
@@ -257,6 +408,7 @@ class PredecessorsGraph:
     def _save_to_execute(self, dot: Dot, time: SysTime) -> None:
         added = self._executed_clock.add(dot.source, dot.sequence)
         assert added
+        self._gen += 1  # execution state changed: watchdog memo stale
         vertex = self._vertices.pop(dot)
         if time is not None:
             self._metrics.collect(
@@ -344,6 +496,7 @@ class PredecessorsGraph:
             assert added, "commands are committed exactly once"
             added = self._executed_clock.add(info.dot.source, info.dot.sequence)
             assert added
+            self._gen += 1  # watchdog memo stale
             if time is not None:
                 # same-batch execution: zero delay, but the histogram must
                 # count every command the per-info path would count
@@ -362,14 +515,32 @@ class PredecessorsExecutor(Executor):
         self._shard_id = shard_id
         self._execute_at_commit = config.execute_at_commit
         self._batched = config.batched_pred_executor
-        self._graph = PredecessorsGraph(process_id, config)
+        # device-resident predecessors plane: the whole pending window
+        # stays on device across feeds (executor/pred_plane.py); it
+        # implements the PredecessorsGraph surface, so everything below
+        # drives either twin identically (oracle-parity tested)
+        if config.device_pred_plane and not config.execute_at_commit:
+            from fantoch_tpu.executor.pred_plane import DevicePredPlane
+
+            self._graph = DevicePredPlane(process_id, config)
+        else:
+            self._graph = PredecessorsGraph(process_id, config)
         self._store = KVStore(
             config.executor_monitor_execution_order,
             config.execution_digests,
         )
         self._to_clients: Deque[ExecutorResult] = deque()
 
+    @property
+    def _plane(self):
+        from fantoch_tpu.executor.pred_plane import DevicePredPlane
+
+        return self._graph if isinstance(self._graph, DevicePredPlane) else None
+
     def handle(self, info, time) -> None:
+        if isinstance(info, PredExecutionArrays):
+            self.handle_batch([info], time)
+            return
         if isinstance(info, PredecessorsNoop):
             # execute-at-commit has no ordering state to resolve
             if not self._execute_at_commit:
@@ -383,10 +554,46 @@ class PredecessorsExecutor(Executor):
         self._drain()
 
     def handle_batch(self, infos, time) -> None:
-        """Batched seam: with ``Config.batched_pred_executor`` the whole
-        batch's two-phase countdown resolves as one device kernel
-        (ops/pred_resolve.py); otherwise per-info.  Noops take the
-        per-info path either way (they carry no clock for the kernel)."""
+        """Batched seam: the device pred plane consumes the whole feed
+        (adds + noops + any column batches from the protocol's arrays
+        builder) as ONE resident dispatch; with
+        ``Config.batched_pred_executor`` the batch resolves as one
+        upload-per-batch kernel (ops/pred_resolve.resolve_pred);
+        otherwise per-info.  Noops take the per-info path on the
+        non-plane paths (they carry no clock for the kernel)."""
+        plane = None if self._execute_at_commit else self._plane
+        if plane is not None:
+            # column batches feed the plane natively (no per-command
+            # objects); interleaved object infos keep their relative
+            # order by flushing as their own column feeds
+            adds, noops = [], []
+
+            def _flush_objects():
+                if adds or noops:
+                    plane.add_batch(adds, time, noops=noops)
+                    adds.clear()
+                    noops.clear()
+
+            for info in infos:
+                if isinstance(info, PredExecutionArrays):
+                    _flush_objects()
+                    plane.add_arrays(info, time)
+                elif isinstance(info, PredecessorsNoop):
+                    noops.append(info.dot)
+                else:
+                    adds.append(info)
+            _flush_objects()
+            self._drain()
+            return
+        expanded = []
+        for info in infos:
+            if isinstance(info, PredExecutionArrays):
+                batch_infos, batch_noops = _unpack_arrays(info)
+                expanded.extend(batch_infos)
+                expanded.extend(batch_noops)
+            else:
+                expanded.append(info)
+        infos = expanded
         if not self._batched or self._execute_at_commit:
             for info in infos:
                 self.handle(info, time)
@@ -405,6 +612,32 @@ class PredecessorsExecutor(Executor):
         if self._execute_at_commit:
             return None
         return self._graph.monitor_pending(time)
+
+    def device_counters(self):
+        """Per-dispatch tallies of the resident predecessors plane (None
+        when the plane is off); folded into the run layer's periodic
+        metrics snapshot and the bench rows — the same
+        ``Executor.device_counters`` seam the table plane feeds, so
+        ``bin/obs.py summarize`` and the telemetry series cover Caesar
+        like Newt."""
+        plane = self._plane
+        if plane is None:
+            return None
+        return {
+            "pred_plane_dispatches": plane.dispatches,
+            "pred_plane_grows": plane.grows,
+            "pred_plane_new_rows": plane.stats["new_rows"],
+            "pred_plane_update_capacity": plane.stats["update_capacity"],
+            "pred_plane_residual_rows": plane.stats["residual_rows"],
+            "pred_plane_compactions": plane.stats["compactions"],
+            "pred_plane_kernel_ms": round(plane.stats["kernel_ms"], 3),
+            # host->device window materializations: 1 lazy initial, +1
+            # per compaction / live capacity-or-width grow, +1 per
+            # restart-from-snapshot — never one per batch
+            "pred_plane_resident_uploads": plane.resident_uploads,
+            # configuration gauge (max-folded, not summed)
+            "pred_plane_slot_capacity": plane._cap,
+        }
 
     def _drain(self) -> None:
         while True:

@@ -330,14 +330,13 @@ class TableExecutor(Executor):
 
     @classmethod
     def _resolve_kernel_threshold(cls, config: Config) -> int:
-        if config.table_kernel_threshold is not None:
-            return int(config.table_kernel_threshold)
-        import os
+        from fantoch_tpu.executor.device_plane import resolve_threshold
 
-        env = os.environ.get("FANTOCH_TABLE_KERNEL_THRESHOLD")
-        if env:
-            return int(env)
-        return cls._KERNEL_THRESHOLD
+        return resolve_threshold(
+            config.table_kernel_threshold,
+            "FANTOCH_TABLE_KERNEL_THRESHOLD",
+            cls._KERNEL_THRESHOLD,
+        )
 
     def __init__(self, process_id: ProcessId, shard_id: ShardId, config: Config):
         _, _, stability_threshold = config.newt_quorum_sizes()

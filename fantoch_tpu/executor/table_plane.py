@@ -16,6 +16,12 @@ buffers + re-feeds it with every later batch until the gap fills —
 after which the frontier equals what the RangeEventSets would hold
 (oracle-equivalence tested, tests/test_table_plane.py).
 
+Buffer lifecycle (donation safety, lazy host-mirror re-materialization
+with the single counted re-upload, pow2 growth, per-dispatch counters)
+comes from the shared :class:`~fantoch_tpu.executor.device_plane.DevicePlane`
+base — the same machinery the Caesar predecessors plane
+(executor/pred_plane.py) rides.
+
 Clock width: device clocks are int32.  The plane refuses clocks at or
 above ``2^31 - 1`` with a typed error instead of silently wrapping —
 real-time-micros clock bumps (``Config.newt_clock_bump_interval_ms``)
@@ -25,12 +31,11 @@ are rejected at config time (core/config.py).
 from __future__ import annotations
 
 import time
-from typing import Dict, List
+from typing import Tuple
 
 import numpy as np
 
-from fantoch_tpu.core.kvs import Key
-from fantoch_tpu.ops.table_ops import next_pow2 as _pow2
+from fantoch_tpu.executor.device_plane import DevicePlane, next_pow2 as _pow2
 
 _INT32_MAX = (1 << 31) - 1
 
@@ -40,7 +45,7 @@ class ClockOverflowError(ValueError):
 
 
 
-class DeviceTablePlane:
+class DeviceTablePlane(DevicePlane):
     """Resident vote-frontier state + fused commit dispatch per batch.
 
     ``commit_votes`` consumes vote columns (already bucketed) and returns
@@ -48,135 +53,41 @@ class DeviceTablePlane:
     matrix never crosses the host boundary (donated in, donated out).
     """
 
-    __slots__ = (
-        "n",
-        "threshold",
-        "_key_index",
-        "_keys",
-        "_cap",
-        "_frontier",
-        "_host_mirror",
-        "_res_key",
-        "_res_by",
-        "_res_start",
-        "_res_end",
-        "dispatches",
-        "grows",
-        "resident_uploads",
-        "stats",
-    )
+    __slots__ = ("n", "threshold")
 
     def __init__(self, n: int, stability_threshold: int, key_buckets: int = 1024):
         assert stability_threshold <= n
+        super().__init__(
+            key_buckets,
+            stats={
+                # per-dispatch observability tallies (observability/
+                # device.py): vote_rows/row_capacity is the batch
+                # occupancy (padding waste), kernel_ms the blocking
+                # dispatch+transfer wall time
+                "vote_rows": 0,
+                "row_capacity": 0,
+                "residual_runs": 0,
+                "kernel_ms": 0.0,
+            },
+        )
         self.n = n
         self.threshold = stability_threshold
-        self._key_index: Dict[Key, int] = {}
-        self._keys: List[Key] = []
-        self._cap = _pow2(max(key_buckets, 2))
-        self._frontier = None  # lazy: created on first dispatch
-        # host copy awaiting re-materialization (restart/unpickle path);
-        # None while the live matrix is device-resident
-        self._host_mirror = None
-        empty = np.empty(0, dtype=np.int64)
-        self._res_key, self._res_by = empty, empty
-        self._res_start, self._res_end = empty, empty
-        self.dispatches = 0
-        self.grows = 0
-        # host->device frontier materializations: 1 for the lazy initial
-        # upload, +1 per restore-from-snapshot re-upload (the recovery
-        # acceptance signal: restart costs ONE upload, not one per batch)
-        self.resident_uploads = 0
-        # per-dispatch observability tallies (observability/device.py):
-        # vote_rows/row_capacity is the batch occupancy (padding waste),
-        # kernel_ms the blocking dispatch+transfer wall time
-        self.stats: Dict[str, float] = {
-            "vote_rows": 0,
-            "row_capacity": 0,
-            "residual_runs": 0,
-            "kernel_ms": 0.0,
-        }
 
-    # --- key registry (string keys -> stable device buckets) ---
+    # --- DevicePlane state hooks (state = the 1-tuple frontier matrix) ---
 
-    def bucket(self, key: Key) -> int:
-        idx = self._key_index.get(key)
-        if idx is None:
-            idx = len(self._keys)
-            self._key_index[key] = idx
-            self._keys.append(key)
-            if idx >= self._cap:
-                self._grow()
-        return idx
+    def _fresh_state(self) -> Tuple[np.ndarray, ...]:
+        return (np.zeros((self._cap, self.n), dtype=np.int32),)
+
+    def _pad_state(self, state, cap: int) -> Tuple[np.ndarray, ...]:
+        (host,) = state
+        padded = np.zeros((cap, self.n), dtype=np.int32)
+        rows = min(len(host), cap)
+        padded[:rows] = host[:rows]
+        return (padded,)
 
     @property
-    def key_count(self) -> int:
-        return len(self._keys)
-
-    def _grow(self) -> None:
-        """Double the bucket capacity; pads the resident frontier (one
-        host round-trip — rare, amortized by the pow2 schedule)."""
-        import jax
-        import jax.numpy as jnp
-
-        new_cap = self._cap * 2
-        if self._frontier is not None:
-            host = np.asarray(jax.device_get(self._frontier))
-            padded = np.zeros((new_cap, self.n), dtype=np.int32)
-            padded[: self._cap] = host
-            # jnp.array copies into an XLA-owned buffer: jnp.asarray
-            # would zero-copy alias ``padded``'s numpy memory on CPU, and
-            # fused_votes_commit donates this buffer (use-after-free)
-            self._frontier = jnp.array(padded)
-            self.resident_uploads += 1
-        self._cap = new_cap
-        self.grows += 1
-
-    def _materialize(self) -> None:
-        """Ensure the frontier matrix is device-resident: lazy initial
-        creation, or the ONE re-upload from the host mirror after
-        restore-from-snapshot (the restart plane's lazy
-        re-materialization seam — same discipline as
-        ``BatchedKeyClocks``)."""
-        if self._frontier is not None:
-            return
-        import jax
-        import jax.numpy as jnp
-
-        if self._host_mirror is not None:
-            padded = np.zeros((self._cap, self.n), dtype=np.int32)
-            rows = min(len(self._host_mirror), self._cap)
-            padded[:rows] = self._host_mirror[:rows]
-            # jnp.array: XLA-owned copy (the donation-safety rule)
-            self._frontier = jnp.array(padded)
-            self._host_mirror = None
-        else:
-            self._frontier = jax.device_put(
-                jnp.zeros((self._cap, self.n), dtype=jnp.int32)
-            )
-        self.resident_uploads += 1
-
-    # --- durability (Executor.snapshot pickles through here) ---
-
-    def __getstate__(self):
-        state = {
-            slot: getattr(self, slot)
-            for slot in self.__slots__
-            if slot not in ("_frontier", "_host_mirror")
-        }
-        host = self._host_mirror
-        if self._frontier is not None:
-            import jax
-
-            host = np.asarray(jax.device_get(self._frontier)).astype(np.int32)
-        state["_host_mirror"] = host
-        return state
-
-    def __setstate__(self, state) -> None:
-        for slot, value in state.items():
-            setattr(self, slot, value)
-        # device state never survives a pickle: the next dispatch
-        # re-materializes from the host mirror (ONE counted upload)
-        self._frontier = None
+    def _frontier(self):
+        return self._resident[0] if self._resident is not None else None
 
     # --- the fused commit dispatch ---
 
@@ -204,10 +115,9 @@ class DeviceTablePlane:
             )
         # prepend buffered residuals so gap-filling batches coalesce with
         # the runs they unblock
-        vkey = np.concatenate([self._res_key, vkey])
-        vby = np.concatenate([self._res_by, vby])
-        vstart = np.concatenate([self._res_start, vstart])
-        vend = np.concatenate([self._res_end, vend])
+        vkey, vby, vstart, vend = self._take_residuals(
+            (vkey, vby, vstart, vend)
+        )
         V = len(vkey)
 
         self._materialize()
@@ -244,22 +154,23 @@ class DeviceTablePlane:
             jnp.asarray(pvalid),
             threshold=self.threshold,
         )
-        self._frontier = out[0]
+        self._resident = (out[0],)
         # one blocking transfer for stability + the residual run columns
         stable, run_key, run_by, run_start, run_end, residual = jax.device_get(
             out[1:]
         )
-        self.dispatches += 1
-        stats = self.stats
-        stats["kernel_ms"] += (time.perf_counter() - t0) * 1000.0
-        stats["vote_rows"] += V
-        stats["row_capacity"] += vcap
         res = np.flatnonzero(residual)
-        stats["residual_runs"] += len(res)
-        self._res_key = run_key[res].astype(np.int64)
-        self._res_by = (run_by[res] + 1).astype(np.int64)  # back to 1-based
-        self._res_start = run_start[res].astype(np.int64)
-        self._res_end = run_end[res].astype(np.int64)
+        self._count_dispatch(
+            t0, vote_rows=V, row_capacity=vcap, residual_runs=len(res)
+        )
+        self._put_residuals(
+            (
+                run_key[res].astype(np.int64),
+                (run_by[res] + 1).astype(np.int64),  # back to 1-based
+                run_start[res].astype(np.int64),
+                run_end[res].astype(np.int64),
+            )
+        )
         return stable.astype(np.int64)[: self.key_count]
 
     # --- introspection (tests / debugging) ---
@@ -267,15 +178,8 @@ class DeviceTablePlane:
     def frontiers(self) -> np.ndarray:
         """Host copy of the live ``int64[key_count, n]`` frontier matrix
         (a device round-trip; for tests and debugging only)."""
-        import jax
-
-        if self._frontier is None:
+        if self._resident is None:
             if self._host_mirror is not None:
-                return self._host_mirror[: self.key_count].astype(np.int64)
+                return self._host_mirror[0][: self.key_count].astype(np.int64)
             return np.zeros((self.key_count, self.n), dtype=np.int64)
-        host = np.asarray(jax.device_get(self._frontier)).astype(np.int64)
-        return host[: self.key_count]
-
-    @property
-    def residual_count(self) -> int:
-        return len(self._res_key)
+        return self._fetch_state()[0].astype(np.int64)[: self.key_count]

@@ -58,7 +58,9 @@ def recompile_count() -> int:
 # dropped (derive_idle_frac recomputes from the folded walls) and
 # configuration gauges fold by max
 _RATIO_KEYS = frozenset({"device_idle_frac"})
-_GAUGE_MAX_KEYS = frozenset({"device_pipeline_depth"})
+_GAUGE_MAX_KEYS = frozenset(
+    {"device_pipeline_depth", "pred_plane_slot_capacity"}
+)
 
 
 def merge_counters(

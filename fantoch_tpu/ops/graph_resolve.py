@@ -720,6 +720,141 @@ def resolve_general_staged(
     )
 
 
+# slot sentinel internal to resolve_general_resident's compaction: a dep
+# whose target was cut at a fixpoint compaction (permanently stuck live
+# rows past the stage capacity) — only ever created after the publish
+# gate closed, so it is never read into a published result
+_FROZEN = -3
+
+
+def _resident_schedule(batch: int, min_size: int) -> Tuple[int, ...]:
+    """Static pow2 halving schedule from the padded batch down to the
+    terminal stage size (inclusive)."""
+    sizes = []
+    size = _pow2_at_least(max(batch, 1))
+    floor_size = _pow2_at_least(max(min_size, 1))
+    while size > floor_size:
+        sizes.append(size)
+        size //= 2
+    sizes.append(size)
+    return tuple(sizes)
+
+
+@functools.partial(jax.jit, static_argnames=("min_size",))
+def resolve_general_resident(
+    deps: jax.Array,  # int32[B, W] — TERMINAL/MISSING sentinels
+    dot_src: jax.Array,
+    dot_seq: jax.Array,
+    *,
+    min_size: int = 4096,
+) -> GeneralResolution:
+    """``resolve_general_staged`` with the state kept DEVICE-RESIDENT
+    between stages: the whole peel-and-compact schedule — frontier
+    peeling until the live set halves, device-side compaction to half
+    capacity, repeat down to ``min_size``, terminal fixpoint — runs as
+    ONE jitted dispatch with no host round-trips.
+
+    The host-orchestrated variant pays a full state fetch + re-upload
+    per stage (the reason its stage kernel is CPU-pinned: measured
+    923 ms at 32k x 4 over the TPU dispatch tunnel); this one costs a
+    single dispatch + one result fetch, so the adversarial fallback
+    (``bench.py general_fallback_*``) is slope-timeable and serves from
+    the accelerator like every other in-dispatch resolver — closing the
+    ~300x general-path fallback cliff (ROADMAP item 4).
+
+    Semantics are the staged peeler's exactly (parity-tested): DAG rows
+    finalize with frontier-proportional total cost, missing-blocked rows
+    and their dependents come back unresolved-not-stuck, cycles never
+    peel and return ``stuck`` for the host Tarjan oracle.  The one
+    divergence-shaped corner — a fixpoint reached while the live set
+    still exceeds the next stage's capacity — closes the publish gate:
+    results are already final at a fixpoint, so later stages (whose cut
+    rows would dangle) cannot corrupt them.
+    """
+    batch, width = deps.shape
+    idx = jnp.arange(batch, dtype=jnp.int32)
+    # self-deps are semantic no-ops (tarjan.py:129)
+    deps = jnp.where(deps == idx[:, None], TERMINAL, deps)
+
+    # full-batch outputs, scatter-published as stages finalize rows
+    out_final = jnp.zeros((batch,), bool)
+    out_miss = jnp.zeros((batch,), bool)
+    out_rank = jnp.full((batch,), _UNRESOLVED_RANK, jnp.int32)
+
+    schedule = _resident_schedule(batch, min_size)
+    size0 = schedule[0]
+    pad = size0 - batch
+    iota0 = jnp.arange(size0, dtype=jnp.int32)
+    tgt = jnp.full((size0, width), TERMINAL, jnp.int32).at[:batch].set(deps)
+    floor = jnp.zeros((size0,), jnp.int32)
+    miss = jnp.zeros((size0,), bool).at[:batch].set((deps == MISSING).any(axis=1))
+    final = iota0 >= batch  # pads are inert
+    rank = jnp.zeros((size0,), jnp.int32)
+    orig = jnp.where(iota0 < batch, iota0, jnp.int32(batch))  # pad -> dropped
+
+    dead = jnp.bool_(False)  # publish gate (see docstring)
+    for size in schedule:
+        tgt, floor, miss, final, rank, _changed = _peel_stage(
+            tgt, floor, miss, final, rank,
+            run_to_fixpoint=size <= min_size,
+        )
+        pub_final = out_final.at[orig].set(final, mode="drop")
+        pub_miss = out_miss.at[orig].set(miss, mode="drop")
+        pub_rank = out_rank.at[orig].set(
+            jnp.where(final, rank, _UNRESOLVED_RANK), mode="drop"
+        )
+        out_final = jnp.where(dead, out_final, pub_final)
+        out_miss = jnp.where(dead, out_miss, pub_miss)
+        out_rank = jnp.where(dead, out_rank, pub_rank)
+        if size <= min_size:
+            break  # terminal stage ran to its fixpoint
+
+        # --- device-side compaction to half capacity ---
+        half = size // 2
+        live = ~final & ~miss
+        # a fixpoint with live > half means every survivor is
+        # permanently blocked: results above are final — close the gate
+        # (cut rows may dangle below, but nothing publishes past here)
+        dead = dead | (live.sum() > half)
+        iota = jnp.arange(size, dtype=jnp.int32)
+        _, perm = jax.lax.sort(
+            ((~live).astype(jnp.int32), iota), num_keys=1, is_stable=True
+        )
+        keep = perm[:half]
+        remap = (
+            jnp.full((size,), _FROZEN, jnp.int32)
+            .at[keep]
+            .set(jnp.arange(half, dtype=jnp.int32))
+        )
+        tgt_k = tgt[keep]
+        valid = tgt_k >= 0
+        t_rows = jnp.where(valid, tgt_k, 0)
+        t_final = final[t_rows] & valid
+        t_miss = miss[t_rows] & valid
+        floor = jnp.maximum(
+            floor[keep],
+            jnp.where(t_final, rank[t_rows] + 1, 0).max(axis=1),
+        )
+        miss = miss[keep] | t_miss.any(axis=1)
+        tgt = jnp.where(
+            t_final, jnp.int32(TERMINAL), jnp.where(valid, remap[t_rows], tgt_k)
+        )
+        final = final[keep]
+        rank = rank[keep]
+        orig = orig[keep]
+
+    stuck = ~out_final & ~out_miss
+    order = jnp.lexsort(
+        (
+            dot_seq,
+            dot_src,
+            idx,
+            jnp.where(out_final, out_rank, _UNRESOLVED_RANK),
+        )
+    ).astype(jnp.int32)
+    return GeneralResolution(order, out_final, out_rank, idx, stuck)
+
+
 def _resolve_general_iterative(deps, dot_src, dot_seq, max_iters):
     """The exact fallback: mutual-edge SCC collapse + affine-max doubling
     (see resolve_general).  Returns the GeneralResolution fields."""

@@ -27,6 +27,7 @@ commit-timestamp order the PredecessorsExecutor promises for conflicts.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -95,3 +96,99 @@ def resolve_pred(
     sort_clock = jnp.where(executed, clock, int_max)
     order = jnp.lexsort((dot_seq, dot_src, sort_clock)).astype(jnp.int32)
     return PredResolution(order, executed)
+
+
+# ---------------------------------------------------------------------------
+# resident plane step (executor/pred_plane.DevicePredPlane)
+# ---------------------------------------------------------------------------
+
+
+class PredPlaneStep(NamedTuple):
+    """One resident dispatch's output: the donated state back, plus which
+    slots executed THIS dispatch.  Execution order among the newly
+    executed is (clock, src) — computed HOST-side from the plane's slot
+    columns (a dynamic-size host lexsort over the executed handful beats
+    a full-capacity device sort every dispatch)."""
+
+    deps: jax.Array  # int32[C, W] — resident slot matrix (donated through)
+    clock: jax.Array  # int32[C]
+    src: jax.Array  # int32[C]
+    occ: jax.Array  # bool[C] — slot holds a committed command
+    executed: jax.Array  # bool[C]
+    newly: jax.Array  # bool[C] — executed by this dispatch
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+def resolve_pred_plane_step(
+    deps: jax.Array,  # int32[C, W] slot indices / TERMINAL / MISSING
+    clock: jax.Array,  # int32[C] — committed timestamp seq
+    src: jax.Array,  # int32[C] — timestamp process id (clock uniqueness)
+    occ: jax.Array,  # bool[C]
+    executed: jax.Array,  # bool[C]
+    u_row: jax.Array,  # int32[U] — new slot ids (pad = C, dropped)
+    u_deps: jax.Array,  # int32[U, W]
+    u_clock: jax.Array,  # int32[U]
+    u_src: jax.Array,  # int32[U]
+    p_row: jax.Array,  # int32[P] — dep-patch cells (pad = C, dropped)
+    p_col: jax.Array,  # int32[P]
+    p_val: jax.Array,  # int32[P] — slot id or TERMINAL
+) -> PredPlaneStep:
+    """The resident twin of :func:`resolve_pred` (executor/pred_plane.py).
+
+    The whole pending window lives ON DEVICE across dispatches: ``C``
+    slots of (deps, clock, src) with occupancy and executed flags, all
+    donated in-place.  Each dispatch (1) installs the batch's new rows,
+    (2) re-points dep cells whose missing dot just committed (the
+    residual re-feed: missing-blocked rows stay resident and wake when a
+    later feed patches them — the pred-plane analog of the table plane's
+    beyond-gap runs), then (3) runs the same monotone two-phase fixpoint
+    as :func:`resolve_pred` over the *entire* resident window, so rows
+    blocked across any number of earlier feeds execute the moment their
+    chain completes.
+
+    Slot recycling is host-owned: a freed slot is simply overwritten by a
+    later ``u_row`` install (occ/executed/clock/deps all re-set), so no
+    clear pass is needed — the host only frees a slot once nothing
+    references it.
+    """
+    cap, _width = deps.shape
+
+    # (1) new rows: full-row install (reused slots are fully overwritten)
+    deps = deps.at[u_row].set(u_deps, mode="drop")
+    clock = clock.at[u_row].set(u_clock, mode="drop")
+    src = src.at[u_row].set(u_src, mode="drop")
+    occ = occ.at[u_row].set(True, mode="drop")
+    executed = executed.at[u_row].set(False, mode="drop")
+    # (2) dep patches: MISSING cells whose dot just committed (or was
+    # recovered as a noop -> TERMINAL)
+    deps = deps.at[p_row, p_col].set(p_val, mode="drop")
+
+    # (3) fixpoint: executable(v) = occ(v) and every dep slot is
+    # TERMINAL, executed, or a committed dep with a higher (clock, src)
+    # key (phase 2's lower-clock rule; MISSING always blocks phase 1)
+    in_res = deps >= 0
+    safe = jnp.maximum(deps, 0)
+    dep_clock, dep_src = clock[safe], src[safe]
+    my_clock, my_src = clock[:, None], src[:, None]
+    dep_higher = (dep_clock > my_clock) | (
+        (dep_clock == my_clock) & (dep_src > my_src)
+    )
+    never_blocks = (deps == TERMINAL) | (in_res & occ[safe] & dep_higher)
+    executed0 = executed
+
+    def body(state):
+        done, _changed = state
+        dep_ok = never_blocks | (in_res & done[safe])
+        new = occ & dep_ok.all(axis=1)
+        changed = (new & ~done).any()
+        return new | done, changed
+
+    def cond(state):
+        _done, changed = state
+        return changed
+
+    first, changed0 = body((executed0, jnp.bool_(True)))
+    done, _ = jax.lax.while_loop(cond, body, (first, changed0))
+
+    newly = done & ~executed0
+    return PredPlaneStep(deps, clock, src, occ, done, newly)

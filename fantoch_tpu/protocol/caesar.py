@@ -65,6 +65,7 @@ from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
 from fantoch_tpu.core.timing import SysTime
 from fantoch_tpu.executor.pred import (
+    PredArraysBuilder,
     PredecessorsExecutionInfo,
     PredecessorsExecutor,
     PredecessorsNoop,
@@ -299,6 +300,14 @@ class Caesar(RecoveryMixin, SyncMixin, Protocol):
         self._gc_track = GCTrack(process_id, shard_id, config.n)
         self._to_processes: Deque[Action] = deque()
         self._to_executors: Deque[PredecessorsExecutionInfo] = deque()
+        # column-borne commit seam (the PR 4 TableVotesArraysBuilder
+        # move): with the device pred plane on, commits/noops accumulate
+        # as columns and drain ONE PredExecutionArrays per to_executors
+        # sweep — no per-command info objects on the plane path (the
+        # runner disables it via set_commit_arrays for executor pools)
+        self._commit_arrays: Optional[PredArraysBuilder] = (
+            PredArraysBuilder() if config.device_pred_plane else None
+        )
         # MRetry/MCommit that arrived before the MPropose (multiplexing)
         self._buffered_retries: Dict[Dot, Tuple[ProcessId, Clock, Set[Dot]]] = {}
         self._buffered_commits: Dict[
@@ -424,7 +433,37 @@ class Caesar(RecoveryMixin, SyncMixin, Protocol):
         return self._to_processes.popleft() if self._to_processes else None
 
     def to_executors(self):
+        if self._commit_arrays is not None and len(self._commit_arrays):
+            return self._commit_arrays.take()
         return self._to_executors.popleft() if self._to_executors else None
+
+    def set_commit_arrays(self, enabled: bool) -> None:
+        """Runner hook (the Newt seam's twin): the arrays commit seam
+        assumes a single predecessors executor consumes this process's
+        infos; executor pools must turn it off (falls back to
+        per-command infos)."""
+        if enabled and self._commit_arrays is None:
+            self._commit_arrays = PredArraysBuilder()
+        elif not enabled and self._commit_arrays is not None:
+            # flush anything accumulated so no commit is lost
+            pending = self._commit_arrays.take()
+            if pending is not None:
+                self._to_executors.append(pending)
+            self._commit_arrays = None
+
+    def _emit_commit(self, dot: Dot, cmd: Command, clock: Clock, deps: Set[Dot]) -> None:
+        if self._commit_arrays is not None:
+            self._commit_arrays.add_commit(dot, cmd, clock, deps)
+        else:
+            self._to_executors.append(
+                PredecessorsExecutionInfo(dot, cmd, clock, deps)
+            )
+
+    def _emit_noop(self, dot: Dot) -> None:
+        if self._commit_arrays is not None:
+            self._commit_arrays.add_noop(dot)
+        else:
+            self._to_executors.append(PredecessorsNoop(dot))
 
     @classmethod
     def parallel(cls) -> bool:
@@ -614,7 +653,7 @@ class Caesar(RecoveryMixin, SyncMixin, Protocol):
                 # again — the zero clock marks it)
                 self.key_clocks.remove(info.cmd, info.clock)
                 info.clock = Clock.zero(self.bp.process_id)
-            self._to_executors.append(PredecessorsNoop(dot))
+            self._emit_noop(dot)
             blocking, info.blocking = info.blocking, set()
             for blocked in blocking:
                 blocked_info = self._cmds.get_existing(blocked)
@@ -640,9 +679,7 @@ class Caesar(RecoveryMixin, SyncMixin, Protocol):
 
         cmd = info.cmd
         assert cmd is not None, "there should be a command payload"
-        self._to_executors.append(
-            PredecessorsExecutionInfo(dot, cmd, clock, set(deps))
-        )
+        self._emit_commit(dot, cmd, clock, set(deps))
 
         info.status = Status.COMMIT
         # audit plane: agreement = same dot, same (clock, predecessors)
