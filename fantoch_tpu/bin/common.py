@@ -37,6 +37,17 @@ def force_platform_from_env(touches_default_backend: bool = True) -> None:
             "FANTOCH_PLATFORM=cpu to force the CPU backend",
             file=sys.stderr,
         )
+    # the persistent XLA compile cache (the same in-repo dir bench.py and
+    # tests/conftest.py use — after the platform forcing above): a CLI
+    # server's first device-plane dispatch otherwise pays a full cold
+    # compile INSIDE the serving loop — on a 1-core rig the graph-plane
+    # step compiles for minutes, starving the heartbeat task until peers
+    # declare the process dead (quorum suicide).  Cache hits load in
+    # well under a second; the helper swallows failures (optimization
+    # only)
+    from fantoch_tpu.hostenv import enable_compile_cache
+
+    enable_compile_cache()
 
 
 def protocol_by_name(name: str):
@@ -85,6 +96,19 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
                         "(executor/pred_plane.py): the pending window "
                         "stays on device across batches; commits drain "
                         "as column batches")
+    parser.add_argument("--device-graph-plane", action="store_true",
+                        default=None,
+                        help="EPaxos/Atlas resident graph plane "
+                        "(executor/graph/graph_plane.py): the dependency "
+                        "backlog stays on device across feeds; requires "
+                        "--batched-graph-executor and shard-count 1; "
+                        "default FANTOCH_GRAPH_PLANE env, else off")
+    parser.add_argument("--graph-kernel-threshold", type=int, default=None,
+                        metavar="N",
+                        help="backlog size gating exact structure metrics "
+                        "and the resident general path in the batched "
+                        "graph executor; default "
+                        "FANTOCH_GRAPH_KERNEL_THRESHOLD env, else 4096")
     parser.add_argument("--serving-pipeline-depth", type=int, default=None,
                         metavar="K",
                         help="device serving pipeline depth (run/pipeline.py): "
@@ -154,6 +178,8 @@ def config_from_args(args: argparse.Namespace):
         caesar_wait_condition=args.caesar_wait_condition,
         skip_fast_ack=args.skip_fast_ack,
         batched_graph_executor=args.batched_graph_executor,
+        device_graph_plane=args.device_graph_plane,
+        graph_kernel_threshold=args.graph_kernel_threshold,
         device_pred_plane=args.device_pred_plane,
         serving_pipeline_depth=args.serving_pipeline_depth,
         wal_sync=args.wal_sync,

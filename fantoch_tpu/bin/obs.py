@@ -85,9 +85,37 @@ def cmd_summarize(args) -> int:
     for name, value in sorted(counters.items()):
         print(f"counter {name} = {value}")
     _print_overlap(counters)
+    _print_planes(counters)
     _print_overload(counters)
     _print_audit(counters)
     return 0
+
+
+def _print_planes(counters) -> int:
+    """One line per resident device plane (table / pred / graph): how
+    many fused dispatches, how many host->device window materializations
+    (``resident_uploads`` — the residency invariant: one lazy initial
+    plus compaction/grow/restore re-uploads, never one per batch), and
+    the current slot capacity gauge."""
+    shown = 0
+    for prefix, label in (
+        ("table_plane", "table plane"),
+        ("pred_plane", "pred plane"),
+        ("graph_plane", "graph plane"),
+    ):
+        if f"{prefix}_dispatches" not in counters:
+            continue
+        parts = [
+            f"dispatches {int(counters.get(f'{prefix}_dispatches', 0))}",
+            f"uploads {int(counters.get(f'{prefix}_resident_uploads', 0))}",
+            f"kernel {counters.get(f'{prefix}_kernel_ms', 0.0):.1f}ms",
+        ]
+        cap = counters.get(f"{prefix}_slot_capacity")
+        if cap is not None:
+            parts.append(f"capacity {int(cap)}")
+        print(f"{label}: " + "  ".join(parts))
+        shown += 1
+    return shown
 
 
 def _print_audit(counters) -> int:

@@ -95,6 +95,27 @@ class Config:
     # drain per to_executors sweep).  Requires timestamp sequences below
     # 2^31 (guarded with a typed ClockOverflowError)
     device_pred_plane: bool = False
+    # device-resident graph plane for EPaxos/Atlas: the batched graph
+    # executor keeps its dependency backlog (src/seq/key columns plus the
+    # dep-slot matrix) ON DEVICE across feeds (executor/graph/
+    # graph_plane.py over ops/graph_resolve.resolve_graph_plane_step):
+    # feeds install new rows and patch MISSING cells in place, resolves
+    # run as donated in-place dispatches with only the emitted order
+    # fetched back, and missing-blocked rows stay resident instead of
+    # round-tripping through host columns.  None = the
+    # FANTOCH_GRAPH_PLANE env var, else off (the host-column path stays
+    # the default oracle twin).  Single-shard only (shard sets must
+    # survive on host for cross-shard requests); requires
+    # batched_graph_executor
+    device_graph_plane: Optional[bool] = None
+    # backlog size at which the batched graph executor stops collecting
+    # exact per-SCC structure metrics (CHAIN_SIZE) and switches the
+    # multi-key path to the resident peeler / the host path to the
+    # arrival-order shortcut.  None = the FANTOCH_GRAPH_KERNEL_THRESHOLD
+    # env var, else the built-in 4096; an explicit value here beats both
+    # (the Config.table_kernel_threshold precedence, resolved through
+    # executor/device_plane.resolve_threshold)
+    graph_kernel_threshold: Optional[int] = None
     # resolver choice for the batched graph executor on *CPU* backends:
     # None = auto (the native C++ SCC resolver, fantoch_tpu/native, when
     # its toolchain is available — a single-threaded host loop beats CPU
@@ -234,6 +255,17 @@ class Config:
         if self.telemetry_interval_ms is not None and self.telemetry_interval_ms < 1:
             raise ValueError(
                 f"telemetry_interval_ms = {self.telemetry_interval_ms} "
+                "must be >= 1"
+            )
+        if self.device_graph_plane and not self.batched_graph_executor:
+            # the plane lives inside BatchedDependencyGraph: without the
+            # batched executor the knob would silently do nothing
+            raise ValueError(
+                "device_graph_plane requires batched_graph_executor"
+            )
+        if self.graph_kernel_threshold is not None and self.graph_kernel_threshold < 1:
+            raise ValueError(
+                f"graph_kernel_threshold = {self.graph_kernel_threshold} "
                 "must be >= 1"
             )
         if self.device_table_plane and self.newt_clock_bump_interval_ms is not None:

@@ -84,10 +84,31 @@ def _use_resident_general() -> bool:
 
     return os.environ.get("FANTOCH_GENERAL_RESIDENT", "1") != "0"
 
+
+# lazy module-level jax singleton: the resolve hot path used to re-run
+# the import machinery (sys.modules probe + attribute walks) on every
+# backlog flush; one cached (jax, jnp) pair serves every resolve
+_JAX_MODS = None
+
+
+def _jax_mods():
+    global _JAX_MODS
+    if _JAX_MODS is None:
+        import jax
+        import jax.numpy as jnp
+        import jax.profiler  # noqa: F401 — TraceAnnotation in _resolve_backlog
+
+        _JAX_MODS = (jax, jnp)
+    return _JAX_MODS
+
+
 _NO_DEP = np.int64(-1)  # packed-dep sentinel: no dependency in this slot
 # below this backlog size, ask the keyed kernel for full structure so
 # CHAIN_SIZE metrics stay exact (tests/sims); above it, skip the extra
-# device sort and only collect aggregate metrics
+# device sort and only collect aggregate metrics.  This is the built-in
+# DEFAULT of the unified kernel-size gate: Config.graph_kernel_threshold
+# beats the FANTOCH_GRAPH_KERNEL_THRESHOLD env var beats this value
+# (executor/device_plane.resolve_threshold, the table-plane precedence)
 _STRUCTURE_THRESHOLD = 4096
 
 
@@ -191,6 +212,39 @@ class BatchedDependencyGraph(DependencyGraph):
             self._dirty = False
             self._last_time: Optional[SysTime] = None
             self._native_auto: Optional[bool] = None
+            # the unified kernel-size gate (config > env > default)
+            from fantoch_tpu.executor.device_plane import resolve_threshold
+
+            self._structure_threshold = resolve_threshold(
+                config.graph_kernel_threshold,
+                "FANTOCH_GRAPH_KERNEL_THRESHOLD",
+                _STRUCTURE_THRESHOLD,
+            )
+            # device-resident backlog plane (executor/graph/graph_plane.py):
+            # the host-column machinery below stays the oracle twin.
+            # Single-shard only — Dependency shard sets must survive on
+            # host for cross-shard requests (ROADMAP item 2's sharded
+            # planes are the multi-shard story)
+            from fantoch_tpu.executor.graph.graph_plane import (
+                graph_plane_enabled,
+            )
+
+            if config.device_graph_plane and self._multi_shard:
+                raise ValueError(
+                    "device_graph_plane requires shard_count == 1 (the "
+                    "backlog plane keeps no per-dep shard sets)"
+                )
+            self._plane = None
+            if graph_plane_enabled(config) and not self._multi_shard:
+                from fantoch_tpu.executor.graph.graph_plane import (
+                    DeviceGraphPlane,
+                )
+
+                self._plane = DeviceGraphPlane(
+                    process_id, shard_id, config, self._frontier,
+                    self._metrics,
+                    structure_threshold=self._structure_threshold,
+                )
             # opt-in array drain (VERDICT r3 item 3): consumers that don't
             # need Command objects (array-native planes, benches) read the
             # execution order as (src, seq) columns and skip the 250k-object
@@ -290,6 +344,13 @@ class BatchedDependencyGraph(DependencyGraph):
         if not self._array_mode:
             return super()._check_pending(dots, time)
         self._dirty = True
+
+    def handle_noop(self, dot: Dot, time: SysTime) -> None:
+        if self._array_mode and self._plane is not None:
+            # the plane's waiter index patches every MISSING cell waiting
+            # on the noop dot to TERMINAL on the next dispatch
+            self._plane.note_noop(int(dot.source), int(dot.sequence))
+        super().handle_noop(dot, time)
 
     def handle_request_reply(self, infos, time: SysTime) -> None:
         if not self._array_mode:
@@ -398,6 +459,11 @@ class BatchedDependencyGraph(DependencyGraph):
     def monitor_pending(self, time: SysTime):
         if not self._array_mode:
             return super().monitor_pending(time)
+        if self._plane is not None:
+            self._flush(time)
+            self._plane.drain_all()
+            self._drain_plane_emissions()
+            return self._plane.monitor_pending(time)
         self._flush(time)
         # liveness watchdog (index.rs:53-103): after a resolve, every
         # still-pending row must be *transitively* missing-blocked — the
@@ -480,6 +546,15 @@ class BatchedDependencyGraph(DependencyGraph):
 
     def _flush(self, time: Optional[SysTime] = None) -> None:
         if not self._array_mode or not self._dirty:
+            if (
+                self._array_mode
+                and self._plane is not None
+                and self._plane._emitted
+            ):
+                # depth-K pipelined serving: results of earlier rounds
+                # may have drained during a later feed — deliver them
+                # even when nothing new is dirty
+                self._drain_plane_emissions()
             return
         self._dirty = False
         if time is None:
@@ -565,11 +640,18 @@ class BatchedDependencyGraph(DependencyGraph):
 
     def _resolve_backlog(self, time: SysTime) -> None:
         if not self._backlog.count:
+            if self._plane is not None and self._plane.has_patches:
+                # patches with no new feed (noop resolutions): the plane
+                # still needs one dispatch to wake waiting residents
+                self._plane.flush(time)
+                self._drain_plane_emissions()
             return
         # host-side latency histogram + device-side xprof annotation
         # (SURVEY §5: jax.profiler is the TPU-native tracer; the host span
-        # lands in fantoch_tpu.utils.prof's registry)
-        import jax.profiler
+        # lands in fantoch_tpu.utils.prof's registry).  jax is a lazy
+        # module-level singleton — the per-resolve import machinery used
+        # to re-run on every backlog flush
+        jax, _jnp = _jax_mods()
 
         from fantoch_tpu.utils.prof import elapsed
 
@@ -595,7 +677,7 @@ class BatchedDependencyGraph(DependencyGraph):
                 )
             return bool(forced)
         if self._native_auto is None:
-            import jax
+            jax, _jnp = _jax_mods()
 
             self._native_auto = (
                 jax.default_backend() == "cpu" and native.available()
@@ -620,7 +702,7 @@ class BatchedDependencyGraph(DependencyGraph):
         if out is None:
             return None
         order, sizes = out
-        if batch <= _STRUCTURE_THRESHOLD and len(order):
+        if batch <= self._structure_threshold and len(order):
             # exact CHAIN_SIZE only at small sizes (the walk is O(#SCCs)
             # Python — same gating as the keyed path's want_structure)
             pos, scc_sizes = 0, []
@@ -631,6 +713,8 @@ class BatchedDependencyGraph(DependencyGraph):
         return order.astype(np.int64)
 
     def _resolve_backlog_inner(self, time: SysTime) -> None:
+        if self._plane is not None:
+            return self._resolve_backlog_plane(time)
         src, seq, key, tms, deps = self._backlog.columns()
         batch = len(src)
         dep_rows = self._map_deps(src, seq, deps)
@@ -643,7 +727,7 @@ class BatchedDependencyGraph(DependencyGraph):
         # Gated to large batches so small (sim/test) batches keep exact
         # CHAIN_SIZE structure from the full resolvers.
         if (
-            batch > _STRUCTURE_THRESHOLD
+            batch > self._structure_threshold
             and bool((dep_rows < np.arange(batch, dtype=np.int32)[:, None]).all())
             and not bool((dep_rows == MISSING).any())
         ):
@@ -681,8 +765,7 @@ class BatchedDependencyGraph(DependencyGraph):
         src32 = src.astype(np.int32)
         seq32 = (seq - seq.min()).astype(np.int32) if batch else src32
 
-        import jax
-        import jax.numpy as jnp
+        jax, jnp = _jax_mods()
 
         if functional and bool((key >= 0).all()):
             col = np.where(
@@ -706,7 +789,7 @@ class BatchedDependencyGraph(DependencyGraph):
             pc[:batch] = col
             ps[:batch] = src32
             pq[:batch] = seq32
-            want_structure = batch <= _STRUCTURE_THRESHOLD
+            want_structure = batch <= self._structure_threshold
             res = resolve_keyed_auto(
                 jnp.asarray(pk),
                 jnp.asarray(pc),
@@ -732,7 +815,7 @@ class BatchedDependencyGraph(DependencyGraph):
                     )
                 )
                 self._metrics.collect_many(ExecutorMetricsKind.CHAIN_SIZE, sizes)
-        elif batch > _STRUCTURE_THRESHOLD:
+        elif batch > self._structure_threshold:
             # large multi-key batch: the peel-and-compact peeler's cost
             # tracks the per-level live set instead of B x depth, so deep
             # alternating chains don't fall off the fixed-budget cliff
@@ -842,6 +925,65 @@ class BatchedDependencyGraph(DependencyGraph):
             deps[keep],
             [cmds[i] for i in keep],
         )
+
+    # --- the device-resident backlog plane (Config.device_graph_plane) ---
+
+    def _resolve_backlog_plane(self, time: SysTime) -> None:
+        """One resident dispatch per flush: the feed columns transfer
+        into the plane (new-row deltas are the only host->device traffic)
+        and the whole pending window re-resolves in place.  The
+        arrival-order fast path is preserved: with nothing resident, a
+        backward-only no-missing feed emits host-side with zero
+        dispatches, exactly like the host-column twin."""
+        plane = self._plane
+        src, seq, key, tms, deps = self._backlog.columns()
+        batch = len(src)  # > 0: _resolve_backlog early-returns on empty
+        if (
+            batch > self._structure_threshold
+            and plane.pending_count == 0
+            and not plane.in_flight
+            and not plane.has_patches
+        ):
+            dep_rows = self._map_deps(src, seq, deps)
+            if (
+                bool((dep_rows < np.arange(batch, dtype=np.int32)[:, None]).all())
+                and not bool((dep_rows == MISSING).any())
+            ):
+                if self.record_order_arrays:
+                    self._order_arrays.append((src, seq))
+                else:
+                    self._to_execute.extend(self._backlog.cmds)
+                self._frontier.add_batch(src, seq)
+                now = float(time.millis())
+                self._metrics.collect_many(
+                    ExecutorMetricsKind.EXECUTION_DELAY,
+                    np.maximum(now - tms, 0.0),
+                )
+                self._backlog.replace(
+                    src[:0], seq[:0], key[:0], tms[:0], deps[:0], []
+                )
+                return
+        cmds = self._backlog.cmds
+        self._backlog.replace(src[:0], seq[:0], key[:0], tms[:0], deps[:0], [])
+        plane.feed(src, seq, key, tms, deps, cmds, time)
+        self._drain_plane_emissions()
+
+    def _drain_plane_emissions(self) -> None:
+        for cmds, src, seq in self._plane.take_emitted():
+            if self.record_order_arrays:
+                self._order_arrays.append((src, seq))
+            else:
+                self._to_execute.extend(cmds)
+
+    def flush_plane_pipeline(self, time: SysTime) -> None:
+        """Retire every in-flight plane round and deliver its results —
+        the end-of-stream flush of a depth-K pipelined serving loop
+        (depth 1, the executor-pool default, never has delivery lag)."""
+        self._last_time = time
+        self._flush(time)
+        if self._plane is not None:
+            self._plane.drain_all()
+            self._drain_plane_emissions()
 
     def resolve_now(self, time: SysTime) -> None:
         """Public flush: run the pending resolve without draining objects

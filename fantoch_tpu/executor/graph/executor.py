@@ -195,6 +195,34 @@ class GraphExecutor(Executor):
         else:
             raise AssertionError(f"unknown execution info {info}")
 
+    def device_counters(self):
+        """Per-dispatch tallies of the resident graph plane (None when
+        the plane is off) — the same ``Executor.device_counters`` seam
+        the table and pred planes feed, so ``bin/obs.py summarize``, the
+        telemetry series, and the bench rows cover EPaxos/Atlas like
+        Newt and Caesar."""
+        plane = getattr(self.graph, "_plane", None)
+        if plane is None:
+            return None
+        return {
+            "graph_plane_dispatches": plane.dispatches,
+            "graph_plane_grows": plane.grows,
+            "graph_plane_new_rows": plane.stats["new_rows"],
+            "graph_plane_update_capacity": plane.stats["update_capacity"],
+            "graph_plane_patched_cells": plane.stats["patched_cells"],
+            "graph_plane_residual_rows": plane.stats["residual_rows"],
+            "graph_plane_compactions": plane.stats["compactions"],
+            "graph_plane_kernel_ms": round(plane.stats["kernel_ms"], 3),
+            # host->device backlog materializations: 1 lazy initial, +1
+            # per compaction / live capacity-or-width grow, +1 per
+            # restart-from-snapshot — never one per resolve (the
+            # residency invariant; new-row deltas are the only steady-
+            # state host->device traffic)
+            "graph_plane_resident_uploads": plane.resident_uploads,
+            # configuration gauge (max-folded, not summed)
+            "graph_plane_slot_capacity": plane._cap,
+        }
+
     def to_clients(self) -> Optional[ExecutorResult]:
         return self._to_clients.popleft() if self._to_clients else None
 

@@ -59,7 +59,11 @@ def recompile_count() -> int:
 # configuration gauges fold by max
 _RATIO_KEYS = frozenset({"device_idle_frac"})
 _GAUGE_MAX_KEYS = frozenset(
-    {"device_pipeline_depth", "pred_plane_slot_capacity"}
+    {
+        "device_pipeline_depth",
+        "pred_plane_slot_capacity",
+        "graph_plane_slot_capacity",
+    }
 )
 
 

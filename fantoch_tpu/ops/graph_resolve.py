@@ -855,6 +855,165 @@ def resolve_general_resident(
     return GeneralResolution(order, out_final, out_rank, idx, stuck)
 
 
+# ---------------------------------------------------------------------------
+# resident graph-plane step (executor/graph/graph_plane.DeviceGraphPlane)
+# ---------------------------------------------------------------------------
+
+
+class GraphPlaneStep(NamedTuple):
+    """One resident dispatch's output: the donated backlog state back,
+    plus the emitted order.  Only the small per-slot result columns
+    (order/newly/stuck/leader) are fetched by the host — the backlog
+    state itself never round-trips."""
+
+    deps: jax.Array  # int32[C, W] — resident dep-slot matrix (donated)
+    key: jax.Array  # int32[C] conflict-key hash (-1 = multi-key)
+    src: jax.Array  # int32[C]
+    seq: jax.Array  # int32[C]
+    occ: jax.Array  # bool[C] — slot holds a committed command
+    executed: jax.Array  # bool[C]
+    order: jax.Array  # int32[C] permutation; emitted = order rows w/ newly
+    newly: jax.Array  # bool[C] — executed by this dispatch
+    stuck: jax.Array  # bool[C] — general modes: cycles for the host oracle
+    leader: jax.Array  # int32[C] — structure modes: SCC leader (CHAIN_SIZE)
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5), static_argnames=("mode",)
+)
+def resolve_graph_plane_step(
+    deps: jax.Array,  # int32[C, W] slot indices / TERMINAL / MISSING
+    key: jax.Array,  # int32[C]
+    src: jax.Array,  # int32[C]
+    seq: jax.Array,  # int32[C]
+    occ: jax.Array,  # bool[C]
+    executed: jax.Array,  # bool[C]
+    u_row: jax.Array,  # int32[U] — new slot ids (pad = C, dropped)
+    u_deps: jax.Array,  # int32[U, W]
+    u_key: jax.Array,  # int32[U]
+    u_src: jax.Array,  # int32[U]
+    u_seq: jax.Array,  # int32[U]
+    p_row: jax.Array,  # int32[P] — dep-patch cells (pad = C, dropped)
+    p_col: jax.Array,  # int32[P]
+    p_val: jax.Array,  # int32[P] — slot id or TERMINAL
+    e_row: jax.Array,  # int32[E] — host-oracle executed marks (pad = C)
+    *,
+    mode: str,  # "keyed" | "general" | "general_resident"
+) -> GraphPlaneStep:
+    """The resident twin of ``BatchedDependencyGraph._resolve_backlog``
+    (executor/graph/graph_plane.py).
+
+    The whole dependency backlog lives ON DEVICE across feeds: ``C``
+    slots of (deps, key, src, seq) with occupancy and executed flags,
+    all donated in-place.  Each dispatch (1) installs the feed's new
+    rows, (2) re-points MISSING dep cells whose dot just committed (the
+    waiter-index residual protocol: missing-blocked rows stay resident
+    and wake when a later feed patches them), (3) applies host-oracle
+    executed marks (stuck-cycle residues the host Tarjan finished), then
+    (4) resolves the *entire* pending window with the same kernels the
+    host-column path dispatches per flush — ``resolve_keyed_auto``'s
+    sort-based kernel for single-key functional windows,
+    ``resolve_general`` (small, exact structure) or
+    ``resolve_general_resident`` (large, peel-and-compact) otherwise —
+    folding dep cells that point at executed slots to TERMINAL first.
+
+    Non-pending slots (free, or executed-but-not-yet-compacted) are
+    masked inert: private pad keys + TERMINAL deps make them resolve as
+    singleton runs, and the host drops them via ``newly``.  Slot
+    recycling is host-owned (compaction re-packs pending rows and
+    re-uploads once).
+    """
+    cap, _width = deps.shape
+    idx = jnp.arange(cap, dtype=jnp.int32)
+
+    # (1) new rows: full-row install (reused slots fully overwritten)
+    deps = deps.at[u_row].set(u_deps, mode="drop")
+    key = key.at[u_row].set(u_key, mode="drop")
+    src = src.at[u_row].set(u_src, mode="drop")
+    seq = seq.at[u_row].set(u_seq, mode="drop")
+    occ = occ.at[u_row].set(True, mode="drop")
+    executed = executed.at[u_row].set(False, mode="drop")
+    # (2) dep patches: MISSING cells whose dot just committed (or was
+    # recovered as a noop -> TERMINAL)
+    deps = deps.at[p_row, p_col].set(p_val, mode="drop")
+    # (3) host-oracle executed marks (stuck residues finished on host)
+    executed = executed.at[e_row].set(True, mode="drop")
+
+    pending = occ & ~executed
+    cell_live = deps >= 0
+    safe = jnp.clip(deps, 0, cap - 1)
+    # fold deps on executed slots to TERMINAL; mask non-pending rows inert
+    dmat = jnp.where(cell_live & executed[safe], jnp.int32(TERMINAL), deps)
+    dmat = jnp.where(pending[:, None], dmat, jnp.int32(TERMINAL))
+
+    zeros_i = jnp.zeros((cap,), jnp.int32)
+    if mode == "keyed":
+        # single-dep column: the first live cell, else MISSING if any cell
+        # is missing, else TERMINAL (the host-column path's compression)
+        live = dmat >= 0
+        has_live = live.any(axis=1)
+        first = jnp.argmax(live, axis=1)
+        col = jnp.take_along_axis(dmat, first[:, None], axis=1)[:, 0]
+        col = jnp.where(
+            has_live,
+            col,
+            jnp.where((dmat == MISSING).any(axis=1), MISSING, TERMINAL),
+        ).astype(jnp.int32)
+        # distinct private keys park every non-pending slot in its own
+        # singleton run (one shared key would flood the residual)
+        pk = jnp.where(pending, key, jnp.iinfo(jnp.int32).max - idx)
+        # a SMALL residual, deliberately: the plane's window is mostly
+        # chain-verified rows plus a thin blocked residue, and the
+        # residual finish (doubling + closure scatters) is the dispatch's
+        # dominant cost when sized to the window; overflow falls back to
+        # exact full-window doubling in-dispatch.  No structure entry:
+        # the plane reports aggregate counters, not exact CHAIN_SIZE
+        # (the host-column twin keeps the exact-structure path)
+        residual_size = _pow2_at_least(max(64, cap // 16))
+        res = resolve_functional_keyed(
+            pk, col, src, seq,
+            residual_size=min(residual_size, cap),
+            return_structure=False,
+        )
+
+        def _kept():
+            # per-vertex resolved from the order permutation (resolved
+            # rows sort first): position-in-order < n_resolved
+            pos = zeros_i.at[res.order].set(idx)
+            return res.order, pos < res.n_resolved
+
+        if residual_size >= cap:
+            order, resolved_v = _kept()
+        else:
+
+            def _overflowed():
+                # residual overflow: rerun via exact full-window doubling
+                # (the resolve_keyed_auto fallback, in-dispatch)
+                full = resolve_functional(col, src, seq)
+                return full.order, full.resolved
+
+            order, resolved_v = jax.lax.cond(res.overflow, _overflowed, _kept)
+        stuck = jnp.zeros((cap,), bool)  # functional cycles resolve exactly
+        leader = zeros_i
+    elif mode == "general":
+        res = resolve_general(dmat, src, seq)
+        order, resolved_v = res.order, res.resolved
+        stuck = res.stuck & pending
+        leader = res.leader
+    else:
+        assert mode == "general_resident", mode
+        res = resolve_general_resident(dmat, src, seq)
+        order, resolved_v = res.order, res.resolved
+        stuck = res.stuck & pending
+        leader = res.leader
+
+    newly = resolved_v & pending
+    executed = executed | newly
+    return GraphPlaneStep(
+        deps, key, src, seq, occ, executed, order, newly, stuck, leader
+    )
+
+
 def _resolve_general_iterative(deps, dot_src, dot_seq, max_iters):
     """The exact fallback: mutual-edge SCC collapse + affine-max doubling
     (see resolve_general).  Returns the GeneralResolution fields."""

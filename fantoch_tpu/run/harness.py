@@ -321,8 +321,24 @@ def run_overload_phase(
             if row["capacity"] and row["depth_hwm"] > 2 * row["capacity"]:
                 violations.append((name, row["depth_hwm"], row["capacity"]))
     total = len(latencies)
+    # device-plane counters folded across the cluster (None entries are
+    # plane-off runtimes): the serving rows assert the plane actually
+    # carried the run (dispatches > 0) instead of silently measuring the
+    # host path
+    from fantoch_tpu.observability.device import merge_counters
+
+    device_counters: dict = {}
+    for runtime in runtimes.values():
+        per_runtime = runtime._device_counters()
+        if per_runtime:
+            # host-process-global: summing across co-hosted runtimes
+            # would n-fold it (observability/device.py)
+            per_runtime = dict(per_runtime)
+            per_runtime.pop("jax_recompiles", None)
+        merge_counters(device_counters, per_runtime)
     return {
         "completed": total,
+        "device": device_counters,
         "goodput_cmds_per_s": int(total / wall_s) if wall_s > 0 else 0,
         "p50_ms": round(latencies[total // 2] / 1000.0, 2) if total else None,
         "p99_ms": (

@@ -713,6 +713,8 @@ class ProcessRuntime:
         # plane can compile (the hook is process-global and idempotent)
         if (
             self.config.device_table_plane
+            or self.config.device_pred_plane
+            or self.config.device_graph_plane
             or self.config.batched_graph_executor
             or self.config.batched_table_executor
             or self.config.batched_pred_executor

@@ -421,6 +421,35 @@ def test_caesar_set_commit_arrays_flushes_pending():
     assert [r.rifl for r in ex.to_clients_iter()] == [Rifl(9, 1)]
 
 
+def test_run_caesar_localhost_through_pred_plane():
+    """The serving path (ROADMAP item 4's remainder): a 3-process
+    localhost TCP Caesar cluster whose executor path orders through the
+    resident pred plane (process_runner -> PredArraysBuilder column
+    drains -> PredecessorsExecutor -> DevicePredPlane), with
+    cross-replica per-key agreement and the plane counters visible
+    through the runtime's device-counter fold."""
+    from test_run_localhost import run_cluster
+
+    from fantoch_tpu.core.config import Config as _Config
+    from fantoch_tpu.protocol import Caesar
+
+    _slow, runtimes = run_cluster(
+        Caesar,
+        _Config(n=3, f=1, device_pred_plane=True),
+        keys_per_command=1,
+        return_runtimes=True,
+    )
+    for runtime in runtimes.values():
+        counters = runtime._device_counters()
+        assert counters["pred_plane_dispatches"] > 0
+        assert (
+            counters["pred_plane_resident_uploads"]
+            <= 1
+            + counters["pred_plane_compactions"]
+            + counters["pred_plane_grows"]
+        )
+
+
 # ---------------------------------------------------------------------------
 # both-planes-on-one-base (the DevicePlane extraction)
 # ---------------------------------------------------------------------------
