@@ -1,7 +1,7 @@
 # Test/bench entry points (the reference pins quality with Makefile:3-7 —
 # fmt + clippy + `cargo test` under a quickcheck budget; here the suite +
 # dryrun + bench are the equivalent gates).
-.PHONY: test test-fast test-chaos test-recovery test-restart test-overload test-fuzz test-device-stripped dryrun bench bench-smoke trace-smoke overload-smoke fuzz-smoke telemetry-smoke
+.PHONY: test test-fast test-chaos test-recovery test-restart test-overload test-fuzz test-device-stripped dryrun bench bench-smoke trace-smoke critpath-smoke overload-smoke fuzz-smoke telemetry-smoke
 
 test:
 	python -m pytest tests/ -x -q
@@ -72,6 +72,16 @@ bench-smoke:
 # per-push CI slice runs this next to bench-smoke
 trace-smoke:
 	python scripts/trace_smoke.py
+
+# critical-path gate: localhost 3-process EPaxos with tracing — >= 99%
+# of sampled spans stitch across processes, every attribution vector
+# telescopes exactly to reply-submit, a SlowProcess nemesis is named
+# the dominant quorum-wait contributor, and a forced
+# StalledExecutionError dumps flight-recorder black boxes from every
+# live process that the same correlator stitches — the per-push CI
+# slice runs this next to trace-smoke
+critpath-smoke:
+	python scripts/critpath_smoke.py
 
 # overload gate: tiny CPU open-loop burst at ~2x saturation against a
 # tight admission limit — bounded queue depths, typed sheds reaching

@@ -150,6 +150,7 @@ def child_main(mode: str) -> None:
     enable_compile_cache(jax)
 
     from fantoch_tpu.observability.device import (
+        compile_ms,
         recompile_count,
         subscribe_recompiles,
     )
@@ -235,8 +236,11 @@ def child_main(mode: str) -> None:
         "residual_size": residual,
         # XLA backend compiles observed during the resolve warmup+timing
         # (observability plane): >0 with a warm persistent cache means a
-        # shape/program change paid compile time inside this row
+        # shape/program change paid compile time inside this row — and
+        # the cumulative wall names what the count hides (one cold
+        # resolve_graph_plane_step program costs ~50s on a 1-core host)
         "graph_resolve_recompiles": recompile_count(),
+        "jax_compile_ms": compile_ms(),
     }
     # print the primary measurement NOW: if a secondary measurement hangs
     # past the parent's timeout, the parent still recovers this line from
@@ -1864,6 +1868,11 @@ def _regress_direction(key: str):
     """"higher" = throughput-like (must not fall), "lower" =
     latency-like (must not grow), None = not a perf key (counts,
     fractions, configuration — informational only)."""
+    if key == "jax_compile_ms":
+        # cumulative XLA compile wall is a CACHE-STATE observation (cold
+        # vs warm .jax_cache), not a perf key: ratioing a cold run
+        # against a warm base would fabricate regressions
+        return None
     if "cmds_per_s" in key or "goodput" in key:
         return "higher"
     if key.endswith(("_ms", "_p50", "_p95", "_p99")) or "_ms_" in key:
@@ -2032,6 +2041,7 @@ def smoke_main() -> None:
     force_cpu_platform()
     enable_compile_cache()
     from fantoch_tpu.observability.device import (
+        compile_ms,
         recompile_count,
         subscribe_recompiles,
     )
@@ -2048,6 +2058,7 @@ def smoke_main() -> None:
         )
     )
     out["jax_recompiles"] = recompile_count()
+    out["jax_compile_ms"] = compile_ms()
     assert out["table_cmds_per_s_arrays"] > 1_000, out
     assert out["table_cmds_per_s_plane"] > 500, out
     assert out["serving_newt_cmds_per_s"] > 100, out

@@ -70,6 +70,13 @@ def build_parser() -> argparse.ArgumentParser:
                         metavar="MS", help="telemetry window cadence "
                         "(default 1000)")
     parser.add_argument("--status-frequency", type=int, default=None)
+    parser.add_argument("--trace", type=float, default=0.0, metavar="RATE",
+                        help="client-plane lifecycle tracing sample rate "
+                        "(needs --trace-file): submit/reply span events "
+                        "that `bin/obs.py critpath` stitches against the "
+                        "servers' logs")
+    parser.add_argument("--trace-file", default=None, metavar="PATH",
+                        help="client-plane span log (JSONL)")
     parser.add_argument("--log-file", default=None)
     return parser
 
@@ -105,19 +112,33 @@ async def drive(args: argparse.Namespace) -> None:
 
     import time
 
+    # client-plane lifecycle tracing: the submit/reply span events the
+    # critical-path correlator stitches against the servers' logs
+    tracer = None
+    if args.trace_file is not None and args.trace > 0:
+        from fantoch_tpu.core.timing import RunTime
+        from fantoch_tpu.observability.tracer import Tracer
+
+        tracer = Tracer(RunTime(), args.trace_file, args.trace, clock="wall")
+
     t0 = time.perf_counter()
-    clients = await run_clients(
-        client_ids,
-        shard_addresses,
-        workload,
-        open_loop_interval_ms=args.interval,
-        arrival_rate_per_s=args.arrival_rate,
-        arrival_seed=args.arrival_seed,
-        deadline_ms=args.deadline,
-        status_frequency=args.status_frequency,
-        telemetry_file=args.telemetry_file,
-        telemetry_interval_ms=args.telemetry_interval,
-    )
+    try:
+        clients = await run_clients(
+            client_ids,
+            shard_addresses,
+            workload,
+            open_loop_interval_ms=args.interval,
+            arrival_rate_per_s=args.arrival_rate,
+            arrival_seed=args.arrival_seed,
+            deadline_ms=args.deadline,
+            status_frequency=args.status_frequency,
+            telemetry_file=args.telemetry_file,
+            telemetry_interval_ms=args.telemetry_interval,
+            **({"tracer": tracer} if tracer is not None else {}),
+        )
+    finally:
+        if tracer is not None:
+            tracer.close()
     elapsed_s = time.perf_counter() - t0
 
     latencies = []  # ClientData latencies are microseconds (data.py)

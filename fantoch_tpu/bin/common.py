@@ -156,6 +156,19 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
                         "errors resolve dots and the auditor can check "
                         "commit-value agreement (audit/test only: the "
                         "log grows with the run)")
+    parser.add_argument("--trace", type=float, default=0.0, metavar="RATE",
+                        dest="trace_sample_rate",
+                        help="per-dot lifecycle tracing sample rate "
+                        "(0.0-1.0; Config.trace_sample_rate).  Servers "
+                        "also need --trace-file; 1.0 stitches every span "
+                        "for `bin/obs.py critpath`")
+    parser.add_argument("--flight-recorder", action="store_true",
+                        help="failure flight recorder "
+                        "(observability/recorder.py): bounded in-memory "
+                        "ring of recent UNSAMPLED trace events, dumped as "
+                        "flight_p<pid>.json on typed failures, WAL-restart "
+                        "boots, and SIGUSR1 (capacity: "
+                        "FANTOCH_FLIGHT_EVENTS)")
 
 
 def config_from_args(args: argparse.Namespace):
@@ -190,6 +203,8 @@ def config_from_args(args: argparse.Namespace):
         execution_digests=args.execution_digests,
         audit_log_commits=args.audit_commits,
         telemetry_interval_ms=args.telemetry_interval,
+        trace_sample_rate=args.trace_sample_rate,
+        flight_recorder=args.flight_recorder,
     )
 
 

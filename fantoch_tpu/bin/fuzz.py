@@ -69,13 +69,23 @@ def cmd_run(args) -> int:
                 f"f={case.f}): {result.violations[:1]}"
             )
             shrunk, runs = shrink_case(case)
-            shrunk_result = run_case(shrunk)
+            os.makedirs(args.out_dir, exist_ok=True)
+            # the shrunk finding's confirmation run records its own
+            # black box: flight-recorder dumps next to the artifact,
+            # attached via the artifact's "flight" field
+            shrunk_result = run_case(
+                shrunk,
+                flight_dir=os.path.join(
+                    args.out_dir, f"fuzz-{index:06d}-flight"
+                ),
+            )
             artifact = repro_artifact(shrunk_result, shrink_runs=runs)
             path = os.path.join(args.out_dir, f"fuzz-{index:06d}.json")
-            os.makedirs(args.out_dir, exist_ok=True)
             write_repro(path, artifact)
             findings.append(path)
             print(f"  shrunk in {runs} runs -> {path}")
+            for flight_path in shrunk_result.flight:
+                print(f"  flight recorder -> {flight_path}")
         index += 1
     elapsed = time.monotonic() - started
     print(

@@ -39,10 +39,19 @@ from typing import Any, Dict, List
 
 
 def _load(paths: List[str]) -> List[Dict[str, Any]]:
+    """Load span logs (JSONL) and/or flight-recorder dumps (.json black
+    boxes) into one event stream — the correlator consumes both alike."""
+    from fantoch_tpu.observability.recorder import flight_events
     from fantoch_tpu.observability.tracer import read_trace
 
     events: List[Dict[str, Any]] = []
     for path in paths:
+        if path.endswith(".json"):
+            try:
+                events.extend(flight_events([path]))
+                continue
+            except (AssertionError, ValueError, KeyError):
+                pass  # not a flight dump: fall through to JSONL reading
         events.extend(read_trace(path))
     return events
 
@@ -86,8 +95,23 @@ def cmd_summarize(args) -> int:
         print(f"counter {name} = {value}")
     _print_overlap(counters)
     _print_planes(counters)
+    _print_compile(counters)
     _print_overload(counters)
     _print_audit(counters)
+    return 0
+
+
+def _print_compile(counters) -> int:
+    """One-line XLA compile readout: how many backend compiles the run
+    paid and their cumulative wall (observability/device.py) — a ~50s
+    cold compile starving heartbeats is invisible in a count of 1."""
+    if "jax_recompiles" not in counters and "jax_compile_ms" not in counters:
+        return 0
+    ms = counters.get("jax_compile_ms", 0.0)
+    print(
+        f"compile: {int(counters.get('jax_recompiles', 0))} XLA backend "
+        f"compile(s), {ms / 1000:.1f}s cumulative wall"
+    )
     return 0
 
 
@@ -331,6 +355,85 @@ def cmd_watch(args) -> int:
         time.sleep(args.interval)
 
 
+def cmd_critpath(args) -> int:
+    """Cross-process critical-path attribution: stitch spans causally
+    over the message edges, resolve clock offsets, and print the p99
+    blame — which stage, which peer, which dependency."""
+    from fantoch_tpu.observability.critpath import critpath_report
+
+    report = critpath_report(
+        _load(args.trace), percentile=args.percentile,
+        exemplars=args.exemplars,
+    )
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+        return 0
+    print(
+        f"spans: {report['spans']}  stitched: {report['stitched']} "
+        f"({report['stitch_rate'] * 100:.1f}%)  clock: {report['clock']}"
+    )
+    if report["telescoping_violations"]:
+        print(f"TELESCOPING VIOLATIONS: {report['telescoping_violations']}")
+    p99 = report["p99"]
+    print(
+        f"p99 cohort: {p99['count']} span(s) >= "
+        f"{p99['threshold_us'] / 1000:.2f}ms"
+        + (
+            f"; dominant stage {p99['dominant_stage']}"
+            if p99["dominant_stage"]
+            else ""
+        )
+    )
+    print(f"{'stage':<22}{'all mean':>12}{'p99 mean':>12}")
+    all_means = report["stage_means_us"]
+    for name in sorted(
+        set(all_means) | set(p99["stage_means_us"]),
+        key=lambda n: -p99["stage_means_us"].get(n, 0),
+    ):
+        print(
+            f"{name:<22}"
+            f"{all_means.get(name, 0) / 1000:>11.2f}m"
+            f"{p99['stage_means_us'].get(name, 0) / 1000:>11.2f}m"
+        )
+    for label, table in (
+        ("quorum blame (all)", report["quorum_blame"]),
+        ("quorum blame (p99)", report["p99_quorum_blame"]),
+    ):
+        for pid, row in sorted(
+            table.items(), key=lambda kv: -kv[1]["count"]
+        ):
+            print(
+                f"{label}: p{pid} blocking {row['count']}x  "
+                f"mean wait {row['mean_wait_us'] / 1000:.2f}ms "
+                f"(net {row['mean_net_us'] / 1000:.2f}ms, "
+                f"remote {row['mean_remote_us'] / 1000:.2f}ms)"
+            )
+    for row in report["peers"]:
+        print(
+            f"peer skew: p{row['pid']} -> p{row['peer']} offset "
+            f"{row['offset_us']}us (rtt {row['rtt_us']}us)"
+        )
+    if report["recovered_spans"]:
+        print(f"recovered spans: {report['recovered_spans']}")
+    for vector in report["exemplars"]:
+        stages = "  ".join(
+            f"{name} {us / 1000:.2f}m"
+            for name, us in sorted(
+                vector["stages"].items(), key=lambda kv: -kv[1]
+            )
+        )
+        quorum = vector["blame"].get("quorum")
+        blamed = f" [quorum p{quorum['pid']}]" if quorum else ""
+        print(
+            f"exemplar rifl {vector['rifl'][0]}.{vector['rifl'][1]} "
+            f"total {vector['total_us'] / 1000:.2f}ms{blamed}: {stages}"
+        )
+    device = report.get("device")
+    if device:
+        _print_overlap(device)
+    return 0
+
+
 def cmd_to_perfetto(args) -> int:
     from fantoch_tpu.observability.perfetto import write_perfetto
 
@@ -340,9 +443,29 @@ def cmd_to_perfetto(args) -> int:
 
 
 def cmd_diff(args) -> int:
-    from fantoch_tpu.observability.report import diff_events
+    from fantoch_tpu.observability.report import diff_events, diff_stages
     from fantoch_tpu.observability.tracer import read_trace
 
+    if args.stages:
+        # tolerance diff of assembled stage latencies: the comparison
+        # that works for wall-clock run-layer traces, where byte
+        # identity can never hold
+        verdict = diff_stages(
+            read_trace(args.a), read_trace(args.b),
+            tol_frac=args.tol_frac, tol_abs_us=args.tol_abs_us,
+        )
+        for line in verdict["mismatches"]:
+            print(line)
+        for side, rifls in (("a", verdict["only_a"]), ("b", verdict["only_b"])):
+            if rifls:
+                print(f"spans only in {side}: {rifls[:10]}")
+        if not verdict["mismatches"] and not verdict["only_a"] and not verdict["only_b"]:
+            print(
+                f"stage latencies agree within tolerance "
+                f"({verdict['matched']} matched spans)"
+            )
+            return 0
+        return 1
     mismatches = diff_events(read_trace(args.a), read_trace(args.b))
     for line in mismatches:
         print(line)
@@ -380,6 +503,20 @@ def main(argv=None) -> int:
                    help="render one frame and exit (CI smoke)")
     p.set_defaults(fn=cmd_watch)
 
+    p = sub.add_parser(
+        "critpath",
+        help="cross-process critical-path attribution (p99 blame)",
+    )
+    p.add_argument("trace", nargs="+",
+                   help="JSONL span log(s) and/or flight dump(s)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.add_argument("--percentile", type=float, default=0.99,
+                   help="tail cohort threshold (default 0.99)")
+    p.add_argument("--exemplars", type=int, default=3,
+                   help="worst spans printed with full vectors")
+    p.set_defaults(fn=cmd_critpath)
+
     p = sub.add_parser("to-perfetto", help="convert to trace-event JSON")
     p.add_argument("trace", nargs="+", help="JSONL span log(s)")
     p.add_argument("-o", "--output", required=True, help="output .json path")
@@ -388,6 +525,14 @@ def main(argv=None) -> int:
     p = sub.add_parser("diff", help="structural diff of two span logs")
     p.add_argument("a")
     p.add_argument("b")
+    p.add_argument("--stages", action="store_true",
+                   help="tolerance diff of assembled span stage "
+                   "latencies (works for wall-clock traces from two "
+                   "different runs; the default byte diff never can)")
+    p.add_argument("--tol-frac", type=float, default=0.5,
+                   help="relative tolerance per segment (default 0.5)")
+    p.add_argument("--tol-abs-us", type=int, default=20_000,
+                   help="absolute tolerance per segment (default 20ms)")
     p.set_defaults(fn=cmd_diff)
 
     args = parser.parse_args(argv)

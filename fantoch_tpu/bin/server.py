@@ -118,6 +118,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="silent intervals before a peer is declared lost; raise on "
              "contended machines (testbeds sharing one core) so CPU "
              "starvation does not read as peer death")
+    parser.add_argument("--trace-file", default=None, metavar="PATH",
+                        help="per-dot lifecycle span log (JSONL; needs "
+                        "--trace RATE > 0): message edges + spans that "
+                        "`bin/obs.py critpath` stitches across processes")
+    parser.add_argument("--flight-dir", default=None, metavar="DIR",
+                        help="where --flight-recorder dumps "
+                        "flight_p<pid>.json black boxes (default: next "
+                        "to the trace/telemetry/metrics file)")
     parser.add_argument("--execution-log", default=None)
     parser.add_argument("--wal-dir", default=None, metavar="DIR",
                         help="durable command log + snapshots (run/wal.py): "
@@ -170,9 +178,12 @@ async def serve_device_step(args: argparse.Namespace) -> None:
         mesh=mesh,
         telemetry_file=args.telemetry_file,
         metrics_port=args.metrics_port,
+        trace_file=args.trace_file,
+        flight_dir=args.flight_dir,
     )
     await runtime.start()
     _arm_profile_signal(args)
+    _arm_flight_signal(runtime)
     print(
         f"p{process_id} (device-step, n={config.n}) serving clients on "
         f"{args.ip}:{args.client_port}"
@@ -191,6 +202,16 @@ async def serve_device_step(args: argparse.Namespace) -> None:
         # short serves must still leave a final metrics snapshot
         if runtime.metrics_file is not None or runtime.telemetry is not None:
             runtime._emit_telemetry()
+
+
+def _arm_flight_signal(runtime) -> None:
+    """SIGUSR1 = dump the flight-recorder ring on demand (a black box
+    without killing the run); no-op when the recorder is off."""
+    if getattr(runtime, "flight", None) is None:
+        return
+    from fantoch_tpu.observability.recorder import install_flight_signal
+
+    install_flight_signal(runtime.flight, runtime.flight_dir)
 
 
 def _arm_profile_signal(args: argparse.Namespace) -> None:
@@ -269,9 +290,12 @@ async def serve(args: argparse.Namespace) -> None:
         wal_snapshot_interval_ms=args.wal_snapshot_interval,
         telemetry_file=args.telemetry_file,
         metrics_port=args.metrics_port,
+        trace_file=args.trace_file,
+        flight_dir=args.flight_dir,
     )
     await runtime.start()
     _arm_profile_signal(args)
+    _arm_flight_signal(runtime)
     print(
         f"p{args.id} ({args.protocol}) up on {args.ip}:{args.port}"
         + (

@@ -208,6 +208,15 @@ class Config:
     # (runners install the zero-cost no-op tracer); runners also need a
     # trace destination (sim `trace_path` / run `trace_file`) to emit
     trace_sample_rate: float = 0.0
+    # failure flight recorder (observability/recorder.py): keep a bounded
+    # in-memory ring of recent UNSAMPLED trace events per process, dumped
+    # as flight_p<pid>.json on typed failures (DivergenceError,
+    # StalledExecutionError, quorum loss), WAL-restart boots, and
+    # SIGUSR1 — the black box every failure ships with.  Ring capacity
+    # is FANTOCH_FLIGHT_EVENTS (default 65536 events).  Off by default:
+    # recording costs one dict append per hook-site event (new knob; no
+    # reference counterpart)
+    flight_recorder: bool = False
 
     def __post_init__(self) -> None:
         # reference panics if f > n/2 only in specific protocols; the config

@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Callable, Dict, Optional
 
 _recompiles = 0
+_compile_ms = 0.0
 _subscribed = False
 
 
@@ -37,10 +38,15 @@ def subscribe_recompiles() -> bool:
     except Exception:  # jax absent or too old: counters just stay 0
         return False
 
-    def _on_duration(key: str, _secs: float) -> None:
-        global _recompiles
+    def _on_duration(key: str, secs: float) -> None:
+        global _recompiles, _compile_ms
         if key.endswith("backend_compile_duration"):
             _recompiles += 1
+            # cumulative compile WALL, not just the count: one ~50s cold
+            # compile starves heartbeats/serving for its whole duration
+            # (PR 14's resolve_graph_plane_step programs) — a count of 1
+            # hides that; the milliseconds name it
+            _compile_ms += secs * 1000.0
 
     monitoring.register_event_duration_secs_listener(_on_duration)
     _subscribed = True
@@ -51,6 +57,13 @@ def recompile_count() -> int:
     """XLA backend compiles observed since :func:`subscribe_recompiles`
     (0 when never subscribed)."""
     return _recompiles
+
+
+def compile_ms() -> float:
+    """Cumulative XLA backend-compile wall milliseconds since
+    :func:`subscribe_recompiles` — host-process-global like
+    :func:`recompile_count` (co-hosted runtimes must not sum it)."""
+    return round(_compile_ms, 1)
 
 
 # fold semantics per counter kind: most keys are monotone tallies and

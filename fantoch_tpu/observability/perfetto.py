@@ -6,6 +6,9 @@ Emits the (legacy, universally-supported) Trace Event Format that both
 - one complete (``ph: "X"``) event per span *segment* on the
   coordinating process's track, ``tid`` = issuing client, args carrying
   the rifl/dot and any stage meta (path decision, batch id);
+- flow (``ph: "s"`` / ``"f"``) event pairs per matched message edge —
+  the arrows between process tracks that show WHERE a span's wait
+  crossed the network (the critpath stitching, rendered);
 - counter (``ph: "C"``) events for the device-plane tallies, one track
   per counter name;
 - metadata (``ph: "M"``) events naming process tracks.
@@ -60,6 +63,44 @@ def to_perfetto(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                     "args": args,
                 }
             )
+    # flow arrows between process tracks: one s/f pair per matched
+    # message edge (the critpath stitching, rendered).  Flows bind to
+    # the rifl's track when the dot resolves to a known span, so the
+    # arrow lands on the same row as the span's segments
+    from fantoch_tpu.observability.critpath import match_edges
+
+    rifl_of_dot = {
+        tuple(span["dot"]): span["rifl"]
+        for span in spans.values()
+        if span["dot"] is not None
+    }
+    dot_edges, _client_edges = match_edges(events)
+    for dot, hops in sorted(dot_edges.items()):
+        tid = rifl_of_dot.get(dot, (0,))[0]
+        for hop in hops:
+            if hop["ts"] is None or hop["tr"] is None:
+                continue  # half-observed hop (drop, or unsampled side)
+            if hop["tr"] < hop["ts"]:
+                # raw timestamps only here: a cross-machine skew larger
+                # than the flight would draw a backwards arrow — skip
+                # (the critpath correlator, not the viewer, owns offsets)
+                continue
+            # dst is part of the id: run-layer broadcasts share ONE seq
+            # across the fan-out (dst disambiguates on the wire too)
+            flow_id = (
+                f"{dot[0]}.{dot[1]}:{hop['src']}.{hop['seq']}>{hop['dst']}"
+            )
+            pids.update((hop["src"], hop["dst"]))
+            trace.append({
+                "name": hop["mt"], "cat": "edge", "ph": "s",
+                "id": flow_id, "ts": hop["ts"], "pid": hop["src"],
+                "tid": tid,
+            })
+            trace.append({
+                "name": hop["mt"], "cat": "edge", "ph": "f", "bp": "e",
+                "id": flow_id, "ts": hop["tr"], "pid": hop["dst"],
+                "tid": tid,
+            })
     for ev in events:
         if ev.get("k") != "ctr":
             continue
@@ -107,9 +148,21 @@ def validate_perfetto(obj: Dict[str, Any]) -> None:
     """Assert the minimal trace-event invariants the viewers rely on
     (used by tests and the trace-smoke gate)."""
     assert isinstance(obj.get("traceEvents"), list), "traceEvents missing"
+    flows: dict = {}
     for ev in obj["traceEvents"]:
         assert "ph" in ev and "pid" in ev, ev
         if ev["ph"] == "X":
             assert "ts" in ev and "dur" in ev and ev["dur"] >= 0, ev
         elif ev["ph"] == "C":
             assert "ts" in ev and "value" in ev["args"], ev
+        elif ev["ph"] in ("s", "f"):
+            assert "ts" in ev and "id" in ev, ev
+            flows.setdefault(ev["id"], []).append(ev)
+    for flow_id, pair in flows.items():
+        # every flow id must form a start+finish pair whose finish does
+        # not precede its start (the arrow the viewers draw)
+        phases = sorted(ev["ph"] for ev in pair)
+        assert phases == ["f", "s"], (flow_id, phases)
+        start = next(ev for ev in pair if ev["ph"] == "s")
+        finish = next(ev for ev in pair if ev["ph"] == "f")
+        assert finish["ts"] >= start["ts"], (flow_id, start, finish)

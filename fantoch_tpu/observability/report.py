@@ -199,6 +199,57 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
+def diff_stages(
+    a: List[Dict[str, Any]],
+    b: List[Dict[str, Any]],
+    tol_frac: float = 0.5,
+    tol_abs_us: int = 20_000,
+    limit: int = 10,
+) -> Dict[str, Any]:
+    """Tolerance diff of assembled span *stage latencies* between two
+    traces — the comparison that works for run-layer (wall-clock) logs,
+    where byte identity can never hold.  Spans match by rifl (same
+    workload/seed => same rifls); each matched span's per-segment
+    durations must agree within ``tol_abs_us + tol_frac * max(a, b)``.
+    Returns ``{"matched", "only_a", "only_b", "mismatches": [lines]}``
+    — empty mismatch/only lists mean the two runs have the same latency
+    *structure* within tolerance."""
+    spans_a = assemble_spans(a)
+    spans_b = assemble_spans(b)
+    only_a = sorted(set(spans_a) - set(spans_b))
+    only_b = sorted(set(spans_b) - set(spans_a))
+    mismatches: List[str] = []
+    matched = 0
+    for rifl in sorted(set(spans_a) & set(spans_b)):
+        matched += 1
+        seg_a = {n: tb - ta for n, ta, tb in span_segments(spans_a[rifl])}
+        seg_b = {n: tb - ta for n, ta, tb in span_segments(spans_b[rifl])}
+        for name in sorted(set(seg_a) | set(seg_b)):
+            if len(mismatches) >= limit:
+                mismatches.append("... (diff truncated)")
+                return {
+                    "matched": matched, "only_a": only_a, "only_b": only_b,
+                    "mismatches": mismatches,
+                }
+            da, db = seg_a.get(name), seg_b.get(name)
+            if da is None or db is None:
+                mismatches.append(
+                    f"span {rifl}: segment {name} present in only one trace "
+                    f"({da} vs {db})"
+                )
+                continue
+            tol = tol_abs_us + tol_frac * max(da, db)
+            if abs(da - db) > tol:
+                mismatches.append(
+                    f"span {rifl}: {name} {da}us vs {db}us "
+                    f"(delta {abs(da - db)}us > tol {tol:.0f}us)"
+                )
+    return {
+        "matched": matched, "only_a": only_a, "only_b": only_b,
+        "mismatches": mismatches,
+    }
+
+
 def diff_events(
     a: List[Dict[str, Any]], b: List[Dict[str, Any]], limit: int = 10
 ) -> List[str]:

@@ -26,6 +26,26 @@ recovery consensus.  *Counter events* (``k == "ctr"``) carry device-plane
 tallies (dispatch counts, batch occupancy, recompiles, kernel wall-ms)
 attached to the trace timeline.
 
+Beyond spans and counters the schema carries three more event kinds,
+added for cross-process critical-path attribution
+(:mod:`fantoch_tpu.observability.critpath`):
+
+- ``k == "hdr"``: one header line per log naming the clock domain —
+  ``"virtual"`` (sim: one shared clock, no skew) or ``"wall"`` (run
+  layer: every process stamps its own wall clock, so the correlator
+  must resolve per-peer offsets before cross-process math);
+- ``k == "edge"``: one *message-edge* event per side of a cross-process
+  hop (``io == "s"`` at the sender, ``"r"`` at the receiver), paired by
+  ``(src, seq)`` — a per-sender monotone sequence carried on the wire —
+  so a send stitches to its delivery causally, Dapper-style.  Edges are
+  sampled by the same deterministic hash as spans (by rifl for
+  client<->server hops, by dot for peer protocol messages), so a
+  sampled span's edges are present whenever its dot/rifl hashes in;
+- ``k == "off"``: a clock-offset estimate for one peer pair
+  (``off`` = peer clock minus local clock in us, ``rtt`` the probe
+  round-trip that bounds its error), emitted by the run layer whenever
+  a heartbeat RTT sample improves the estimate (run/links.py).
+
 Sampling is a deterministic hash of the span id (:func:`span_hash` over
 ``(rifl.source, rifl.sequence)``) against ``Config.trace_sample_rate``:
 the same seed yields the same sampled dot set, with no RNG state touched
@@ -71,6 +91,69 @@ def span_hash(source: int, sequence: int) -> int:
     return x & (_SAMPLE_SPACE - 1)
 
 
+# --- canonical event builders ---
+#
+# ONE place constructs each event kind: the live Tracer serializes
+# these to JSONL, and the flight recorder (observability/recorder.py)
+# rings the same dicts unsampled — so the correlator can never see two
+# schemas drift apart.
+
+
+def span_event(t_us, stage, rifl, dot=None, pid=None, cid=None, meta=None):
+    ev: Dict[str, Any] = {
+        "k": "span", "stage": stage, "rifl": [rifl[0], rifl[1]], "t": t_us,
+    }
+    if dot is not None:
+        ev["dot"] = [dot[0], dot[1]]
+    if pid is not None:
+        ev["pid"] = pid
+    if cid is not None:
+        ev["cid"] = cid
+    if meta:
+        ev["m"] = meta
+    return ev
+
+
+def counter_event(t_us, name, value, pid=None, meta=None):
+    ev: Dict[str, Any] = {"k": "ctr", "name": name, "v": value, "t": t_us}
+    if pid is not None:
+        ev["pid"] = pid
+    if meta:
+        ev["m"] = meta
+    return ev
+
+
+def edge_event(t_us, io, mtype, src, dst, seq, dot=None, rifl=None):
+    ev: Dict[str, Any] = {
+        "k": "edge", "io": io, "mt": mtype, "src": src, "dst": dst,
+        "seq": seq, "t": t_us,
+    }
+    if dot is not None:
+        ev["dot"] = [dot[0], dot[1]]
+    if rifl is not None:
+        ev["rifl"] = [rifl[0], rifl[1]]
+    return ev
+
+
+def offset_event(t_us, pid, peer, offset_us, rtt_us):
+    return {
+        "k": "off", "pid": pid, "peer": peer, "off": offset_us,
+        "rtt": rtt_us, "t": t_us,
+    }
+
+
+def edge_dot(msg: Any):
+    """The dot a protocol message's trace edges key on: a single
+    ``.dot`` field (MCollect/MCollectAck/MCommit/... across the
+    leaderless protocols).  Batched array messages and slot-keyed
+    (leader-based) frames carry no single dot — their spans stitch via
+    the client edges alone."""
+    dot = getattr(msg, "dot", None)
+    if isinstance(dot, tuple) and len(dot) == 2:
+        return dot
+    return None
+
+
 def _noop() -> "_NoopTracer":
     return NOOP_TRACER
 
@@ -91,6 +174,12 @@ class _NoopTracer:
         pass
 
     def counter(self, name, value, pid=None, meta=None) -> None:
+        pass
+
+    def edge(self, io, mtype, src, dst, seq, dot=None, rifl=None) -> None:
+        pass
+
+    def offset(self, pid, peer, offset_us, rtt_us) -> None:
         pass
 
     def flush(self) -> None:
@@ -119,15 +208,21 @@ class Tracer:
     enabled = True
 
     def __init__(self, time, path: str, sample_rate: float = 1.0,
-                 flush_every: int = 512):
+                 flush_every: int = 512, clock: str = "virtual"):
+        assert clock in ("virtual", "wall"), clock
         self._time = time
         self.path = path
+        self.clock = clock
         self.sample_rate = max(0.0, min(1.0, float(sample_rate)))
         self._threshold = int(self.sample_rate * _SAMPLE_SPACE)
         self._fh = open(path, "w", buffering=1 << 16)
         self._flush_every = flush_every
         self._pending = 0
         self._closed = False
+        # one header line names the clock domain: "wall" logs need the
+        # correlator's offset resolution before cross-process math,
+        # "virtual" logs share one clock by construction
+        self._write({"k": "hdr", "clock": clock, "v": 1})
 
     # --- sampling ---
 
@@ -149,21 +244,12 @@ class Tracer:
     ) -> None:
         if span_hash(rifl[0], rifl[1]) >= self._threshold:
             return
-        ev: Dict[str, Any] = {
-            "k": "span",
-            "stage": stage,
-            "rifl": [rifl[0], rifl[1]],
-            "t": self._time.micros(),
-        }
-        if dot is not None:
-            ev["dot"] = [dot[0], dot[1]]
-        if pid is not None:
-            ev["pid"] = pid
-        if cid is not None:
-            ev["cid"] = cid
-        if meta:
-            ev["m"] = meta
-        self._write(ev)
+        self._write(
+            span_event(
+                self._time.micros(), stage, rifl,
+                dot=dot, pid=pid, cid=cid, meta=meta,
+            )
+        )
 
     def counter(
         self,
@@ -172,17 +258,42 @@ class Tracer:
         pid: Optional[int] = None,
         meta: Optional[Dict[str, Any]] = None,
     ) -> None:
-        ev: Dict[str, Any] = {
-            "k": "ctr",
-            "name": name,
-            "v": value,
-            "t": self._time.micros(),
-        }
-        if pid is not None:
-            ev["pid"] = pid
-        if meta:
-            ev["m"] = meta
-        self._write(ev)
+        self._write(
+            counter_event(self._time.micros(), name, value, pid=pid, meta=meta)
+        )
+
+    def edge(
+        self,
+        io: str,
+        mtype: str,
+        src: int,
+        dst: int,
+        seq: int,
+        dot=None,
+        rifl=None,
+    ) -> None:
+        """One side of a cross-process message hop (``io`` = ``"s"`` at
+        the sender, ``"r"`` at the receiver), paired by ``(src, seq)``.
+        Sampled by the rifl when given (client<->server hops), else by
+        the dot (peer protocol messages) — both through the same hash,
+        so a rate-1.0 trace stitches every span."""
+        key = rifl if rifl is not None else dot
+        if key is None or span_hash(key[0], key[1]) >= self._threshold:
+            return
+        self._write(
+            edge_event(
+                self._time.micros(), io, mtype, src, dst, seq,
+                dot=dot, rifl=rifl,
+            )
+        )
+
+    def offset(self, pid: int, peer: int, offset_us: int, rtt_us: int) -> None:
+        """A per-peer clock-offset estimate (peer clock minus ``pid``'s,
+        microseconds) with the probe RTT that bounds its error — emitted
+        whenever a better (lower-RTT) heartbeat sample lands."""
+        self._write(
+            offset_event(self._time.micros(), pid, peer, offset_us, rtt_us)
+        )
 
     def _write(self, ev: Dict[str, Any]) -> None:
         if self._closed:

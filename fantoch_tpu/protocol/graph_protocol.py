@@ -547,10 +547,22 @@ class GraphProtocol(PartialCommitMixin, RecoveryMixin, SyncMixin, CommitGCMixin,
                 ),
             )
         if info.cmd is not None:
-            self.bp.trace_span(
-                "commit", info.cmd.rifl, dot=dot,
-                meta={"noop": True} if value.is_noop else None,
-            )
+            meta = {"noop": True} if value.is_noop else None
+            if (
+                not value.is_noop
+                and self.bp.tracer.enabled
+                and self.bp.tracer.sample(info.cmd.rifl)
+            ):
+                # stamp the agreed dep set (capped) so the critical-path
+                # walk can name WHICH dot the executor then waited on
+                # (observability/critpath.py dep-wait blame); meta built
+                # only for sampled spans — it costs a sort per commit
+                deps = sorted(dep.dot for dep in value.deps)
+                if deps:
+                    meta = {"deps": [[d[0], d[1]] for d in deps[:16]]}
+                    if len(deps) > 16:
+                        meta["deps_total"] = len(deps)
+            self.bp.trace_span("commit", info.cmd.rifl, dot=dot, meta=meta)
         out = info.synod.handle(from_, MChosen(value))
         assert out is None
         self._recovery_untrack(dot)

@@ -1306,6 +1306,15 @@ class _DeviceClientSession:
         sid = shards[result.key]
         done = self.pending_by_shard[sid].add_executor_result(result)
         if done is not None:
+            tracer = self.runtime.tracer
+            if tracer.enabled:
+                tracer.span(
+                    "executed", done.rifl, pid=self.runtime.process_id
+                )
+                tracer.edge(
+                    "s", "Reply", self.runtime.process_id, 0, 0,
+                    rifl=done.rifl,
+                )
             self.rw.write(ToClient(done))
             self._flush_needed.set()
             self._shards_left[result.rifl] -= 1
@@ -1423,6 +1432,14 @@ class _DeviceClientSession:
                     if not isinstance(msg, Submit):
                         raise ProtocolError(f"unexpected message {msg!r}")
                     cmd = msg.cmd
+                    tracer = self.runtime.tracer
+                    if tracer.enabled:
+                        # ingress edge: client->server network vs queue
+                        # split in the critpath report
+                        tracer.edge(
+                            "r", "Submit", 0, self.runtime.process_id, 0,
+                            rifl=cmd.rifl,
+                        )
                     why = self._validate(cmd)
                     if why is not None:
                         self._reject(cmd, why)
@@ -1437,6 +1454,11 @@ class _DeviceClientSession:
                     self.track(cmd)
                     self.runtime.rifl_sessions[cmd.rifl] = self
                     dot = self.runtime.dot_gen.next_id()
+                    if tracer.enabled:
+                        tracer.span(
+                            "payload", cmd.rifl, dot=dot,
+                            pid=self.runtime.process_id,
+                        )
                     self.runtime.submit(dot, cmd)
             finally:
                 flusher.cancel()
@@ -1479,6 +1501,8 @@ class DeviceRuntime:
         mesh=None,
         telemetry_file: Optional[str] = None,
         metrics_port: Optional[int] = None,
+        trace_file: Optional[str] = None,
+        flight_dir: Optional[str] = None,
     ):
         from fantoch_tpu.core.ids import AtomicIdGen
 
@@ -1578,15 +1602,44 @@ class DeviceRuntime:
             if config.telemetry_interval_ms is not None
             else metrics_interval_ms
         )
+        from fantoch_tpu.core.timing import RunTime
+
+        self.time = RunTime()
         self.telemetry = None
         if telemetry_file is not None:
-            from fantoch_tpu.core.timing import RunTime
             from fantoch_tpu.observability.timeseries import SeriesWriter
 
             self.telemetry = SeriesWriter(
-                telemetry_file, RunTime(),
+                telemetry_file, self.time,
                 window_ms=self.telemetry_interval_ms,
             )
+        # lifecycle tracing at the serving edge: client-hop edges plus
+        # payload/executed spans per command (the device rounds stay
+        # batch-attributed through the per-dispatch counters), so
+        # `bin/obs.py critpath` stitches device serving traces too
+        from fantoch_tpu.observability.tracer import NOOP_TRACER, Tracer
+
+        self.tracer = NOOP_TRACER
+        if trace_file is not None and config.trace_sample_rate > 0:
+            self.tracer = Tracer(
+                self.time, trace_file, config.trace_sample_rate, clock="wall"
+            )
+        # failure flight recorder (observability/recorder.py): black box
+        # dumped on fatal driver failures
+        self.flight = None
+        self.flight_dir = flight_dir
+        if config.flight_recorder:
+            from fantoch_tpu.observability.exposition import profile_output_dir
+            from fantoch_tpu.observability.recorder import FlightRecorder
+
+            if self.flight_dir is None:
+                self.flight_dir = profile_output_dir(
+                    trace_file, telemetry_file, metrics_file
+                )
+            self.flight = FlightRecorder(
+                self.time, pid=process_id, inner=self.tracer
+            )
+            self.tracer = self.flight
         self.metrics_port = metrics_port
         self.metrics_server = None
         # serving-edge throughput tallies (the submit/reply rate series)
@@ -1635,6 +1688,14 @@ class DeviceRuntime:
             if self.failure is None:
                 self.failure = exc
                 self.failed.set()
+                if self.flight is not None:
+                    try:
+                        self.flight.dump(
+                            f"{self.flight_dir}/flight_p{self.process_id}.json",
+                            f"{type(exc).__name__}: {exc}",
+                        )
+                    except OSError as dump_exc:
+                        logger.error("flight dump failed: %r", dump_exc)
             self._teardown()
 
     def _on_session_done(self, task: asyncio.Task) -> None:
@@ -1682,7 +1743,7 @@ class DeviceRuntime:
         concurrently with driver.step, which runs to completion on the
         pool thread before the loop resumes): the snapshot task reads this
         consistent copy, not live counters mid-mutation."""
-        from fantoch_tpu.observability.device import recompile_count
+        from fantoch_tpu.observability.device import compile_ms, recompile_count
 
         d = self.driver
         self._tallies = {
@@ -1703,6 +1764,7 @@ class DeviceRuntime:
             # per-dispatch device counters (observability/device.py)
             **d.device_counters(),
             "jax_recompiles": recompile_count(),
+            "jax_compile_ms": compile_ms(),
         }
 
     def _write_metrics_snapshot(self) -> None:
@@ -1745,6 +1807,7 @@ class DeviceRuntime:
         while True:
             await asyncio.sleep(self.telemetry_interval_ms / 1000)
             self._emit_telemetry()
+            self.tracer.flush()
 
     async def stop(self) -> None:
         if self.metrics_server is not None:
@@ -1756,6 +1819,7 @@ class DeviceRuntime:
             self._emit_telemetry()
         if self.telemetry is not None:
             self.telemetry.close()
+        self.tracer.close()
 
     # --- client plane ---
 

@@ -88,7 +88,7 @@ async def run_localhost_cluster(
 
         client_tracer = Tracer(
             RunTime(), f"{observe_dir}/trace_clients.jsonl",
-            config.trace_sample_rate,
+            config.trace_sample_rate, clock="wall",
         )
     shard_count = config.shard_count
     shard_ids = {s: list(process_ids(s, config.n)) for s in range(shard_count)}
@@ -148,6 +148,10 @@ async def run_localhost_cluster(
                 f"{observe_dir}/telemetry_p{pid}.jsonl" if observe_dir else None
             ),
             metrics_port=(metrics_ports or {}).get(pid),
+            # flight recorder dumps land next to the traces they stitch
+            # against (Config.flight_recorder resolves its own default
+            # when no observe dir exists)
+            flight_dir=(observe_dir if config.flight_recorder else None),
             **(runtime_kwargs or {}),
         )
 
@@ -332,9 +336,10 @@ def run_overload_phase(
         per_runtime = runtime._device_counters()
         if per_runtime:
             # host-process-global: summing across co-hosted runtimes
-            # would n-fold it (observability/device.py)
+            # would n-fold them (observability/device.py)
             per_runtime = dict(per_runtime)
             per_runtime.pop("jax_recompiles", None)
+            per_runtime.pop("jax_compile_ms", None)
         merge_counters(device_counters, per_runtime)
     return {
         "completed": total,
@@ -375,6 +380,8 @@ async def run_device_server(
     pipeline_depth: Optional[int] = None,
     telemetry_file: Optional[str] = None,
     metrics_port: Optional[int] = None,
+    trace_file: Optional[str] = None,
+    flight_dir: Optional[str] = None,
 ):
     """Boot the TPU serving path (run/device_runner.py) on a localhost
     port and drive real TCP clients against it; returns
@@ -396,6 +403,8 @@ async def run_device_server(
         pipeline_depth=pipeline_depth,
         telemetry_file=telemetry_file,
         metrics_port=metrics_port,
+        trace_file=trace_file,
+        flight_dir=flight_dir,
     )
     await runtime.start()
     client_task = asyncio.ensure_future(

@@ -24,7 +24,7 @@ import asyncio
 import random
 from collections import deque
 from dataclasses import dataclass
-from typing import Any, Deque, List, Optional, Tuple
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from fantoch_tpu.run.backpressure import DEFAULT_UNACKED_CAP
 
@@ -57,6 +57,48 @@ class ReconnectPolicy:
         for _ in range(self.attempts):
             yield delay * (1.0 - self.jitter) + rng.uniform(0, delay * self.jitter)
             delay = min(delay * self.factor, self.cap_s)
+
+
+class ClockOffsetEstimator:
+    """Per-peer wall-clock offset from heartbeat RTT brackets.
+
+    Each heartbeat carries the sender's clock; the reply echoes it plus
+    the replier's clock at reply time.  One bracket gives the classic
+    one-stamp NTP estimate ``off = t_remote - (t_send + t_recv) / 2``
+    (peer clock minus ours, error bounded by rtt/2 plus the peer's
+    turnaround, which rides inside the measured rtt here).  The
+    estimator keeps the LOWEST-RTT sample per peer — the tightest error
+    bound — and reports only improvements, so the tracer logs one
+    ``k == "off"`` event per betterment rather than per heartbeat.
+    The critical-path correlator (observability/critpath.py) consumes
+    these to compare run-layer timestamps across processes; sim virtual
+    time shares one clock and never needs it."""
+
+    __slots__ = ("best",)
+
+    def __init__(self) -> None:
+        # peer -> (rtt_us, offset_us) of the best (lowest-rtt) sample
+        self.best: Dict[int, Tuple[int, int]] = {}
+
+    def sample(
+        self, peer: int, t_send_us: int, t_remote_us: int, t_recv_us: int
+    ) -> Optional[Tuple[int, int]]:
+        """Fold one bracket; returns ``(rtt_us, offset_us)`` when it
+        improves the peer's estimate, else None (including degenerate
+        brackets where the clock stepped backwards mid-probe)."""
+        rtt = t_recv_us - t_send_us
+        if rtt < 0:
+            return None
+        offset = t_remote_us - (t_send_us + t_recv_us) // 2
+        kept = self.best.get(peer)
+        if kept is None or rtt < kept[0]:
+            self.best[peer] = (rtt, offset)
+            return (rtt, offset)
+        return None
+
+    def offset_us(self, peer: int) -> Optional[int]:
+        kept = self.best.get(peer)
+        return kept[1] if kept is not None else None
 
 
 class LinkState:

@@ -161,20 +161,40 @@ class PingReq:
     ``Config.execution_digests`` is on: the receiver verifies every key
     where it is at least as far along — replicas cross-audit each other
     on the heartbeat cadence, and a fork surfaces as a typed
-    DivergenceError instead of silently serving diverged reads."""
+    DivergenceError instead of silently serving diverged reads.
+
+    ``t_send_us`` (the sender's wall clock at send) turns the heartbeat
+    into a clock-offset probe: the reply echoes it plus the replier's
+    own clock, and the sender folds the bracket into its per-peer
+    offset estimate (run/links.ClockOffsetEstimator) — what the
+    critical-path correlator uses to compare timestamps across
+    processes."""
 
     nonce: int
     digest: Optional[Dict[str, Any]] = None
+    t_send_us: Optional[int] = None
 
 
 @dataclass
 class PingReply:
     nonce: int
+    # clock-offset echo: the request's send stamp plus the replier's
+    # clock at reply time (None on pings that did not carry a stamp)
+    req_t_send_us: Optional[int] = None
+    t_reply_us: Optional[int] = None
 
 
 @dataclass
 class POEProtocol:
+    """A protocol message frame.  ``edge`` carries the sender's
+    message-edge sequence number when the dot is trace-sampled
+    (observability/tracer.py ``k == "edge"`` events): the receiver
+    emits the matching recv edge so the critical-path correlator can
+    stitch the hop causally.  None (the overwhelmingly common case)
+    costs nothing on the wire beyond the field."""
+
     msg: Any
+    edge: Optional[int] = None
 
 
 @dataclass

@@ -37,6 +37,7 @@ from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import AtomicIdGen, ClientId, ProcessId, ShardId
 from fantoch_tpu.core.timing import RunTime
 from fantoch_tpu.errors import PeerLostError, QuorumLostError
+from fantoch_tpu.observability.tracer import edge_dot
 from fantoch_tpu.executor.aggregate import AggregatePending
 from fantoch_tpu.executor.base import ExecutorResult
 from fantoch_tpu.protocol.base import Protocol, ToForward, ToSend
@@ -184,6 +185,15 @@ class _ClientSession:
     def _emit(self, cmd_result) -> None:
         if cmd_result is not None:
             self.runtime.replied += 1
+            tracer = self.runtime.tracer
+            if tracer.enabled:
+                # the send half of the coordinator->client hop: with the
+                # client's own `reply` span event this brackets the
+                # return network flight (critpath's reply_net split)
+                tracer.edge(
+                    "s", "Reply", self.runtime.process.id, 0, 0,
+                    rifl=cmd_result.rifl,
+                )
             self.rw.write(ToClient(cmd_result))
             self._flush_needed.set()  # single per-session flusher picks it up
 
@@ -232,6 +242,15 @@ class _ClientSession:
                 assert isinstance(msg, Submit)
                 cmd = msg.cmd
                 self.runtime.submitted += 1
+                tracer = self.runtime.tracer
+                if tracer.enabled:
+                    # ingress edge: the recv half of the client->server
+                    # hop — splits submit->payload into network flight
+                    # vs coordinator ingest queue in the critpath report
+                    tracer.edge(
+                        "r", "Submit", 0, self.runtime.process.id, 0,
+                        rifl=cmd.rifl,
+                    )
                 limit = self.runtime.config.admission_limit
                 if limit is not None:
                     depth = self.runtime.admission_depth()
@@ -303,6 +322,7 @@ class ProcessRuntime:
         wal_snapshot_interval_ms: int = 2000,
         telemetry_file: Optional[str] = None,
         metrics_port: Optional[int] = None,
+        flight_dir: Optional[str] = None,
     ):
         self.protocol_cls = protocol_cls
         self.config = config
@@ -469,7 +489,39 @@ class ProcessRuntime:
 
         self.tracer = NOOP_TRACER
         if trace_file is not None and config.trace_sample_rate > 0:
-            self.tracer = Tracer(self.time, trace_file, config.trace_sample_rate)
+            self.tracer = Tracer(
+                self.time, trace_file, config.trace_sample_rate, clock="wall"
+            )
+        # message-edge sequence for cross-process span stitching: one
+        # monotone counter per sender, carried as POEProtocol.edge so the
+        # receiver's recv event pairs with our send event.  Offset by the
+        # WAL incarnation so a restarted life's seqs never collide with
+        # the previous life's edges still present in PEERS' trace logs
+        # (our own log truncates on reopen; theirs does not)
+        self._edge_seq = self.incarnation << 32
+        # per-peer wall-clock offsets from heartbeat RTT brackets — the
+        # correlator's skew table (run/links.ClockOffsetEstimator)
+        from fantoch_tpu.run.links import ClockOffsetEstimator
+
+        self._clock_offsets = ClockOffsetEstimator()
+        # failure flight recorder (observability/recorder.py): a bounded
+        # ring of UNSAMPLED events teed off the same tracer seam, dumped
+        # as flight_p<pid>.json on fatal failures / WAL-restart boots /
+        # SIGUSR1 — every failure ships its own black box
+        self.flight = None
+        self.flight_dir = flight_dir
+        if config.flight_recorder:
+            from fantoch_tpu.observability.exposition import profile_output_dir
+            from fantoch_tpu.observability.recorder import FlightRecorder
+
+            if self.flight_dir is None:
+                self.flight_dir = profile_output_dir(
+                    trace_file, telemetry_file, metrics_file
+                )
+            self.flight = FlightRecorder(
+                self.time, pid=process_id, inner=self.tracer
+            )
+            self.tracer = self.flight
         self.process.set_tracer(self.tracer)
         for executor in self.executors:
             executor.set_tracer(self.tracer)
@@ -687,11 +739,45 @@ class ProcessRuntime:
             self._fail(exc)
 
     def _fail(self, exc: BaseException) -> None:
-        """Record the first fatal failure and tear the runtime down."""
+        """Record the first fatal failure and tear the runtime down.
+        The flight recorder dumps FIRST — the ring's recent unsampled
+        events are the black box that explains the typed failure
+        (DivergenceError, StalledExecutionError, QuorumLostError, ...)."""
         if self.failure is None:
             self.failure = exc
             self.failed.set()
+            self._dump_flight(f"{type(exc).__name__}: {exc}")
         self._teardown()
+
+    def _dump_flight(self, reason: str, suffix: str = "") -> Optional[str]:
+        """Write the flight ring (no-op without a recorder); dump
+        failures must never mask the failure being recorded."""
+        if self.flight is None:
+            return None
+        path = f"{self.flight_dir}/flight_p{self.process.id}{suffix}.json"
+        try:
+            self.flight.dump(path, reason)
+        except OSError as exc:
+            logger.error("flight dump to %s failed: %r", path, exc)
+            return None
+        logger.warning(
+            "p%s: flight recorder dumped %d event(s) to %s (%s)",
+            self.process.id, len(self.flight.events()), path, reason,
+        )
+        return path
+
+    async def _boot_flight_dump(self) -> None:
+        """WAL-restart boot trigger: give the rejoin exchange one
+        snapshot interval to land in the ring, then dump the new life's
+        replay/rejoin black box (its own file — a later failure dump
+        must not overwrite the boot record)."""
+        await asyncio.sleep(
+            min(1.0, self._wal_snapshot_interval_ms / 1000)
+        )
+        self._dump_flight(
+            f"wal-restart-boot (incarnation {self.incarnation})",
+            suffix="_boot",
+        )
 
     def _teardown(self) -> None:
         self._stopping = True
@@ -830,6 +916,8 @@ class ProcessRuntime:
             # rejoin: now that the mesh is connected, broadcast MSync so
             # live peers stream the commits we missed while down
             self.workers.forward_to(0, ("rejoin", None))
+            if self.flight is not None:
+                self.spawn(self._boot_flight_dump())
         self._connected.set()
 
     async def stop(self) -> None:
@@ -970,7 +1058,18 @@ class ProcessRuntime:
                 # (pings fly during start); wait for it rather than crash
                 while from_ not in self._peer_writers:
                     await asyncio.sleep(0.01)
-                self._peer_writers[from_].put_nowait(serialize(PingReply(msg.nonce)))
+                t_send = getattr(msg, "t_send_us", None)
+                self._peer_writers[from_].put_nowait(
+                    serialize(
+                        PingReply(
+                            msg.nonce,
+                            req_t_send_us=t_send,
+                            t_reply_us=(
+                                self.time.micros() if t_send is not None else None
+                            ),
+                        )
+                    )
+                )
                 digest = getattr(msg, "digest", None)
                 if digest is not None:
                     self._check_peer_digest(from_, digest)
@@ -986,11 +1085,32 @@ class ProcessRuntime:
                 waiter = self._ping_waiters.pop(msg.nonce, None)
                 if waiter is not None and not waiter.done():
                     waiter.set_result(None)
+                # clock-offset bracket: fold the echoed stamps into the
+                # per-peer estimate; an improved (lower-RTT) sample rides
+                # the trace so the correlator sees the best-known skew
+                req_t = getattr(msg, "req_t_send_us", None)
+                if req_t is not None and msg.t_reply_us is not None:
+                    improved = self._clock_offsets.sample(
+                        from_, req_t, msg.t_reply_us, self.time.micros()
+                    )
+                    if improved is not None and self.tracer.enabled:
+                        rtt, off = improved
+                        self.tracer.offset(self.process.id, from_, off, rtt)
             elif isinstance(msg, POEExecutor):
                 position = self._executor_position(msg.info)
                 self.executor_pool.forward_to(position, msg.info)
             else:
                 assert isinstance(msg, POEProtocol)
+                edge_seq = getattr(msg, "edge", None)
+                if edge_seq is not None and self.tracer.enabled:
+                    # the recv half of a stitched message edge: pairs
+                    # with the sender's (src, seq) send event
+                    dot = edge_dot(msg.msg)
+                    if dot is not None:
+                        self.tracer.edge(
+                            "r", type(msg.msg).__name__, from_,
+                            self.process.id, edge_seq, dot=dot,
+                        )
                 index = self.protocol_cls.message_index(msg.msg)
                 self.workers.forward(index, ("msg", from_, from_shard, msg.msg))
             if self.workers.gated or self.executor_pool.gated:
@@ -1228,10 +1348,17 @@ class ProcessRuntime:
                 if peer_id in self.dead_peers:
                     continue
                 # fire-and-forget probe: any reply (or any other frame)
-                # refreshes _last_heard via the reader
+                # refreshes _last_heard via the reader.  The send stamp
+                # turns each probe into a clock-offset bracket (the
+                # reply echoes it plus the replier's clock)
                 self._ping_nonce += 1
                 self._peer_writers[peer_id].put_nowait(
-                    serialize(PingReq(self._ping_nonce, digest))
+                    serialize(
+                        PingReq(
+                            self._ping_nonce, digest,
+                            t_send_us=self.time.micros(),
+                        )
+                    )
                 )
                 silent_for = loop.time() - self._last_heard[peer_id]
                 if silent_for > silence_window:
@@ -1471,16 +1598,35 @@ class ProcessRuntime:
         """Ship protocol outputs (the send_to_processes_and_executors analog,
         process.rs:580-654)."""
         process = self.process
+        tracer = self.tracer
         for action in process.to_processes_iter():
             if isinstance(action, ToSend):
                 # serialize once, NOW: the self-delivered copy is handled by
                 # a worker that may mutate the message in place (e.g. Newt
                 # strips MCommit votes), so peers must get bytes captured
-                # before any local handling
+                # before any local handling.  When the message's dot is
+                # trace-sampled, each peer frame instead carries its own
+                # edge sequence (one send event per hop, paired with the
+                # receiver's recv event) — per-target serialization, same
+                # capture-before-local-handling discipline
+                e_dot = None
+                if tracer.enabled:
+                    e_dot = edge_dot(action.msg)
+                    if e_dot is not None and not tracer.sample(e_dot):
+                        e_dot = None
+                seq = None
+                mtype = None
+                if e_dot is not None:
+                    # ONE edge seq per broadcast, shared by every target
+                    # (the hop key is (src, seq, dst) — dst disambiguates)
+                    # so the frame still serializes exactly once
+                    self._edge_seq += 1
+                    seq = self._edge_seq
+                    mtype = type(action.msg).__name__
                 frame = None
                 for target in sorted(action.target):
                     if target != process.id and frame is None:
-                        frame = serialize(POEProtocol(action.msg))
+                        frame = serialize(POEProtocol(action.msg, edge=seq))
                 for target in sorted(action.target):
                     if target == process.id:
                         index = self.protocol_cls.message_index(action.msg)
@@ -1488,6 +1634,11 @@ class ProcessRuntime:
                             index, ("msg", process.id, process.shard_id, action.msg)
                         )
                     else:
+                        if seq is not None:
+                            tracer.edge(
+                                "s", mtype, process.id, target, seq,
+                                dot=e_dot,
+                            )
                         self._peer_writers[target].put_nowait(frame)
             elif isinstance(action, ToForward):
                 index = self.protocol_cls.message_index(action.msg)
@@ -1642,15 +1793,20 @@ class ProcessRuntime:
             device = self._device_counters()
         if device is not None and self.tracer.enabled:
             # counters ride the trace too, next to the spans of the
-            # batches they carried.  jax_recompiles is host-process-global
-            # (a module tally in observability/device.py), so it goes out
-            # unattributed: co-hosted runtimes (the localhost harness)
-            # overwrite one (name, pid=None) observation instead of each
-            # claiming the same compiles — summing per-pid would n-fold it
+            # batches they carried.  jax_recompiles/jax_compile_ms are
+            # host-process-global (module tallies in
+            # observability/device.py), so they go out unattributed:
+            # co-hosted runtimes (the localhost harness) overwrite one
+            # (name, pid=None) observation instead of each claiming the
+            # same compiles — summing per-pid would n-fold them
             for name, value in sorted(device.items()):
                 self.tracer.counter(
                     name, value,
-                    pid=None if name == "jax_recompiles" else self.process.id,
+                    pid=(
+                        None
+                        if name in ("jax_recompiles", "jax_compile_ms")
+                        else self.process.id
+                    ),
                 )
         if queues is None:
             queues = self.queue_stats()
@@ -1682,6 +1838,7 @@ class ProcessRuntime:
         runtime's snapshot carries the same total, so readers must not
         sum it across runtimes of one host."""
         from fantoch_tpu.observability.device import (
+            compile_ms,
             derive_idle_frac,
             merge_counters,
             recompile_count,
@@ -1695,6 +1852,7 @@ class ProcessRuntime:
             # folded busy/span walls (frac itself never sums)
             derive_idle_frac(device)
             device["jax_recompiles"] = recompile_count()
+            device["jax_compile_ms"] = compile_ms()
             return device
         return None
 

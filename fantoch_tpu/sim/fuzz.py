@@ -138,6 +138,11 @@ class FuzzResult:
     plan_digest: str = ""
     trace_digest: str = ""
     verdict_digest: str = ""
+    # flight-recorder black boxes dumped by the failing run (populated
+    # when run_case was given a flight_dir; excluded from the verdict
+    # digest — timestamps inside make them run-local evidence, not part
+    # of the byte-identity contract)
+    flight: List[str] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -297,11 +302,16 @@ def _fuzz_planet(n: int):
     return regions, Planet.from_latencies(latencies)
 
 
-def run_case(case: FuzzCase) -> FuzzResult:
+def run_case(case: FuzzCase, flight_dir: Optional[str] = None) -> FuzzResult:
     """Drive one case through the deterministic sim and audit the
     outcome.  Never raises for in-model failures: typed stalls become
     ``stall`` verdicts, safety violations (auditor findings OR internal
-    protocol assertions) become ``violation``."""
+    protocol assertions) become ``violation``.
+
+    ``flight_dir`` arms the flight recorder (observability/recorder.py):
+    a stall or internal assertion dumps per-process black boxes there
+    and the result's ``flight`` lists them — what the repro artifact
+    attaches so every shrunk finding ships its own flight record."""
     from fantoch_tpu.client import ConflictRateKeyGen, Workload
     from fantoch_tpu.core.audit import ConsistencyAuditor
     from fantoch_tpu.sim import Runner
@@ -327,6 +337,7 @@ def run_case(case: FuzzCase) -> FuzzResult:
         seed=case.sim_seed,
         fault_plan=case.plan,
         open_loop_rate_per_s=case.open_loop_rate_per_s,
+        flight_dir=flight_dir,
     )
     result = FuzzResult(case, OK, plan_digest=_plan_digest(case.plan))
     try:
@@ -336,6 +347,7 @@ def run_case(case: FuzzCase) -> FuzzResult:
     except (SimStalledError, StalledExecutionError, QuorumLostError) as exc:
         result.verdict = STALL
         result.error = f"{type(exc).__name__}: {exc}"
+        result.flight = list(getattr(runner, "flight_dumps", []))
         _finalize_digests(result, runner, committed=None)
         return result
     except AssertionError as exc:
@@ -345,6 +357,7 @@ def run_case(case: FuzzCase) -> FuzzResult:
         result.verdict = VIOLATION
         result.violations = [f"internal-assertion: {exc}"]
         result.error = f"AssertionError: {exc}"
+        result.flight = list(getattr(runner, "flight_dumps", []))
         _finalize_digests(result, runner, committed=None)
         return result
 
@@ -380,6 +393,12 @@ def run_case(case: FuzzCase) -> FuzzResult:
     if not verdict.ok:
         result.verdict = VIOLATION
         result.violations = [str(v) for v in verdict.violations]
+    if not result.ok:
+        # failures that do not raise (auditor violations, incomplete
+        # clients) still ship their black box
+        result.flight = runner.dump_flight(
+            f"{result.verdict}: {(result.violations or [result.error])[0]}"
+        )
     _finalize_digests(result, runner, committed=survivors)
     return result
 
@@ -557,6 +576,10 @@ def repro_artifact(
         "verdict_digest": result.verdict_digest,
         "shrink_runs": shrink_runs,
         "issue": issue,
+        # the shrunk finding's own black boxes (flight-recorder dumps,
+        # observability/recorder.py) — readable by the same critpath
+        # correlator as live traces
+        "flight": result.flight,
     }
 
 

@@ -22,9 +22,9 @@ from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import ClientId, ProcessId, ShardId, process_ids
 from fantoch_tpu.core.metrics import Histogram, Metrics
 from fantoch_tpu.core.planet import Planet, Region
-from fantoch_tpu.errors import SimStalledError
+from fantoch_tpu.errors import FaultToleranceError, SimStalledError
 from fantoch_tpu.executor.monitor import ExecutionOrderMonitor
-from fantoch_tpu.observability.tracer import NOOP_TRACER, Tracer
+from fantoch_tpu.observability.tracer import NOOP_TRACER, Tracer, edge_dot
 from fantoch_tpu.protocol.base import Protocol, ToForward, ToSend
 from fantoch_tpu.sim.faults import DEFER, DELIVER, DROP, FaultPlan, Nemesis, NemesisMark
 from fantoch_tpu.sim.schedule import Schedule
@@ -45,6 +45,12 @@ class SendToProc:
     from_shard_id: ShardId
     to: ProcessId
     msg: Any
+    # message-edge sequence for cross-process span stitching (set when
+    # the message's dot is trace-sampled): the delivery emits the recv
+    # half pairing with the send event stamped at schedule time.  A
+    # nemesis-duplicated delivery shares the seq — the correlator keeps
+    # the earliest receive, which is what unblocks the receiver
+    edge_seq: Optional[int] = None
 
 
 @dataclass
@@ -129,6 +135,7 @@ class Runner:
         trace_path: Optional[str] = None,
         open_loop_rate_per_s: Optional[float] = None,
         telemetry_path: Optional[str] = None,
+        flight_dir: Optional[str] = None,
     ):
         assert len(process_regions) == config.n, "one region per process"
         assert config.gc_interval_ms is not None, "sim requires gc running"
@@ -155,6 +162,26 @@ class Runner:
             self._tracer = Tracer(
                 self._simulation.time, trace_path, config.trace_sample_rate
             )
+        # failure flight recorder (observability/recorder.py): one shared
+        # ring for the whole sim (events carry their pid), dumped split
+        # into flight_p<pid>.json files when a typed stall/violation
+        # escapes the loop — the sim twin of the run layer's per-process
+        # black boxes, correlated by the same critpath stitching
+        self._flight = None
+        self._flight_dir = flight_dir
+        if flight_dir is not None or config.flight_recorder:
+            from fantoch_tpu.observability.recorder import FlightRecorder
+
+            self._flight_dir = flight_dir if flight_dir is not None else "."
+            self._flight = FlightRecorder(
+                self._simulation.time, inner=self._tracer, clock="virtual"
+            )
+            self._tracer = self._flight
+        # per-sender message-edge sequences (cross-process stitching)
+        self._edge_seqs: Dict[ProcessId, int] = {}
+        # black boxes written by this runner (filled on typed failures,
+        # or by an explicit dump_flight call)
+        self.flight_dumps: List[str] = []
         # live telemetry (observability/timeseries.py): windowed series on
         # the virtual timeline — one window line per process + one for the
         # client plane per tick, byte-identical for same-seed runs
@@ -285,6 +312,17 @@ class Runner:
     def tracer(self):
         return self._tracer
 
+    def dump_flight(self, reason: str) -> List[str]:
+        """Dump the flight ring on demand (no-op without a recorder):
+        the post-run trigger for failures that do not raise — an
+        auditor ``Violation`` classifies a *completed* run as unsafe,
+        and its black box is this ring."""
+        if self._flight is None:
+            return []
+        paths = self._flight.dump_all(self._flight_dir, reason)
+        self.flight_dumps = paths
+        return paths
+
     @property
     def nemesis(self) -> Optional[Nemesis]:
         return self._nemesis
@@ -301,6 +339,7 @@ class Runner:
         """Run to completion; returns (process metrics, executor monitors,
         per-region (issued commands, latency histogram ms))."""
         tracer = self._tracer
+        self.flight_dumps = []
         if self._open_loop_rate is not None:
             # open loop: arrivals drive submissions; the first arrival of
             # each client is itself an exponential gap from t=0
@@ -313,6 +352,17 @@ class Runner:
                 self._schedule_submit(("client", client_id), process_id, cmd)
         try:
             self._simulation_loop(extra_sim_time_ms)
+        except (FaultToleranceError, AssertionError) as exc:
+            # typed stalls (StalledExecutionError / SimStalledError /
+            # divergence) and internal safety assertions are the flight
+            # recorder's trigger: dump every live process's black box
+            # before the error propagates (fuzz attaches these to repro
+            # artifacts)
+            if self._flight is not None:
+                self.flight_dumps = self._flight.dump_all(
+                    self._flight_dir, f"{type(exc).__name__}: {exc}"
+                )
+            raise
         finally:
             # flush+close so the span log is complete (and readable) even
             # when the loop raises a typed stall error
@@ -361,6 +411,16 @@ class Runner:
             elif isinstance(action, SubmitToProc):
                 self._handle_submit_to_proc(action.process_id, action.cmd)
             elif isinstance(action, SendToProc):
+                if action.edge_seq is not None and self._tracer.enabled:
+                    # recv half of the stitched hop (the send half was
+                    # stamped at schedule time); duplicates share the
+                    # seq and the correlator keeps the earliest
+                    dot = edge_dot(action.msg)
+                    if dot is not None:
+                        self._tracer.edge(
+                            "r", type(action.msg).__name__, action.from_,
+                            action.to, action.edge_seq, dot=dot,
+                        )
                 self._handle_send_to_proc(action.from_, action.from_shard_id, action.to, action.msg)
             elif isinstance(action, OpenLoopArrival):
                 self._handle_open_loop_arrival(action.client_id)
@@ -643,6 +703,10 @@ class Runner:
         self._submit_counts[process_id] = (
             self._submit_counts.get(process_id, 0) + 1
         )
+        if self._tracer.enabled:
+            # ingress edge: the client->coordinator hop's receive half
+            # (the client's own `submit` span event is the send half)
+            self._tracer.edge("r", "Submit", 0, process_id, 0, rifl=cmd.rifl)
         process, _, pending = self._simulation.get_process(process_id)
         pending.wait_for(cmd)
         process.submit(None, cmd, self._simulation.time)
@@ -714,11 +778,29 @@ class Runner:
 
     def _schedule_to_client(self, from_region_key, cmd_result: CommandResult) -> None:
         client_id = cmd_result.rifl.source
+        if self._tracer.enabled and from_region_key[0] == "process":
+            # reply edge: the coordinator->client hop's send half (the
+            # client's `reply` span event is the receive half)
+            self._tracer.edge(
+                "s", "Reply", from_region_key[1], 0, 0, rifl=cmd_result.rifl
+            )
         self._schedule_message(
             from_region_key, ("client", client_id), SendToClient(client_id, cmd_result)
         )
 
     def _schedule_message(self, from_key, to_key, action: Any) -> None:
+        if isinstance(action, SendToProc) and self._tracer.enabled:
+            # send half of a stitched peer hop, stamped at schedule time
+            # (= the sender's "now"); the delivery emits the recv half
+            dot = edge_dot(action.msg)
+            if dot is not None and self._tracer.sample(dot):
+                seq = self._edge_seqs.get(action.from_, 0) + 1
+                self._edge_seqs[action.from_] = seq
+                action.edge_seq = seq
+                self._tracer.edge(
+                    "s", type(action.msg).__name__, action.from_, action.to,
+                    seq, dot=dot,
+                )
         distance = self._distance(self._region_of(from_key), self._region_of(to_key))
         if self._reorder_messages:
             distance = int(distance * self._rng.uniform(0.0, 10.0))

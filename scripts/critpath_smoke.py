@@ -1,0 +1,199 @@
+"""Critical-path smoke gate (``make critpath-smoke``): the causal
+attribution plane end to end, CPU-only and tiny.
+
+1. A localhost 3-process EPaxos TCP cluster with tracing at rate 1.0:
+   ``bin/obs.py critpath`` over the per-process span logs must stitch
+   >= 99% of sampled spans across processes (wall clocks, heartbeat
+   offset resolution) and every attribution vector must telescope
+   EXACTLY to reply - submit.
+2. A SlowProcess sim nemesis: the deliberately slowed peer must be
+   named the dominant quorum-wait contributor.
+3. A forced StalledExecutionError (crash-forever past the executor's
+   bounded wait): every live process must dump a flight-recorder black
+   box that the SAME correlator stitches.
+
+The per-push CI step runs this next to trace-smoke.
+"""
+
+import dataclasses
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+COMMANDS_PER_CLIENT = 5
+
+
+def _workload():
+    from fantoch_tpu.client import ConflictRateKeyGen, Workload
+
+    return Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=2,
+        commands_per_client=COMMANDS_PER_CLIENT,
+        payload_size=1,
+    )
+
+
+def _near_far():
+    """p3 sits inside p1's and p2's fast quorums."""
+    from fantoch_tpu.core.planet import Planet, Region
+
+    regions = [Region("r1"), Region("r2"), Region("r3")]
+    latencies = {
+        regions[0]: {regions[0]: 0, regions[1]: 80, regions[2]: 10},
+        regions[1]: {regions[0]: 80, regions[1]: 0, regions[2]: 10},
+        regions[2]: {regions[0]: 10, regions[1]: 10, regions[2]: 0},
+    }
+    return regions, Planet.from_latencies(latencies)
+
+
+def check_localhost(tmp: str) -> dict:
+    import asyncio
+
+    from fantoch_tpu.core import Config
+    from fantoch_tpu.observability.critpath import critpath_report
+    from fantoch_tpu.observability.tracer import read_trace
+    from fantoch_tpu.protocol import EPaxos
+    from fantoch_tpu.run.harness import run_localhost_cluster
+
+    obs_dir = f"{tmp}/localhost"
+    config = Config(n=3, f=1, gc_interval_ms=50, trace_sample_rate=1.0)
+    asyncio.run(
+        run_localhost_cluster(
+            EPaxos, config, _workload(), clients_per_process=2,
+            observe_dir=obs_dir,
+            runtime_kwargs={"heartbeat_interval_s": 0.1},
+        )
+    )
+    paths = sorted(glob.glob(f"{obs_dir}/trace_*.jsonl"))
+    events = []
+    for path in paths:
+        events.extend(read_trace(path))
+    report = critpath_report(events)
+    assert report["clock"] == "wall", report["clock"]
+    assert report["spans"] == 3 * 2 * COMMANDS_PER_CLIENT, report["spans"]
+    assert report["stitch_rate"] >= 0.99, report["stitch_rate"]
+    assert report["telescoping_violations"] == 0, report
+    assert report["quorum_blame"], "quorum waits must resolve to peers"
+
+    # the CLI agrees (exit 0, machine payload carries the same verdict)
+    proc = subprocess.run(
+        [sys.executable, "-m", "fantoch_tpu.bin.obs", "critpath", "--json"]
+        + paths,
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["stitch_rate"] >= 0.99
+    assert payload["telescoping_violations"] == 0
+    return report
+
+
+def check_slow_process(tmp: str) -> int:
+    from fantoch_tpu.core import Config
+    from fantoch_tpu.observability.critpath import (
+        critpath_report,
+        dominant_quorum_peer,
+    )
+    from fantoch_tpu.observability.tracer import read_trace
+    from fantoch_tpu.protocol import EPaxos
+    from fantoch_tpu.sim import Runner
+    from fantoch_tpu.sim.faults import FaultPlan
+
+    regions, planet = _near_far()
+    config = Config(
+        n=3, f=1, gc_interval_ms=100,
+        executor_executed_notification_interval_ms=100,
+        trace_sample_rate=1.0,
+    )
+    path = f"{tmp}/slow.jsonl"
+    runner = Runner(
+        EPaxos, planet, config, _workload(), clients_per_process=2,
+        process_regions=regions, client_regions=regions[:2], seed=7,
+        trace_path=path,
+        fault_plan=FaultPlan().with_slow_process(3, slow_ms=150),
+    )
+    runner.run(extra_sim_time_ms=2000)
+    report = critpath_report(read_trace(path))
+    blamed = dominant_quorum_peer(report)
+    assert blamed == 3, (
+        f"slowed p3 must dominate the quorum wait, got p{blamed}: "
+        f"{report['quorum_blame']}"
+    )
+    assert report["quorum_blame"][3]["mean_wait_us"] >= 150_000
+    return blamed
+
+
+def check_flight(tmp: str) -> int:
+    from fantoch_tpu.core import Config
+    from fantoch_tpu.errors import StalledExecutionError
+    from fantoch_tpu.observability.critpath import critpath_report
+    from fantoch_tpu.observability.recorder import flight_events
+    from fantoch_tpu.protocol import EPaxos
+    from fantoch_tpu.sim import Runner
+    from fantoch_tpu.sim.faults import FaultPlan
+
+    regions, planet = _near_far()
+    config = Config(
+        n=3, f=1, gc_interval_ms=100,
+        executor_executed_notification_interval_ms=100,
+        trace_sample_rate=1.0,
+        executor_monitor_pending_interval_ms=200,
+        executor_pending_fail_ms=800,
+    )
+    flight_dir = f"{tmp}/flight"
+    plan = dataclasses.replace(
+        FaultPlan().with_crash(1, at_ms=60), max_sim_time_ms=6000
+    )
+    runner = Runner(
+        EPaxos, planet, config, _workload(), clients_per_process=2,
+        process_regions=regions, client_regions=regions, seed=7,
+        trace_path=f"{tmp}/stall.jsonl", fault_plan=plan,
+        flight_dir=flight_dir,
+    )
+    try:
+        runner.run(extra_sim_time_ms=2000)
+        raise AssertionError("the crash-without-recovery run must stall")
+    except StalledExecutionError:
+        pass
+    dumps = sorted(glob.glob(f"{flight_dir}/flight_p*.json"))
+    names = [os.path.basename(p) for p in dumps]
+    assert names == [
+        "flight_p1.json", "flight_p2.json", "flight_p3.json"
+    ], names
+    # the same correlator stitches the black boxes
+    report = critpath_report(
+        flight_events(dumps + [f"{flight_dir}/flight_clients.json"])
+    )
+    assert report["spans"] > 0
+    assert report["telescoping_violations"] == 0
+    return len(dumps)
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as tmp:
+        report = check_localhost(tmp)
+        blamed = check_slow_process(tmp)
+        dumps = check_flight(tmp)
+    print(json.dumps({
+        "metric": "critpath_smoke",
+        "critpath_spans": report["spans"],
+        "critpath_stitch_rate": report["stitch_rate"],
+        "critpath_p99_dominant_stage": report["p99"]["dominant_stage"],
+        "critpath_blamed_slow_peer": blamed,
+        "critpath_flight_dumps": dumps,
+        "ok": True,
+    }))
+
+
+if __name__ == "__main__":
+    main()

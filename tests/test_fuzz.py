@@ -271,7 +271,7 @@ def test_bin_fuzz_run_exits_nonzero_on_filed_artifact(tmp_path, monkeypatch, cap
     import fantoch_tpu.sim.fuzz as fuzz_mod
     from fantoch_tpu.bin import fuzz as bin_fuzz
 
-    def fake_run_case(case):
+    def fake_run_case(case, flight_dir=None):
         return FuzzResult(case, VIOLATION, violations=["[order-divergence] x"])
 
     monkeypatch.setattr(fuzz_mod, "run_case", fake_run_case)

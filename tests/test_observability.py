@@ -371,7 +371,9 @@ def test_span_schema_roundtrip(tmp_path):
     tracer.close()
 
     events = read_trace(path)
-    assert len(events) == 8
+    # 8 emitted events + the clock-domain header line
+    assert len(events) == 9
+    assert events[0] == {"k": "hdr", "clock": "virtual", "v": 1}
     spans = assemble_spans(events)
     assert len(spans) == 1
     span = spans[rifl]
@@ -391,7 +393,7 @@ def test_span_schema_roundtrip(tmp_path):
     # crash consistency: a torn final line is dropped on read
     with open(path, "a") as fh:
         fh.write('{"k":"span","stage":"reply","rifl":[7,')
-    assert len(read_trace(path)) == 8
+    assert len(read_trace(path)) == 9
 
 
 def test_span_assembly_survives_crashed_coordinator():

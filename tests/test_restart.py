@@ -249,6 +249,81 @@ def test_restart_matrix_52(protocol_cls, config, loss):
 # --- determinism: restart decisions replay byte-identically ---
 
 
+def test_critpath_blame_survives_crash_restart(tmp_path):
+    """Critical-path satellite: the PR 5 restart rows assert span-log
+    byte identity, but never ASSEMBLE attribution across a crash.  This
+    row does: the span log spans all three lives (crash, durable image,
+    restore + rejoin) and every assembled blame vector still telescopes
+    EXACTLY to reply - submit, with cross-process quorum edges resolved
+    for the stitched spans."""
+    from fantoch_tpu.observability.critpath import critpath_report
+    from fantoch_tpu.observability.tracer import read_trace
+
+    # recovery on, like every restored-tolerance row: a dot whose
+    # MCollect was in flight at the crash instant only commits via
+    # recovery consensus
+    config = Config(3, 1, recovery_delay_ms=1000, trace_sample_rate=1.0)
+    plan = FaultPlan(max_sim_time_ms=300_000).with_crash(
+        1, at_ms=150, restart_at_ms=700
+    )
+    path = str(tmp_path / "restart.jsonl")
+    runner, _monitors = restart_sim(EPaxos, config, plan, trace_path=path)
+    kinds = {kind for _t, kind, _d in runner.nemesis.trace}
+    assert {"crash", "durable-image", "restart"} <= kinds
+    report = critpath_report(read_trace(path))
+    assert report["spans"] > 0
+    # exactness survives the crash: no vector may mis-telescope, even
+    # ones whose stages straddle the restart
+    assert report["telescoping_violations"] == 0
+    # most spans still stitch (in-flight hops dropped AT the crash
+    # instant legitimately lose their recv half)
+    assert report["stitch_rate"] >= 0.9
+    assert report["quorum_blame"]
+
+
+def test_critpath_names_recovery_stage_for_crashed_coordinator(tmp_path):
+    """A crashed-forever coordinator's in-flight dots heal by recovery
+    consensus — and the blame vector must NAME that detour: the span
+    keeps the out-of-chain recovery stage and the attribution carries
+    ``blame["recovery"]`` with the entry point and the detour-to-commit
+    wall."""
+    from fantoch_tpu.observability.critpath import (
+        OffsetTable,
+        attribute_span,
+        commit_times,
+        estimate_client_offsets,
+        match_edges,
+    )
+    from fantoch_tpu.observability.report import assemble_spans
+    from fantoch_tpu.observability.tracer import read_trace
+
+    config = Config(
+        3, 1, recovery_delay_ms=300,
+        trace_sample_rate=1.0,
+    )
+    plan = FaultPlan(max_sim_time_ms=300_000).with_crash(1, at_ms=120)
+    path = str(tmp_path / "recover.jsonl")
+    restart_sim(EPaxos, config, plan, trace_path=path)
+    events = read_trace(path)
+    spans = assemble_spans(events)
+    recovered = [
+        span for span in spans.values() if "recovery" in span["stages"]
+    ]
+    assert recovered, "a crashed-coordinator dot must enter recovery"
+    dot_edges, client_edges = match_edges(events)
+    offsets = OffsetTable(events, wall=False)
+    client_off = estimate_client_offsets(spans, client_edges, wall=False)
+    commits = commit_times(events)
+    for span in recovered:
+        vector = attribute_span(
+            span, dot_edges, client_edges, offsets, client_off, commits
+        )
+        detour = vector["blame"]["recovery"]
+        assert detour["entered_us"] == span["stages"]["recovery"]
+        if "commit" in span["stages"]:
+            assert detour["to_commit_us"] >= 0
+
+
 def test_restart_determinism_and_trace_byte_identity(tmp_path):
     """Same seed twice through crash + durable image + restore + rejoin
     => identical nemesis traces, identical committed orders, and
