@@ -331,13 +331,27 @@ def child_main(mode: str) -> None:
         if "serving_newt_cmds_per_s" in record:
             # end-to-end serving is a HEADLINE metric next to the kernel
             # p50 (ROADMAP item 1): the pipelined Newt serving loop's
-            # cmds/s, promoted to its own top-level metric triple
+            # cmds/s, promoted to its own top-level metric triple, with
+            # the r16 occupancy gauge riding along — throughput without
+            # fill is half a story (empty rounds can post big cmds/s on
+            # a full feed while starving under real arrivals)
             record["serving_metric"] = "serving_newt_cmds_per_s"
             record["serving_value"] = record["serving_newt_cmds_per_s"]
             record["serving_unit"] = "cmds/s"
+            record["serving_fill_frac"] = record.get(
+                "serving_newt_dispatch_fill_frac", 0.0
+            )
     except Exception as exc:  # noqa: BLE001
         print(f"# device-serving bench failed: {exc!r}", file=sys.stderr)
         record["serving_error"] = repr(exc)[:200]
+    try:
+        # the r16 adaptive-ingest row: open-loop arrivals at 2x this
+        # rig's saturation through the batched+chained serving loop vs
+        # the legacy dispatch-on-anything loop
+        record.update(bench_serving_batched())
+    except Exception as exc:  # noqa: BLE001
+        print(f"# batched-serving bench failed: {exc!r}", file=sys.stderr)
+        record["serving_ingest_error"] = repr(exc)[:200]
     try:
         record.update(bench_local_pool())
     except Exception as exc:  # noqa: BLE001
@@ -1385,7 +1399,8 @@ def bench_device_serving(
         """Steady-state serving rounds; ``pipelined`` runs the depth-K
         loop (dispatch runs ahead; the tail flushes inside the timed
         region — it serves real commands).  Returns (round_ms, cmds/s,
-        idle_frac) with idle_frac from the driver's overlap counters."""
+        idle_frac, device_counters) with idle_frac from the driver's
+        overlap counters."""
         driver = driver_cls(n, batch_size=batch_size, key_buckets=8192)
         driver.pipeline_depth = depth if pipelined else 1
         driver.step(cmds[:batch_size])  # compile + warm
@@ -1402,15 +1417,16 @@ def bench_device_serving(
         wall_ms = (time.perf_counter() - t0) * 1000.0
         rounds = (total - batch_size) // batch_size
         assert served == total - batch_size, f"served {served}/{total}"
-        idle = driver.device_counters().get("device_idle_frac", 0.0)
+        counters = driver.device_counters()
         return (
             round(wall_ms / rounds, 2),
             int(served / (wall_ms / 1000.0)),
-            idle,
+            counters.get("device_idle_frac", 0.0),
+            counters,
         )
 
-    round_ms, cmds_per_s, sync_idle = measure(batch)
-    pipe_ms, pipe_cps, pipe_idle = measure(batch, pipelined=True)
+    round_ms, cmds_per_s, sync_idle, _ = measure(batch)
+    pipe_ms, pipe_cps, pipe_idle, pipe_ctrs = measure(batch, pipelined=True)
     out = {
         "serving_batch": batch,
         "serving_pipeline_depth": depth,
@@ -1420,6 +1436,12 @@ def bench_device_serving(
         "serving_pipelined_round_ms": pipe_ms,
         "serving_pipelined_cmds_per_s": pipe_cps,
         "serving_pipelined_idle_frac": pipe_idle,
+        # batch occupancy + chain gauge (run/pipeline.py counters): the
+        # full-feed bench runs full rounds, so fill sits near 1 — the
+        # gauges earn their keep on the batched open-loop row, where the
+        # ingest batcher is what fills them
+        "serving_dispatch_fill_frac": pipe_ctrs.get("dispatch_fill_frac", 0.0),
+        "serving_chain_len": pipe_ctrs.get("serving_chain_len", 1),
     }
     # the other three consensus families' serving rounds at one batch
     # size — Newt (timestamp proposal + stability), Caesar (timestamp +
@@ -1442,8 +1464,8 @@ def bench_device_serving(
                 # depth-K loop (redefined r07, the steady-state
                 # redefinition move of table_cmds_per_s_arrays r06); the
                 # synchronous round keeps the old definition as _sync
-                sync_ms, sync_cps, fam_sync_idle = measure(batch, cls)
-                fam_ms, fam_cps, fam_idle = measure(
+                sync_ms, sync_cps, fam_sync_idle, _ = measure(batch, cls)
+                fam_ms, fam_cps, fam_idle, fam_ctrs = measure(
                     batch, cls, pipelined=True
                 )
                 out["serving_newt_sync_round_ms"] = sync_ms
@@ -1452,13 +1474,22 @@ def bench_device_serving(
                 out["serving_newt_round_ms"] = fam_ms
                 out["serving_newt_cmds_per_s"] = fam_cps
                 out["serving_newt_idle_frac"] = fam_idle
+                out["serving_newt_dispatch_fill_frac"] = fam_ctrs.get(
+                    "dispatch_fill_frac", 0.0
+                )
+                out["serving_newt_chain_len"] = fam_ctrs.get(
+                    "serving_chain_len", 1
+                )
                 out["serving_newt_definition"] = (
                     f"depth-{depth} pipelined serving loop "
-                    "(run/pipeline.py, r07); pre-r07 synchronous round "
-                    "kept as serving_newt_sync_*"
+                    "(run/pipeline.py, r07); r16 stamps "
+                    "dispatch_fill_frac/chain_len and adds the "
+                    "adaptive-ingest serving_ingest_* keys "
+                    "(run/ingest.py); pre-r07 synchronous round kept "
+                    "as serving_newt_sync_*"
                 )
             else:
-                fam_ms, fam_cps, _ = measure(batch, cls)
+                fam_ms, fam_cps, _, _ = measure(batch, cls)
                 out[f"serving_{name}_round_ms"] = fam_ms
                 out[f"serving_{name}_cmds_per_s"] = fam_cps
                 if name == "caesar":
@@ -1466,7 +1497,7 @@ def bench_device_serving(
                     # pipelined row (new keys — serving_caesar_* keeps
                     # its synchronous definition); the smoke gates
                     # pipelined >= 0.6x sync like the Newt row
-                    pipe_ms2, pipe_cps2, pipe_idle2 = measure(
+                    pipe_ms2, pipe_cps2, pipe_idle2, _ = measure(
                         batch, cls, pipelined=True
                     )
                     out["serving_caesar_pipelined_round_ms"] = pipe_ms2
@@ -1501,7 +1532,7 @@ def bench_device_serving(
         for other in (1024, 16384):
             if total < 2 * other:
                 continue  # needs >= one steady-state round past the warm one
-            ms, cps, _ = measure(other)
+            ms, cps, _, _ = measure(other)
             out[f"serving_round_ms_{other // 1024}k"] = ms
             out[f"serving_cmds_per_s_{other // 1024}k"] = cps
     return out
@@ -1554,6 +1585,195 @@ def _measure_newt_chained(
     if depth:
         out[f"{prefix}_idle_frac"] = driver.device_counters().get(
             "device_idle_frac", 0.0
+        )
+    return out
+
+
+def bench_serving_batched(
+    total: int = 16_384, batch: int = 64, n: int = 3,
+    rate_factor: float = 2.0, deadline_ms: float = 2.0, chain: int = 8,
+):
+    """The adaptive-ingest serving row (run/ingest.py): a timed arrival
+    stream offered at ``rate_factor``x this rig's measured saturation
+    rate feeds the Newt serving loop two ways —
+
+    * **unbatched** (the pre-r16 loop): dispatch the instant anything is
+      queued, one round per dispatch — under a trickle the device
+      round-trip is paid per near-empty round;
+    * **batched**: the size-or-deadline gate holds arrivals, and a
+      backlog covering ``chain`` rounds goes out as ONE chained dispatch
+      (``step_chained_pipelined``) — rounds leave full and the dispatch
+      round-trip is amortized ``chain``x.
+
+    Both arms replay the same arrival schedule (command i arrives at
+    ``i / rate`` after t0) against real wall time, so the row measures
+    the serving loops, not the generator.  ``serving_ingest_fill_frac``
+    is the batched arm's steady-state batch occupancy (delta over the
+    timed region) and ``serving_ingest_recompiles_timed`` must stay 0 —
+    every program the timed region runs is compiled in the warm phase
+    (single step, plus the S=``chain`` chained program for the batched
+    arm; the arm only ever dispatches those two shapes).
+
+    Sizing rule: the timed region must be MANY multiples of
+    ``chain * batch`` — at 2x saturation the backlog grows at the
+    saturation rate, so fused dispatches only engage once it crosses a
+    full chain; a short region never gets there and the row degenerates
+    to single rounds.
+
+    Regime rule: chaining amortizes PER-DISPATCH overhead, so it only
+    wins where that overhead is a large fraction of the round — small
+    batches.  Measured on the dev rig: batch=64 S=8 is 1.37x the single
+    loop, batch=256 S=4 is 1.21x, and batch=1024 ANY S loses (the big
+    batch already amortizes the dispatch and the fused program only
+    forfeits drain overlap).  The defaults sit in the winning regime;
+    the serving-loop auto-tuner (run/ingest.py ChainAutoTuner) encodes
+    the same rule dynamically via the overhead/busy ratio."""
+    import numpy as np
+
+    from fantoch_tpu.core import Command, Dot, KVOp, Rifl
+    from fantoch_tpu.observability.device import (
+        recompile_count,
+        subscribe_recompiles,
+    )
+    from fantoch_tpu.run.device_runner import NewtDeviceDriver
+    from fantoch_tpu.run.ingest import AdaptiveIngestBatcher
+
+    subscribe_recompiles()
+    rng = np.random.default_rng(23)
+    keys = 1 + rng.integers(0, 4096, size=total)
+    cmds = [
+        (
+            Dot(1, i + 1),
+            Command.from_single(
+                Rifl(1, i + 1), 0, f"bk{keys[i]}", KVOp.put("")
+            ),
+        )
+        for i in range(total)
+    ]
+    warm_rows = (1 + chain) * batch  # single-step warm + S=chain warm
+    assert total > warm_rows + 2 * batch, (
+        f"total {total} leaves no steady-state feed past warm {warm_rows}"
+    )
+
+    # calibrate saturation on a throwaway driver: warm full rounds of the
+    # plain loop give the rate the arrival stream is scaled against
+    cal = NewtDeviceDriver(n, batch_size=batch, key_buckets=8192)
+    cal.step(cmds[:batch])
+    t0 = time.perf_counter()
+    cal_rounds = 0
+    for start in range(batch, min(total, 4 * batch), batch):
+        cal.step(cmds[start : start + batch])
+        cal_rounds += 1
+    sat_cps = cal_rounds * batch / max(1e-9, time.perf_counter() - t0)
+    rate_per_ms = rate_factor * sat_cps / 1000.0
+
+    def serve(batched: bool) -> dict:
+        driver = NewtDeviceDriver(n, batch_size=batch, key_buckets=8192)
+        driver.pipeline_depth = 2
+        driver.step(cmds[:batch])  # compile + warm the single step
+        if batched:
+            # compile the S=chain fused program outside the timed region
+            driver.step_chained_pipelined(
+                [
+                    cmds[batch + i * batch : batch + (i + 1) * batch]
+                    for i in range(chain)
+                ]
+            )
+            driver.flush_pipeline()
+        feed = cmds[warm_rows:] if batched else cmds[batch:]
+        # identical steady-state length for both arms (the batched arm's
+        # extra warm rows come off the front)
+        feed = feed[: total - warm_rows]
+        ntimed = len(feed)
+        batcher = (
+            AdaptiveIngestBatcher(deadline_ms, max_target=chain * batch)
+            if batched else None
+        )
+        driver.reset_overlap_instrument()
+        c0 = driver.device_counters()
+        recompiles0 = recompile_count()
+        served = 0
+        taken = 0
+        noted = 0
+        fused_dispatches = 0
+        t1 = time.perf_counter()
+        while taken < ntimed:
+            now_ms = (time.perf_counter() - t1) * 1000.0
+            arrived = min(ntimed, int(now_ms * rate_per_ms))
+            queued = arrived - taken
+            if queued <= 0:
+                # sleep to the next arrival instant
+                gap_ms = (taken + 1) / rate_per_ms - now_ms
+                time.sleep(max(gap_ms, 0.05) / 1000.0)
+                continue
+            if batcher is None:
+                take = min(queued, batch)
+                served += len(driver.step_pipelined(feed[taken : taken + take]))
+                taken += take
+                continue
+            if noted < arrived:
+                batcher.note_arrivals(now_ms, arrived - noted)
+                noted = arrived
+            release, wait_ms = batcher.poll(now_ms, queued)
+            if not release:
+                time.sleep((wait_ms or 0.05) / 1000.0)
+                continue
+            if queued >= chain * batch:
+                # backlog covers a full chain: one fused dispatch (the
+                # only chained shape compiled — a partial chain would
+                # recompile, so anything shorter goes out as single
+                # full-or-partial rounds)
+                take = chain * batch
+                rows = feed[taken : taken + take]
+                taken += take
+                batcher.note_release(now_ms, take)
+                fused_dispatches += 1
+                served += len(
+                    driver.step_chained_pipelined(
+                        [rows[i * batch : (i + 1) * batch] for i in range(chain)]
+                    )
+                )
+            else:
+                take = min(queued, batch)
+                served += len(driver.step_pipelined(feed[taken : taken + take]))
+                taken += take
+                batcher.note_release(now_ms, take)
+        served += len(driver.flush_pipeline())
+        wall_ms = (time.perf_counter() - t1) * 1000.0
+        assert served == ntimed, f"served {served}/{ntimed}"
+        c1 = driver.device_counters()
+        d_rows = c1["device_dispatched_rows"] - c0["device_dispatched_rows"]
+        d_cap = c1["device_batch_capacity"] - c0["device_batch_capacity"]
+        return {
+            "cmds_per_s": int(served / (wall_ms / 1000.0)),
+            "fill_frac": round(d_rows / max(1, d_cap), 4),
+            # the chain the arm actually fused (the driver's
+            # serving_chain_len gauge reads the LAST dispatch, which is
+            # a tail single round here)
+            "chain_len": chain if fused_dispatches else 1,
+            "fused_dispatches": fused_dispatches,
+            "recompiles": recompile_count() - recompiles0,
+        }
+
+    plain = serve(batched=False)
+    fused = serve(batched=True)
+    out = {
+        "serving_ingest_deadline_ms": deadline_ms,
+        "serving_ingest_rate_factor": rate_factor,
+        "serving_ingest_offered_cmds_per_s": int(rate_per_ms * 1000.0),
+        "serving_ingest_unbatched_cmds_per_s": plain["cmds_per_s"],
+        "serving_ingest_unbatched_fill_frac": plain["fill_frac"],
+        "serving_ingest_batched_cmds_per_s": fused["cmds_per_s"],
+        "serving_ingest_fill_frac": fused["fill_frac"],
+        "serving_ingest_chain_len": fused["chain_len"],
+        "serving_ingest_fused_dispatches": fused["fused_dispatches"],
+        "serving_ingest_recompiles_timed": (
+            plain["recompiles"] + fused["recompiles"]
+        ),
+    }
+    if plain["cmds_per_s"] > 0:
+        out["serving_ingest_speedup"] = round(
+            fused["cmds_per_s"] / plain["cmds_per_s"], 3
         )
     return out
 
@@ -1837,6 +2057,10 @@ def bench_overload(
 REGRESS_BANDS = (
     ("pool_", 3.0),
     ("overload_", 3.0),
+    # adaptive-ingest serving rows ride a wall-clock arrival stream
+    # calibrated against the rig's own saturation rate: shared-CI
+    # scheduling noise moves both the offered rate and the served rate
+    ("serving_ingest_", 2.5),
     ("general_fallback_", 2.5),
     # pred-plane rows time a python-vs-kernel race on shared CI cores:
     # scheduling noise swings the ratio harder than the plane does
@@ -2057,6 +2281,7 @@ def smoke_main() -> None:
             pipeline_depth=2,
         )
     )
+    out.update(bench_serving_batched(total=8192, batch=256, chain=3))
     out["jax_recompiles"] = recompile_count()
     out["jax_compile_ms"] = compile_ms()
     assert out["table_cmds_per_s_arrays"] > 1_000, out
@@ -2125,6 +2350,17 @@ def smoke_main() -> None:
         out["serving_caesar_pipelined_cmds_per_s"]
         >= 0.6 * out["serving_caesar_cmds_per_s"]
     ), out
+    # the r16 adaptive-ingest row: at 2x-saturation arrivals the batched
+    # loop must fill its rounds (the batcher's whole job), must not lose
+    # to the legacy dispatch-on-anything loop, and the timed region must
+    # run fully warm — zero XLA compiles, every program (single step +
+    # S=chain fused) compiled in the warm phase
+    assert out["serving_ingest_fill_frac"] >= 0.5, out
+    assert (
+        out["serving_ingest_batched_cmds_per_s"]
+        >= out["serving_ingest_unbatched_cmds_per_s"]
+    ), out
+    assert out["serving_ingest_recompiles_timed"] == 0, out
     # persist the row for the telemetry smoke's report-only regression
     # pass (bench.py --regress BENCH_SMOKE_LATEST.json); bookkeeping
     # must never fail the smoke itself

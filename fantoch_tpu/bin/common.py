@@ -114,6 +114,24 @@ def add_config_flags(parser: argparse.ArgumentParser) -> None:
                         help="device serving pipeline depth (run/pipeline.py): "
                         "dispatched-but-undrained rounds kept in flight; "
                         "default FANTOCH_SERVING_PIPELINE_DEPTH env, else 1")
+    parser.add_argument("--ingest-deadline", type=float, default=None,
+                        metavar="MS", dest="ingest_deadline_ms",
+                        help="adaptive ingest batching deadline budget "
+                        "(run/ingest.py): a queued submission waits at most "
+                        "this long for its round to fill; default "
+                        "FANTOCH_INGEST_DEADLINE_MS env, else 2.0; "
+                        "0 disables batching")
+    parser.add_argument("--ingest-target", type=int, default=None,
+                        metavar="N", dest="ingest_target",
+                        help="fixed ingest size target (rows that release "
+                        "a round), overriding the EWMA-adaptive target; "
+                        "default FANTOCH_INGEST_TARGET env, else adaptive")
+    parser.add_argument("--serving-chain-max", type=int, default=None,
+                        metavar="S", dest="serving_chain_max",
+                        help="ceiling on the auto-tuned serving chain "
+                        "length (rounds fused per device dispatch); "
+                        "default FANTOCH_SERVING_CHAIN_MAX env, else 8; "
+                        "1 disables chaining")
     parser.add_argument("--wal-sync", default=None,
                         choices=("always", "interval", "never"),
                         help="durable command-log fsync policy (run/wal.py); "
@@ -195,6 +213,9 @@ def config_from_args(args: argparse.Namespace):
         graph_kernel_threshold=args.graph_kernel_threshold,
         device_pred_plane=args.device_pred_plane,
         serving_pipeline_depth=args.serving_pipeline_depth,
+        ingest_deadline_ms=args.ingest_deadline_ms,
+        ingest_target=args.ingest_target,
+        serving_chain_max=args.serving_chain_max,
         wal_sync=args.wal_sync,
         queue_capacity=args.queue_capacity,
         admission_limit=args.admission_limit,

@@ -408,6 +408,16 @@ def cmd_critpath(args) -> int:
                 f"(net {row['mean_net_us'] / 1000:.2f}ms, "
                 f"remote {row['mean_remote_us'] / 1000:.2f}ms)"
             )
+    for label, row in (
+        ("ingest-batching (all)", report["ingest_batching"]),
+        ("ingest-batching (p99)", report["p99_ingest_batching"]),
+    ):
+        if row["spans"]:
+            print(
+                f"{label}: {row['spans']} span(s)  "
+                f"mean hold {row['mean_us'] / 1000:.2f}ms  "
+                f"max {row['max_us'] / 1000:.2f}ms"
+            )
     for row in report["peers"]:
         print(
             f"peer skew: p{row['pid']} -> p{row['peer']} offset "

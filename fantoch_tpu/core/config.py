@@ -144,6 +144,26 @@ class Config:
     # DeviceRuntime into pipelining on CPU backends (new knob; no
     # reference counterpart — the reference's runner is message-at-a-time)
     serving_pipeline_depth: Optional[int] = None
+    # adaptive ingest batching at the serving edge (run/ingest.py): the
+    # deadline budget (ms) a queued submission may wait for its round to
+    # fill before it is released anyway.  One knob like
+    # serving_pipeline_depth: None = the FANTOCH_INGEST_DEADLINE_MS env
+    # var, else 2.0; an explicit 0 disables batching (legacy
+    # dispatch-on-anything).  The size target adapts from the EWMA
+    # arrival rate unless ingest_target pins it; a lone command in an
+    # otherwise idle system always dispatches immediately (the sync-
+    # latency fast path), whatever these knobs say
+    ingest_deadline_ms: Optional[float] = None
+    # fixed ingest size target (rows that release a round) overriding
+    # the EWMA-adaptive target.  None = the FANTOCH_INGEST_TARGET env
+    # var, else adaptive
+    ingest_target: Optional[int] = None
+    # ceiling on the auto-tuned serving chain length S (rounds fused per
+    # device dispatch, NewtDeviceDriver.step_chained_pipelined): the
+    # tuner grows S while per-round dispatch overhead dominates device
+    # time and never past this.  None = the FANTOCH_SERVING_CHAIN_MAX
+    # env var, else 8; 1 disables chaining
+    serving_chain_max: Optional[int] = None
     # durable command-log fsync policy (run/wal.py): "always" fsyncs
     # every append (commit-durable before anything acks it), "interval"
     # fsyncs on the runtime's periodic WAL tick (bounded loss window),
@@ -232,6 +252,20 @@ class Config:
             raise ValueError(
                 f"serving_pipeline_depth = {self.serving_pipeline_depth} "
                 "must be >= 1"
+            )
+        if self.ingest_deadline_ms is not None and self.ingest_deadline_ms < 0:
+            raise ValueError(
+                f"ingest_deadline_ms = {self.ingest_deadline_ms} must be "
+                ">= 0 (0 = batching off)"
+            )
+        if self.ingest_target is not None and self.ingest_target < 1:
+            raise ValueError(
+                f"ingest_target = {self.ingest_target} must be >= 1"
+            )
+        if self.serving_chain_max is not None and self.serving_chain_max < 1:
+            raise ValueError(
+                f"serving_chain_max = {self.serving_chain_max} must be >= 1 "
+                "(1 = chaining off)"
             )
         if self.wal_sync is not None and self.wal_sync not in (
             "always", "interval", "never",

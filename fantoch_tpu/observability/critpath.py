@@ -273,6 +273,14 @@ def attribute_span(
         blame["client_net_us"] = int(net)
         blame["coord_queue_us"] = int(seg_us - net)
 
+    # payload -> ingest: the adaptive batcher's hold (run/ingest.py) —
+    # an explicit bucket, already a stage segment so it telescopes by
+    # construction (the deadline budget is attributed, not hidden)
+    if "payload" in stages and "ingest" in stages:
+        blame["ingest_batching_us"] = int(
+            _clamp(stages["ingest"] - stages["payload"], 0, float("inf"))
+        )
+
     # payload -> path: the quorum wait and its slowest member
     if dot is not None and pid is not None and "path" in stages:
         edges = dot_edges.get(tuple(dot), ())
@@ -283,7 +291,10 @@ def attribute_span(
         if acks:
             blocking = max(acks, key=lambda e: e["tr"])
             peer = blocking["src"]
-            start = stages.get("payload")
+            # the quorum wait starts when the round left ingest (the
+            # batching hold has its own bucket above); payload is the
+            # pre-batching fallback
+            start = stages.get("ingest", stages.get("payload"))
             if start is None and "submit" in stages:
                 # payload stamp lost (a restart truncates the
                 # coordinator's log): submit is on the CLIENT clock —
@@ -453,6 +464,18 @@ def critpath_report(
                 row[f"mean_{key}"] = row.pop(key) // max(1, row["count"])
         return table
 
+    def _ingest_row(vecs: List[Dict[str, Any]]) -> Dict[str, int]:
+        waits = [
+            v["blame"]["ingest_batching_us"]
+            for v in vecs
+            if "ingest_batching_us" in v["blame"]
+        ]
+        return {
+            "spans": len(waits),
+            "mean_us": sum(waits) // len(waits) if waits else 0,
+            "max_us": max(waits) if waits else 0,
+        }
+
     p99_means = _stage_means(cohort)
     dominant = max(p99_means.items(), key=lambda kv: kv[1])[0] if p99_means else None
     counters = counters_total(events)
@@ -482,6 +505,10 @@ def critpath_report(
         },
         "quorum_blame": _quorum_table(complete),
         "p99_quorum_blame": _quorum_table(cohort),
+        # the adaptive batcher's hold, as an explicit bucket (exact:
+        # each entry is the span's payload->ingest stage segment)
+        "ingest_batching": _ingest_row(complete),
+        "p99_ingest_batching": _ingest_row(cohort),
         "recovered_spans": recoveries,
         "peers": offsets.rows(),
         # string-keyed for JSON: one estimate per (client, coordinator)

@@ -66,6 +66,11 @@ from typing import Any, Dict, List, Optional
 STAGES = (
     "submit",
     "payload",
+    # batch release: stamped when the adaptive ingest batcher
+    # (run/ingest.py) releases the command's round toward dispatch —
+    # payload->ingest IS the ingest-queue + batching wait, so the
+    # deadline budget is attributed, never hidden in a merged segment
+    "ingest",
     "path",
     "commit",
     "ready",

@@ -56,6 +56,13 @@ from fantoch_tpu.core.ids import ClientId, Dot, ProcessId, Rifl, ShardId
 from fantoch_tpu.core.kvs import KVStore
 from fantoch_tpu.executor.aggregate import AggregatePending
 from fantoch_tpu.executor.base import ExecutorResult
+from fantoch_tpu.run.ingest import (
+    AdaptiveIngestBatcher,
+    ChainAutoTuner,
+    resolve_ingest_deadline_ms,
+    resolve_ingest_target,
+    resolve_serving_chain_max,
+)
 from fantoch_tpu.run.pipeline import (
     BoundedSubmitRing,
     PipelineCore,
@@ -330,6 +337,12 @@ class _DriverCore(PipelineCore):
         into the next batch by the caller."""
         out, self._requeue = self._requeue, []
         return out
+
+    @property
+    def has_requeue(self) -> bool:
+        """Overflow-requeued commands are waiting (the serving loop's
+        ingest gate never holds these — they were admitted a round ago)."""
+        return bool(self._requeue)
 
     @staticmethod
     def _packed(src, seq) -> int:
@@ -1498,6 +1511,9 @@ class DeviceRuntime:
         metrics_interval_ms: int = 5000,
         pipeline: Optional[bool] = None,
         pipeline_depth: Optional[int] = None,
+        ingest_deadline_ms: Optional[float] = None,
+        ingest_target: Optional[int] = None,
+        serving_chain_max: Optional[int] = None,
         mesh=None,
         telemetry_file: Optional[str] = None,
         metrics_port: Optional[int] = None,
@@ -1591,6 +1607,30 @@ class DeviceRuntime:
         # every driver implements the dispatch/drain split, so the
         # scaffold's step_pipelined is always available
         self.pipeline = bool(pipeline)
+        # adaptive ingest batching (run/ingest.py): accumulate queued
+        # submissions until the EWMA size target or the deadline budget
+        # fills, so rounds dispatch full under load; the idle-system
+        # fast path keeps the lone closed-loop command synchronous.
+        # Same one-knob precedence as the depth above; deadline 0 turns
+        # the gate off (legacy dispatch-on-anything)
+        self.ingest_deadline_ms = resolve_ingest_deadline_ms(
+            ingest_deadline_ms, config
+        )
+        self._batcher = AdaptiveIngestBatcher(
+            self.ingest_deadline_ms,
+            # the size target never exceeds what one release can carry:
+            # a full chain of full rounds
+            max_target=self.driver.batch_size
+            * resolve_serving_chain_max(serving_chain_max, config),
+            fixed_target=resolve_ingest_target(ingest_target, config),
+        )
+        # chained-by-default serving: every dispatch may fuse up to S
+        # rounds (PipelineCore.step_chained_pipelined; Newt runs them as
+        # ONE device program), with S auto-tuned from the measured
+        # per-round dispatch overhead vs in-dispatch time
+        self._chain_tuner = ChainAutoTuner(
+            resolve_serving_chain_max(serving_chain_max, config)
+        )
         self.dot_gen = AtomicIdGen(process_id)
         self.metrics_file = metrics_file
         self.metrics_interval_ms = metrics_interval_ms
@@ -1763,6 +1803,8 @@ class DeviceRuntime:
             "shed_submissions": self._submit_queue.sheds,
             # per-dispatch device counters (observability/device.py)
             **d.device_counters(),
+            # adaptive ingest batcher tallies (run/ingest.py)
+            **self._batcher.counters(),
             "jax_recompiles": recompile_count(),
             "jax_compile_ms": compile_ms(),
         }
@@ -1781,6 +1823,8 @@ class DeviceRuntime:
     _GAUGE_TALLIES = frozenset({
         "in_flight", "stable_watermark", "queued", "queued_hwm",
         "queue_capacity", "device_idle_frac", "device_pipeline_depth",
+        "dispatch_fill_frac", "serving_chain_len", "ingest_target",
+        "ingest_rate_per_s",
     })
 
     def telemetry_sample(self):
@@ -1860,6 +1904,9 @@ class DeviceRuntime:
                 self._submit_queue.capacity or 0,
                 self.retry_after_ms(),
             )
+        from time import monotonic
+
+        self._batcher.note_arrivals(monotonic() * 1000.0, 1)
         self._work.set()
 
     def drop_session(self, session: "_DeviceClientSession") -> None:
@@ -1893,18 +1940,29 @@ class DeviceRuntime:
     # --- the serving loop ---
 
     async def _driver_task(self) -> None:
+        from time import monotonic
+
         loop = asyncio.get_running_loop()
         driver = self.driver
         # dispatch/drain pipelining (DeviceDriver only): under saturation
         # round k+1's device dispatch overlaps round k's host emit loop
         can_pipeline = self.pipeline
+        batcher = self._batcher
+        tuner = self._chain_tuner
+        tracer = self.tracer
         idle_rounds = 0  # empty-input rounds yielding no results
         while True:
             if not self._submit_queue and can_pipeline and driver.has_outstanding:
                 # the queue went quiet with a round still in flight:
                 # retire it directly — its results must not strand, and
                 # dispatching a padding-only round just to drain it would
-                # waste a full device round
+                # waste a full device round.  A submission landing while
+                # flush_pipeline runs on the pool thread is safe: this
+                # task is the driver's only caller, so the flush retires
+                # each in-flight round exactly once and the next loop
+                # iteration re-evaluates the queue from scratch — the
+                # arrival simply waits one flush, it can never interleave
+                # a dispatch into the flushing pipeline
                 results = await loop.run_in_executor(
                     None, driver.flush_pipeline
                 )
@@ -1914,11 +1972,65 @@ class DeviceRuntime:
             if not self._submit_queue and driver.in_flight == 0:
                 self._work.clear()
                 await self._work.wait()
-            batch = []
-            for dot_cmd in driver.take_requeue():
-                batch.append(dot_cmd)
-            while self._submit_queue and len(batch) < driver.batch_size:
-                batch.append(self._submit_queue.popleft())
+            # adaptive ingest gate (run/ingest.py): hold a part-empty
+            # round while arrivals fill it toward the EWMA size target,
+            # for at most the deadline budget.  Requeued overflow is
+            # never held (it was admitted a round ago), nor are
+            # pending-buffer progress rounds (empty queue, in_flight>0).
+            # The idle-system fast path releases a lone closed-loop
+            # command immediately, so sync latency never regresses.
+            if (
+                self._submit_queue
+                and not driver.has_requeue
+                and batcher.deadline_ms > 0
+            ):
+                release, wait_ms = batcher.poll(
+                    monotonic() * 1000.0,
+                    len(self._submit_queue),
+                    idle_system=(
+                        driver.in_flight == 0 and not driver.has_outstanding
+                    ),
+                )
+                if not release:
+                    self._work.clear()
+                    # a submit that landed since the poll set _work
+                    # before the clear — the wait returns immediately
+                    try:
+                        await asyncio.wait_for(
+                            self._work.wait(), timeout=wait_ms / 1000.0
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+                    continue
+            # chained-by-default: assemble up to S rounds (the
+            # auto-tuned chain length) from requeue + the released queue
+            batches: List[List[Tuple[Dot, Command]]] = []
+            pending = driver.take_requeue()
+            released = 0
+            while (pending or self._submit_queue) and len(batches) < tuner.chain:
+                batch: List[Tuple[Dot, Command]] = []
+                while pending and len(batch) < driver.batch_size:
+                    batch.append(pending.pop(0))
+                while self._submit_queue and len(batch) < driver.batch_size:
+                    dot, cmd = self._submit_queue.popleft()
+                    if tracer.enabled:
+                        # batch release: payload->ingest is the queue +
+                        # batching wait (critpath's ingest-batching
+                        # bucket)
+                        tracer.span(
+                            "ingest", cmd.rifl, dot=dot, pid=self.process_id
+                        )
+                    batch.append((dot, cmd))
+                    released += 1
+                batches.append(batch)
+            if pending:
+                # overflow past S full rounds goes back to the requeue
+                # (next iteration dispatches it first)
+                driver._requeue[:0] = pending
+            if released:
+                batcher.note_release(monotonic() * 1000.0, released)
+            if not batches:
+                batches = [[]]  # pending-buffer progress round
             # pipelining pays one round of delivery lag, so engage it only
             # when another batch is already waiting (throughput regime);
             # a lone closed-loop command keeps the immediate sync round.
@@ -1928,9 +2040,28 @@ class DeviceRuntime:
                 driver.has_outstanding or len(self._submit_queue) > 0
             )
             # blocking device dispatch off the event loop: connections and
-            # result flushes stay live during the round
-            results = await loop.run_in_executor(
-                None, driver.step_pipelined if pipeline else driver.step, batch
+            # result flushes stay live during the round.  Chains route
+            # through the shared chained surface (one fused device
+            # program on Newt, S plain rounds elsewhere)
+            if len(batches) > 1:
+                step = (
+                    driver.step_chained_pipelined
+                    if pipeline else driver.step_chained
+                )
+                results = await loop.run_in_executor(None, step, batches)
+            else:
+                results = await loop.run_in_executor(
+                    None,
+                    driver.step_pipelined if pipeline else driver.step,
+                    batches[0],
+                )
+            # feed the chain auto-tuner the cumulative overlap counters
+            # (it rate-limits itself by dispatch count)
+            tuner.observe(
+                driver.dispatches,
+                driver.dispatch_wall_ms,
+                driver.device_counters()["device_busy_ms"],
+                driver.rounds,
             )
             self._deliver(results)
             self._publish_tallies()

@@ -1,0 +1,411 @@
+"""Adaptive ingest batching for the serving edge.
+
+The device kernels already amortize: one fused dispatch orders a whole
+4096-slot round in ~3 ms, and ``step_chained`` proves ~0.9M cmds/s
+in-dispatch.  End-to-end serving was ~25x slower because the serving
+loops dispatch the instant anything is queued — under open-loop load a
+round leaves with a handful of rows and the device round-trip is paid
+per trickle, not per batch.  This module is the accumulate-fuse-
+dispatch-lazily discipline of the GraphBLAS nonblocking-execution line
+(PAPERS.md) applied to that edge, shared by every serving surface
+(``DeviceRuntime._driver_task``, the process runner's executor pools,
+the sim's open-loop arrivals, and ``OrderingPool`` shard rounds):
+
+* :class:`AdaptiveIngestBatcher` — hold queued submissions until a
+  **size target** or a **deadline budget** fills.  The size target
+  tracks the recent queue-arrival rate (EWMA): the expected number of
+  arrivals inside one deadline window, so under saturation rounds go
+  out full and under a trickle the target collapses to 1 and nothing
+  waits.  The deadline bounds the latency a queued command can pay to
+  batching.  An **idle-system fast path** releases a lone closed-loop
+  command immediately — sync latency never regresses.
+* :class:`ChainAutoTuner` — pick S, the serving rounds fused per device
+  dispatch (``step_chained_pipelined``), from the measured per-round
+  host dispatch overhead vs in-dispatch device time (the PR 6 busy/span
+  counters): grow S while the dispatch round-trip still dominates a
+  round, shrink once it is amortized, clamp at
+  ``Config.serving_chain_max``.
+
+Knob resolution follows the ``serving_pipeline_depth`` one-knob rule
+(run/pipeline.py): explicit argument > ``Config`` field > env var >
+default — any spelling is the same knob, never three.
+
+Time is injected (float milliseconds): the run layer passes a monotonic
+wall clock, the sim its virtual clock — the batcher itself never reads
+a clock, which is what makes the sim wire-through deterministic
+(same-seed byte-identical traces with the batcher on).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, List, Optional, Sequence, Tuple
+
+ENV_INGEST_DEADLINE_MS = "FANTOCH_INGEST_DEADLINE_MS"
+ENV_INGEST_TARGET = "FANTOCH_INGEST_TARGET"
+ENV_SERVING_CHAIN_MAX = "FANTOCH_SERVING_CHAIN_MAX"
+
+# the default latency budget a queued command may pay to batching: small
+# against the ~68 ms remote dispatch round-trip the batch amortizes, and
+# against any cross-region commit, yet ~the device kernel time — so a
+# deadline-released round still carries most of a saturated window
+DEFAULT_INGEST_DEADLINE_MS = 2.0
+# chain-length ceiling for the auto-tuner: 8 rounds per dispatch already
+# cuts per-round dispatch overhead 8x while keeping result lag bounded
+DEFAULT_SERVING_CHAIN_MAX = 8
+
+
+def requested_ingest_deadline_ms(
+    explicit: Optional[float] = None, config: Any = None
+) -> Optional[float]:
+    """The explicitly requested ingest deadline budget, by precedence:
+    an explicit value, then ``Config.ingest_deadline_ms``, then the
+    ``FANTOCH_INGEST_DEADLINE_MS`` env var — or None when no channel
+    requested one (callers that stay legacy-immediate unless asked, like
+    the sim and the host executor pools, branch on this)."""
+    deadline = explicit
+    if deadline is None and config is not None:
+        deadline = getattr(config, "ingest_deadline_ms", None)
+    if deadline is None:
+        raw = os.environ.get(ENV_INGEST_DEADLINE_MS)
+        if raw:
+            deadline = float(raw)
+    return None if deadline is None else float(deadline)
+
+
+def resolve_ingest_deadline_ms(
+    explicit: Optional[float] = None, config: Any = None
+) -> float:
+    """:func:`requested_ingest_deadline_ms` with the default applied
+    (2 ms).  0 is a valid resolution: batching off, release immediately
+    (the legacy dispatch-on-anything behavior)."""
+    deadline = requested_ingest_deadline_ms(explicit, config)
+    if deadline is None:
+        deadline = DEFAULT_INGEST_DEADLINE_MS
+    if deadline < 0:
+        raise ValueError(f"ingest deadline must be >= 0 ms, got {deadline}")
+    return deadline
+
+
+def resolve_ingest_target(
+    explicit: Optional[int] = None, config: Any = None
+) -> Optional[int]:
+    """Fixed size-target override (explicit > ``Config.ingest_target`` >
+    ``FANTOCH_INGEST_TARGET`` env).  None means adaptive: the batcher
+    tracks the target from the EWMA arrival rate."""
+    target = explicit
+    if target is None and config is not None:
+        target = getattr(config, "ingest_target", None)
+    if target is None:
+        raw = os.environ.get(ENV_INGEST_TARGET)
+        if raw:
+            target = int(raw)
+    if target is None:
+        return None
+    target = int(target)
+    if target < 1:
+        raise ValueError(f"ingest target must be >= 1, got {target}")
+    return target
+
+
+def resolve_serving_chain_max(
+    explicit: Optional[int] = None, config: Any = None
+) -> int:
+    """Chain-length ceiling for the auto-tuner (explicit >
+    ``Config.serving_chain_max`` > ``FANTOCH_SERVING_CHAIN_MAX`` env >
+    8).  1 disables chaining: every dispatch carries one round."""
+    chain_max = explicit
+    if chain_max is None and config is not None:
+        chain_max = getattr(config, "serving_chain_max", None)
+    if chain_max is None:
+        raw = os.environ.get(ENV_SERVING_CHAIN_MAX)
+        if raw:
+            chain_max = int(raw)
+    if chain_max is None:
+        chain_max = DEFAULT_SERVING_CHAIN_MAX
+    chain_max = int(chain_max)
+    if chain_max < 1:
+        raise ValueError(f"serving chain max must be >= 1, got {chain_max}")
+    return chain_max
+
+
+class AdaptiveIngestBatcher:
+    """Release-gating for one serving queue: size target or deadline.
+
+    The caller owns the queue; the batcher only decides *when* to
+    release.  Protocol per iteration: ``note_arrivals(now_ms, n)`` as
+    submissions land, then ``poll(now_ms, queued, idle_system)`` —
+    ``(True, None)`` means release everything queued now,
+    ``(False, wait_ms)`` means hold for up to ``wait_ms`` more (or until
+    more arrivals make the size target), ``(False, None)`` means the
+    queue is empty.  After a release, ``note_release(now_ms, rows)``
+    closes the window and tallies the cause.
+
+    Release causes:
+
+    * **fast** — ``idle_system`` (nothing in flight anywhere): a lone
+      closed-loop command dispatches immediately, whatever the EWMA
+      says.  This is the sync-latency guarantee.
+    * **size** — ``queued >= target`` where ``target`` is the expected
+      arrivals per deadline window, ``ceil(ewma_rate * deadline)``
+      clamped to ``[1, max_target]`` (or the fixed ``--ingest-target``
+      override).  A cold EWMA targets 1, so batching only engages once
+      sustained load is *measured*.
+    * **deadline** — the oldest queued command has waited the full
+      budget.
+
+    A gap longer than ~8 deadline windows hard-resets the EWMA instead
+    of decaying it: an idle period ends the throughput regime, and the
+    first command after it must not inherit a stale high target.
+    """
+
+    __slots__ = (
+        "deadline_ms", "max_target", "fixed_target", "_alpha",
+        "_rate_per_ms", "_accum", "_last_arrival_ms", "_window_start",
+        "_cause", "arrivals", "releases", "released_rows",
+        "releases_fast", "releases_size", "releases_deadline",
+    )
+
+    def __init__(
+        self,
+        deadline_ms: float,
+        max_target: int,
+        fixed_target: Optional[int] = None,
+        alpha: float = 0.2,
+    ):
+        assert deadline_ms >= 0 and max_target >= 1
+        assert fixed_target is None or fixed_target >= 1
+        self.deadline_ms = float(deadline_ms)
+        self.max_target = int(max_target)
+        self.fixed_target = fixed_target
+        self._alpha = float(alpha)
+        self._rate_per_ms = 0.0  # EWMA arrivals per millisecond
+        self._accum = 0.0  # arrivals recorded at _last_arrival_ms
+        self._last_arrival_ms: Optional[float] = None
+        self._window_start: Optional[float] = None  # oldest unreleased wait
+        self._cause: Optional[str] = None
+        self.arrivals = 0
+        self.releases = 0
+        self.released_rows = 0
+        self.releases_fast = 0
+        self.releases_size = 0
+        self.releases_deadline = 0
+
+    def note_arrivals(self, now_ms: float, n: int = 1) -> None:
+        """Fold ``n`` submissions arriving at ``now_ms`` into the EWMA
+        and open the deadline window if it is not already open."""
+        if n <= 0:
+            return
+        self.arrivals += n
+        if self._window_start is None:
+            self._window_start = now_ms
+        last = self._last_arrival_ms
+        self._last_arrival_ms = now_ms
+        if last is None:
+            self._accum = float(n)
+            return
+        dt = now_ms - last
+        if dt <= 0.0:
+            self._accum += n
+            return
+        inst = self._accum / dt
+        self._accum = float(n)
+        idle_bound = max(self.deadline_ms, 0.125) * 8.0
+        if dt >= idle_bound:
+            # the throughput regime ended across the gap: snap, don't
+            # decay — a closed-loop client must see target 1 at once
+            self._rate_per_ms = inst
+        else:
+            self._rate_per_ms += self._alpha * (inst - self._rate_per_ms)
+
+    def rate_per_s(self) -> float:
+        return self._rate_per_ms * 1000.0
+
+    def target(self) -> int:
+        """The current size target (rows that trigger a release)."""
+        if self.fixed_target is not None:
+            return min(self.fixed_target, self.max_target)
+        if self.deadline_ms <= 0:
+            return 1
+        expected = math.ceil(self._rate_per_ms * self.deadline_ms)
+        return max(1, min(int(expected), self.max_target))
+
+    def poll(
+        self, now_ms: float, queued: int, idle_system: bool = False
+    ) -> Tuple[bool, Optional[float]]:
+        """``(release, wait_ms)`` for ``queued`` pending submissions at
+        ``now_ms``; ``idle_system`` is the fast-path witness (nothing in
+        flight downstream — the queued command is alone in the system)."""
+        if queued <= 0:
+            self._window_start = None
+            return (False, None)
+        if self._window_start is None:
+            # arrivals the caller never noted individually (e.g. drained
+            # from an inner queue): the window opens at first sight
+            self._window_start = now_ms
+        if self.deadline_ms <= 0:
+            self._cause = "size"
+            return (True, None)
+        if idle_system:
+            self._cause = "fast"
+            return (True, None)
+        if queued >= self.target():
+            self._cause = "size"
+            return (True, None)
+        waited = now_ms - self._window_start
+        if waited >= self.deadline_ms:
+            self._cause = "deadline"
+            return (True, None)
+        return (False, self.deadline_ms - waited)
+
+    def note_release(self, now_ms: float, rows: int) -> None:
+        """Tally one release of ``rows`` commands and close the window
+        (the next arrival or poll reopens it)."""
+        self.releases += 1
+        self.released_rows += rows
+        cause = self._cause or "size"
+        if cause == "fast":
+            self.releases_fast += 1
+        elif cause == "deadline":
+            self.releases_deadline += 1
+        else:
+            self.releases_size += 1
+        self._cause = None
+        self._window_start = None
+
+    def counters(self) -> dict:
+        """Tallies for the metrics snapshot (``ingest_target`` and
+        ``ingest_rate_per_s`` are gauges, the rest monotone)."""
+        return {
+            "ingest_arrivals": self.arrivals,
+            "ingest_releases": self.releases,
+            "ingest_released_rows": self.released_rows,
+            "ingest_releases_fast": self.releases_fast,
+            "ingest_releases_size": self.releases_size,
+            "ingest_releases_deadline": self.releases_deadline,
+            "ingest_target": self.target(),
+            "ingest_rate_per_s": round(self.rate_per_s(), 1),
+        }
+
+
+class ChainAutoTuner:
+    """Auto-tuned S for chained serving (``step_chained_pipelined``).
+
+    Starts at S=1 and adjusts from deltas of the shared PipelineCore
+    counters: per-round host dispatch overhead
+    (``dispatch_wall_ms / rounds``) vs per-round in-dispatch device time
+    (``busy_ms / rounds``).  While the dispatch call still costs more
+    than ``grow_frac`` of a round's device time, fusing more rounds per
+    dispatch keeps paying — S doubles (fast convergence from cold).
+    Once overhead falls under ``shrink_frac`` the chain shrinks by one
+    (slow decay: hysteresis between the two bands keeps S stable).
+    Clamped to ``[1, chain_max]``; observations under ``min_dispatches``
+    new dispatches are deferred so one jittery round cannot thrash S.
+    """
+
+    __slots__ = (
+        "chain", "chain_max", "grow_frac", "shrink_frac",
+        "min_dispatches", "adjustments", "_last",
+    )
+
+    def __init__(
+        self,
+        chain_max: int,
+        grow_frac: float = 0.25,
+        shrink_frac: float = 0.05,
+        min_dispatches: int = 8,
+    ):
+        assert chain_max >= 1
+        self.chain = 1
+        self.chain_max = int(chain_max)
+        self.grow_frac = float(grow_frac)
+        self.shrink_frac = float(shrink_frac)
+        self.min_dispatches = int(min_dispatches)
+        self.adjustments = 0
+        self._last: Optional[Tuple[float, float, float, float]] = None
+
+    def observe(
+        self,
+        dispatches: float,
+        dispatch_wall_ms: float,
+        busy_ms: float,
+        rounds: float,
+    ) -> int:
+        """Feed cumulative counters; returns the (possibly adjusted)
+        chain length.  Call as often as convenient — the tuner
+        rate-limits itself by dispatch count."""
+        if self._last is None:
+            self._last = (dispatches, dispatch_wall_ms, busy_ms, rounds)
+            return self.chain
+        d_disp = dispatches - self._last[0]
+        if d_disp < self.min_dispatches:
+            return self.chain
+        d_wall = dispatch_wall_ms - self._last[1]
+        d_busy = busy_ms - self._last[2]
+        d_rounds = rounds - self._last[3]
+        self._last = (dispatches, dispatch_wall_ms, busy_ms, rounds)
+        if d_rounds <= 0 or d_busy <= 0:
+            return self.chain
+        ratio = (d_wall / d_rounds) / (d_busy / d_rounds)
+        if ratio > self.grow_frac and self.chain < self.chain_max:
+            self.chain = min(self.chain * 2, self.chain_max)
+            self.adjustments += 1
+        elif ratio < self.shrink_frac and self.chain > 1:
+            self.chain -= 1
+            self.adjustments += 1
+        return self.chain
+
+
+def plan_ingest_releases(
+    arrival_ms: Sequence[float], batcher: AdaptiveIngestBatcher
+) -> List[Tuple[float, int, int]]:
+    """Replay a sorted arrival-time column through a batcher, returning
+    the release plan ``[(release_ms, start, end)]`` over half-open index
+    groups — the offline coalescing used by ``OrderingPool`` shard
+    rounds (and the unit tests' oracle for the online loops).  A
+    deadline that expires between two arrivals releases at the deadline
+    instant, without the later arrival; the tail releases at its
+    window's deadline."""
+    out: List[Tuple[float, int, int]] = []
+    start = 0
+    for i, t in enumerate(arrival_ms):
+        pending = i - start
+        if pending:
+            opened = batcher._window_start
+            deadline_at = (
+                None if opened is None or batcher.deadline_ms <= 0
+                else opened + batcher.deadline_ms
+            )
+            if deadline_at is not None and t >= deadline_at:
+                batcher.poll(deadline_at, pending)
+                # a deadline release by construction; the poll at the
+                # computed instant can land 1 ulp short of the budget
+                # (opened + d - opened < d in floats), so the cause is
+                # pinned rather than trusted to the comparison
+                batcher._cause = "deadline"
+                batcher.note_release(deadline_at, pending)
+                out.append((deadline_at, start, i))
+                start = i
+        batcher.note_arrivals(t, 1)
+        pending = i + 1 - start
+        release, _wait = batcher.poll(t, pending)
+        if release:
+            batcher.note_release(t, pending)
+            out.append((t, start, i + 1))
+            start = i + 1
+    n = len(arrival_ms)
+    if start < n:
+        opened = batcher._window_start
+        deadline_tail = opened is not None and batcher.deadline_ms > 0
+        t = (
+            opened + batcher.deadline_ms if deadline_tail
+            else arrival_ms[n - 1]
+        )
+        batcher.poll(t, n - start)
+        if deadline_tail:
+            # pinned for the same 1-ulp reason as the in-loop release
+            batcher._cause = "deadline"
+        batcher.note_release(t, n - start)
+        out.append((t, start, n))
+    return out

@@ -24,9 +24,11 @@ interpreter-start hang; see fantoch_tpu/hostenv.py).
 from __future__ import annotations
 
 import multiprocessing as mp
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from fantoch_tpu.run.ingest import AdaptiveIngestBatcher, plan_ingest_releases
 
 
 def _worker_main(conn, worker_index: int) -> None:
@@ -251,6 +253,48 @@ class OrderingPool:
         if feeder_error:
             raise RuntimeError("pool feeder failed") from feeder_error[0]
         return results
+
+    def run_shards_adaptive(
+        self,
+        key: np.ndarray,
+        src: np.ndarray,
+        seq: np.ndarray,
+        dep_rows: np.ndarray,
+        arrival_ms: Sequence[float],
+        batcher: AdaptiveIngestBatcher,
+        depth: int = 1,
+    ) -> Tuple[
+        List[Tuple[float, int, int]],
+        List[List[Tuple[np.ndarray, np.ndarray]]],
+    ]:
+        """Coalesce an arrival-stamped workload into ingest rounds and
+        run them through the pipelined pool: the adaptive batcher's
+        size-or-deadline policy (run/ingest.py) replayed offline over the
+        sorted ``arrival_ms`` column decides the round boundaries, each
+        round is key-sharded and shipped, and up to ``depth`` rounds stay
+        in flight.  Returns ``(release plan, per-round orders)`` with the
+        plan's half-open ``(release_ms, start, end)`` groups indexing the
+        input rows.
+
+        A dependency row that falls in an *earlier* round is dropped
+        (-1): each pipe is FIFO, so by the time a round reaches its
+        worker every earlier round's rows are already ordered there —
+        submission order satisfies the cross-round edge by construction,
+        exactly as an earlier dispatch satisfies a dependency in the
+        device serving loop."""
+        plan = plan_ingest_releases(arrival_ms, batcher)
+        workloads = []
+        for _release_ms, start, end in plan:
+            dep = dep_rows[start:end]
+            in_round = dep >= start
+            dep = np.where(in_round, dep - start, -1)
+            workloads.append(
+                self.shard_columns(
+                    key[start:end], src[start:end], seq[start:end],
+                    dep, self.workers,
+                )
+            )
+        return plan, self.run_shards_pipelined(workloads, depth=depth)
 
     def close(self) -> None:
         for conn in self._conns:

@@ -205,6 +205,7 @@ class PipelineCore:
         self.drain_wall_ms = 0.0
         self.fetch_wall_ms = 0.0  # blocking device->host wait inside drains
         self.pipelined_rounds = 0  # rounds dispatched over an in-flight one
+        self.chain_len = 1  # rounds the latest dispatch carried (gauge)
         # the in-flight ring: dispatched-but-undrained round tokens, FIFO
         self._inflight: Deque[Any] = deque()
         # rounds dispatched and not yet entered drain — during a drain
@@ -258,6 +259,28 @@ class PipelineCore:
         results = self.flush_pipeline()
         tok = self._dispatch_tracked(batch)
         results.extend(self._drain_tracked(tok))
+        return results
+
+    def step_chained(self, batches) -> List[Any]:
+        """S rounds per call, synchronous.  The base implementation runs
+        them as S plain steps (exact same results, no fusion); drivers
+        with a fused multi-round program (NewtDeviceDriver) override to
+        pay ONE dispatch round-trip for the whole chain — the serving
+        loop routes through this surface unconditionally so chaining is
+        a driver capability, not a call-site branch."""
+        results = self.flush_pipeline()
+        for batch in batches:
+            results.extend(self.step(batch))
+        return results
+
+    def step_chained_pipelined(self, batches) -> List[Any]:
+        """S rounds per call composed with the depth-K pipeline.  Base
+        implementation: S consecutive ``step_pipelined`` rounds (the
+        chain is a grouping hint, not a semantic change); fused drivers
+        override to dispatch the chain as one token."""
+        results: List[Any] = []
+        for batch in batches:
+            results.extend(self.step_pipelined(batch))
         return results
 
     def step_pipelined(self, batch) -> List[Any]:
@@ -318,6 +341,7 @@ class PipelineCore:
         self.dispatches += 1
         self.dispatched_rows += rows
         self.dispatched_capacity += capacity
+        self.chain_len = max(1, rounds)
         self._undrained += 1
         self._undrained_rounds += rounds
         if self._busy_t0 is None:
@@ -390,10 +414,19 @@ class PipelineCore:
         idle_frac = (
             max(0.0, 1.0 - busy_ms / span_ms) if span_ms > 0 else 0.0
         )
+        # occupancy: rows actually carried / rows the dispatched rounds
+        # could carry — the adaptive ingest batcher's whole job is
+        # driving this toward 1 under load
+        fill_frac = (
+            self.dispatched_rows / self.dispatched_capacity
+            if self.dispatched_capacity > 0 else 0.0
+        )
         return {
             "device_dispatches": self.dispatches,
             "device_dispatched_rows": self.dispatched_rows,
             "device_batch_capacity": self.dispatched_capacity,
+            "dispatch_fill_frac": round(fill_frac, 4),
+            "serving_chain_len": self.chain_len,
             "device_dispatch_ms": round(self.dispatch_wall_ms, 3),
             "device_drain_ms": round(self.drain_wall_ms, 3),
             "device_fetch_ms": round(self.fetch_wall_ms, 3),

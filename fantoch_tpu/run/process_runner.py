@@ -71,6 +71,11 @@ from fantoch_tpu.run.prelude import (
     Unregister,
     WarnQueue,
 )
+from fantoch_tpu.run.ingest import (
+    AdaptiveIngestBatcher,
+    requested_ingest_deadline_ms,
+    resolve_ingest_target,
+)
 from fantoch_tpu.run.routing import worker_dot_index_shift
 from fantoch_tpu.run.rw import Rw, connect_with_retry, deserialize, serialize
 from fantoch_tpu.utils import key_hash, logger
@@ -1573,8 +1578,24 @@ class ProcessRuntime:
                         _, d2, c2 = queue.get_nowait()
                         pairs.append((d2, c2))
                     submit_batch(pairs, self.time)
+                    if self.tracer.enabled:
+                        # ingest = the worker handing the command to the
+                        # protocol; no batching gate on this runner's
+                        # submit edge yet, so payload->ingest is ~0 (the
+                        # canonical chain stays complete either way).
+                        # Stamped AFTER submit: the protocol's payload
+                        # stamp runs inside it, and payload <= ingest
+                        # must hold on the wall clock
+                        for _d, c in pairs:
+                            self.tracer.span(
+                                "ingest", c.rifl, pid=self.process.id
+                            )
                 else:
                     process.submit(dot, cmd, self.time)
+                    if self.tracer.enabled:
+                        self.tracer.span(
+                            "ingest", cmd.rifl, pid=self.process.id
+                        )
             elif kind == "event":
                 process.handle_event(item[1], self.time)
             elif kind == "executed":
@@ -1688,6 +1709,22 @@ class ProcessRuntime:
     async def _executor_task(self, position: int) -> None:
         queue = self.executor_pool.queue(position)
         executor = self.executors[position]
+        # adaptive ingest (run/ingest.py), opt-in: only when a channel
+        # requested a positive deadline (Config.ingest_deadline_ms or the
+        # env knob) does the drain hold for a fuller batch — unset keeps
+        # the legacy drain-whatever-is-queued behavior bit-for-bit
+        from time import monotonic
+
+        deadline = requested_ingest_deadline_ms(None, self.config)
+        batcher: Optional[AdaptiveIngestBatcher] = None
+        if deadline is not None and deadline > 0:
+            batcher = AdaptiveIngestBatcher(
+                deadline,
+                # no device round bound on a host executor drain; 1024
+                # caps a hold at the batched-resolver sweet spot
+                max_target=1024,
+                fixed_target=resolve_ingest_target(None, self.config),
+            )
         while True:
             # drain the whole queue: batch-oriented executors (the batched
             # graph resolver) amortize one device round-trip over the drain
@@ -1697,6 +1734,26 @@ class ProcessRuntime:
                     infos.append(queue.get_nowait())
                 except asyncio.QueueEmpty:
                     break
+            if batcher is not None:
+                now = monotonic() * 1000.0
+                batcher.note_arrivals(now, len(infos))
+                seen = len(infos)
+                while True:
+                    release, wait_ms = batcher.poll(now, len(infos))
+                    if release or wait_ms is None:
+                        break
+                    # hold for the remaining budget, then sweep whatever
+                    # landed; a size-target fill releases on the re-poll
+                    await asyncio.sleep(wait_ms / 1000.0)
+                    while True:
+                        try:
+                            infos.append(queue.get_nowait())
+                        except asyncio.QueueEmpty:
+                            break
+                    now = monotonic() * 1000.0
+                    batcher.note_arrivals(now, len(infos) - seen)
+                    seen = len(infos)
+                batcher.note_release(now, len(infos))
             if self.execution_logger is not None:
                 self.execution_logger.log(infos)
             executor.handle_batch(infos, self.time)

@@ -11,6 +11,7 @@ Optional adversity: symmetric distances, and random message reordering
 from __future__ import annotations
 
 import copy
+import math
 import random
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
@@ -26,6 +27,11 @@ from fantoch_tpu.errors import FaultToleranceError, SimStalledError
 from fantoch_tpu.executor.monitor import ExecutionOrderMonitor
 from fantoch_tpu.observability.tracer import NOOP_TRACER, Tracer, edge_dot
 from fantoch_tpu.protocol.base import Protocol, ToForward, ToSend
+from fantoch_tpu.run.ingest import (
+    AdaptiveIngestBatcher,
+    requested_ingest_deadline_ms,
+    resolve_ingest_target,
+)
 from fantoch_tpu.sim.faults import DEFER, DELIVER, DROP, FaultPlan, Nemesis, NemesisMark
 from fantoch_tpu.sim.schedule import Schedule
 from fantoch_tpu.sim.simulation import Simulation
@@ -83,6 +89,19 @@ class OpenLoopArrival:
     self-throttle and can never push the system past saturation."""
 
     client_id: ClientId
+
+
+@dataclass
+class IngestRelease:
+    """Deadline tick of one process's adaptive ingest buffer
+    (run/ingest.py wired into the sim): when it fires, the buffered
+    submissions release toward the protocol unless a size-triggered
+    release already emptied the buffer — then the tick re-polls and
+    either stands down or rearms for the freshly opened window.  Riding
+    the schedule keeps the batcher on virtual time: same seed, same
+    release instants, byte-identical traces."""
+
+    process_id: ProcessId
 
 
 @dataclass
@@ -209,6 +228,19 @@ class Runner:
         self._client_replies = 0
         self._submit_counts: Dict[ProcessId, int] = {}
         self._client_latency = Histogram()
+        # adaptive ingest batching (run/ingest.py), opt-in: engages only
+        # when a channel *requested* a deadline (Config field or env) and
+        # it is positive — 0 and unset both mean the legacy
+        # submit-immediately path, so the existing sim matrix is
+        # bit-for-bit unchanged.  One batcher + buffer per process, all
+        # on the virtual clock.
+        deadline = requested_ingest_deadline_ms(None, config)
+        self._ingest_deadline_ms = (
+            deadline if deadline is not None and deadline > 0 else None
+        )
+        self._ingest_batchers: Dict[ProcessId, AdaptiveIngestBatcher] = {}
+        self._ingest_buffers: Dict[ProcessId, List[Command]] = {}
+        self._ingest_tick_armed: Dict[ProcessId, bool] = {}
 
         # a single shard in simulation
         shard_id = 0
@@ -424,6 +456,8 @@ class Runner:
                 self._handle_send_to_proc(action.from_, action.from_shard_id, action.to, action.msg)
             elif isinstance(action, OpenLoopArrival):
                 self._handle_open_loop_arrival(action.client_id)
+            elif isinstance(action, IngestRelease):
+                self._handle_ingest_release(action.process_id)
             elif isinstance(action, PeerDownNotification):
                 self._handle_peer_down_notification(action.dead)
             elif isinstance(action, SendToClient):
@@ -709,7 +743,90 @@ class Runner:
             self._tracer.edge("r", "Submit", 0, process_id, 0, rifl=cmd.rifl)
         process, _, pending = self._simulation.get_process(process_id)
         pending.wait_for(cmd)
-        process.submit(None, cmd, self._simulation.time)
+        if self._ingest_deadline_ms is None:
+            process.submit(None, cmd, self._simulation.time)
+            if self._tracer.enabled:
+                # no batching gate: ingest coincides with the protocol's
+                # payload stamp (a zero-width payload->ingest segment),
+                # keeping the canonical stage chain complete
+                self._tracer.span("ingest", cmd.rifl, pid=process_id)
+            self._send_to_processes_and_executors(process_id)
+            return
+        # adaptive ingest plane: the coordinator owns the payload the
+        # moment it arrives — stamped here so the hold until release is
+        # the payload->ingest segment, attributed to batching instead of
+        # hidden in a merged wait (this runner stamp precedes the
+        # protocol's own payload stamp at submit, so it is the first
+        # coordinator observation and wins canonical selection)
+        if self._tracer.enabled:
+            self._tracer.span("payload", cmd.rifl, pid=process_id)
+        batcher = self._ingest_batchers.get(process_id)
+        if batcher is None:
+            batcher = AdaptiveIngestBatcher(
+                self._ingest_deadline_ms,
+                # a full protocol round has no device capacity bound here;
+                # 1024 caps a release at the batched-executor sweet spot
+                max_target=1024,
+                fixed_target=resolve_ingest_target(None, self._config),
+            )
+            self._ingest_batchers[process_id] = batcher
+        self._ingest_buffers.setdefault(process_id, []).append(cmd)
+        batcher.note_arrivals(float(self._simulation.time.millis()), 1)
+        self._ingest_poll(process_id)
+
+    def _ingest_poll(self, process_id: ProcessId) -> None:
+        """Release the process's ingest buffer if the batcher says so,
+        else arm (at most) one deadline tick for the open window."""
+        buf = self._ingest_buffers.get(process_id)
+        if not buf:
+            return
+        batcher = self._ingest_batchers[process_id]
+        release, wait_ms = batcher.poll(
+            float(self._simulation.time.millis()), len(buf)
+        )
+        if release:
+            self._ingest_release(process_id)
+        elif wait_ms is not None and not self._ingest_tick_armed.get(process_id):
+            self._ingest_tick_armed[process_id] = True
+            self._schedule.schedule(
+                self._simulation.time,
+                # schedule granularity is whole virtual ms; never 0 so
+                # the tick cannot livelock the loop at one instant
+                max(1, math.ceil(wait_ms)),
+                IngestRelease(process_id),
+            )
+
+    def _handle_ingest_release(self, process_id: ProcessId) -> None:
+        self._ingest_tick_armed[process_id] = False
+        if self._nemesis is not None and self._nemesis.is_dead(
+            process_id, self._simulation.time.millis()
+        ):
+            # buffered-at-the-crash submissions evaporate like any other
+            # in-flight input (the durable image excludes them); a
+            # restart-deferred SubmitToProc re-buffers after the restart
+            self._ingest_buffers[process_id] = []
+            return
+        # a size-triggered release may have emptied (and new arrivals
+        # partially refilled) the buffer since this tick was armed:
+        # re-poll so a freshly opened window keeps its full deadline
+        self._ingest_poll(process_id)
+
+    def _ingest_release(self, process_id: ProcessId) -> None:
+        buf = self._ingest_buffers.get(process_id)
+        if not buf:
+            return
+        self._ingest_buffers[process_id] = []
+        self._ingest_batchers[process_id].note_release(
+            float(self._simulation.time.millis()), len(buf)
+        )
+        process, _, _ = self._simulation.get_process(process_id)
+        tracer = self._tracer
+        for cmd in buf:
+            if tracer.enabled:
+                tracer.span("ingest", cmd.rifl, pid=process_id)
+            process.submit(None, cmd, self._simulation.time)
+        # one drain for the whole release: the executor sees the round's
+        # infos as a batch, which is the throughput point of batching
         self._send_to_processes_and_executors(process_id)
 
     def _handle_send_to_proc(

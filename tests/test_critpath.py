@@ -468,7 +468,9 @@ def test_diff_stages_tolerates_wall_jitter_and_catches_structure(tmp_path):
             ev["t"] += 900_000
         bumped.append(ev)
     verdict = diff_stages(read_trace(path_a), bumped)
-    assert any("payload->path" in line for line in verdict["mismatches"])
+    # "ingest" sits between payload and path in the canonical chain, so the
+    # inflated segment is the ingest->path hop
+    assert any("ingest->path" in line for line in verdict["mismatches"])
     # the CLI spelling agrees
     from fantoch_tpu.bin import obs
 

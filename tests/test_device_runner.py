@@ -1609,3 +1609,98 @@ def test_runtime_pipeline_engages_on_backlog():
     monitor = driver.store.monitor
     seen = [r for key in monitor.keys() for r in monitor.get_order(key)]
     assert len(seen) == len(set(seen)) == 24
+
+
+def test_quiet_flush_vs_new_arrival_race():
+    """r16 audit fix regression: under depth K>1 the quiet-flush path
+    (queue went empty with rounds still in flight) retires each
+    in-flight round exactly once even as fresh submissions keep landing
+    mid-flush on the event loop — no stranded results, no double
+    delivery, no dispatch interleaved into the flushing pipeline."""
+    from fantoch_tpu.run.device_runner import DeviceRuntime
+    from fantoch_tpu.run.harness import free_port
+
+    async def go():
+        config = Config(3, 1, shard_count=1, serving_pipeline_depth=2)
+        runtime = DeviceRuntime(
+            config,
+            ("127.0.0.1", free_port()),
+            batch_size=8,
+            key_buckets=64,
+            monitor_execution_order=True,
+        )
+        # two full rounds land before the driver task first runs: both
+        # dispatch pipelined, then the queue is quiet with rounds in
+        # flight and the loop takes the quiet-flush branch...
+        for i in range(16):
+            cmd = Command.from_single(
+                Rifl(9, i + 1), 0, f"k{i % 3}", KVOp.put(str(i))
+            )
+            runtime.submit(runtime.dot_gen.next_id(), cmd)
+        await runtime.start()
+        # ...while fresh arrivals race it from the event-loop side
+        for i in range(16, 40):
+            await asyncio.sleep(0.002)
+            cmd = Command.from_single(
+                Rifl(9, i + 1), 0, f"k{i % 3}", KVOp.put(str(i))
+            )
+            runtime.submit(runtime.dot_gen.next_id(), cmd)
+        # generous bound: the first dispatch pays the driver's XLA
+        # compile, ~18 s on the older jaxlib pins
+        for _ in range(1500):
+            if runtime.failure is not None:
+                raise runtime.failure
+            if (
+                runtime.driver.executed >= 40
+                and not runtime.driver.has_outstanding
+            ):
+                break
+            await asyncio.sleep(0.02)
+        await runtime.stop()
+        return runtime
+
+    runtime = asyncio.run(go())
+    driver = runtime.driver
+    assert driver.executed == 40
+    assert driver.in_flight == 0 and not driver.has_outstanding
+    # exactly-once execution across flush/dispatch interleavings
+    monitor = driver.store.monitor
+    seen = [r for key in monitor.keys() for r in monitor.get_order(key)]
+    assert len(seen) == len(set(seen)) == 40
+
+
+def test_lone_command_fast_path_releases_immediately():
+    """The idle-system fast path (run/ingest.py): a lone closed-loop
+    command on an idle runtime releases without sitting out the ingest
+    deadline.  The deadline here is far longer than the wait loop, so a
+    missing fast path fails the test by timeout, not by a timing
+    margin; the batcher's cause tally pins the path taken."""
+    from fantoch_tpu.run.device_runner import DeviceRuntime
+    from fantoch_tpu.run.harness import free_port
+
+    async def go():
+        config = Config(3, 1, shard_count=1, ingest_deadline_ms=300_000.0)
+        runtime = DeviceRuntime(
+            config,
+            ("127.0.0.1", free_port()),
+            batch_size=8,
+            key_buckets=64,
+        )
+        cmd = Command.from_single(Rifl(9, 1), 0, "k0", KVOp.put("v"))
+        runtime.submit(runtime.dot_gen.next_id(), cmd)
+        await runtime.start()
+        # ~30 s (covers the first-dispatch XLA compile): far under the
+        # 300 s deadline a missing fast path would sit out
+        for _ in range(1500):
+            if runtime.failure is not None:
+                raise runtime.failure
+            if runtime.driver.executed >= 1:
+                break
+            await asyncio.sleep(0.02)
+        await runtime.stop()
+        return runtime
+
+    runtime = asyncio.run(go())
+    assert runtime.driver.executed == 1
+    assert runtime._batcher.releases_fast >= 1
+    assert runtime._batcher.releases_deadline == 0

@@ -174,6 +174,271 @@ def test_ingest_ring_cycles_and_resets():
     assert (key0b == -1).all() and (src0b == 0).all()
 
 
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_step_chained_parity_with_unbatched(depth):
+    """The generic chained surfaces (base PipelineCore: S grouped
+    rounds, no fusion) are bit-for-bit the unbatched loop — same results
+    in the same order at every depth, chains only a grouping hint."""
+    rounds = [[f"r{i}a", f"r{i}b", f"r{i}c"] for i in range(12)]
+    groups = [rounds[i * 3 : (i + 1) * 3] for i in range(4)]
+
+    plain = _FakeDriver()
+    plain.pipeline_depth = depth
+    expect = [r for b in rounds for r in plain.step_pipelined(b)]
+    expect += plain.flush_pipeline()
+
+    chained = _FakeDriver()
+    chained.pipeline_depth = depth
+    got = [r for g in groups for r in chained.step_chained_pipelined(g)]
+    got += chained.flush_pipeline()
+    assert got == expect
+    assert chained.dispatches == plain.dispatches == 12
+
+    sync = _FakeDriver()
+    got_sync = [r for g in groups for r in sync.step_chained(g)]
+    assert got_sync == expect
+    assert not sync.has_outstanding
+
+
+def test_ingest_knob_precedence(monkeypatch):
+    """The three r16 knobs follow the one-knob rule: explicit > Config
+    field > env var > default, any spelling the same knob."""
+    from fantoch_tpu.run.ingest import (
+        DEFAULT_INGEST_DEADLINE_MS,
+        DEFAULT_SERVING_CHAIN_MAX,
+        ENV_INGEST_DEADLINE_MS,
+        ENV_INGEST_TARGET,
+        ENV_SERVING_CHAIN_MAX,
+        requested_ingest_deadline_ms,
+        resolve_ingest_deadline_ms,
+        resolve_ingest_target,
+        resolve_serving_chain_max,
+    )
+
+    for var in (ENV_INGEST_DEADLINE_MS, ENV_INGEST_TARGET,
+                ENV_SERVING_CHAIN_MAX):
+        monkeypatch.delenv(var, raising=False)
+
+    # no channel set: requested is None (opt-in surfaces stay legacy),
+    # resolved falls to the defaults
+    assert requested_ingest_deadline_ms() is None
+    assert resolve_ingest_deadline_ms() == DEFAULT_INGEST_DEADLINE_MS
+    assert resolve_ingest_target() is None
+    assert resolve_serving_chain_max() == DEFAULT_SERVING_CHAIN_MAX
+
+    monkeypatch.setenv(ENV_INGEST_DEADLINE_MS, "7.5")
+    monkeypatch.setenv(ENV_INGEST_TARGET, "32")
+    monkeypatch.setenv(ENV_SERVING_CHAIN_MAX, "4")
+    assert requested_ingest_deadline_ms() == 7.5
+    assert resolve_ingest_target() == 32
+    assert resolve_serving_chain_max() == 4
+
+    class Cfg:
+        ingest_deadline_ms = 3.0
+        ingest_target = 16
+        serving_chain_max = 2
+
+    # config beats env; explicit beats config
+    assert requested_ingest_deadline_ms(None, Cfg()) == 3.0
+    assert requested_ingest_deadline_ms(1.0, Cfg()) == 1.0
+    assert resolve_ingest_target(None, Cfg()) == 16
+    assert resolve_ingest_target(8, Cfg()) == 8
+    assert resolve_serving_chain_max(None, Cfg()) == 2
+    assert resolve_serving_chain_max(6, Cfg()) == 6
+
+    # 0 is a valid deadline resolution (batching off), negatives are not
+    assert resolve_ingest_deadline_ms(0.0) == 0.0
+    with pytest.raises(ValueError):
+        resolve_ingest_deadline_ms(-1.0)
+    with pytest.raises(ValueError):
+        resolve_ingest_target(0)
+    with pytest.raises(ValueError):
+        resolve_serving_chain_max(0)
+
+
+def test_config_ingest_knobs_validate():
+    from fantoch_tpu.core import Config
+
+    cfg = Config(3, 1, ingest_deadline_ms=1.5, ingest_target=64,
+                 serving_chain_max=4)
+    assert cfg.ingest_deadline_ms == 1.5
+    assert cfg.ingest_target == 64
+    assert cfg.serving_chain_max == 4
+    with pytest.raises(ValueError):
+        Config(3, 1, ingest_deadline_ms=-0.5)
+    with pytest.raises(ValueError):
+        Config(3, 1, ingest_target=0)
+    with pytest.raises(ValueError):
+        Config(3, 1, serving_chain_max=0)
+
+
+def test_batcher_release_causes():
+    """The three release causes: fast (idle system, lone command), size
+    (queued >= EWMA target), deadline (budget exhausted)."""
+    from fantoch_tpu.run.ingest import AdaptiveIngestBatcher
+
+    b = AdaptiveIngestBatcher(deadline_ms=2.0, max_target=1024)
+
+    # lone closed-loop command on an idle system: immediate release
+    b.note_arrivals(0.0, 1)
+    release, wait = b.poll(0.0, 1, idle_system=True)
+    assert release and wait is None
+    b.note_release(0.0, 1)
+    assert b.releases_fast == 1
+
+    # cold EWMA: target 1, so even a busy system releases a lone command
+    assert b.target() == 1
+    b.note_arrivals(10.0, 1)
+    release, _ = b.poll(10.0, 1)
+    assert release
+    b.note_release(10.0, 1)
+    assert b.releases_size == 1
+
+    # sustained 100/ms raises the target; the backlog itself goes out
+    # by size
+    t = 20.0
+    for _ in range(50):
+        t += 0.1
+        b.note_arrivals(t, 10)
+    assert b.target() > 1
+    release, _ = b.poll(t, 500)
+    assert release
+    b.note_release(t, 500)
+    assert b.releases_size == 2
+
+    # a fresh below-target window holds with the remaining budget; the
+    # full budget forces a deadline release
+    t += 0.1
+    b.note_arrivals(t, 1)
+    release, wait = b.poll(t, 1)
+    assert not release and 0 < wait <= 2.0
+    release, wait = b.poll(t + 2.0, 1)
+    assert release
+    b.note_release(t + 2.0, 1)
+    assert b.releases_deadline == 1
+
+    c = b.counters()
+    assert c["ingest_releases"] == 4
+    assert c["ingest_arrivals"] == 2 + 500 + 1
+    assert (
+        c["ingest_releases_fast"] + c["ingest_releases_size"]
+        + c["ingest_releases_deadline"] == c["ingest_releases"]
+    )
+
+
+def test_batcher_ewma_target_and_hard_reset():
+    """The size target tracks expected arrivals per deadline window
+    (EWMA rate x deadline, clamped), and an idle gap SNAPS the rate
+    down instead of decaying it — the first command after idle must not
+    inherit a stale high target."""
+    from fantoch_tpu.run.ingest import AdaptiveIngestBatcher
+
+    b = AdaptiveIngestBatcher(deadline_ms=2.0, max_target=256)
+    t = 0.0
+    for _ in range(200):
+        t += 0.1
+        b.note_arrivals(t, 10)  # 100/ms sustained
+    # converged: ~100/ms * 2ms = 200 rows
+    assert 150 <= b.target() <= 256
+    assert b.rate_per_s() == pytest.approx(100_000.0, rel=0.15)
+
+    # a gap past ~8 deadline windows ends the regime: the single
+    # arrival after it sees target 1 at once
+    b.note_arrivals(t + 1000.0, 1)
+    assert b.target() == 1
+
+    # fixed_target pins the knob regardless of the EWMA
+    fixed = AdaptiveIngestBatcher(2.0, max_target=256, fixed_target=32)
+    for i in range(100):
+        fixed.note_arrivals(i * 0.1, 10)
+    assert fixed.target() == 32
+
+    # deadline 0 = batching off: always release, target 1
+    off = AdaptiveIngestBatcher(0.0, max_target=256)
+    off.note_arrivals(0.0, 5)
+    assert off.target() == 1
+    release, _ = off.poll(0.0, 5)
+    assert release
+
+
+def test_chain_autotuner_convergence():
+    """Under a synthetic fixed-overhead driver (O ms host overhead per
+    dispatch, C ms device time per round) the tuner doubles S while the
+    per-round overhead ratio O/(S*C) exceeds grow_frac, then holds —
+    and the [shrink_frac, grow_frac] hysteresis band keeps S stable."""
+    from fantoch_tpu.run.ingest import ChainAutoTuner
+
+    O, C = 1.0, 0.5  # ratio at S: (O/S)/C = 2/S
+    tuner = ChainAutoTuner(chain_max=8)
+    counters = [0.0, 0.0, 0.0, 0.0]  # dispatches, wall, busy, rounds
+
+    def feed(n_dispatches):
+        S = tuner.chain
+        counters[0] += n_dispatches
+        counters[1] += n_dispatches * O
+        counters[2] += n_dispatches * S * C
+        counters[3] += n_dispatches * S
+        return tuner.observe(*counters)
+
+    assert feed(8) == 1  # first observation only seeds the baseline
+    seen = [feed(8) for _ in range(6)]
+    # S: 1 -> 2 (ratio 2.0) -> 4 (1.0) -> 8 (0.5) -> stays (0.25 not >)
+    assert seen == [2, 4, 8, 8, 8, 8]
+    assert tuner.adjustments == 3
+
+    # overhead collapses far under shrink_frac: S decays one at a time
+    # (and an observation under min_dispatches new dispatches is
+    # deferred — it folds into the next qualifying delta)
+    O = 0.01
+    before = tuner.chain
+    assert feed(3) == before
+    assert feed(8) == 7
+    assert feed(8) == 6
+
+    # hysteresis: a ratio inside [shrink, grow] leaves S alone
+    O = 6 * C * 0.1  # ratio 0.1 at S=6
+    assert feed(8) == 6
+    assert feed(8) == 6
+
+
+def test_plan_ingest_releases_oracle():
+    """The offline replay (OrderingPool's coalescer and the online
+    loops' oracle): releases partition the arrival column, a deadline
+    expiring between two arrivals releases at the deadline instant
+    WITHOUT the later arrival, and the tail releases at its window's
+    deadline."""
+    from fantoch_tpu.run.ingest import (
+        AdaptiveIngestBatcher,
+        plan_ingest_releases,
+    )
+
+    # trickle: each arrival 10ms apart, deadline 2ms — the cold/reset
+    # EWMA targets 1, so every lone command releases at its own arrival
+    # instant (batching never engages without measured sustained load)
+    b = AdaptiveIngestBatcher(2.0, max_target=64)
+    arrivals = [0.0, 10.0, 20.0]
+    plan = plan_ingest_releases(arrivals, b)
+    assert plan == [(0.0, 0, 1), (10.0, 1, 2), (20.0, 2, 3)]
+    assert b.releases == 3 and b.released_rows == 3
+
+    # a fixed target groups a dense burst into size releases plus a
+    # deadline tail
+    b2 = AdaptiveIngestBatcher(2.0, max_target=64, fixed_target=4)
+    dense = [i * 0.1 for i in range(10)]
+    plan2 = plan_ingest_releases(dense, b2)
+    starts = [s for _t, s, _e in plan2]
+    ends = [e for _t, _s, e in plan2]
+    assert starts == [0] + ends[:-1] and ends[-1] == 10  # partition
+    assert plan2[0] == (pytest.approx(0.3), 0, 4)
+    assert plan2[1] == (pytest.approx(0.7), 4, 8)
+    # tail: 2 rows < target, released at the window's deadline
+    assert plan2[2] == (pytest.approx(0.8 + 2.0), 8, 10)
+    assert b2.releases_size == 2 and b2.releases_deadline == 1
+
+    # empty column: empty plan
+    assert plan_ingest_releases([], AdaptiveIngestBatcher(2.0, 64)) == []
+
+
 def test_ingest_ring_slot_never_reused_while_in_flight():
     """The driver contract: with PipelineCore._staging (the production
     ring sizing: slots = depth + 1), the staging columns of any round
