@@ -1,7 +1,7 @@
 # Test/bench entry points (the reference pins quality with Makefile:3-7 —
 # fmt + clippy + `cargo test` under a quickcheck budget; here the suite +
 # dryrun + bench are the equivalent gates).
-.PHONY: test test-fast test-chaos test-recovery test-restart test-overload test-fuzz test-device-stripped dryrun bench bench-smoke trace-smoke critpath-smoke overload-smoke fuzz-smoke telemetry-smoke
+.PHONY: test test-fast test-chaos test-recovery test-restart test-overload test-fuzz test-devicefault test-device-stripped dryrun bench bench-smoke trace-smoke critpath-smoke overload-smoke fuzz-smoke failover-smoke telemetry-smoke
 
 test:
 	python -m pytest tests/ -x -q
@@ -109,3 +109,18 @@ telemetry-smoke:
 # bench/trace/overload-smoke
 fuzz-smoke:
 	python scripts/fuzz_smoke.py
+
+# the accelerator fault-tolerance slice: DeviceFault nemesis (hang /
+# raise / corrupt) against all three device planes, dispatch deadlines,
+# shadow-check corruption attribution, host-twin failover bit-for-bit
+# parity, exactly-once pipeline replay, and online rebuild + cutback
+test-devicefault:
+	python -m pytest tests/ -x -q -m devicefault
+
+# accelerator failover gate: a seeded device hang against a live plane
+# — the typed DeviceFailedError is observed, host-twin goodput stays
+# nonzero while degraded, cutback costs exactly one counted re-upload,
+# and the faulted run's output is bit-for-bit the fault-free run's —
+# the per-push CI slice runs this next to fuzz-smoke
+failover-smoke:
+	python scripts/failover_smoke.py

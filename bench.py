@@ -364,6 +364,13 @@ def child_main(mode: str) -> None:
     except Exception as exc:  # noqa: BLE001
         print(f"# overload bench failed: {exc!r}", file=sys.stderr)
         record["overload_error"] = repr(exc)[:200]
+    try:
+        # accelerator failover drill (fault plane, r17): rides both
+        # children — the table plane + injector are backend-agnostic
+        record.update(bench_failover())
+    except Exception as exc:  # noqa: BLE001
+        print(f"# failover bench failed: {exc!r}", file=sys.stderr)
+        record["failover_error"] = repr(exc)[:200]
     # scaling row last and chip only: CPU sorts at 4M would eat the
     # fallback child's whole budget, and a cold 4M compile must not
     # crowd out the rows above on first run after a kernel change
@@ -2039,6 +2046,109 @@ def bench_overload(
     return out
 
 
+def bench_failover(
+    keys: int = 256, rounds: int = 30, votes_per_round: int = 2048,
+    fault_at: int = 10, down: int = 8,
+) -> dict:
+    """Accelerator failover drill (round 17): the device votes-table
+    plane (executor/table_plane.py) under a deterministic injected
+    dispatch hang (sim/device_faults.py).  Three headline walls:
+    ``failover_time_to_failover_ms`` — the faulted dispatch's wall, i.e.
+    detection (typed DeviceFailedError) plus the first batch served from
+    the host twin; ``failover_degraded_cmds_per_s`` — goodput through
+    the twin while the fault window is open; and
+    ``failover_time_to_cutback_ms`` — the rebuild dispatch's wall (twin
+    fold + the ONE counted resident re-upload).  Self-checking: the
+    faulted run's final frontiers must be bit-for-bit the fault-free
+    run's, the plane must end healthy, and cutback must cost exactly
+    one upload."""
+    import numpy as np
+
+    from fantoch_tpu.core import Config
+    from fantoch_tpu.executor.table_plane import DeviceTablePlane
+    from fantoch_tpu.sim.device_faults import DeviceFault, DeviceFaultInjector
+
+    n = 3
+    rng = np.random.default_rng(17)
+    batches = []
+    for _ in range(rounds):
+        vk = rng.integers(0, keys, size=votes_per_round).astype(np.int64)
+        vb = rng.integers(1, n + 1, size=votes_per_round).astype(np.int64)
+        vs = rng.integers(1, 200, size=votes_per_round).astype(np.int64)
+        ve = (vs + rng.integers(0, 6, size=votes_per_round)).astype(np.int64)
+        batches.append((vk, vb, vs, ve))
+
+    def build(injector):
+        plane = DeviceTablePlane(n, stability_threshold=2, key_buckets=keys)
+        for k in range(keys):
+            plane.bucket(f"b{k}")
+        plane.configure_faults(Config(n, 1), process_id=1)
+        if injector is not None:
+            plane.attach_injector(injector)
+        return plane
+
+    # fault-free reference (also warms the kernel compiles)
+    reference = build(None)
+    for vk, vb, vs, ve in batches:
+        reference.commit_votes(vk, vb, vs, ve)
+
+    fault = DeviceFault(
+        plane="table", kind="hang",
+        at_dispatch=fault_at, down_dispatches=down,
+    )
+    plane = build(DeviceFaultInjector((fault,), process_id=1))
+    failover_ms = cutback_ms = None
+    healthy_walls = []
+    degraded_wall_ms = 0.0
+    degraded_cmds = 0
+    uploads_before_rebuild = None
+    for index, (vk, vb, vs, ve) in enumerate(batches):
+        before = plane.fault_counters()
+        if before["rebuilds"] == 0 and before["failovers"] > 0:
+            uploads_before_rebuild = plane.resident_uploads
+        t0 = time.perf_counter()
+        plane.commit_votes(vk, vb, vs, ve)
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        after = plane.fault_counters()
+        if failover_ms is None and after["failovers"] > before["failovers"]:
+            failover_ms = wall_ms
+        if cutback_ms is None and after["rebuilds"] > before["rebuilds"]:
+            cutback_ms = wall_ms
+        if after["failovers"] > 0 and after["rebuilds"] == 0:
+            degraded_wall_ms += wall_ms
+            degraded_cmds += votes_per_round
+        elif after["failovers"] == 0 and 1 < index < fault_at:
+            healthy_walls.append(wall_ms)
+
+    counters = plane.fault_counters()
+    assert failover_ms is not None and cutback_ms is not None, counters
+    assert counters["failovers"] == 1 and counters["rebuilds"] == 1, counters
+    assert counters["health"] == 0, counters  # cut back to healthy
+    cutback_uploads = plane.resident_uploads - uploads_before_rebuild
+    assert cutback_uploads == 1, (
+        f"cutback must cost exactly one counted upload, got {cutback_uploads}"
+    )
+    assert np.array_equal(plane.frontiers(), reference.frontiers()), (
+        "host-twin serving diverged from the fault-free run"
+    )
+    healthy_ms = sum(healthy_walls) / max(1, len(healthy_walls))
+    return {
+        "failover_definition": (
+            "table plane, injected dispatch hang at dispatch "
+            f"{fault_at} for {down} dispatches, {votes_per_round} votes x "
+            f"{rounds} rounds over {keys} keys (r17)"
+        ),
+        "failover_time_to_failover_ms": round(failover_ms, 3),
+        "failover_time_to_cutback_ms": round(cutback_ms, 3),
+        "failover_degraded_cmds_per_s": int(
+            degraded_cmds / max(1e-9, degraded_wall_ms / 1000.0)
+        ),
+        "failover_healthy_round_ms": round(healthy_ms, 3),
+        "failover_degraded_wall_ms": round(degraded_wall_ms, 3),
+        "failover_cutback_uploads": cutback_uploads,
+    }
+
+
 # --- perf-regression gate (bench.py --regress) ---
 #
 # Compare a fresh bench row against the BENCH trajectory with per-key
@@ -2069,6 +2179,10 @@ REGRESS_BANDS = (
     # same rationale (pred_plane_serving_* additionally rides asyncio
     # boot noise and is covered by the pred_ band above)
     ("graph_", 2.5),
+    # failover drill walls time one-shot detection/rebuild events (a
+    # single dispatch each) on shared CI cores — scheduling noise, not
+    # the plane, dominates the spread
+    ("failover_", 3.0),
     ("", 1.5),
 )
 
@@ -2085,6 +2199,7 @@ DEFINITION_STAMPS = (
     # r13 re-measured the fallback via chained slope (the one-shot
     # executor-seam wall moved to general_fallback_seam_ms)
     ("general_fallback_", "general_fallback_definition"),
+    ("failover_", "failover_definition"),
 )
 
 
@@ -2282,6 +2397,13 @@ def smoke_main() -> None:
         )
     )
     out.update(bench_serving_batched(total=8192, batch=256, chain=3))
+    # accelerator failover drill, CPU-sized: the row's own asserts cover
+    # exactly-one cutback upload + bit-for-bit twin parity; the smoke
+    # additionally refuses a degraded plane that served nothing
+    out.update(
+        bench_failover(keys=64, rounds=16, votes_per_round=256,
+                       fault_at=5, down=4)
+    )
     out["jax_recompiles"] = recompile_count()
     out["jax_compile_ms"] = compile_ms()
     assert out["table_cmds_per_s_arrays"] > 1_000, out
@@ -2297,6 +2419,8 @@ def smoke_main() -> None:
     assert out["pred_plane_cmds_per_s"] > 1_000, out
     assert out["pred_plane_dispatches"] > 0, out
     assert out["pred_plane_residual_rows"] > 0, out  # seam exercised
+    assert out["failover_degraded_cmds_per_s"] > 0, out
+    assert out["failover_cutback_uploads"] == 1, out
     # one lazy materialization + one counted re-upload per compaction
     # or live capacity/width grow, never an upload per batch (the
     # residency invariant)

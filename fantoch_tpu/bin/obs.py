@@ -137,6 +137,22 @@ def _print_planes(counters) -> int:
         cap = counters.get(f"{prefix}_slot_capacity")
         if cap is not None:
             parts.append(f"capacity {int(cap)}")
+        # accelerator fault tolerance (executor/device_plane.py): the
+        # max-folded health gauge plus failover/rebuild tallies and the
+        # wall spent serving from the host twin
+        health = counters.get(f"{prefix}_health")
+        if health is not None:
+            from fantoch_tpu.executor.device_plane import HEALTH_NAMES
+
+            parts.append(f"health {HEALTH_NAMES[int(health)]}")
+        failovers = int(counters.get(f"{prefix}_failovers", 0))
+        rebuilds = int(counters.get(f"{prefix}_rebuilds", 0))
+        if failovers or rebuilds:
+            parts.append(f"failovers {failovers}")
+            parts.append(f"rebuilds {rebuilds}")
+            parts.append(
+                f"degraded {counters.get(f'{prefix}_degraded_ms', 0.0):.1f}ms"
+            )
         print(f"{label}: " + "  ".join(parts))
         shown += 1
     return shown

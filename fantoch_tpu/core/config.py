@@ -122,6 +122,26 @@ class Config:
     # XLA sorts; accelerator backends always use the device kernels),
     # True/False force it on/off (tests pin the XLA path with False)
     host_native_resolver: Optional[bool] = None
+    # accelerator fault tolerance (executor/device_plane.py): per-dispatch
+    # deadline in wall ms — a fused dispatch (including its blocking
+    # drain) overrunning it raises a typed DeviceFailedError inside the
+    # plane, which fails over to the host twin and rebuilds.  Setting it
+    # ARMS the fault plane: the plane starts keeping the host-twin
+    # dispatch log failover replays from.  None (default) = unarmed, the
+    # plane trusts the device unconditionally (zero overhead)
+    device_dispatch_timeout_ms: Optional[float] = None
+    # sampled shadow-check rate in [0, 1]: with probability p per
+    # dispatch (seeded, deterministic) the plane replays the dispatch's
+    # inputs through the same kernel on host-owned twin state and
+    # compares the resident post-state bit-for-bit — silent corruption
+    # of a resident buffer surfaces as a typed DeviceCorruptionError
+    # naming the first diverging row, instead of as a cross-replica
+    # digest mismatch minutes later.  1.0 catches corruption on the very
+    # dispatch it happens (the fuzz/test setting); production rates
+    # trade detection latency for dispatch cost, with the PR 9
+    # execution-digest auditor as the backstop.  > 0 arms the fault
+    # plane like the deadline does
+    plane_shadow_rate: float = 0.0
     # garbage-collection interval; None disables GC
     gc_interval_ms: Optional[int] = None
     # leader process (leader-based protocols, i.e. FPaxos)
@@ -310,6 +330,20 @@ class Config:
             raise ValueError(
                 f"graph_kernel_threshold = {self.graph_kernel_threshold} "
                 "must be >= 1"
+            )
+        if (
+            self.device_dispatch_timeout_ms is not None
+            and self.device_dispatch_timeout_ms <= 0
+        ):
+            raise ValueError(
+                f"device_dispatch_timeout_ms = "
+                f"{self.device_dispatch_timeout_ms} must be > 0 "
+                "(None = deadline off)"
+            )
+        if not (0.0 <= self.plane_shadow_rate <= 1.0):
+            raise ValueError(
+                f"plane_shadow_rate = {self.plane_shadow_rate} must be "
+                "in [0, 1]"
             )
         if self.device_table_plane and self.newt_clock_bump_interval_ms is not None:
             # real-time clock bumps vote wall-clock micros, which overflow

@@ -161,6 +161,83 @@ class DivergenceError(FaultToleranceError):
         )
 
 
+class DeviceFailedError(FaultToleranceError):
+    """A device plane's fused dispatch hung past the per-dispatch
+    deadline (``Config.device_dispatch_timeout_ms``), or the XLA runtime
+    raised out of it — the accelerator itself failed, not the protocol.
+
+    The owning plane catches this internally: it transitions its health
+    state machine (healthy -> suspect -> failed), serves the batch from
+    the host twin, and rebuilds the resident state when the device
+    recovers — so the executor API above it never observes the error,
+    only the ``plane_failovers``/``degraded_ms`` counters do.
+
+    ``kind`` names the detection channel: ``"hang"`` (an injected
+    never-completing dispatch), ``"timeout"`` (a real dispatch that
+    overran the deadline, measured at the blocking drain), or
+    ``"raise"`` (the XLA runtime raised)."""
+
+    def __init__(
+        self,
+        plane: str,
+        process_id: Optional[int],
+        kind: str,
+        dispatch: int,
+        timeout_ms: Optional[float] = None,
+        cause: Optional[BaseException] = None,
+    ):
+        self.plane = plane
+        self.process_id = process_id
+        self.kind = kind
+        self.dispatch = dispatch
+        self.timeout_ms = timeout_ms
+        self.cause = cause
+        deadline = (
+            f" (deadline {timeout_ms:.0f}ms)" if timeout_ms is not None else ""
+        )
+        cause_note = f": {cause!r}" if cause is not None else ""
+        super().__init__(
+            f"p{process_id}: {plane} plane dispatch #{dispatch} failed "
+            f"[{kind}]{deadline}{cause_note}"
+        )
+
+
+class DeviceCorruptionError(FaultToleranceError):
+    """A device plane's resident state silently diverged from the host
+    twin — caught by the sampled shadow-check (``Config.plane_shadow_rate``
+    replays a dispatch's inputs through the same kernel on host-owned
+    state and compares bit-for-bit), named with the first diverging
+    device row so the corruption is attributable like the digest
+    auditor's first-diverging key.
+
+    Like :class:`DeviceFailedError` this is caught inside the plane:
+    the poisoned resident buffers are dropped, the batch is served from
+    the (provably clean) twin, and a rebuild re-uploads the twin state.
+    """
+
+    def __init__(
+        self,
+        plane: str,
+        process_id: Optional[int],
+        dispatch: int,
+        array_index: int,
+        row: int,
+        key=None,
+    ):
+        self.plane = plane
+        self.process_id = process_id
+        self.dispatch = dispatch
+        self.array_index = array_index
+        self.row = row
+        self.key = key
+        key_note = f" (key {key!r})" if key is not None else ""
+        super().__init__(
+            f"p{process_id}: {plane} plane resident state diverged from the "
+            f"host twin at dispatch #{dispatch}: state array "
+            f"{array_index}, first diverging row {row}{key_note}"
+        )
+
+
 class SimStalledError(FaultToleranceError):
     """The simulation passed its virtual-time bound with clients still
     waiting — the whole-system analog of :class:`StalledExecutionError`

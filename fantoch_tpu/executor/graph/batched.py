@@ -245,6 +245,9 @@ class BatchedDependencyGraph(DependencyGraph):
                     self._metrics,
                     structure_threshold=self._structure_threshold,
                 )
+                # arm the fault plane (deadline + shadow-check) from the
+                # config; runners re-seed and attach injectors on top
+                self._plane.configure_faults(config, process_id=process_id)
             # opt-in array drain (VERDICT r3 item 3): consumers that don't
             # need Command objects (array-native planes, benches) read the
             # execution order as (src, seq) columns and skip the 250k-object

@@ -63,6 +63,7 @@ from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.ids import Dot, ProcessId, ShardId
 from fantoch_tpu.core.metrics import Metrics
 from fantoch_tpu.core.timing import SysTime
+from fantoch_tpu.errors import DeviceCorruptionError, DeviceFailedError
 from fantoch_tpu.executor.base import ExecutorMetricsKind
 from fantoch_tpu.executor.device_plane import DevicePlane, next_pow2 as _pow2
 from fantoch_tpu.executor.table_plane import ClockOverflowError
@@ -117,6 +118,8 @@ class DeviceGraphPlane(DevicePlane):
         "_emitted",
         "pipeline_depth",
     )
+
+    plane_name = "graph"
 
     def __init__(
         self,
@@ -391,18 +394,16 @@ class DeviceGraphPlane(DevicePlane):
         if U == 0 and P == 0:
             return
         out, mode, t0, ucap = self._dispatch_raw(
-            slots, u_deps, u_key, u_src, u_seq, patches, ()
+            slots, u_deps, u_key, u_src, u_seq, patches, (), time=time
         )
         self._inflight.append((mode, out, U, ucap, P, time, t0))
         while len(self._inflight) > max(self.pipeline_depth - 1, 0):
             self._drain_one()
 
-    def _dispatch_raw(self, slots, u_deps, u_key, u_src, u_seq, patches, marks):
-        import jax.numpy as jnp
-
-        from fantoch_tpu.ops.graph_resolve import resolve_graph_plane_step
-
-        self._materialize()
+    def _pad_columns(self, slots, u_deps, u_key, u_src, u_seq, patches, marks):
+        """The padded kernel columns for one dispatch — shared by the
+        resident dispatch and the host twin's stuck follow-ups, so both
+        feed the kernel bit-identical inputs."""
         cap = self._cap
         U, P, E = len(slots), len(patches), len(marks)
         # pad to pow2 FLOORS so the common serving shapes share compiled
@@ -430,23 +431,121 @@ class DeviceGraphPlane(DevicePlane):
         e_row = np.full(ecap, cap, dtype=np.int32)  # pad -> dropped
         if E:
             e_row[:E] = marks
+        return (u_row, u_dep, u_k, u_s, u_q, p_row, p_col, p_val, e_row), ucap
+
+    def _dispatch_raw(
+        self, slots, u_deps, u_key, u_src, u_seq, patches, marks, time=None
+    ):
+        import jax.numpy as jnp
+
+        from fantoch_tpu.ops.graph_resolve import resolve_graph_plane_step
+
+        cols, ucap = self._pad_columns(
+            slots, u_deps, u_key, u_src, u_seq, patches, marks
+        )
         mode = self._mode()
+        # every dispatch — primary AND stuck follow-up — is logged with
+        # its mode, so the twin replays the identical kernel sequence and
+        # tracks the resident state bit-for-bit (armed-only no-op)
+        self._twin_note((mode, time) + cols)
         t0 = _time.perf_counter()
+        if self.degraded:
+            # served from the twin at this round's drain (out=None token)
+            return None, mode, t0, ucap
+        try:
+            fault = self._fault_check_pre()
+            self._materialize()
+            out = resolve_graph_plane_step(
+                *self._resident,
+                *(jnp.asarray(c) for c in cols),
+                mode=mode,
+            )
+            self._resident = tuple(out[:6])
+            if fault is not None:
+                self._poison_resident(fault)
+            return out, mode, t0, ucap
+        except (DeviceFailedError, DeviceCorruptionError) as exc:
+            # dispatch-time failure (injected hang/raise): the round — and
+            # every in-flight round, whose device results are no longer
+            # trusted — is served from the twin at its drain
+            self._device_failure(exc)
+            self._fail_inflight()
+            self._note_degraded(t0)
+            return None, mode, t0, ucap
+
+    def _fail_inflight(self) -> None:
+        """Invalidate the device results of every in-flight round after a
+        failure: their rows replay from the twin log (emission dedup makes
+        the replay exactly-once), the drains just count them."""
+        if self._inflight:
+            self._inflight = deque(
+                (m, None, u, uc, p, tm, tt)
+                for (m, _o, u, uc, p, tm, tt) in self._inflight
+            )
+
+    # --- host twin (accelerator fault tolerance; DevicePlane base) ---
+
+    def _twin_replay(self, state, entry):
+        """One logged dispatch replayed statelessly through the SAME
+        kernel (fresh ``jnp.array`` uploads — the donation-safety rule),
+        with host emission performed HERE: emission dedup
+        (``_exec_host``) makes rounds the device already drained replay
+        as no-ops, while in-flight rounds at pipeline depth K emit
+        exactly once, in round order — the depth-K exactly-once replay.
+        Degraded serving has no device follow-ups, so stuck residues
+        resolve on the twin itself (healthy folds see them already
+        emitted and skip — the device's own follow-up was logged)."""
+        mode, time = entry[0], entry[1]
+        state, fetched = self._twin_step(state, mode, entry[2:])
+        order, newly, stuck, leader = fetched
+        self._emit(order[newly[order]], leader, time)
+        while stuck is not None:
+            stuck_slots = np.nonzero(stuck & ~self._exec_host)[0]
+            if not len(stuck_slots):
+                break
+            closed = self._close_stuck(stuck_slots)
+            if not len(closed):
+                break
+            self._stuck_oracle(closed, time)
+            empty = np.empty(0, dtype=np.int64)
+            mcols, _ucap = self._pad_columns(
+                empty, np.empty((0, self._width), np.int32),
+                empty.astype(np.int32), empty.astype(np.int32),
+                empty.astype(np.int32), (), closed,
+            )
+            state, fetched = self._twin_step(state, self._mode(), mcols)
+            order, newly, stuck, leader = fetched
+            self._emit(order[newly[order]], leader, time)
+        return state, fetched
+
+    def _twin_step(self, state, mode, cols):
+        """One kernel run on host-owned twin state; returns the new
+        state and the per-mode result columns, all host numpy."""
+        import jax
+        import jax.numpy as jnp
+
+        from fantoch_tpu.ops.graph_resolve import resolve_graph_plane_step
+
         out = resolve_graph_plane_step(
-            *self._resident,
-            jnp.asarray(u_row),
-            jnp.asarray(u_dep),
-            jnp.asarray(u_k),
-            jnp.asarray(u_s),
-            jnp.asarray(u_q),
-            jnp.asarray(p_row),
-            jnp.asarray(p_col),
-            jnp.asarray(p_val),
-            jnp.asarray(e_row),
+            *(jnp.array(a) for a in state),
+            *(jnp.asarray(c) for c in cols),
             mode=mode,
         )
-        self._resident = tuple(out[:6])
-        return out, mode, t0, ucap
+        new_state = tuple(np.asarray(a) for a in jax.device_get(out[:6]))
+        if mode == "keyed":
+            order, newly = jax.device_get((out.order, out.newly))
+            fetched = (np.asarray(order), np.asarray(newly), None, None)
+        else:
+            order, newly, stuck, leader = jax.device_get(
+                (out.order, out.newly, out.stuck, out.leader)
+            )
+            fetched = (
+                np.asarray(order),
+                np.asarray(newly),
+                np.asarray(stuck),
+                np.asarray(leader) if mode == "general" else None,
+            )
+        return new_state, fetched
 
     def _fetch_result(self, mode: str, out):
         """One blocking transfer for a dispatch's small result columns
@@ -464,27 +563,67 @@ class DeviceGraphPlane(DevicePlane):
 
     def _drain_one(self) -> None:
         mode, out, U, ucap, P, time, t0 = self._inflight.popleft()
-        order, newly, stuck, leader = self._fetch_result(mode, out)
-        self._emit(order[newly[order]], leader, time)
-        # stuck residues (general modes: 3+-cycles the device pass cannot
-        # collapse) finish on the host Tarjan oracle; a follow-up
-        # dispatch marks them executed on device and resolves dependents
-        while stuck is not None:
-            stuck_slots = np.nonzero(stuck & ~self._exec_host)[0]
-            if not len(stuck_slots):
-                break
-            closed = self._close_stuck(stuck_slots)
-            if not len(closed):
-                break  # budget misclassification: wait for a later feed
-            self._stuck_oracle(closed, time)
-            empty = np.empty(0, dtype=np.int64)
-            out2, mode2, _t0b, _ucap2 = self._dispatch_raw(
-                empty, np.empty((0, self._width), np.int32),
-                empty.astype(np.int32), empty.astype(np.int32),
-                empty.astype(np.int32), (), closed,
-            )
-            order, newly, stuck, leader = self._fetch_result(mode2, out2)
-            self._emit(order[newly[order]], leader, time)
+        if out is None:
+            # the round is (or already was, by an earlier fold) served
+            # bit-for-bit from the twin — emission dedup makes rounds an
+            # earlier fold replayed pure no-ops here
+            self._twin_fold()
+            self._note_degraded(t0)
+        else:
+            try:
+                order, newly, stuck, leader = self._fetch_result(mode, out)
+                self._check_deadline(t0)
+                live_stuck = stuck is not None and bool(
+                    (stuck & ~self._exec_host).any()
+                )
+                if (
+                    not self._inflight
+                    and not live_stuck
+                    and self._shadow_sampled()
+                ):
+                    # serve the round from the twin FIRST (the device
+                    # emission below dedups to a no-op), then verify the
+                    # device state against it — a corrupt ``newly`` never
+                    # reaches the host bookkeeping.  Rounds with live
+                    # stuck residues defer to the next sampled round (the
+                    # follow-up dispatch below would race the compare).
+                    self._twin_fold()
+                    self._shadow_compare(self._fetch_state())
+                self._emit(order[newly[order]], leader, time)
+                # stuck residues (general modes: 3+-cycles the device
+                # pass cannot collapse) finish on the host Tarjan oracle;
+                # a follow-up dispatch marks them executed on device and
+                # resolves dependents
+                while stuck is not None:
+                    stuck_slots = np.nonzero(stuck & ~self._exec_host)[0]
+                    if not len(stuck_slots):
+                        break
+                    closed = self._close_stuck(stuck_slots)
+                    if not len(closed):
+                        break  # budget misclassification: wait for a later feed
+                    self._stuck_oracle(closed, time)
+                    empty = np.empty(0, dtype=np.int64)
+                    out2, mode2, _t0b, _ucap2 = self._dispatch_raw(
+                        empty, np.empty((0, self._width), np.int32),
+                        empty.astype(np.int32), empty.astype(np.int32),
+                        empty.astype(np.int32), (), closed, time=time,
+                    )
+                    if out2 is None:
+                        # the follow-up itself hit the injected fault:
+                        # its marks entry replays through the twin
+                        self._twin_fold()
+                        break
+                    order, newly, stuck, leader = self._fetch_result(
+                        mode2, out2
+                    )
+                    self._emit(order[newly[order]], leader, time)
+            except (DeviceFailedError, DeviceCorruptionError) as exc:
+                # serve this round — and everything still logged — from
+                # the twin; in-flight device results are dropped
+                self._twin_fold()
+                self._device_failure(exc)
+                self._fail_inflight()
+                self._note_degraded(t0)
         self._count_dispatch(
             t0,
             new_rows=U,
@@ -492,6 +631,9 @@ class DeviceGraphPlane(DevicePlane):
             patched_cells=P,
             residual_rows=self.pending_count,
         )
+        # cutback: once the fault window closed, ONE counted re-upload of
+        # the folded twin state (no-op unless failed)
+        self._maybe_rebuild()
 
     def _emit(self, slots: np.ndarray, leader, time) -> None:
         """Host bookkeeping for one drain's executed slots, in emission
@@ -629,6 +771,11 @@ class DeviceGraphPlane(DevicePlane):
         if width <= self._width:
             return
         self.drain_all()
+        if self._fault_armed and self._twin_log:
+            # entries logged at the old width cannot replay against the
+            # widened twin — fold them out first (emission dedup makes
+            # the healthy-path replays no-ops)
+            self._twin_fold()
         new_w = _pow2(width)
         deps = np.full((self._cap, new_w), TERMINAL, dtype=np.int32)
         deps[:, : self._width] = self._slot_deps
@@ -639,6 +786,8 @@ class DeviceGraphPlane(DevicePlane):
             self._upload(state)
         elif self._host_mirror is not None:
             self._host_mirror = state
+        if self._twin_state is not None:
+            self._twin_resync(state)
         self.grows += 1
 
     def _compact(self) -> None:
@@ -647,6 +796,10 @@ class DeviceGraphPlane(DevicePlane):
         a LUT, references to executed rows fold to TERMINAL, one counted
         re-upload."""
         assert not self._inflight
+        if self._fault_armed and self._twin_log:
+            # entries describe the pre-compaction slot layout: fold them
+            # before the renumbering (healthy replays dedup to no-ops)
+            self._twin_fold()
         cap = self._cap
         old = np.fromiter(self._slot_of.values(), np.int64, len(self._slot_of))
         old.sort()  # stable re-pack keeps slot order deterministic
@@ -690,10 +843,16 @@ class DeviceGraphPlane(DevicePlane):
         ]
         self._next_slot = P
         state = self._rebuild_state()
-        if self._resident is not None or self._host_mirror is None:
+        if self.degraded:
+            # no upload while failed over: the compacted window becomes
+            # the new twin state; cutback re-uploads it (ONE upload)
+            pass
+        elif self._resident is not None or self._host_mirror is None:
             self._upload(state)
         else:
             self._host_mirror = state
+        if self._twin_state is not None:
+            self._twin_resync(state)
         self.stats["compactions"] += 1
 
     def _rebuild_state(self) -> Tuple[np.ndarray, ...]:
@@ -838,4 +997,8 @@ class DeviceGraphPlane(DevicePlane):
 
     def __getstate__(self):
         self.drain_all()
+        if self._fault_armed and self._twin_log:
+            # fold so the pickled log is empty (entries hold live time
+            # handles); post-drain replays dedup to no-op emissions
+            self._twin_fold()
         return super().__getstate__()

@@ -523,6 +523,9 @@ class PredecessorsExecutor(Executor):
             from fantoch_tpu.executor.pred_plane import DevicePredPlane
 
             self._graph = DevicePredPlane(process_id, config)
+            # arm the fault plane (deadline + shadow-check) from config;
+            # the runners re-seed and attach injectors/listeners on top
+            self._graph.configure_faults(config, process_id=process_id)
         else:
             self._graph = PredecessorsGraph(process_id, config)
         self._store = KVStore(
@@ -637,7 +640,17 @@ class PredecessorsExecutor(Executor):
             "pred_plane_resident_uploads": plane.resident_uploads,
             # configuration gauge (max-folded, not summed)
             "pred_plane_slot_capacity": plane._cap,
+            # accelerator fault tolerance: failover/rebuild tallies,
+            # degraded wall, and the health gauge (max-folded)
+            **{
+                f"pred_plane_{k}": v
+                for k, v in plane.fault_counters().items()
+            },
         }
+
+    def device_planes(self):
+        plane = self._plane
+        return (plane,) if plane is not None else ()
 
     def _drain(self) -> None:
         while True:

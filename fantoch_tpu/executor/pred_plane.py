@@ -51,6 +51,7 @@ from fantoch_tpu.core.ids import Dot, ProcessId, all_process_ids
 from fantoch_tpu.core.metrics import Metrics
 from fantoch_tpu.core.timing import SysTime
 from fantoch_tpu.executor.base import ExecutorMetricsKind
+from fantoch_tpu.errors import DeviceCorruptionError, DeviceFailedError
 from fantoch_tpu.executor.device_plane import DevicePlane, next_pow2 as _pow2
 from fantoch_tpu.executor.table_plane import ClockOverflowError
 from fantoch_tpu.protocol.common.pred_clocks import Clock
@@ -97,6 +98,8 @@ class DevicePredPlane(DevicePlane):
         "_metrics",
         "_to_execute",
     )
+
+    plane_name = "pred"
 
     def __init__(
         self,
@@ -378,10 +381,17 @@ class DevicePredPlane(DevicePlane):
 
         from fantoch_tpu.ops.graph_resolve import TERMINAL
 
-        self._materialize()
-        # only the dep matrix needs the device round trip: timestamps and
-        # occupancy rebuild from the host-mirrored slot columns
-        deps = np.asarray(jax.device_get(self._resident[0]))
+        if self._fault_armed and self._twin_state is not None:
+            # the twin is the trusted copy (a resident bit-flip the
+            # shadow-check has not sampled yet must never survive a
+            # compaction); while failed over it is also the ONLY copy
+            self._twin_fold()
+            deps = self._twin_state[0]
+        else:
+            self._materialize()
+            # only the dep matrix needs the device round trip: timestamps
+            # and occupancy rebuild from the host-mirrored slot columns
+            deps = np.asarray(jax.device_get(self._resident[0]))
         old = np.fromiter(self._slot_of.values(), np.int64, len(self._slot_of))
         old.sort()  # stable re-pack keeps slot order deterministic
         P = len(old)
@@ -398,7 +408,14 @@ class DevicePredPlane(DevicePlane):
         state[2][:P] = self._slot_csrc[old]
         state[3][:P] = True  # occ
         # executed stays False: only pending rows survive a compaction
-        self._upload(tuple(state))
+        if self.degraded:
+            # no upload while failed over — the compacted window becomes
+            # the new twin state; cutback re-uploads it (ONE upload)
+            self._twin_resync(tuple(state))
+        else:
+            self._upload(tuple(state))
+            self._host_mirror = None
+            self._twin_resync(tuple(state))
         # host columns follow the same re-pack
         self._slot_src[:P] = self._slot_src[old]
         self._slot_seq[:P] = self._slot_seq[old]
@@ -427,7 +444,16 @@ class DevicePredPlane(DevicePlane):
         if width <= self._width:
             return
         new_w = _pow2(width)
-        if self._resident is not None:
+        if self._fault_armed and self._twin_state is not None:
+            # widen from the folded twin (provably clean; the only copy
+            # while failed over) — mirrors the base _grow armed path
+            self._twin_fold()
+            had_resident = self._resident is not None
+            self._width = new_w
+            self._twin_state = self._pad_state(self._twin_state, self._cap)
+            if had_resident:
+                self._upload(self._twin_state)
+        elif self._resident is not None:
             state = self._fetch_state()
             self._width = new_w
             self._upload(self._pad_state(state, self._cap))
@@ -435,16 +461,38 @@ class DevicePredPlane(DevicePlane):
             self._width = new_w
         self.grows += 1
 
-    def _dispatch_columns(self, slots, cseq, rows, patches, time, csrc=None) -> None:
+    # --- host twin (accelerator fault tolerance; DevicePlane base) ---
+
+    def _twin_replay(self, state, entry):
+        """One logged window step replayed statelessly: the SAME fused
+        kernel over fresh XLA-owned copies of the twin state
+        (``jnp.array`` — the donation-safety rule) plus the exact padded
+        install/patch columns the resident dispatch consumed.  Outputs
+        are the ``newly``-executed mask; the host emission bookkeeping
+        (:meth:`_emit`) is shared between device and twin serving, so a
+        twin-served dispatch executes bit-for-bit the same commands."""
         import jax
         import jax.numpy as jnp
 
-        from fantoch_tpu.ops.graph_resolve import TERMINAL
         from fantoch_tpu.ops.pred_resolve import resolve_pred_plane_step
 
-        self._materialize()
+        out = resolve_pred_plane_step(
+            *(jnp.array(a) for a in state),
+            *(jnp.asarray(c) for c in entry),
+        )
+        fetched = jax.device_get(out)
+        return (
+            tuple(np.asarray(a) for a in fetched[:5]),
+            np.asarray(fetched.newly),
+        )
+
+    def _dispatch_columns(self, slots, cseq, rows, patches, time, csrc=None) -> None:
+        from fantoch_tpu.ops.graph_resolve import TERMINAL
+
         U, P = len(slots), len(patches)
         if U == 0 and P == 0:
+            if not self.degraded:
+                self._materialize()
             return
         # pad the patch columns to a floor so the common serving shapes
         # (a full install batch with zero or a handful of residual
@@ -467,21 +515,13 @@ class DevicePredPlane(DevicePlane):
         for i, (slot, col, val) in enumerate(patches):
             p_row[i], p_col[i], p_val[i] = slot, col, val
 
+        # the twin logs the exact padded columns BEFORE the dispatch, so
+        # a failure mid-dispatch still replays it (armed-only no-op)
+        entry = (u_row, u_deps, u_clock, u_src, p_row, p_col, p_val)
+        self._twin_note(entry)
         t0 = _time.perf_counter()
-        out = resolve_pred_plane_step(
-            *self._resident,
-            jnp.asarray(u_row),
-            jnp.asarray(u_deps),
-            jnp.asarray(u_clock),
-            jnp.asarray(u_src),
-            jnp.asarray(p_row),
-            jnp.asarray(p_col),
-            jnp.asarray(p_val),
-        )
-        self._resident = tuple(out[:5])
-        # one blocking transfer for the dispatch's whole result
-        newly = np.asarray(jax.device_get(out.newly))
-        if newly.any():
+        newly = self._serve_step(t0, entry)
+        if newly is not None and newly.any():
             self._emit(newly, time)
         self._count_dispatch(
             t0,
@@ -489,6 +529,53 @@ class DevicePredPlane(DevicePlane):
             update_capacity=ucap,
             residual_rows=self.pending_count,
         )
+        # cutback: once the fault window closed, ONE counted re-upload
+        # of the folded twin state (no-op unless failed)
+        self._maybe_rebuild()
+
+    def _serve_step(self, t0, entry):
+        """One window step under the fault plane: the resident fused
+        dispatch when healthy (guarded by the injector, the per-dispatch
+        deadline, and the sampled shadow-check), the host twin
+        bit-for-bit while failed over.  Returns the ``newly``-executed
+        mask consumed by the shared host emission path."""
+        import jax
+        import jax.numpy as jnp
+
+        from fantoch_tpu.ops.pred_resolve import resolve_pred_plane_step
+
+        if self.degraded:
+            newly = self._twin_fold()
+            self._note_degraded(t0)
+            return newly
+        twin_out = None
+        try:
+            fault = self._fault_check_pre()
+            self._materialize()
+            out = resolve_pred_plane_step(
+                *self._resident,
+                *(jnp.asarray(c) for c in entry),
+            )
+            self._resident = tuple(out[:5])
+            if fault is not None:
+                self._poison_resident(fault)
+            # one blocking transfer for the dispatch's whole result
+            newly = np.asarray(jax.device_get(out.newly))
+            self._check_deadline(t0)
+            if self._shadow_sampled():
+                # the fold's outputs ARE this dispatch's bit-exact twin
+                # outputs — kept so a corruption verdict can serve the
+                # step without re-replaying
+                twin_out = self._twin_fold()
+                self._shadow_compare(self._fetch_state())
+            return newly
+        except (DeviceFailedError, DeviceCorruptionError) as exc:
+            # serve THIS step from the twin: the corrupt dispatch's
+            # ``newly`` (if any) is discarded before any host bookkeeping
+            outputs = twin_out if twin_out is not None else self._twin_fold()
+            self._device_failure(exc)
+            self._note_degraded(t0)
+            return outputs
 
     def _emit(self, newly: np.ndarray, time) -> None:
         """Vectorized emission of one dispatch's executed slots in

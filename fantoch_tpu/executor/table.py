@@ -362,6 +362,9 @@ class TableExecutor(Executor):
             from fantoch_tpu.executor.table_plane import DeviceTablePlane
 
             self._plane = DeviceTablePlane(config.n, stability_threshold)
+            # arm the fault plane (deadline + shadow-check) from config;
+            # the runners re-seed and attach injectors/listeners on top
+            self._plane.configure_faults(config, process_id=process_id)
         # opt-in array drain (the record_order_arrays move from the graph
         # executor): stable rows emit as (rifl_src, rifl_seq) columns and
         # skip KVStore execution + ExecutorResult materialization — for
@@ -849,7 +852,16 @@ class TableExecutor(Executor):
             # host->device frontier materializations: stays at 1 in
             # steady state; restart-from-snapshot costs exactly one more
             "table_plane_resident_uploads": plane.resident_uploads,
+            # accelerator fault tolerance: failover/rebuild tallies,
+            # degraded wall, and the health gauge (max-folded)
+            **{
+                f"table_plane_{k}": v
+                for k, v in plane.fault_counters().items()
+            },
         }
+
+    def device_planes(self):
+        return (self._plane,) if self._plane is not None else ()
 
     def take_order_arrays(self) -> Tuple["np.ndarray", "np.ndarray"]:
         """Concatenated (rifl_src, rifl_seq) execution-order columns since

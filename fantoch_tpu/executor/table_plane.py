@@ -35,6 +35,7 @@ from typing import Tuple
 
 import numpy as np
 
+from fantoch_tpu.errors import DeviceCorruptionError, DeviceFailedError
 from fantoch_tpu.executor.device_plane import DevicePlane, next_pow2 as _pow2
 
 _INT32_MAX = (1 << 31) - 1
@@ -54,6 +55,8 @@ class DeviceTablePlane(DevicePlane):
     """
 
     __slots__ = ("n", "threshold")
+
+    plane_name = "table"
 
     def __init__(self, n: int, stability_threshold: int, key_buckets: int = 1024):
         assert stability_threshold <= n
@@ -89,6 +92,35 @@ class DeviceTablePlane(DevicePlane):
     def _frontier(self):
         return self._resident[0] if self._resident is not None else None
 
+    # --- host twin (accelerator fault tolerance; DevicePlane base) ---
+
+    def _twin_replay(self, state, entry):
+        """One logged commit dispatch replayed statelessly: the SAME
+        fused kernel over a fresh XLA-owned copy of the twin frontier
+        (``jnp.array`` — the donation-safety rule) plus the exact padded
+        columns the resident dispatch consumed — outputs are bit-for-bit
+        what a healthy device produced/would have produced."""
+        import jax
+        import jax.numpy as jnp
+
+        from fantoch_tpu.ops.table_ops import fused_votes_commit
+
+        pk, pb, ps, pe, pvalid = entry
+        (frontier,) = state
+        out = fused_votes_commit(
+            jnp.array(frontier),
+            jnp.asarray(pk),
+            jnp.asarray(pb),
+            jnp.asarray(ps),
+            jnp.asarray(pe),
+            jnp.asarray(pvalid),
+            threshold=self.threshold,
+        )
+        fetched = jax.device_get(out)
+        return (np.asarray(fetched[0]),), tuple(
+            np.asarray(a) for a in fetched[1:]
+        )
+
     # --- the fused commit dispatch ---
 
     def commit_votes(
@@ -102,11 +134,6 @@ class DeviceTablePlane(DevicePlane):
         stable clocks (post-batch) for every registered bucket.  Residual
         (beyond-gap) runs are buffered internally and re-fed with the
         next batch."""
-        import jax
-        import jax.numpy as jnp
-
-        from fantoch_tpu.ops.table_ops import fused_votes_commit
-
         if len(vend) and int(np.max(vend)) >= _INT32_MAX:
             raise ClockOverflowError(
                 "vote endpoint >= 2^31 - 1: the device table plane is "
@@ -120,16 +147,8 @@ class DeviceTablePlane(DevicePlane):
         )
         V = len(vkey)
 
-        self._materialize()
         if V == 0:
-            # nothing to apply: stability unchanged — read it off the
-            # resident state with the plain (non-donating) kernel
-            from fantoch_tpu.ops.table_ops import stable_clocks
-
-            stable = stable_clocks(self._frontier, threshold=self.threshold)
-            return np.asarray(jax.device_get(stable)).astype(np.int64)[
-                : self.key_count
-            ]
+            return self._stable_only()
 
         # pad the vote columns to pow2 so XLA compiles O(log) programs
         vcap = _pow2(V)
@@ -144,20 +163,12 @@ class DeviceTablePlane(DevicePlane):
         pvalid = np.zeros(vcap, dtype=bool)
         pvalid[:V] = True
 
+        # the twin logs the exact padded columns BEFORE the dispatch, so
+        # a failure mid-dispatch still replays it (armed-only no-op)
+        self._twin_note((pk, pb, ps, pe, pvalid))
         t0 = time.perf_counter()
-        out = fused_votes_commit(
-            self._frontier,
-            jnp.asarray(pk),
-            jnp.asarray(pb),
-            jnp.asarray(ps),
-            jnp.asarray(pe),
-            jnp.asarray(pvalid),
-            threshold=self.threshold,
-        )
-        self._resident = (out[0],)
-        # one blocking transfer for stability + the residual run columns
-        stable, run_key, run_by, run_start, run_end, residual = jax.device_get(
-            out[1:]
+        stable, run_key, run_by, run_start, run_end, residual = (
+            self._serve_commit(t0, pk, pb, ps, pe, pvalid)
         )
         res = np.flatnonzero(residual)
         self._count_dispatch(
@@ -171,7 +182,86 @@ class DeviceTablePlane(DevicePlane):
                 run_end[res].astype(np.int64),
             )
         )
+        # cutback: once the fault window closed, ONE counted re-upload
+        # of the folded twin state (no-op unless failed)
+        self._maybe_rebuild()
         return stable.astype(np.int64)[: self.key_count]
+
+    def _serve_commit(self, t0, pk, pb, ps, pe, pvalid):
+        """One commit dispatch under the fault plane: the resident fused
+        dispatch when healthy (guarded by the injector, the per-dispatch
+        deadline, and the sampled shadow-check), the host twin bit-for-bit
+        while failed over."""
+        import jax
+        import jax.numpy as jnp
+
+        from fantoch_tpu.ops.table_ops import fused_votes_commit
+
+        if self.degraded:
+            outputs = self._twin_fold()
+            self._note_degraded(t0)
+            return outputs
+        twin_out = None
+        try:
+            fault = self._fault_check_pre()
+            self._materialize()
+            out = fused_votes_commit(
+                self._frontier,
+                jnp.asarray(pk),
+                jnp.asarray(pb),
+                jnp.asarray(ps),
+                jnp.asarray(pe),
+                jnp.asarray(pvalid),
+                threshold=self.threshold,
+            )
+            self._resident = (out[0],)
+            if fault is not None:
+                self._poison_resident(fault)
+            # one blocking transfer for stability + the residual columns
+            fetched = jax.device_get(out[1:])
+            self._check_deadline(t0)
+            if self._shadow_sampled():
+                # the fold's outputs ARE this dispatch's bit-exact twin
+                # outputs — kept so a corruption verdict can serve the
+                # batch without re-replaying
+                twin_out = self._twin_fold()
+                self._shadow_compare(self._fetch_state())
+            return tuple(np.asarray(a) for a in fetched)
+        except (DeviceFailedError, DeviceCorruptionError) as exc:
+            # serve THIS batch from the twin: either the shadow fold
+            # above already produced its outputs, or the log still holds
+            # the entry and one fold replays it
+            outputs = twin_out if twin_out is not None else self._twin_fold()
+            self._device_failure(exc)
+            self._note_degraded(t0)
+            return outputs
+
+    def _stable_only(self):
+        """The V == 0 path: stability unchanged — read it off the
+        resident state (or the twin while failed over) with the plain
+        non-donating kernel."""
+        import jax
+        import jax.numpy as jnp
+
+        from fantoch_tpu.ops.table_ops import stable_clocks
+
+        if self.degraded:
+            t0 = time.perf_counter()
+            self._twin_fold()
+            stable = stable_clocks(
+                jnp.asarray(self._twin_state[0]), threshold=self.threshold
+            )
+            result = np.asarray(jax.device_get(stable)).astype(np.int64)[
+                : self.key_count
+            ]
+            self._note_degraded(t0)
+            self._maybe_rebuild()
+            return result
+        self._materialize()
+        stable = stable_clocks(self._frontier, threshold=self.threshold)
+        return np.asarray(jax.device_get(stable)).astype(np.int64)[
+            : self.key_count
+        ]
 
     # --- introspection (tests / debugging) ---
 
@@ -179,6 +269,9 @@ class DeviceTablePlane(DevicePlane):
         """Host copy of the live ``int64[key_count, n]`` frontier matrix
         (a device round-trip; for tests and debugging only)."""
         if self._resident is None:
+            if self.degraded and self._twin_state is not None:
+                self._twin_fold()
+                return self._twin_state[0][: self.key_count].astype(np.int64)
             if self._host_mirror is not None:
                 return self._host_mirror[0][: self.key_count].astype(np.int64)
             return np.zeros((self.key_count, self.n), dtype=np.int64)

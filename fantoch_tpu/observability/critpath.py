@@ -483,10 +483,12 @@ def critpath_report(
         name: value
         for name, value in counters.items()
         if name.startswith("device_") or name.endswith(
-            ("_dispatches", "_kernel_ms", "_resident_uploads")
+            ("_dispatches", "_kernel_ms", "_resident_uploads",
+             "_failovers", "_rebuilds", "_degraded_ms", "_plane_health")
         )
     }
     recoveries = sum(1 for v in complete if "recovery" in v["blame"])
+    degraded = _degraded_serving_row(counters)
     report: Dict[str, Any] = {
         "clock": "wall" if wall else "virtual",
         "spans": len(complete),
@@ -510,6 +512,11 @@ def critpath_report(
         "ingest_batching": _ingest_row(complete),
         "p99_ingest_batching": _ingest_row(cohort),
         "recovered_spans": recoveries,
+        # accelerator degraded-serving blame: wall spent serving from
+        # the host twin after a device failover (per plane), so a tail
+        # dominated by twin-speed serving is named instead of smeared
+        # across the stage segments it inflates
+        "degraded_serving": degraded,
         "peers": offsets.rows(),
         # string-keyed for JSON: one estimate per (client, coordinator)
         "client_offsets_us": {
@@ -523,6 +530,34 @@ def critpath_report(
     if device:
         report["device"] = device
     return report
+
+
+def _degraded_serving_row(counters: Dict[str, float]) -> Dict[str, Any]:
+    """The degraded-serving blame bucket: per-plane host-twin serving
+    wall (``*_plane_degraded_ms``) plus failover/rebuild tallies from
+    the trace's counter events.  Empty planes dict when no plane ever
+    degraded — the common case costs one dict scan."""
+    planes: Dict[str, Dict[str, float]] = {}
+    for name, value in counters.items():
+        for suffix in ("_degraded_ms", "_failovers", "_rebuilds"):
+            if name.endswith(f"_plane{suffix}"):
+                plane = name[: -len(f"_plane{suffix}")]
+                planes.setdefault(
+                    plane, {"degraded_ms": 0.0, "failovers": 0, "rebuilds": 0}
+                )[suffix[1:]] = value
+    planes = {
+        plane: row
+        for plane, row in planes.items()
+        if row["failovers"] or row["degraded_ms"]
+    }
+    return {
+        "planes": planes,
+        "degraded_ms": round(
+            sum(row["degraded_ms"] for row in planes.values()), 3
+        ),
+        "failovers": int(sum(row["failovers"] for row in planes.values())),
+        "rebuilds": int(sum(row["rebuilds"] for row in planes.values())),
+    }
 
 
 def dominant_quorum_peer(report: Dict[str, Any], tail: bool = True) -> Optional[int]:

@@ -76,6 +76,12 @@ _GAUGE_MAX_KEYS = frozenset(
         "device_pipeline_depth",
         "pred_plane_slot_capacity",
         "graph_plane_slot_capacity",
+        # plane health gauge (0 healthy / 1 rebuilding / 2 suspect /
+        # 3 failed — ordered by numeric severity, so the max IS the
+        # worst health across co-hosted executors)
+        "table_plane_health",
+        "pred_plane_health",
+        "graph_plane_health",
     }
 )
 

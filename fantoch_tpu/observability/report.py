@@ -146,7 +146,9 @@ def counters_total(events: Iterable[Dict[str, Any]]) -> Dict[str, float]:
             last[(ev["name"], ev.get("pid"))] = ev["v"]
     out: Dict[str, float] = {}
     for (name, _pid), value in last.items():
-        if name.endswith("_hwm") or name == "queue_depth":
+        if name.endswith(("_hwm", "_plane_health")) or name == "queue_depth":
+            # gauges: the cluster-wide value is the worst process's
+            # (plane health is severity-ordered, so max IS worst)
             out[name] = max(out.get(name, 0), value)
         else:
             out[name] = out.get(name, 0) + value

@@ -1752,10 +1752,49 @@ class DeviceRuntime:
         for server in self._servers:
             server.close()
 
+    def _arm_device_faults(self) -> None:
+        """Arm the accelerator fault plane on any device planes the
+        serving driver exposes (the ``device_planes`` seam shared with
+        the executor pools): config knobs, ``FANTOCH_DEVICE_FAULT`` env
+        rehearsal faults, and a flight-ring dump per failover.  The
+        fused serving drivers expose no planes today, so this costs one
+        empty-tuple check — the seam exists so a driver that grows a
+        resident plane is covered without touching the runtime."""
+        planes = tuple(
+            getattr(self.driver, "device_planes", lambda: ())()
+        )
+        if not planes:
+            return
+        from fantoch_tpu.sim.device_faults import install_env_faults
+
+        pid = self.process_id
+        for plane in planes:
+            plane.configure_faults(self.config, process_id=pid)
+        install_env_faults(planes, process_id=pid)
+
+        def on_failure(plane, exc):
+            logger.warning(
+                "p%s: %s plane failed over (%r); serving from host twin",
+                pid, plane.plane_name, exc,
+            )
+            if self.flight is not None:
+                try:
+                    self.flight.dump(
+                        f"{self.flight_dir}/flight_p{pid}_{plane.plane_name}.json",
+                        f"device-failover: {plane.plane_name}: "
+                        f"{type(exc).__name__}",
+                    )
+                except OSError as dump_exc:
+                    logger.error("flight dump failed: %r", dump_exc)
+
+        for plane in planes:
+            plane.attach_failure_listener(on_failure)
+
     async def start(self) -> None:
         from fantoch_tpu.observability.device import subscribe_recompiles
 
         subscribe_recompiles()
+        self._arm_device_faults()
         server = await asyncio.start_server(self._on_client, *self.client_addr)
         self._servers = [server]
         self.spawn(self._driver_task())

@@ -414,6 +414,12 @@ class ProcessRuntime:
         if share is not None:
             for executor in self.executors[1:]:
                 executor.share_state_from(self.executors[0])
+        # accelerator fault tolerance: arm the pool's device planes
+        # (deadline/shadow knobs travel in the config; FANTOCH_DEVICE_FAULT
+        # env specs rehearse deterministic failures on a live rig) and
+        # dump the flight ring on every failover.  After a WAL restore
+        # this re-attaches the live handles the pickled planes dropped.
+        self._arm_device_faults()
         self.dot_gen = AtomicIdGen(process_id)
         if self._dot_lease:
             # never re-issue a pre-crash sequence (the WAL dot lease)
@@ -742,6 +748,49 @@ class ProcessRuntime:
         if exc is not None:
             logger.error("runner task crashed: %r", exc)
             self._fail(exc)
+
+    def _arm_device_faults(self) -> None:
+        """Wire the accelerator fault plane into every device plane the
+        executor pool drives: re-apply the config knobs (per-dispatch
+        deadline, shadow-check rate), install any ``FANTOCH_DEVICE_FAULT``
+        env-spec injector (sim/device_faults.py — the live rehearsal of
+        the sim nemesis), and attach a failure listener that dumps the
+        flight ring.  A failover is NOT fatal: the plane keeps serving
+        bit-for-bit from its host twin and cuts back after rebuild — the
+        dump is the black box, not a teardown."""
+        from fantoch_tpu.sim.device_faults import install_env_faults
+
+        planes = [
+            plane
+            for executor in self.executors
+            for plane in executor.device_planes()
+        ]
+        if not planes:
+            return
+        pid = self.process.id
+        for plane in planes:
+            plane.configure_faults(self.config, process_id=pid)
+
+        def record(plane_name, kind, dispatch, detail):
+            logger.warning(
+                "p%s: injected device fault %s on %s plane at dispatch %d (%s)",
+                pid, kind, plane_name, dispatch, detail,
+            )
+
+        install_env_faults(planes, process_id=pid, record=record)
+
+        def on_failure(plane, exc):
+            logger.warning(
+                "p%s: %s plane failed over (%r); serving from host twin",
+                pid, plane.plane_name, exc,
+            )
+            self._dump_flight(
+                f"device-failover: {plane.plane_name}: {type(exc).__name__}",
+                suffix=f"_{plane.plane_name}",
+            )
+
+        for plane in planes:
+            plane.attach_failure_listener(on_failure)
 
     def _fail(self, exc: BaseException) -> None:
         """Record the first fatal failure and tear the runtime down.

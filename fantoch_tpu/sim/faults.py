@@ -58,6 +58,7 @@ from dataclasses import dataclass, field
 from typing import Any, List, Optional, Tuple
 
 from fantoch_tpu.errors import SimStalledError  # noqa: F401  (re-export)
+from fantoch_tpu.sim.device_faults import DeviceFault
 
 # endpoint keys as used by sim/runner.py: ("process", pid) | ("client", cid)
 EndpointKey = Tuple[str, int]
@@ -235,6 +236,12 @@ class FaultPlan:
     crashes: Tuple[Crash, ...] = ()
     pauses: Tuple[Pause, ...] = ()
     slow_processes: Tuple[SlowProcess, ...] = ()
+    # accelerator faults (sim/device_faults.py): deterministic dispatch
+    # hangs / XLA raises / resident bit-flips against a process's device
+    # plane, windowed in dispatch counts (not time) so same-seed runs
+    # replay bit-identically.  Only meaningful on plane-enabled configs;
+    # the runner attaches one injector per targeted process
+    device_faults: Tuple["DeviceFault", ...] = ()
     reorder: Optional[ReorderJitter] = None
     # failure-detector model: when set, every crash-FOREVER is announced
     # to all live processes ``detector_delay_ms`` after the crash via
@@ -310,6 +317,29 @@ class FaultPlan:
             self, reorder=ReorderJitter(factor, from_ms, until_ms)
         )
 
+    def with_device_fault(
+        self,
+        process_id: int,
+        plane: str,
+        kind: str,
+        at_dispatch: int,
+        down_dispatches: int = 4,
+    ) -> "FaultPlan":
+        """Deterministic accelerator failure against one process's
+        device plane (see :class:`~fantoch_tpu.sim.device_faults
+        .DeviceFault`): windowed in dispatch counts so the firing point
+        is schedule-exact across same-seed runs."""
+        fault = DeviceFault(
+            plane=plane,
+            kind=kind,
+            at_dispatch=at_dispatch,
+            down_dispatches=down_dispatches,
+            process_id=process_id,
+        )
+        return dataclasses.replace(
+            self, device_faults=self.device_faults + (fault,)
+        )
+
     def crashed_ids(self) -> Tuple[int, ...]:
         return tuple(sorted({c.process_id for c in self.crashes}))
 
@@ -347,6 +377,9 @@ class FaultPlan:
             pauses=tuple(Pause(**p) for p in data.get("pauses", ())),
             slow_processes=tuple(
                 SlowProcess(**s) for s in data.get("slow_processes", ())
+            ),
+            device_faults=tuple(
+                DeviceFault(**d) for d in data.get("device_faults", ())
             ),
             reorder=(
                 ReorderJitter(**data["reorder"])

@@ -80,6 +80,29 @@ PROTOCOL_SPECS: Dict[str, ProtocolSpec] = {
 }
 
 
+# which device plane each protocol's executor drives (the accelerator
+# fault nemesis only makes sense on plane-enabled configs): Newt's
+# table executor, Caesar's predecessor executor, EPaxos/Atlas's graph
+# executor; FPaxos's slot executor has no resident plane
+DEVICE_PLANE_OF = {
+    "newt": "table",
+    "caesar": "pred",
+    "epaxos": "graph",
+    "atlas": "graph",
+}
+
+# config flags that turn the matching plane on
+_DEVICE_PLANE_FLAGS = {
+    "table": {"device_table_plane": True},
+    "pred": {"device_pred_plane": True},
+    "graph": {
+        "device_graph_plane": True,
+        "batched_graph_executor": True,
+        "host_native_resolver": False,
+    },
+}
+
+
 def _protocol_cls(name: str):
     from fantoch_tpu import protocol as protocols
 
@@ -169,6 +192,7 @@ class FaultPlanFuzzer:
         conflict_rate = rng.choice((20, 50, 100))
         keys_per_command = 1 if conflict_rate == 100 else rng.choice((1, 2))
         plan = self._sample_plan(rng, n, f)
+        plan = self._sample_device_faults(index, name, n, plan)
         open_loop = None
         if rng.random() < 0.25:
             # open-loop Poisson arrivals (the overload plane's sim
@@ -186,6 +210,30 @@ class FaultPlanFuzzer:
             clients_per_process=2,
             open_loop_rate_per_s=open_loop,
         )
+
+    def _sample_device_faults(
+        self, index: int, protocol: str, n: int, plan: FaultPlan
+    ) -> FaultPlan:
+        """Maybe add accelerator faults (device_faults.py) against the
+        protocol's device plane.  Drawn from a SEPARATE rng stream
+        (``"{seed}:{index}:device"``) so arming this nemesis class left
+        every pre-existing sampled case byte-identical."""
+        plane = DEVICE_PLANE_OF.get(protocol)
+        if plane is None:
+            return plan
+        rng = random.Random(f"{self.seed}:{index}:device")
+        if rng.random() >= 0.25:
+            return plan
+        count = 1 if rng.random() < 0.8 else 2
+        for _ in range(count):
+            plan = plan.with_device_fault(
+                process_id=rng.randrange(1, n + 1),
+                plane=plane,
+                kind=rng.choice(("hang", "raise", "corrupt")),
+                at_dispatch=rng.randrange(1, 10),
+                down_dispatches=rng.randrange(2, 6),
+            )
+        return plan
 
     def _sample_plan(self, rng: random.Random, n: int, f: int) -> FaultPlan:
         horizon = self.HORIZON_MS
@@ -283,6 +331,14 @@ def _fuzz_config(case: FuzzCase) -> Config:
         kwargs["executor_monitor_pending_interval_ms"] = 500
         if case.protocol == "fpaxos":
             kwargs["fpaxos_leader_timeout_ms"] = 2000
+    if case.plan.device_faults:
+        # accelerator faults need the plane on plus the detection knobs:
+        # a dispatch deadline (hangs surface as DeviceFailedError) and
+        # always-on shadow checking (corruption surfaces on the faulted
+        # dispatch, not whenever sampling happens to look)
+        kwargs.update(_DEVICE_PLANE_FLAGS[DEVICE_PLANE_OF[case.protocol]])
+        kwargs["device_dispatch_timeout_ms"] = 250.0
+        kwargs["plane_shadow_rate"] = 1.0
     return Config(case.n, case.f, **kwargs)
 
 
@@ -455,6 +511,7 @@ def shrink_case(
 
     component_fields = (
         "link_faults", "partitions", "crashes", "pauses", "slow_processes",
+        "device_faults",
     )
     changed = True
     while changed and runs < max_runs:

@@ -168,6 +168,10 @@ class Runner:
         self._simulation = Simulation()
         self._schedule: Schedule = Schedule()
         self._rng = random.Random(seed)
+        # deterministic seed for the device-plane shadow sampler (the
+        # fault plane hashes seed:plane:dispatch, so same-seed runs make
+        # identical shadow decisions)
+        self._seed = seed if seed is not None else 0
         self._make_distances_symmetric = False
         self._reorder_messages = False
         self._nemesis: Optional[Nemesis] = (
@@ -276,6 +280,7 @@ class Runner:
             executor = protocol_cls.Executor(process.id, process.shard_id, config)
             process.set_tracer(self._tracer)
             executor.set_tracer(self._tracer)
+            self._arm_device_faults(executor, process.id)
             self._simulation.register_process(process, executor)
 
         # register clients
@@ -331,6 +336,53 @@ class Runner:
                         watchdog,
                         PeriodicExecutorWatchdog(pid, watchdog),
                     )
+
+    def _arm_device_faults(self, executor, process_id: ProcessId) -> None:
+        """Wire the accelerator fault plane into this executor's device
+        planes (no-op when it drives none): re-seed the shadow sampler
+        from the sim seed, attach the FaultPlan's DeviceFault injector
+        (per-process — every replica counts its own dispatches), and a
+        failure listener that records each failover in the nemesis trace
+        and dumps the flight ring (the black box for device failures)."""
+        planes = executor.device_planes()
+        if not planes:
+            return
+        for plane in planes:
+            plane.configure_faults(
+                self._config, seed=self._seed, process_id=process_id
+            )
+        device_faults = (
+            self._nemesis.plan.device_faults
+            if self._nemesis is not None
+            else ()
+        )
+        if device_faults:
+            from fantoch_tpu.sim.device_faults import DeviceFaultInjector
+
+            def record(plane_name, kind, dispatch, detail, _pid=process_id):
+                self._nemesis.record(
+                    self._simulation.time.millis(),
+                    f"device-{kind}",
+                    f"p{_pid}:{plane_name}@{dispatch} {detail}",
+                )
+
+            injector = DeviceFaultInjector(
+                device_faults, process_id=process_id, record=record
+            )
+            for plane in planes:
+                plane.attach_injector(injector)
+
+        def on_failure(plane, exc, _pid=process_id):
+            if self._nemesis is not None:
+                self._nemesis.record(
+                    self._simulation.time.millis(),
+                    "device-failover",
+                    f"p{_pid}:{plane.plane_name} {type(exc).__name__}",
+                )
+            self.dump_flight(f"device-failover-p{_pid}-{plane.plane_name}")
+
+        for plane in planes:
+            plane.attach_failure_listener(on_failure)
 
     # --- adversity knobs (runner.rs:192-198) ---
 
@@ -604,6 +656,9 @@ class Runner:
         executor = self._protocol_cls.Executor.restore(exec_blob)
         protocol.set_tracer(self._tracer)
         executor.set_tracer(self._tracer)
+        # device planes drop their injector/listener on pickling (live
+        # handles): re-arm the fault plane exactly as at first boot
+        self._arm_device_faults(executor, process_id)
         self._simulation.replace_process(protocol, executor, pending)
         for action in self._stalled_periodics.pop(process_id, []):
             self._schedule.schedule(self._simulation.time, action.delay_ms, action)

@@ -71,7 +71,17 @@ def main() -> int:
     # crash-restart and pin those cases into the gate (budget-checked:
     # the scan is over pure case values, only the two hits are run)
     targeted = []
-    for protocol, wants in (("caesar", "crash"), ("fpaxos", "restart")):
+    for protocol, wants in (
+        ("caesar", "crash"),
+        ("fpaxos", "restart"),
+        # the accelerator fault nemesis (PR 17), one row per device
+        # plane: the first sampled plan WITH a DeviceFault runs with the
+        # plane on, a dispatch deadline, and rate-1.0 shadow checking —
+        # failover to the host twin must stay auditor-clean
+        ("newt", "device"),
+        ("caesar", "device"),
+        ("epaxos", "device"),
+    ):
         for index in range(SMOKE_CASES, 64):
             plan = fuzzer.case(index, protocol=protocol).plan
             if wants == "crash" and plan.crashes:
@@ -80,6 +90,9 @@ def main() -> int:
             if wants == "restart" and any(
                 crash.restart_at_ms is not None for crash in plan.crashes
             ):
+                targeted.append((protocol, index))
+                break
+            if wants == "device" and plan.device_faults:
                 targeted.append((protocol, index))
                 break
         else:

@@ -1,0 +1,573 @@
+"""Accelerator fault tolerance: the seeded DeviceFault nemesis against
+the device planes — dispatch deadlines surfacing hangs as typed
+``DeviceFailedError``, the sampled shadow-check naming silent corruption
+(``DeviceCorruptionError`` with the first-diverging key), bit-for-bit
+host-twin failover, exactly-once replay across in-flight pipeline
+rounds, snapshot/restore mid-failover, and online rebuild + cutback.
+The sim acceptance rows drive a full protocol run per plane with a
+DeviceFault plan: auditor-clean, ``plane_failovers >= 1``,
+``plane_rebuilds >= 1``, output bit-for-bit the fault-free run's, and
+same-seed byte-identical digests.
+"""
+
+import itertools
+import pickle
+import random
+
+import numpy as np
+import pytest
+
+from fantoch_tpu.core import Command, Config, Dot, KVOp, Rifl, RunTime
+from fantoch_tpu.errors import DeviceCorruptionError, DeviceFailedError
+from fantoch_tpu.executor.device_plane import HEALTH_HEALTHY
+from fantoch_tpu.executor.table_plane import DeviceTablePlane
+from fantoch_tpu.sim.device_faults import (
+    DeviceFault,
+    DeviceFaultInjector,
+    faults_from_env,
+)
+from fantoch_tpu.sim.faults import FaultPlan
+
+pytestmark = pytest.mark.devicefault
+
+SHARD = 0
+TIME = RunTime()
+
+
+# ---------------------------------------------------------------------------
+# the injector model: windows, exactly-once corrupt, env specs
+# ---------------------------------------------------------------------------
+
+
+def test_injector_window_fires_and_vetoes_rebuild():
+    fault = DeviceFault("table", "hang", at_dispatch=3, down_dispatches=2)
+    fired = []
+    injector = DeviceFaultInjector(
+        [fault], 1, record=lambda *args: fired.append(args)
+    )
+    assert injector.on_dispatch("table", 2) is None
+    assert injector.on_dispatch("pred", 3) is None  # wrong plane
+    assert injector.on_dispatch("table", 3) is fault
+    assert injector.on_dispatch("table", 4) is fault  # hangs re-fire
+    assert injector.on_dispatch("table", 5) is None  # window closed
+    assert not injector.rebuild_allowed("table", 4)
+    assert injector.rebuild_allowed("table", 5)
+    assert injector.rebuild_allowed("pred", 4)
+    assert [f[:3] for f in fired] == [("table", "hang", 3), ("table", "hang", 4)]
+
+
+def test_injector_corrupt_fires_exactly_once():
+    fault = DeviceFault("pred", "corrupt", at_dispatch=2, down_dispatches=4)
+    injector = DeviceFaultInjector([fault], 1)
+    assert injector.on_dispatch("pred", 2) is fault
+    # the bit-flip is a one-shot event; the window still vetoes rebuild
+    assert injector.on_dispatch("pred", 3) is None
+    assert not injector.rebuild_allowed("pred", 3)
+
+
+def test_injector_filters_by_process_id():
+    fault = DeviceFault(
+        "table", "raise", at_dispatch=1, down_dispatches=2, process_id=2
+    )
+    assert DeviceFaultInjector([fault], 1).on_dispatch("table", 1) is None
+    assert DeviceFaultInjector([fault], 2).on_dispatch("table", 1) is fault
+
+
+def test_env_spec_round_trip():
+    faults = faults_from_env("table:hang:3:5:2, pred:corrupt:7")
+    assert faults == (
+        DeviceFault("table", "hang", at_dispatch=3, down_dispatches=5,
+                    process_id=2),
+        DeviceFault("pred", "corrupt", at_dispatch=7),
+    )
+    assert faults_from_env("") == ()
+    with pytest.raises(ValueError):
+        faults_from_env("table:hang")
+    with pytest.raises(ValueError):
+        faults_from_env("hbm:hang:3")
+
+
+def test_fault_plan_carries_device_faults():
+    plan = FaultPlan(seed=3).with_device_fault(
+        process_id=1, plane="graph", kind="hang", at_dispatch=4,
+        down_dispatches=2,
+    )
+    assert plan.device_faults[0].plane == "graph"
+    round_trip = FaultPlan.from_dict(plan.to_dict())
+    assert round_trip.device_faults == plan.device_faults
+
+
+# ---------------------------------------------------------------------------
+# table plane: hang -> deadline -> failover; corrupt -> shadow-catch
+# ---------------------------------------------------------------------------
+
+
+def _table_run(fault=None, timeout=None, shadow=0.0, rounds=12, n_keys=8):
+    """Feed the same seeded vote batches through a DeviceTablePlane,
+    optionally armed (deadline/shadow) and faulted."""
+    rng = np.random.default_rng(7)
+    plane = DeviceTablePlane(3, stability_threshold=2, key_buckets=n_keys)
+    for k in range(n_keys):
+        plane.bucket(f"k{k}")
+    if fault is not None or timeout is not None or shadow:
+        config = Config(
+            3, 1,
+            device_dispatch_timeout_ms=timeout,
+            plane_shadow_rate=shadow,
+        )
+        plane.configure_faults(config, seed=5, process_id=1)
+    if fault is not None:
+        plane.attach_injector(DeviceFaultInjector([fault], 1))
+    for _ in range(rounds):
+        count = 16
+        vk = rng.integers(0, n_keys, count).astype(np.int64)
+        vb = rng.integers(1, 4, count).astype(np.int64)
+        vs = rng.integers(1, 40, count).astype(np.int64)
+        plane.commit_votes(
+            vk, vb, vs, vs + rng.integers(0, 6, count).astype(np.int64)
+        )
+    return plane
+
+
+def test_table_armed_parity_without_fault():
+    """Arming (shadow at rate 1.0 + a generous deadline) must not
+    change behavior: zero failovers, frontiers bit-for-bit."""
+    reference = _table_run()
+    plane = _table_run(timeout=60_000.0, shadow=1.0)
+    counters = plane.fault_counters()
+    assert counters["failovers"] == 0 and counters["rebuilds"] == 0
+    assert counters["health"] == HEALTH_HEALTHY
+    assert np.array_equal(plane.frontiers(), reference.frontiers())
+
+
+def test_table_hang_deadline_failover_and_rebuild():
+    """A hung dispatch trips the deadline as a typed DeviceFailedError;
+    the plane fails over to the host twin (bit-for-bit), serves
+    degraded, and rebuilds back to healthy once the window closes."""
+    reference = _table_run()
+    plane = _table_run(
+        fault=DeviceFault("table", "hang", at_dispatch=3, down_dispatches=3),
+        timeout=250.0,
+    )
+    assert isinstance(plane.last_failure, DeviceFailedError)
+    counters = plane.fault_counters()
+    assert counters["failovers"] == 1
+    assert counters["rebuilds"] == 1
+    assert counters["health"] == HEALTH_HEALTHY
+    assert counters["degraded_ms"] > 0.0
+    assert np.array_equal(plane.frontiers(), reference.frontiers())
+
+
+def test_table_corruption_shadow_catch_names_key():
+    """A silent resident bit-flip is caught by the rate-1.0 shadow-check
+    on the faulted dispatch and attributed to the first diverging key;
+    the twin keeps the output bit-for-bit."""
+    reference = _table_run()
+    plane = _table_run(
+        fault=DeviceFault("table", "corrupt", at_dispatch=4,
+                          down_dispatches=2),
+        shadow=1.0,
+    )
+    failure = plane.last_failure
+    assert isinstance(failure, DeviceCorruptionError)
+    # the nemesis flips bit 20 of state array 0, flat element 0 -> the
+    # first registered key's row
+    assert failure.row == 0
+    assert failure.key == "k0"
+    counters = plane.fault_counters()
+    assert counters["failovers"] == 1 and counters["rebuilds"] == 1
+    assert np.array_equal(plane.frontiers(), reference.frontiers())
+
+
+# ---------------------------------------------------------------------------
+# pred plane: executor-level failover parity + snapshot mid-failover
+# ---------------------------------------------------------------------------
+
+
+def _pred_workload(rng, count=80, keys=("Ka", "Kb", "Kc")):
+    from fantoch_tpu.executor.pred import PredecessorsExecutionInfo
+    from fantoch_tpu.protocol.common.pred_clocks import Clock
+
+    per_key = {k: [] for k in keys}
+    infos = []
+    for i in range(count):
+        dot = Dot(rng.randrange(1, 4), i + 1)
+        ks = rng.sample(list(keys), rng.randrange(1, 3))
+        deps = set()
+        for k in ks:
+            deps.update(per_key[k])
+            per_key[k].append(dot)
+        command = Command.from_keys(
+            Rifl(9, i + 1), SHARD, {k: (KVOp.put(str(i)),) for k in ks}
+        )
+        infos.append(
+            PredecessorsExecutionInfo(dot, command, Clock(i + 1, dot.source),
+                                      deps)
+        )
+    return infos
+
+
+def _pred_run(fault=None, shadow=0.0, pickle_at=None):
+    from fantoch_tpu.executor.pred import PredecessorsExecutor
+
+    config = Config(
+        3, 1,
+        device_pred_plane=True,
+        executor_monitor_execution_order=True,
+        plane_shadow_rate=shadow,
+    )
+    executor = PredecessorsExecutor(1, SHARD, config)
+    if fault is not None or shadow:
+        executor._plane.configure_faults(config, seed=7, process_id=1)
+    injector = DeviceFaultInjector([fault], 1) if fault is not None else None
+    if injector is not None:
+        executor._plane.attach_injector(injector)
+    infos = _pred_workload(random.Random(42))
+    for n, i in enumerate(range(0, len(infos), 7)):
+        if pickle_at is not None and n == pickle_at:
+            # snapshot/restore mid-run: the injector is re-attached the
+            # way the sim runner re-arms a restarted process
+            executor = pickle.loads(pickle.dumps(executor))
+            if injector is not None:
+                executor._plane.attach_injector(injector)
+        for info in infos[i:i + 7]:
+            executor.handle(info, None)
+    executed = sorted(r.rifl for r in executor.to_clients_iter())
+    monitor = executor.monitor()
+    order = {k: monitor.get_order(k) for k in monitor.keys()}
+    return executed, order, executor._plane
+
+
+def test_pred_hang_failover_bit_for_bit():
+    want, want_order, _plane = _pred_run()
+    got, order, plane = _pred_run(
+        fault=DeviceFault("pred", "hang", at_dispatch=3, down_dispatches=3)
+    )
+    assert (got, order) == (want, want_order)
+    counters = plane.fault_counters()
+    assert counters["failovers"] == 1 and counters["rebuilds"] == 1
+    assert counters["degraded_ms"] > 0.0
+    assert counters["health"] == HEALTH_HEALTHY
+    assert isinstance(plane.last_failure, DeviceFailedError)
+
+
+def test_pred_corruption_shadow_catch():
+    want, want_order, _plane = _pred_run()
+    got, order, plane = _pred_run(
+        fault=DeviceFault("pred", "corrupt", at_dispatch=4,
+                          down_dispatches=2),
+        shadow=1.0,
+    )
+    assert (got, order) == (want, want_order)
+    assert isinstance(plane.last_failure, DeviceCorruptionError)
+    counters = plane.fault_counters()
+    assert counters["failovers"] == 1 and counters["rebuilds"] == 1
+
+
+def test_pred_snapshot_restore_mid_failover():
+    """Pickle the executor while the plane is serving degraded (the
+    fault window still open): the restored twin must carry the full
+    state and the run must stay bit-for-bit."""
+    want, want_order, _plane = _pred_run()
+    got, order, plane = _pred_run(
+        fault=DeviceFault("pred", "hang", at_dispatch=3, down_dispatches=4),
+        pickle_at=5,
+    )
+    assert (got, order) == (want, want_order)
+    assert plane.fault_counters()["health"] == HEALTH_HEALTHY
+
+
+# ---------------------------------------------------------------------------
+# graph plane: exactly-once across pipeline depth, all fault kinds
+# ---------------------------------------------------------------------------
+
+
+def _graph_args(n, events_per_process, rng):
+    from fantoch_tpu.core.ids import process_ids
+
+    possible_keys = ["A", "B", "C", "D"]
+    dots = [
+        Dot(pid, seq)
+        for pid in process_ids(SHARD, n)
+        for seq in range(1, events_per_process + 1)
+    ]
+    keys = {dot: set(rng.sample(possible_keys, 2)) for dot in dots}
+    deps = {dot: set() for dot in dots}
+    for left, right in itertools.combinations(dots, 2):
+        if not (keys[left] & keys[right]):
+            continue
+        if left.source == right.source:
+            if left.sequence < right.sequence:
+                deps[right].add(left)
+            else:
+                deps[left].add(right)
+        else:
+            choice = rng.randrange(3)
+            if choice in (0, 2):
+                deps[left].add(right)
+            if choice in (1, 2):
+                deps[right].add(left)
+    return [(dot, sorted(keys[dot]), deps[dot]) for dot in dots]
+
+
+def _graph_run(feeds, fault=None, depth=1, shadow=0.0, pickle_at=None):
+    from fantoch_tpu.executor.graph.batched import BatchedDependencyGraph
+    from fantoch_tpu.protocol.common.graph_deps import Dependency
+
+    config = Config(
+        3, 1,
+        host_native_resolver=False,
+        batched_graph_executor=True,
+        device_graph_plane=True,
+        plane_shadow_rate=shadow,
+    )
+    graph = BatchedDependencyGraph(1, SHARD, config)
+    plane = graph._plane
+    plane.pipeline_depth = depth
+    injector = DeviceFaultInjector([fault], 1) if fault is not None else None
+    if injector is not None or shadow:
+        plane.configure_faults(config, seed=11, process_id=1)
+    if injector is not None:
+        plane.attach_injector(injector)
+    order = {}
+    pending = set()
+
+    def drain():
+        for ready in graph.commands_to_execute():
+            pending.discard(ready.rifl)
+            for key in ready.keys(SHARD):
+                order.setdefault(key, []).append(ready.rifl)
+
+    for n, feed in enumerate(feeds):
+        if pickle_at is not None and n == pickle_at:
+            graph = pickle.loads(pickle.dumps(graph))
+            plane = graph._plane
+            if injector is not None:
+                plane.attach_injector(injector)
+        adds = []
+        for dot, keys, dep_dots in feed:
+            command = Command.from_keys(
+                Rifl(dot.source, dot.sequence), SHARD,
+                {k: (KVOp.put(""),) for k in keys},
+            )
+            pending.add(command.rifl)
+            adds.append(
+                (dot, command,
+                 [Dependency(d, frozenset({SHARD})) for d in dep_dots])
+            )
+        graph.handle_add_batch(adds, TIME)
+        drain()
+    graph.resolve_now(TIME)
+    plane.drain_all()
+    drain()
+    # exactly-once: every command executed (none lost), and the order
+    # map below dedups nothing (a double emission would show up as a
+    # repeated rifl and fail the parity compare)
+    assert not pending, f"not all executed: {pending}"
+    return order, plane
+
+
+@pytest.fixture(scope="module")
+def graph_feeds():
+    rng = random.Random(5)
+    args = _graph_args(2, 6, rng)
+    rng.shuffle(args)
+    feeds = []
+    at = 0
+    while at < len(args):
+        size = rng.randrange(1, 6)
+        feeds.append(args[at:at + size])
+        at += size
+    return feeds
+
+
+@pytest.mark.parametrize("kind", ["hang", "raise", "corrupt"])
+def test_graph_failover_all_kinds(graph_feeds, kind):
+    want, _plane = _graph_run(graph_feeds)
+    shadow = 1.0 if kind == "corrupt" else 0.0
+    got, plane = _graph_run(
+        graph_feeds,
+        fault=DeviceFault("graph", kind, at_dispatch=2, down_dispatches=3),
+        shadow=shadow,
+    )
+    assert got == want
+    counters = plane.fault_counters()
+    assert counters["failovers"] == 1 and counters["rebuilds"] == 1
+    assert counters["health"] == HEALTH_HEALTHY
+    expected = (
+        DeviceCorruptionError if kind == "corrupt" else DeviceFailedError
+    )
+    assert isinstance(plane.last_failure, expected)
+
+
+def test_graph_exactly_once_across_failover_at_depth_2(graph_feeds):
+    """With two rounds in flight, a failure mid-pipeline must replay the
+    unserved rounds through the twin exactly once — no command lost, no
+    command emitted twice, order bit-for-bit the depth-1 fault-free
+    run's."""
+    want, _plane = _graph_run(graph_feeds)
+    got, plane = _graph_run(
+        graph_feeds,
+        fault=DeviceFault("graph", "hang", at_dispatch=2, down_dispatches=3),
+        depth=2,
+    )
+    assert got == want
+    counters = plane.fault_counters()
+    assert counters["failovers"] == 1 and counters["rebuilds"] == 1
+
+
+def test_graph_snapshot_restore_mid_failover(graph_feeds):
+    """Pickle the graph mid-window (the plane FAILED, rounds in the twin
+    log): the restored run must stay bit-for-bit and still cut back —
+    the window is short enough that post-window dispatches remain."""
+    want, _plane = _graph_run(graph_feeds)
+    got, plane = _graph_run(
+        graph_feeds,
+        fault=DeviceFault("graph", "hang", at_dispatch=2, down_dispatches=3),
+        pickle_at=3,
+    )
+    assert got == want
+    counters = plane.fault_counters()
+    assert counters["failovers"] == 1 and counters["rebuilds"] == 1
+    assert counters["health"] == HEALTH_HEALTHY
+
+
+# ---------------------------------------------------------------------------
+# sim acceptance: a full protocol run per plane under a DeviceFault plan
+# ---------------------------------------------------------------------------
+
+
+def _sim_config(protocol):
+    from fantoch_tpu.sim.fuzz import DEVICE_PLANE_OF, _DEVICE_PLANE_FLAGS
+
+    kwargs = dict(
+        shard_count=1,
+        executor_monitor_execution_order=True,
+        audit_log_commits=True,
+        gc_interval_ms=100,
+        executor_executed_notification_interval_ms=100,
+        device_dispatch_timeout_ms=250.0,
+        plane_shadow_rate=1.0,
+    )
+    if protocol == "newt":
+        kwargs["newt_detached_send_interval_ms"] = 100
+    kwargs.update(_DEVICE_PLANE_FLAGS[DEVICE_PLANE_OF[protocol]])
+    return Config(3, 1, **kwargs)
+
+
+def _sim_run(protocol, plan, sim_seed=11):
+    from fantoch_tpu.client import ConflictRateKeyGen, Workload
+    from fantoch_tpu.sim import Runner
+    from fantoch_tpu.sim.fuzz import _fuzz_planet, _protocol_cls
+
+    regions, planet = _fuzz_planet(3)
+    workload = Workload(
+        shard_count=1,
+        key_gen=ConflictRateKeyGen(50),
+        keys_per_command=2,
+        commands_per_client=6,
+        payload_size=1,
+    )
+    runner = Runner(
+        _protocol_cls(protocol),
+        planet,
+        _sim_config(protocol),
+        workload,
+        2,
+        process_regions=list(regions),
+        client_regions=list(regions),
+        seed=sim_seed,
+        fault_plan=plan,
+    )
+    _metrics, monitors, _latencies = runner.run(extra_sim_time_ms=2000)
+    counters = {
+        pid: dict(executor.device_counters() or {})
+        for pid, (_process, executor, _pending) in
+        runner._simulation.processes()
+    }
+    unfinished = [
+        client_id
+        for client_id, client in runner._simulation.clients()
+        if client.issued_commands != 6
+    ]
+    return monitors, counters, list(runner.nemesis.trace), unfinished
+
+
+@pytest.mark.parametrize(
+    "protocol,kind",
+    [("newt", "hang"), ("caesar", "corrupt"), ("epaxos", "raise")],
+)
+def test_sim_failover_acceptance(protocol, kind):
+    """The ISSUE acceptance row per plane: a seeded sim run with a
+    DeviceFault plan completes (every client finished), records at
+    least one failover and one rebuild on the faulted plane, and its
+    execution-order monitors are bit-for-bit the fault-free run's."""
+    from fantoch_tpu.sim.fuzz import DEVICE_PLANE_OF
+
+    base = FaultPlan(seed=7, max_sim_time_ms=600_000)
+    plan = base.with_device_fault(
+        process_id=1, plane=DEVICE_PLANE_OF[protocol], kind=kind,
+        at_dispatch=2, down_dispatches=3,
+    )
+    clean_monitors, _cc, _ct, clean_unfinished = _sim_run(protocol, base)
+    monitors, counters, trace, unfinished = _sim_run(protocol, plan)
+    assert not unfinished and not clean_unfinished
+    prefix = f"{DEVICE_PLANE_OF[protocol]}_plane_"
+    faulted = counters[1]
+    assert faulted[f"{prefix}failovers"] >= 1, faulted
+    assert faulted[f"{prefix}rebuilds"] >= 1, faulted
+    assert faulted[f"{prefix}health"] == HEALTH_HEALTHY, faulted
+    assert any(event == f"device-{kind}" for _t, event, _d in trace), trace
+    assert any(event == "device-failover" for _t, event, _d in trace), trace
+    for pid in monitors:
+        assert repr(monitors[pid]) == repr(clean_monitors[pid]), (
+            f"p{pid} execution order diverged from the fault-free run"
+        )
+
+
+def test_sim_device_fault_auditor_clean_and_deterministic():
+    """run_case over a device-fault plan: the ConsistencyAuditor finds
+    no violation, and the same seed reproduces byte-identical plan,
+    fault-trace, and verdict digests."""
+    from fantoch_tpu.sim.fuzz import OK, FuzzCase, run_case
+
+    plan = FaultPlan(seed=3, max_sim_time_ms=600_000).with_device_fault(
+        process_id=2, plane="pred", kind="corrupt", at_dispatch=3,
+        down_dispatches=3,
+    )
+    case = FuzzCase(protocol="caesar", n=3, f=1, plan=plan, sim_seed=5)
+    first = run_case(case)
+    assert first.verdict == OK, (first.violations, first.error)
+    second = run_case(case)
+    assert first.plan_digest == second.plan_digest
+    assert first.trace_digest == second.trace_digest
+    assert first.verdict_digest == second.verdict_digest
+
+
+def test_fuzzer_samples_device_faults_with_plane_on():
+    """The fuzzer's device-fault stream: sampled plans carry DeviceFaults
+    only alongside a plane-on config, and sampling is deterministic."""
+    from fantoch_tpu.sim.fuzz import (
+        DEVICE_PLANE_OF,
+        FaultPlanFuzzer,
+        _fuzz_config,
+    )
+
+    fuzzer = FaultPlanFuzzer(seed=0)
+    hit = None
+    for index in range(64):
+        case = fuzzer.case(index, protocol="newt")
+        if case.plan.device_faults:
+            hit = (index, case)
+            break
+    assert hit is not None, "no device fault sampled in 64 newt cases"
+    index, case = hit
+    config = _fuzz_config(case)
+    assert config.device_table_plane
+    assert config.device_dispatch_timeout_ms == 250.0
+    assert config.plane_shadow_rate == 1.0
+    for fault in case.plan.device_faults:
+        assert fault.plane == DEVICE_PLANE_OF["newt"]
+        assert 1 <= fault.process_id <= case.n
+    again = FaultPlanFuzzer(seed=0).case(index, protocol="newt")
+    assert again.plan.device_faults == case.plan.device_faults

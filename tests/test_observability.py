@@ -250,6 +250,12 @@ def test_table_plane_device_counters():
         "table_plane_residual_runs": 0,
         "table_plane_kernel_ms": 0,
         "table_plane_resident_uploads": 0,
+        # the fault-tolerance tallies (failovers/rebuilds/degraded wall
+        # + the severity-ordered health gauge) ride the same surface
+        "table_plane_failovers": 0,
+        "table_plane_rebuilds": 0,
+        "table_plane_degraded_ms": 0.0,
+        "table_plane_health": 0,
     }
     # plane off -> no counters contributed
     assert TableExecutor(1, 0, Config(3, 1)).device_counters() is None
