@@ -130,6 +130,21 @@ class Config:
     # dispatch log failover replays from.  None (default) = unarmed, the
     # plane trusts the device unconditionally (zero overhead)
     device_dispatch_timeout_ms: Optional[float] = None
+    # Pallas-fused resolve kernels (ops/pallas_resolve.py): route the
+    # hot plane dispatches (graph/pred plane step, fused table round,
+    # votes commit) through hand-fused Pallas kernels instead of the
+    # XLA-composed programs.  None = the FANTOCH_PALLAS env var, else
+    # the backend default (on for TPU, off elsewhere — on CPU the
+    # kernels run in interpret mode, a parity instrument not a perf
+    # win).  Bit-for-bit either way; unsupported backends fall back to
+    # the composed programs automatically.  Process-global (the routers
+    # are module-level): co-hosted executors share one route
+    pallas_kernels: Optional[bool] = None
+    # persistent XLA compilation-cache directory
+    # (core/compile_cache.py): an explicit path here beats the
+    # FANTOCH_COMPILE_CACHE_DIR env var, which beats the obs-dir /
+    # repo-adjacent defaults.  None = resolve through env/defaults
+    compile_cache_dir: Optional[str] = None
     # sampled shadow-check rate in [0, 1]: with probability p per
     # dispatch (seeded, deterministic) the plane replays the dispatch's
     # inputs through the same kernel on host-owned twin state and

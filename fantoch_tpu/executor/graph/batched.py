@@ -239,7 +239,14 @@ class BatchedDependencyGraph(DependencyGraph):
                 from fantoch_tpu.executor.graph.graph_plane import (
                     DeviceGraphPlane,
                 )
+                from fantoch_tpu.ops.pallas_resolve import (
+                    apply_pallas_config,
+                )
 
+                # fold Config.pallas_kernels into the kernel route before
+                # the plane's first dispatch (config > env > backend
+                # default)
+                apply_pallas_config(config)
                 self._plane = DeviceGraphPlane(
                     process_id, shard_id, config, self._frontier,
                     self._metrics,

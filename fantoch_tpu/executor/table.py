@@ -360,7 +360,11 @@ class TableExecutor(Executor):
         self._plane = None
         if config.device_table_plane:
             from fantoch_tpu.executor.table_plane import DeviceTablePlane
+            from fantoch_tpu.ops.pallas_resolve import apply_pallas_config
 
+            # fold Config.pallas_kernels into the kernel route before the
+            # plane's first dispatch (config > env > backend default)
+            apply_pallas_config(config)
             self._plane = DeviceTablePlane(config.n, stability_threshold)
             # arm the fault plane (deadline + shadow-check) from config;
             # the runners re-seed and attach injectors/listeners on top

@@ -26,6 +26,8 @@ from fantoch_tpu.observability.tracer import (
     span_hash,
 )
 from fantoch_tpu.observability.device import (
+    cache_hit_count,
+    cache_miss_count,
     recompile_count,
     subscribe_recompiles,
 )
@@ -45,6 +47,8 @@ __all__ = [
     "Tracer",
     "read_trace",
     "span_hash",
+    "cache_hit_count",
+    "cache_miss_count",
     "recompile_count",
     "subscribe_recompiles",
 ]

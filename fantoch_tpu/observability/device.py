@@ -13,8 +13,16 @@ classic silent latency cliff).  These counters ride two channels:
   next to the spans of the batches they carried.
 
 Recompiles are counted by subscribing to ``jax.monitoring`` duration
-events (``.../backend_compile_duration`` fires once per XLA backend
-compile); the subscription is process-global and idempotent.
+events; the subscription is process-global and idempotent.  With the
+persistent compilation cache on (core/compile_cache.py), the raw
+``.../backend_compile_duration`` event is ambiguous — it wraps
+``compile_or_get_cached``, so it fires for disk retrievals too.  The
+listener therefore PAIRS each duration event with the cache hit/miss
+event that jax emits immediately before it: a duration event preceded
+by a cache hit is a retrieval (counted in :func:`cache_hit_count`, its
+wall in :func:`compile_ms` — retrieval stalls serving just like a
+compile, only shorter), everything else is a TRUE compile.  That makes
+``jax_recompiles == 0`` the proof a warm-cache sweep never paid XLA.
 """
 
 from __future__ import annotations
@@ -23,13 +31,17 @@ from typing import Callable, Dict, Optional
 
 _recompiles = 0
 _compile_ms = 0.0
+_cache_hits = 0
+_cache_misses = 0
+_pending_hits = 0
 _subscribed = False
 
 
 def subscribe_recompiles() -> bool:
-    """Start counting XLA backend compiles (idempotent; returns whether
-    the jax.monitoring hook is installed).  Safe to call before any jax
-    work — the listener costs nothing until a compile happens."""
+    """Start counting XLA backend compiles and persistent-cache traffic
+    (idempotent; returns whether the jax.monitoring hooks installed).
+    Safe to call before any jax work — the listeners cost nothing until
+    a compile happens."""
     global _subscribed
     if _subscribed:
         return True
@@ -38,32 +50,65 @@ def subscribe_recompiles() -> bool:
     except Exception:  # jax absent or too old: counters just stay 0
         return False
 
+    def _on_event(key: str) -> None:
+        global _cache_hits, _cache_misses, _pending_hits
+        # the persistent-cache outcome events fire BEFORE the duration
+        # event of the compile-or-retrieve they describe (verified on the
+        # pinned jax); a pending hit reclassifies that duration event as
+        # a retrieval
+        if key.endswith("compilation_cache/cache_hits"):
+            _cache_hits += 1
+            _pending_hits += 1
+        elif key.endswith("compilation_cache/cache_misses"):
+            _cache_misses += 1
+
     def _on_duration(key: str, secs: float) -> None:
-        global _recompiles, _compile_ms
+        global _recompiles, _compile_ms, _pending_hits
         if key.endswith("backend_compile_duration"):
-            _recompiles += 1
+            if _pending_hits > 0:
+                _pending_hits -= 1
+            else:
+                _recompiles += 1
             # cumulative compile WALL, not just the count: one ~50s cold
             # compile starves heartbeats/serving for its whole duration
             # (PR 14's resolve_graph_plane_step programs) — a count of 1
-            # hides that; the milliseconds name it
+            # hides that; the milliseconds name it.  Retrieval wall is
+            # included: a warm run's compile_ms is the disk-load cost.
             _compile_ms += secs * 1000.0
 
+    try:
+        monitoring.register_event_listener(_on_event)
+    except Exception:  # noqa: BLE001 — older jax: hits/misses stay 0 and
+        pass  # every duration event counts as a compile (pre-cache rule)
     monitoring.register_event_duration_secs_listener(_on_duration)
     _subscribed = True
     return True
 
 
 def recompile_count() -> int:
-    """XLA backend compiles observed since :func:`subscribe_recompiles`
-    (0 when never subscribed)."""
+    """TRUE XLA backend compiles observed since
+    :func:`subscribe_recompiles` (0 when never subscribed); persistent-
+    cache retrievals are excluded — see the module docstring."""
     return _recompiles
 
 
 def compile_ms() -> float:
-    """Cumulative XLA backend-compile wall milliseconds since
+    """Cumulative XLA backend compile-or-retrieve wall milliseconds since
     :func:`subscribe_recompiles` — host-process-global like
     :func:`recompile_count` (co-hosted runtimes must not sum it)."""
     return round(_compile_ms, 1)
+
+
+def cache_hit_count() -> int:
+    """Persistent-compilation-cache hits (disk retrievals instead of XLA
+    compiles) since :func:`subscribe_recompiles`."""
+    return _cache_hits
+
+
+def cache_miss_count() -> int:
+    """Persistent-compilation-cache misses (programs that went to XLA)
+    since :func:`subscribe_recompiles`."""
+    return _cache_misses
 
 
 # fold semantics per counter kind: most keys are monotone tallies and

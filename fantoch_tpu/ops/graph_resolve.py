@@ -60,6 +60,8 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from fantoch_tpu.core.compile_cache import register_program
+
 TERMINAL = -1  # dependency executed / absent (pruned)
 MISSING = -2  # dependency not committed here yet: blocks resolution
 
@@ -878,10 +880,7 @@ class GraphPlaneStep(NamedTuple):
     leader: jax.Array  # int32[C] — structure modes: SCC leader (CHAIN_SIZE)
 
 
-@functools.partial(
-    jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5), static_argnames=("mode",)
-)
-def resolve_graph_plane_step(
+def graph_plane_step_core(
     deps: jax.Array,  # int32[C, W] slot indices / TERMINAL / MISSING
     key: jax.Array,  # int32[C]
     src: jax.Array,  # int32[C]
@@ -1012,6 +1011,42 @@ def resolve_graph_plane_step(
     return GraphPlaneStep(
         deps, key, src, seq, occ, executed, order, newly, stuck, leader
     )
+
+
+# the composed program: graph_plane_step_core compiled as one donated
+# dispatch (the pre-Pallas default, and the fallback route).  The core
+# stays un-jitted so the Pallas kernel (ops/pallas_resolve.py) can trace
+# the IDENTICAL program inside its kernel body — parity by construction.
+resolve_graph_plane_step_xla = functools.partial(
+    jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5), static_argnames=("mode",)
+)(graph_plane_step_core)
+
+register_program("graph_plane_step_xla", resolve_graph_plane_step_xla)
+
+
+def resolve_graph_plane_step(
+    deps, key, src, seq, occ, executed,
+    u_row, u_deps, u_key, u_src, u_seq,
+    p_row, p_col, p_val, e_row,
+    *,
+    mode: str,
+) -> GraphPlaneStep:
+    """Route one resident graph-plane dispatch: the Pallas-fused kernel
+    when :func:`fantoch_tpu.ops.pallas_resolve.pallas_enabled` says so
+    (and the backlog fits VMEM), else the composed
+    :func:`resolve_graph_plane_step_xla`.  Same signature, donation set,
+    and bit-for-bit :class:`GraphPlaneStep` either way — executors, twin
+    replay, and shadow checks all call through here."""
+    from fantoch_tpu.ops import pallas_resolve as pr
+
+    args = (deps, key, src, seq, occ, executed,
+            u_row, u_deps, u_key, u_src, u_seq, p_row, p_col, p_val, e_row)
+    if pr.pallas_enabled() and pr._fits_vmem(deps, key, src, seq, u_deps):
+        return pr.route_dispatch(
+            "graph_plane_step", pr.graph_plane_step_pallas,
+            resolve_graph_plane_step_xla, args, {"mode": mode},
+        )
+    return resolve_graph_plane_step_xla(*args, mode=mode)
 
 
 def _resolve_general_iterative(deps, dot_src, dot_seq, max_iters):

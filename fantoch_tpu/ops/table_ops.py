@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fantoch_tpu.core.compile_cache import register_program
+
 _WINDOW_MAX = (1 << 31) - 1
 
 
@@ -255,28 +257,20 @@ def stable_clocks(frontiers: jax.Array, *, threshold: int) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("threshold",), donate_argnums=(0,))
-def fused_votes_commit(
-    frontier: jax.Array,  # int32[K, n], DONATED — resident vote frontiers
-    vkey: jax.Array,  # int32[V] — key bucket per vote range
-    vby: jax.Array,  # int32[V] — voting process, 0-based column index
+def _votes_commit_core(
+    frontier: jax.Array,  # int32[K, n]
+    vkey: jax.Array,  # int32[V]
+    vby: jax.Array,  # int32[V]
     vstart: jax.Array,  # int32[V]
     vend: jax.Array,  # int32[V]
-    valid: jax.Array,  # bool[V] — pad rows False
+    valid: jax.Array,  # bool[V]
     *,
     threshold: int,
 ):
-    """One dispatch for the executor side of the table plane: coalesce
-    vote ranges per (key, process), advance the resident frontiers, and
-    compute every key's stable clock.
-
-    Returns ``(new_frontier[K, n], stable[K], run_key[V], run_by[V],
-    run_start[V], run_end[V], residual[V])``: the ``run_*`` columns hold
-    the merged vote runs (one slot per run, invalid slots have
-    ``residual`` False) and ``residual`` marks runs that start beyond
-    the frontier gap — the caller buffers those and re-feeds them with
-    the next batch (RangeEventSet semantics preserved across batches).
-    """
+    """Traceable body of :func:`fused_votes_commit` — shared with the
+    Pallas table kernel (ops/pallas_resolve.py), which traces this same
+    program inside one VMEM-resident kernel body so the two routes are
+    bit-for-bit by construction."""
     K, n = frontier.shape
     V = vkey.shape[0]
     int_min = jnp.iinfo(jnp.int32).min
@@ -324,6 +318,54 @@ def fused_votes_commit(
     return new_frontier, stable, run_key, run_by, run_start, run_end, residual
 
 
+@functools.partial(jax.jit, static_argnames=("threshold",), donate_argnums=(0,))
+def fused_votes_commit_xla(
+    frontier: jax.Array,  # int32[K, n], DONATED — resident vote frontiers
+    vkey: jax.Array,  # int32[V] — key bucket per vote range
+    vby: jax.Array,  # int32[V] — voting process, 0-based column index
+    vstart: jax.Array,  # int32[V]
+    vend: jax.Array,  # int32[V]
+    valid: jax.Array,  # bool[V] — pad rows False
+    *,
+    threshold: int,
+):
+    """One dispatch for the executor side of the table plane: coalesce
+    vote ranges per (key, process), advance the resident frontiers, and
+    compute every key's stable clock.
+
+    Returns ``(new_frontier[K, n], stable[K], run_key[V], run_by[V],
+    run_start[V], run_end[V], residual[V])``: the ``run_*`` columns hold
+    the merged vote runs (one slot per run, invalid slots have
+    ``residual`` False) and ``residual`` marks runs that start beyond
+    the frontier gap — the caller buffers those and re-feeds them with
+    the next batch (RangeEventSet semantics preserved across batches).
+    """
+    return _votes_commit_core(
+        frontier, vkey, vby, vstart, vend, valid, threshold=threshold
+    )
+
+
+register_program("votes_commit_xla", fused_votes_commit_xla)
+
+
+def fused_votes_commit(frontier, vkey, vby, vstart, vend, valid, *, threshold):
+    """Route one table-plane commit dispatch: the Pallas-fused kernel
+    when :func:`fantoch_tpu.ops.pallas_resolve.pallas_enabled` says so
+    (and the window fits VMEM), else the composed
+    :func:`fused_votes_commit_xla`.  Same signature, donation, and
+    bit-for-bit 7-tuple either way (the residual-column protocol is
+    part of the contract)."""
+    from fantoch_tpu.ops import pallas_resolve as pr
+
+    args = (frontier, vkey, vby, vstart, vend, valid)
+    if pr.pallas_enabled() and pr._fits_vmem(frontier, vkey, vstart, vend):
+        return pr.route_dispatch(
+            "votes_commit", pr.votes_commit_pallas, fused_votes_commit_xla,
+            args, {"threshold": threshold},
+        )
+    return fused_votes_commit_xla(*args, threshold=threshold)
+
+
 def _fused_round_core(prior, frontier, key, min_clock, threshold, voters):
     """One full table round in-trace: proposal + contiguous vote
     application + stability.  The dense serving regime: the first
@@ -352,7 +394,7 @@ def _fused_round_core(prior, frontier, key, min_clock, threshold, voters):
 @functools.partial(
     jax.jit, static_argnames=("threshold", "voters"), donate_argnums=(0, 1)
 )
-def fused_table_round(
+def fused_table_round_xla(
     prior: jax.Array,  # int32[K], DONATED
     frontier: jax.Array,  # int32[K, n], DONATED
     key: jax.Array,  # int32[B]
@@ -368,6 +410,26 @@ def fused_table_round(
     gaps[])``; callers must keep the last key bucket as a scratch/pad
     bucket (the BatchedKeyClocks convention) if they pad batches."""
     return _fused_round_core(prior, frontier, key, min_clock, threshold, voters)
+
+
+register_program("table_round_xla", fused_table_round_xla)
+
+
+def fused_table_round(prior, frontier, key, min_clock, *, threshold, voters):
+    """Route one dense table round: the Pallas-fused kernel when
+    :func:`fantoch_tpu.ops.pallas_resolve.pallas_enabled` says so (and
+    the tables fit VMEM), else the composed
+    :func:`fused_table_round_xla`.  Bit-for-bit either way."""
+    from fantoch_tpu.ops import pallas_resolve as pr
+
+    args = (prior, frontier, key, min_clock)
+    kwargs = {"threshold": threshold, "voters": voters}
+    if pr.pallas_enabled() and pr._fits_vmem(prior, frontier, key):
+        return pr.route_dispatch(
+            "table_round", pr.table_round_pallas, fused_table_round_xla,
+            args, kwargs,
+        )
+    return fused_table_round_xla(*args, **kwargs)
 
 
 @functools.partial(

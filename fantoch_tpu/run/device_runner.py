@@ -1791,9 +1791,14 @@ class DeviceRuntime:
             plane.attach_failure_listener(on_failure)
 
     async def start(self) -> None:
+        from fantoch_tpu.core.compile_cache import ensure_compile_cache
         from fantoch_tpu.observability.device import subscribe_recompiles
 
         subscribe_recompiles()
+        # persistent compile cache before the first plane dispatch:
+        # restarted/rebuilt runners reload their programs from disk
+        # instead of re-paying the compile wall
+        ensure_compile_cache(self.config)
         self._arm_device_faults()
         server = await asyncio.start_server(self._on_client, *self.client_addr)
         self._servers = [server]
@@ -1822,7 +1827,12 @@ class DeviceRuntime:
         concurrently with driver.step, which runs to completion on the
         pool thread before the loop resumes): the snapshot task reads this
         consistent copy, not live counters mid-mutation."""
-        from fantoch_tpu.observability.device import compile_ms, recompile_count
+        from fantoch_tpu.observability.device import (
+            cache_hit_count,
+            cache_miss_count,
+            compile_ms,
+            recompile_count,
+        )
 
         d = self.driver
         self._tallies = {
@@ -1846,6 +1856,8 @@ class DeviceRuntime:
             **self._batcher.counters(),
             "jax_recompiles": recompile_count(),
             "jax_compile_ms": compile_ms(),
+            "jax_cache_hits": cache_hit_count(),
+            "jax_cache_misses": cache_miss_count(),
         }
 
     def _write_metrics_snapshot(self) -> None:
@@ -2062,6 +2074,19 @@ class DeviceRuntime:
                     batch.append((dot, cmd))
                     released += 1
                 batches.append(batch)
+            if len(batches) > 1:
+                # canonicalize the dispatched chain length to the pow2
+                # ladder: the chained step programs compile per chain
+                # length, so dispatching whatever 1..S rounds the queue
+                # happened to fill would mint a compiled program per
+                # value — truncate to the pow2 floor and requeue the
+                # remainder (it leads the next chain)
+                keep = 1
+                while keep * 2 <= len(batches):
+                    keep *= 2
+                for batch in reversed(batches[keep:]):
+                    pending[:0] = batch
+                del batches[keep:]
             if pending:
                 # overflow past S full rounds goes back to the requeue
                 # (next iteration dispatches it first)

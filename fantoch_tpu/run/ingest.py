@@ -298,10 +298,15 @@ class ChainAutoTuner:
     (``busy_ms / rounds``).  While the dispatch call still costs more
     than ``grow_frac`` of a round's device time, fusing more rounds per
     dispatch keeps paying — S doubles (fast convergence from cold).
-    Once overhead falls under ``shrink_frac`` the chain shrinks by one
-    (slow decay: hysteresis between the two bands keeps S stable).
-    Clamped to ``[1, chain_max]``; observations under ``min_dispatches``
-    new dispatches are deferred so one jittery round cannot thrash S.
+    Once overhead falls under ``shrink_frac`` the chain HALVES
+    (hysteresis between the two bands keeps S stable).  S moves on a
+    strict pow2 schedule — double up, halve down, ceiling at the pow2
+    floor of ``chain_max`` — because the chained step programs compile
+    per chain length: a decrement schedule would bake every value in
+    ``[1, chain_max]`` into a distinct compiled signature (the compile
+    wall), while pow2 bounds the set at O(log chain_max) programs.
+    Observations under ``min_dispatches`` new dispatches are deferred so
+    one jittery round cannot thrash S.
     """
 
     __slots__ = (
@@ -318,7 +323,12 @@ class ChainAutoTuner:
     ):
         assert chain_max >= 1
         self.chain = 1
-        self.chain_max = int(chain_max)
+        # pow2 floor: the largest chain the tuner will emit.  chain_max
+        # itself may be arbitrary (config/env), but every EMITTED S must
+        # come from the pow2 ladder (see the class docstring)
+        self.chain_max = 1
+        while self.chain_max * 2 <= int(chain_max):
+            self.chain_max *= 2
         self.grow_frac = float(grow_frac)
         self.shrink_frac = float(shrink_frac)
         self.min_dispatches = int(min_dispatches)
@@ -352,7 +362,9 @@ class ChainAutoTuner:
             self.chain = min(self.chain * 2, self.chain_max)
             self.adjustments += 1
         elif ratio < self.shrink_frac and self.chain > 1:
-            self.chain -= 1
+            # halve, not decrement: stay on the pow2 ladder so shrink
+            # never mints a fresh compiled chain program
+            self.chain //= 2
             self.adjustments += 1
         return self.chain
 

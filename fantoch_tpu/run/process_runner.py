@@ -859,9 +859,14 @@ class ProcessRuntime:
             or self.config.batched_table_executor
             or self.config.batched_pred_executor
         ):
+            from fantoch_tpu.core.compile_cache import ensure_compile_cache
             from fantoch_tpu.observability.device import subscribe_recompiles
 
             subscribe_recompiles()
+            # persistent compile cache before the first dispatch:
+            # restarted processes reload programs from disk instead of
+            # re-paying the compile wall
+            ensure_compile_cache(self.config, obs_dir=self._obs_dir())
         peer_server = await asyncio.start_server(self._on_peer, *self.listen_addr)
         client_server = await asyncio.start_server(self._on_client, *self.client_addr)
         self._servers = [peer_server, client_server]
@@ -1910,7 +1915,10 @@ class ProcessRuntime:
                     name, value,
                     pid=(
                         None
-                        if name in ("jax_recompiles", "jax_compile_ms")
+                        if name in (
+                            "jax_recompiles", "jax_compile_ms",
+                            "jax_cache_hits", "jax_cache_misses",
+                        )
                         else self.process.id
                     ),
                 )
@@ -1944,6 +1952,8 @@ class ProcessRuntime:
         runtime's snapshot carries the same total, so readers must not
         sum it across runtimes of one host."""
         from fantoch_tpu.observability.device import (
+            cache_hit_count,
+            cache_miss_count,
             compile_ms,
             derive_idle_frac,
             merge_counters,
@@ -1959,6 +1969,8 @@ class ProcessRuntime:
             derive_idle_frac(device)
             device["jax_recompiles"] = recompile_count()
             device["jax_compile_ms"] = compile_ms()
+            device["jax_cache_hits"] = cache_hit_count()
+            device["jax_cache_misses"] = cache_miss_count()
             return device
         return None
 

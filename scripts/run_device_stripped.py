@@ -21,6 +21,14 @@ On jax >= 0.5 the guard is inactive and the regular suite already runs
 the modules; the script exits 0 without duplicating the work (pass
 ``--force`` to run the stripped copies anyway).
 
+A second leg re-runs the Pallas parity suite
+(tests/test_pallas_resolve.py) in its own pytest process with
+``FANTOCH_PALLAS=1`` forced through the environment: tier-1 already runs
+the suite with routes forced per-test, but this leg additionally proves
+the ENV escape-hatch path — the route every executor takes when the flag
+is set rig-wide — end to end on whatever backend is attached (interpret
+mode on the CPU pin, Mosaic-lowered kernels on a TPU rig).
+
 Usage: make test-device-stripped  (or: python scripts/run_device_stripped.py)
 """
 
@@ -48,6 +56,28 @@ def guarded_modules():
     return found
 
 
+def run_pallas_forced() -> int:
+    """Re-run the Pallas parity suite with FANTOCH_PALLAS=1 forced: the
+    env-route leg (executors resolve the route from the environment, not
+    a per-test override)."""
+    suite = os.path.join(REPO, "tests", "test_pallas_resolve.py")
+    if not os.path.exists(suite):
+        print(
+            "tests/test_pallas_resolve.py is gone: update "
+            "scripts/run_device_stripped.py",
+            file=sys.stderr,
+        )
+        return 2
+    return subprocess.run(
+        [
+            sys.executable, "-m", "pytest", suite, "-q",
+            "-p", "no:cacheprovider", "-p", "no:randomly",
+        ],
+        cwd=REPO,
+        env={**os.environ, "FANTOCH_PALLAS": "1"},
+    ).returncode
+
+
 def main() -> int:
     import jax
 
@@ -56,9 +86,10 @@ def main() -> int:
         print(
             f"jax {jax.__version__}: the version guard is inactive and the "
             "regular suite runs the guarded device modules — nothing to "
-            "strip (pass --force to run the stripped copies anyway)"
+            "strip (pass --force to run the stripped copies anyway); "
+            "running the FANTOCH_PALLAS=1 leg only"
         )
-        return 0
+        return run_pallas_forced()
 
     modules = guarded_modules()
     if not modules:
@@ -104,7 +135,7 @@ def main() -> int:
                 os.unlink(stripped)
             except OSError:
                 pass
-    return rc
+    return run_pallas_forced() or rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,200 @@
+"""Compile-wall regression suite (core/compile_cache.py).
+
+Two proofs the ISSUE demands:
+
+* **Compiled-identity discipline** — a multi-point sweep over batch
+  sizes routed through the canonicalized (pow2-padded) shapes compiles
+  each plane program exactly ONCE (``program_compile_counts`` reads each
+  registered jit's compiled-signature count).  A count > 1 names the
+  program whose inputs leaked a non-canonical axis into the signature.
+
+* **Persistent-cache collapse** — a cold-then-warm subprocess pair
+  against one cache directory: the warm run retrieves every program from
+  disk (``cache_hits > 0``), pays ZERO true XLA compiles
+  (``recompile_count() == 0`` — the hit/miss-paired counter), and its
+  ``compile_ms`` collapses versus cold.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from fantoch_tpu.core import compile_cache
+
+
+def test_registry_counts_and_identities():
+    """register/program_compile_counts round-trip on a toy jit."""
+    import jax
+
+    @jax.jit
+    def toy(x):
+        return x + 1
+
+    compile_cache.register_program("_toy", toy)
+    try:
+        assert compile_cache.program_compile_counts()["_toy"] == 0
+        toy(np.zeros((4,), np.float32))
+        toy(np.ones((4,), np.float32))  # same shape: same signature
+        assert compile_cache.program_compile_counts()["_toy"] == 1
+        toy(np.zeros((8,), np.float32))  # new shape: second signature
+        assert compile_cache.program_compile_counts()["_toy"] == 2
+        assert compile_cache.compiled_program_identities() >= 2
+    finally:
+        compile_cache._programs.pop("_toy", None)
+
+
+def test_resolve_cache_dir_precedence(monkeypatch, tmp_path):
+    """config > FANTOCH_COMPILE_CACHE_DIR > obs-dir default > None."""
+    from fantoch_tpu.core.config import Config
+
+    monkeypatch.delenv("FANTOCH_COMPILE_CACHE_DIR", raising=False)
+    assert compile_cache.resolve_cache_dir(None) is None
+    assert compile_cache.resolve_cache_dir(
+        None, obs_dir=str(tmp_path)
+    ) == os.path.join(str(tmp_path), ".jax_cache")
+    monkeypatch.setenv("FANTOCH_COMPILE_CACHE_DIR", "/env/dir")
+    assert compile_cache.resolve_cache_dir(None, obs_dir=str(tmp_path)) == "/env/dir"
+    cfg = Config(3, 1, compile_cache_dir="/cfg/dir")
+    assert compile_cache.resolve_cache_dir(cfg, obs_dir=str(tmp_path)) == "/cfg/dir"
+
+
+def test_plane_sweep_compiles_each_program_once():
+    """5-point batch-size sweep through the canonicalized shapes: every
+    plane program ends the sweep with exactly ONE compiled signature.
+
+    The sweep drives the real call paths (the table plane's pow2 vote
+    padding, the pred/graph planes' pow2 feed chopping) with batch sizes
+    chosen to land in one pow2 bucket — the canonicalization the compile
+    wall depends on."""
+    import random
+
+    from fantoch_tpu.executor.table_plane import DeviceTablePlane
+    from tests.test_pred_plane import _plane_executor
+
+    # table plane: 5 batch sizes inside one pow2 pad (vcap 16)
+    plane = DeviceTablePlane(3, stability_threshold=2, key_buckets=8)
+    for k in range(6):
+        plane.bucket(f"k{k}")
+    before = compile_cache.program_compile_counts()["votes_commit_xla"]
+    r = random.Random(5)
+    for batch in (9, 11, 13, 15, 16):
+        vk = np.array([r.randrange(0, 6) for _ in range(batch)], np.int64)
+        vb = np.array([r.randrange(1, 4) for _ in range(batch)], np.int64)
+        # contiguous-from-1 ranges: no residual re-feeds, so V == batch
+        # and all five sizes land in the SAME pow2 vote pad (16)
+        vs = np.ones(batch, np.int64)
+        ve = np.array([r.randrange(1, 10) for _ in range(batch)], np.int64)
+        plane.commit_votes(vk, vb, vs, ve)
+    after = compile_cache.program_compile_counts()["votes_commit_xla"]
+    assert after - before == 1, (
+        "table-plane sweep minted extra compiled signatures: a batch "
+        "axis leaked past the pow2 pad"
+    )
+
+    # pred plane: 5 feed sizes inside one pow2 install pad (ucap 8) over
+    # a bounded-dep-width chain workload (width growth is a legitimate
+    # O(log) axis; this pins the FEED axis)
+    from fantoch_tpu.core.ids import Dot
+    from fantoch_tpu.executor.pred import PredecessorsExecutionInfo
+    from fantoch_tpu.protocol.common.pred_clocks import Clock
+    from tests.test_pred_plane import cmd
+
+    def chain_infos(count):
+        infos, last = [], {}
+        for i in range(count):
+            src = (i % 3) + 1
+            dot = Dot(src, i + 1)
+            k = f"K{i % 2}"
+            deps = {last[k]} if k in last else set()
+            last[k] = dot
+            infos.append(
+                PredecessorsExecutionInfo(
+                    dot, cmd(i + 1, [k]), Clock(i + 1, src), deps
+                )
+            )
+        return infos
+
+    counts0 = compile_cache.program_compile_counts()["pred_plane_step_xla"]
+    ex = _plane_executor()
+    infos = chain_infos(40)
+    at = 0
+    for size in (5, 6, 7, 8, 5):
+        ex.handle_batch(infos[at : at + size], None)
+        at += size
+    counts1 = compile_cache.program_compile_counts()["pred_plane_step_xla"]
+    assert counts1 - counts0 == 1, (
+        "pred-plane sweep minted extra compiled signatures: a feed axis "
+        "leaked past the pow2 chop"
+    )
+
+
+_SUBPROC = textwrap.dedent(
+    """
+    import json, sys
+    from fantoch_tpu.hostenv import force_cpu_platform
+    force_cpu_platform()
+    from fantoch_tpu.core.compile_cache import ensure_compile_cache
+    from fantoch_tpu.observability.device import (
+        cache_hit_count, cache_miss_count, compile_ms, recompile_count,
+        subscribe_recompiles,
+    )
+
+    class Cfg:
+        compile_cache_dir = sys.argv[1]
+
+    subscribe_recompiles()
+    ensure_compile_cache(Cfg())
+
+    import numpy as np
+    from fantoch_tpu.ops.table_ops import fused_votes_commit_xla
+    import jax.numpy as jnp
+
+    f = jnp.zeros((8, 3), jnp.int32)
+    out = fused_votes_commit_xla(
+        f, jnp.zeros((8,), jnp.int32), jnp.zeros((8,), jnp.int32),
+        jnp.ones((8,), jnp.int32), jnp.ones((8,), jnp.int32),
+        jnp.ones((8,), bool), threshold=2,
+    )
+    [o.block_until_ready() for o in out]
+    print(json.dumps({
+        "recompiles": recompile_count(),
+        "hits": cache_hit_count(),
+        "misses": cache_miss_count(),
+        "compile_ms": compile_ms(),
+    }))
+    """
+)
+
+
+@pytest.mark.slow
+def test_cold_vs_warm_persistent_cache(tmp_path):
+    """Cold run misses and truly compiles; the warm run against the same
+    cache dir retrieves from disk (hits > 0), reports ZERO true
+    recompiles, and its compile wall collapses."""
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, "-c", _SUBPROC, str(tmp_path / "cache")],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    cold = run()
+    assert cold["misses"] > 0
+    assert cold["recompiles"] > 0
+    warm = run()
+    assert warm["hits"] > 0
+    assert warm["recompiles"] == 0, (
+        "warm persistent cache still paid a true XLA compile"
+    )
+    assert warm["compile_ms"] < max(cold["compile_ms"], 1.0), (
+        f"no compile-wall collapse: cold {cold['compile_ms']} ms vs "
+        f"warm {warm['compile_ms']} ms"
+    )

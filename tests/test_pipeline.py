@@ -386,19 +386,52 @@ def test_chain_autotuner_convergence():
     assert seen == [2, 4, 8, 8, 8, 8]
     assert tuner.adjustments == 3
 
-    # overhead collapses far under shrink_frac: S decays one at a time
-    # (and an observation under min_dispatches new dispatches is
-    # deferred — it folds into the next qualifying delta)
+    # overhead collapses far under shrink_frac: S halves down the pow2
+    # ladder (never a decrement — each chain length is a distinct
+    # compiled program, so the tuner only emits pow2 values; see the
+    # ChainAutoTuner docstring) — and an observation under
+    # min_dispatches new dispatches is deferred, folding into the next
+    # qualifying delta
     O = 0.01
     before = tuner.chain
     assert feed(3) == before
-    assert feed(8) == 7
-    assert feed(8) == 6
+    assert feed(8) == 4
+    assert feed(8) == 2
 
     # hysteresis: a ratio inside [shrink, grow] leaves S alone
-    O = 6 * C * 0.1  # ratio 0.1 at S=6
-    assert feed(8) == 6
-    assert feed(8) == 6
+    O = 2 * C * 0.1  # ratio 0.1 at S=2
+    assert feed(8) == 2
+    assert feed(8) == 2
+
+
+def test_chain_autotuner_pow2_only():
+    """Every S the tuner can emit is a power of two, and the ceiling is
+    the pow2 FLOOR of an arbitrary chain_max — a non-pow2 ceiling would
+    bake a fresh compiled chain program the moment the tuner hit it."""
+    from fantoch_tpu.run.ingest import ChainAutoTuner
+
+    tuner = ChainAutoTuner(chain_max=13)
+    assert tuner.chain_max == 8
+    counters = [0.0, 0.0, 0.0, 0.0]
+    O, C = 4.0, 0.5
+
+    def feed(n):
+        S = tuner.chain
+        counters[0] += n
+        counters[1] += n * O
+        counters[2] += n * S * C
+        counters[3] += n * S
+        return tuner.observe(*counters)
+
+    feed(8)  # seed
+    seen = set()
+    for _ in range(12):
+        seen.add(feed(8))
+    O = 0.001  # collapse: walk back down
+    for _ in range(12):
+        seen.add(feed(8))
+    assert seen <= {1, 2, 4, 8}
+    assert tuner.chain == 1
 
 
 def test_plan_ingest_releases_oracle():
