@@ -1,7 +1,7 @@
 # Test/bench entry points (the reference pins quality with Makefile:3-7 —
 # fmt + clippy + `cargo test` under a quickcheck budget; here the suite +
 # dryrun + bench are the equivalent gates).
-.PHONY: test test-fast test-chaos test-recovery test-restart test-overload test-fuzz test-devicefault test-device-stripped dryrun bench bench-smoke trace-smoke critpath-smoke overload-smoke fuzz-smoke failover-smoke telemetry-smoke pallas-smoke
+.PHONY: test test-fast test-chaos test-recovery test-restart test-overload test-fuzz test-devicefault test-device-stripped dryrun bench bench-smoke trace-smoke critpath-smoke overload-smoke fuzz-smoke failover-smoke telemetry-smoke pallas-smoke scenario-smoke
 
 test:
 	python -m pytest tests/ -x -q
@@ -133,3 +133,11 @@ failover-smoke:
 # failover-smoke
 pallas-smoke:
 	python scripts/pallas_smoke.py
+
+# scenario-observatory gate (r20): a declarative spec expands
+# byte-identically, a 3-point offered-rate ladder (sim timeline, EPaxos
+# n=3) runs to a DETECTED saturation knee with p50/p95/p99 + goodput
+# per point, curves.json round-trips through plot/db, the PNG renders
+# headless, and `obs curves` passes the spec's SLO verdicts
+scenario-smoke:
+	python scripts/scenario_smoke.py

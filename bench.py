@@ -365,6 +365,13 @@ def child_main(mode: str) -> None:
         print(f"# overload bench failed: {exc!r}", file=sys.stderr)
         record["overload_error"] = repr(exc)[:200]
     try:
+        # r20 scenario-curve row: deterministic virtual-time sim, so it
+        # rides both children unchanged (no backend in the loop)
+        record.update(bench_curve())
+    except Exception as exc:  # noqa: BLE001
+        print(f"# curve bench failed: {exc!r}", file=sys.stderr)
+        record["curve_error"] = repr(exc)[:200]
+    try:
         # accelerator failover drill (fault plane, r17): rides both
         # children — the table plane + injector are backend-agnostic
         record.update(bench_failover())
@@ -2054,6 +2061,64 @@ def bench_overload(
     return out
 
 
+def bench_curve(
+    commands_per_client: int = 10,
+    clients_per_process: int = 2,
+    rates=(50.0, 400.0, 3200.0),
+) -> dict:
+    """Scenario-observatory saturation row (r20): a declarative spec
+    (exp/scenarios.py) sweeps sim-timeline EPaxos n=3 over an offered
+    open-loop rate ladder and the row reports the detected saturation
+    knee plus the p99 at half saturation.  Runs on the deterministic
+    virtual-time sim — the knee is real (goodput caps at
+    total_commands / commit-latency span as the arrival window
+    compresses) and byte-stable across machines, so the regression band
+    guards the *curve pipeline*, not rig noise."""
+    import shutil
+    import tempfile
+
+    from fantoch_tpu.exp.scenarios import ScenarioSpec, run_scenario
+
+    spec = ScenarioSpec(
+        name="bench_curve",
+        protocols=("epaxos",),
+        sites=((3, 1),),
+        timeline="sim",
+        seed=20,
+        clients_per_process=clients_per_process,
+        commands_per_client=commands_per_client,
+        rates=tuple(rates),
+    )
+    out_dir = tempfile.mkdtemp(prefix="bench_curve_")
+    try:
+        doc = run_scenario(spec, out_dir, render=False)
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)
+    curve = doc["curves"][0]
+    out = {
+        "curve_definition": (
+            "sim-timeline EPaxos n=3 (gcp planet), seed 20, offered "
+            "open-loop ladder 50/400/3200 cmds/s via exp/scenarios "
+            "run_scenario; knee = detect_knee defaults (r20)"
+        ),
+        "curve_points": len(curve["points"]),
+    }
+    knee = curve["knee"]
+    assert knee is not None, "bench_curve ladder must reach saturation"
+    out["curve_knee_offered_cmds_per_s"] = knee["offered_cmds_per_s"]
+    out["curve_knee_goodput_cmds_per_s"] = knee["goodput_cmds_per_s"]
+    # p99 at half saturation: the measured point whose offered rate is
+    # nearest half the knee's offered rate (no interpolation — the
+    # ladder is coarse and the row must stay deterministic)
+    half = knee["offered_cmds_per_s"] / 2.0
+    nearest = min(
+        (p for p in curve["points"] if p["offered_cmds_per_s"]),
+        key=lambda p: abs(p["offered_cmds_per_s"] - half),
+    )
+    out["curve_p99_at_half_saturation_ms"] = nearest["p99_ms"]
+    return out
+
+
 def bench_failover(
     keys: int = 256, rounds: int = 30, votes_per_round: int = 2048,
     fault_at: int = 10, down: int = 8,
@@ -2468,6 +2533,10 @@ REGRESS_BANDS = (
     # harder than any plumbing change; the chip rows carry the claim
     ("pallas_resolve_", 2.5),
     ("table_pallas_", 2.5),
+    # scenario-curve rows (r20) ride the deterministic sim, but the knee
+    # snaps between ladder points when detect_knee thresholds or the
+    # serving path move — same coarse-grained band as overload_
+    ("curve_", 3.0),
     ("", 1.5),
 )
 
@@ -2489,6 +2558,9 @@ DEFINITION_STAMPS = (
     # executor-seam wall moved to general_fallback_seam_ms)
     ("general_fallback_", "general_fallback_definition"),
     ("failover_", "failover_definition"),
+    # r20 scenario-curve rows: the knee keys only compare when both
+    # records ran the same ladder + detector definition
+    ("curve_", "curve_definition"),
 )
 
 
@@ -2700,6 +2772,9 @@ def smoke_main() -> None:
     # the smoke here, not on the rig
     out.update(bench_pallas_resolve(cap=128, width=4, rounds=4))
     out.update(bench_table_pallas(keys=64, batch=256, rounds=4))
+    # r20 scenario-curve row: deterministic sim sweep — asserts in-row
+    # that the ladder saturates (a missing knee is a pipeline break)
+    out.update(bench_curve())
     out["jax_recompiles"] = recompile_count()
     out["jax_compile_ms"] = compile_ms()
     out["jax_cache_hits"] = cache_hit_count()
@@ -2789,6 +2864,12 @@ def smoke_main() -> None:
     assert out["pallas_resolve_graph_ms"] > 0, out
     assert out["table_pallas_commit_ms"] > 0, out
     assert out["pallas_resolve_interpret"] is True, out  # cpu smoke
+    # the r20 curve row: all three ladder points measured, knee detected
+    # past the first point (the 50/s point must serve comfortably), and
+    # the knee's goodput nonzero
+    assert out["curve_points"] == 3, out
+    assert out["curve_knee_goodput_cmds_per_s"] > 0, out
+    assert out["curve_knee_offered_cmds_per_s"] > 50, out
     # compile-wall discipline (r19): on a warm persistent cache every
     # program is RETRIEVED (hits, no misses) and the true-recompile
     # counter stays at zero; a cold cache legitimately misses and

@@ -501,6 +501,66 @@ def cmd_diff(args) -> int:
     return 1
 
 
+def cmd_curves(args) -> int:
+    """Capacity/SLO report over a scenario curves document: the knee
+    table (per curve: points, detected saturation knee, p99 at the knee)
+    and every per-cell SLO verdict (typed pass/fail, targets from the
+    spec's slo block).  Exit 1 when any verdict fails — the CI shape."""
+    import json as _json
+    import os
+
+    from fantoch_tpu.plot.db import load_curves
+
+    path = args.curves
+    if os.path.isdir(path):
+        path = os.path.join(path, "curves.json")
+    doc = load_curves(path)
+    if args.json:
+        print(_json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        print(f"scenario {doc['scenario']} ({doc['timeline']} timeline, "
+              f"seed {doc['seed']})")
+        header = (
+            f"{'curve':<24} {'points':>6} {'knee offered/s':>14} "
+            f"{'knee goodput/s':>14} {'p99@knee ms':>12}"
+        )
+        print(header)
+        for curve in doc["curves"]:
+            label = f"{curve['protocol']} n={curve['n']} f={curve['f']}"
+            knee = curve.get("knee")
+            if knee is None:
+                print(f"{label:<24} {len(curve['points']):>6} "
+                      f"{'unsaturated':>14} {'-':>14} {'-':>12}")
+                continue
+            offered = knee["offered_cmds_per_s"]
+            print(
+                f"{label:<24} {len(curve['points']):>6} "
+                f"{offered if offered is not None else '-':>14} "
+                f"{knee['goodput_cmds_per_s']:>14} "
+                f"{knee['p99_ms'] if knee['p99_ms'] is not None else '-':>12}"
+            )
+    failed = 0
+    checked = 0
+    for curve in doc["curves"]:
+        for verdict in curve.get("slo", []):
+            if not verdict["checks"]:
+                continue
+            checked += 1
+            status = "PASS" if verdict["pass"] else "FAIL"
+            if not verdict["pass"]:
+                failed += 1
+            if not args.json:
+                details = ", ".join(
+                    f"{name} {check['actual']} vs {check['target']} "
+                    f"{'ok' if check['pass'] else 'VIOLATED'}"
+                    for name, check in sorted(verdict["checks"].items())
+                )
+                print(f"  slo {status} {verdict['cell']}: {details}")
+    if not args.json and checked == 0:
+        print("  (no SLO declared in the spec)")
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="obs", description="dot-lifecycle trace tooling"
@@ -560,6 +620,17 @@ def main(argv=None) -> int:
     p.add_argument("--tol-abs-us", type=int, default=20_000,
                    help="absolute tolerance per segment (default 20ms)")
     p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser(
+        "curves",
+        help="scenario knee table + per-cell SLO verdicts "
+        "(exp/scenarios.py curves.json)",
+    )
+    p.add_argument("curves",
+                   help="curves.json path or a scenario output dir")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw curves document")
+    p.set_defaults(fn=cmd_curves)
 
     args = parser.parse_args(argv)
     return args.fn(args)

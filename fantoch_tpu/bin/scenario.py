@@ -1,0 +1,88 @@
+"""Scenario observatory CLI: expand / run declarative sweep specs.
+
+The fantoch_exp driver analog over exp/scenarios.py: a JSON spec file
+declares the whole cross product (protocol x n/f x fault plan x skew x
+rate ladder x knobs x placement) and this tool either prints its
+deterministic expansion (``expand`` — byte-identical for the same spec,
+the reproducibility contract) or executes every cell and emits the
+throughput-latency curve artifacts (``run`` — per-cell obs dirs,
+``curves.json``, rendered PNG).  Inspect results with
+``python -m fantoch_tpu.bin.obs curves <out dir>``.
+
+    python -m fantoch_tpu.bin.scenario expand spec.json
+    python -m fantoch_tpu.bin.scenario run spec.json --out /tmp/obs
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def cmd_expand(args) -> int:
+    from fantoch_tpu.exp.scenarios import canonical_expansion, load_spec
+
+    text = canonical_expansion(load_spec(args.spec))
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text)
+            fh.write("\n")
+    else:
+        print(text)
+    return 0
+
+
+def cmd_run(args) -> int:
+    from fantoch_tpu.exp.scenarios import load_spec, run_scenario
+
+    doc = run_scenario(
+        load_spec(args.spec), args.out, render=not args.no_render
+    )
+    failed = 0
+    for curve in doc["curves"]:
+        label = f"{curve['protocol']} n={curve['n']} f={curve['f']}"
+        knee = curve.get("knee")
+        knee_text = (
+            f"knee at offered {knee['offered_cmds_per_s']}/s "
+            f"(goodput {knee['goodput_cmds_per_s']}/s)"
+            if knee is not None
+            else "unsaturated"
+        )
+        print(f"{label}: {len(curve['points'])} points, {knee_text}")
+        failed += sum(
+            1 for verdict in curve["slo"]
+            if verdict["checks"] and not verdict["pass"]
+        )
+    print(f"artifacts in {args.out} (curves.json"
+          + ("" if args.no_render else " + curves.png") + ")")
+    return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="scenario", description="declarative scenario sweeps"
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser(
+        "expand", help="print the deterministic run-matrix expansion"
+    )
+    p.add_argument("spec", help="scenario spec JSON file")
+    p.add_argument("--out", help="write the expansion here instead")
+    p.set_defaults(fn=cmd_expand)
+
+    p = sub.add_parser(
+        "run", help="execute every cell and emit saturation curves"
+    )
+    p.add_argument("spec", help="scenario spec JSON file")
+    p.add_argument("--out", required=True, help="output directory")
+    p.add_argument("--no-render", action="store_true",
+                   help="skip the PNG (curves.json only)")
+    p.set_defaults(fn=cmd_run)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,7 +3,10 @@
 Reference: fantoch_ps/src/bin/shard_distribution.rs:1-111 — for a given
 shard count and zipf coefficient, sample commands and report how many
 touch more than one shard (and more than one key), the planner-side input
-for deciding whether partial replication pays off.
+for deciding whether partial replication pays off.  The scenario
+observatory (exp/scenarios.expand) calls :func:`compute_distribution`
+directly so every zipf spec's expansion manifest carries its expected
+multi-shard fraction.
 
     python -m fantoch_tpu.bin.shard_distribution --shard-count 4 \\
         --keys-per-command 2 --coefficient 0.7
@@ -13,6 +16,53 @@ from __future__ import annotations
 
 import argparse
 import json
+from typing import Dict
+
+
+def compute_distribution(
+    shard_count: int,
+    keys_per_command: int = 2,
+    coefficient: float = 1.0,
+    keys_per_shard: int = 1_000_000,
+    commands: int = 10_000,
+    seed: int = 0,
+) -> Dict[str, float]:
+    """Deterministic for fixed inputs (seeded rng, analytic zipf cdf)."""
+    import random
+
+    from fantoch_tpu.client.key_gen import KeyGenState, ZipfKeyGen
+    from fantoch_tpu.client.workload import Workload
+    from fantoch_tpu.core.ids import IdGen
+
+    workload = Workload(
+        shard_count=shard_count,
+        key_gen=ZipfKeyGen(coefficient, keys_per_shard),
+        keys_per_command=keys_per_command,
+        commands_per_client=commands,
+        payload_size=0,
+    )
+    state = KeyGenState(
+        workload.key_gen, shard_count, 1, rng=random.Random(seed)
+    )
+    rifl_gen = IdGen(1)
+
+    multi_shard = 0
+    multi_key = 0
+    for _ in range(commands):
+        nxt = workload.next_cmd(rifl_gen, state)
+        assert nxt is not None
+        _target, cmd = nxt
+        if cmd.multi_shard():
+            multi_shard += 1
+        if cmd.total_key_count > 1:
+            multi_key += 1
+
+    return {
+        "shard_count": shard_count,
+        "commands": commands,
+        "multi_shard_pct": round(100 * multi_shard / commands, 2),
+        "multi_key_pct": round(100 * multi_key / commands, 2),
+    }
 
 
 def main(argv=None) -> None:
@@ -26,43 +76,16 @@ def main(argv=None) -> None:
     parser.add_argument("--commands", type=int, default=10_000)
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
-
-    from fantoch_tpu.client.key_gen import KeyGenState, ZipfKeyGen
-    from fantoch_tpu.client.workload import Workload
-    from fantoch_tpu.core.ids import IdGen
-    import random
-
-    workload = Workload(
-        shard_count=args.shard_count,
-        key_gen=ZipfKeyGen(args.coefficient, args.keys_per_shard),
-        keys_per_command=args.keys_per_command,
-        commands_per_client=args.commands,
-        payload_size=0,
-    )
-    state = KeyGenState(
-        workload.key_gen, args.shard_count, 1, rng=random.Random(args.seed)
-    )
-    rifl_gen = IdGen(1)
-
-    multi_shard = 0
-    multi_key = 0
-    for _ in range(args.commands):
-        nxt = workload.next_cmd(rifl_gen, state)
-        assert nxt is not None
-        _target, cmd = nxt
-        if cmd.multi_shard():
-            multi_shard += 1
-        if cmd.total_key_count > 1:
-            multi_key += 1
-
     print(
         json.dumps(
-            {
-                "shard_count": args.shard_count,
-                "commands": args.commands,
-                "multi_shard_pct": round(100 * multi_shard / args.commands, 2),
-                "multi_key_pct": round(100 * multi_key / args.commands, 2),
-            }
+            compute_distribution(
+                shard_count=args.shard_count,
+                keys_per_command=args.keys_per_command,
+                coefficient=args.coefficient,
+                keys_per_shard=args.keys_per_shard,
+                commands=args.commands,
+                seed=args.seed,
+            )
         ),
         flush=True,
     )

@@ -13,5 +13,23 @@ and raises with a clear message.
 
 from fantoch_tpu.exp.config import ExperimentConfig
 from fantoch_tpu.exp.bench import run_experiment, run_sweep
+from fantoch_tpu.exp.scenarios import (
+    ScenarioSpec,
+    canonical_expansion,
+    detect_knee,
+    expand,
+    load_spec,
+    run_scenario,
+)
 
-__all__ = ["ExperimentConfig", "run_experiment", "run_sweep"]
+__all__ = [
+    "ExperimentConfig",
+    "ScenarioSpec",
+    "canonical_expansion",
+    "detect_knee",
+    "expand",
+    "load_spec",
+    "run_experiment",
+    "run_scenario",
+    "run_sweep",
+]

@@ -7,11 +7,17 @@ FPaxos/EPaxos baselines.
 """
 
 from fantoch_tpu.planner.bote import Bote, minority, quorum_size
-from fantoch_tpu.planner.search import ConfigScore, RankingParams, Search
+from fantoch_tpu.planner.search import (
+    ConfigScore,
+    Placement,
+    RankingParams,
+    Search,
+)
 
 __all__ = [
     "Bote",
     "ConfigScore",
+    "Placement",
     "RankingParams",
     "Search",
     "minority",

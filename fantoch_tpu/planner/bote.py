@@ -28,16 +28,22 @@ def minority(n: int) -> int:
 
 
 def quorum_size(protocol: str, n: int, f: int) -> int:
-    """Per-protocol quorum size (fantoch_bote/src/protocol.rs:20-35).
+    """Per-protocol (fast-path) quorum size, matching the Config helpers
+    in core/config.py (fantoch_bote/src/protocol.rs:20-35).
 
-    EPaxos ignores the given f: it always tolerates a minority."""
-    if protocol == "fpaxos":
+    EPaxos ignores the given f: it always tolerates a minority.  Newt's
+    fast quorum is minority + f (Config.newt_quorum_sizes, non-tiny);
+    Caesar's is 3n//4 + 1 (Config.caesar_quorum_sizes); Basic and
+    FPaxos write to a bare majority-of-voters f + 1."""
+    if protocol in ("fpaxos", "basic"):
         return f + 1
     if protocol == "epaxos":
         fm = minority(n)
         return fm + (fm + 1) // 2
-    if protocol == "atlas":
+    if protocol in ("atlas", "newt"):
         return minority(n) + f
+    if protocol == "caesar":
+        return (3 * n) // 4 + 1
     raise ValueError(f"unknown protocol {protocol}")
 
 

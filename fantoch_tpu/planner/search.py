@@ -50,6 +50,17 @@ class ConfigScore:
     stats: Dict[str, Histogram] = field(compare=False, hash=False, default_factory=dict)
 
 
+@dataclass(frozen=True)
+class Placement:
+    """A single-protocol placement picked by :meth:`Search.best_placement`
+    — the scenario observatory's config *output* (the expansion manifest
+    records both the chosen regions and the objective value)."""
+
+    regions: Tuple[Region, ...]
+    objective: str
+    value: float
+
+
 class Search:
     def __init__(
         self,
@@ -118,6 +129,92 @@ class Search:
         q_ep = quorum_size("epaxos", n, minority(n))
         add("e", to_closest + ss[closest_srv, q_ep - 1])
         return out
+
+    # --- single-protocol placement (scenario observatory) ---
+
+    def placement_latencies(
+        self,
+        config: Sequence[Region],
+        protocol: str,
+        f: int,
+        colocated: bool = False,
+    ) -> np.ndarray:
+        """Per-client perceived latency (ms) for one protocol on one
+        placement: leaderless protocols pay client -> closest server ->
+        that server's closest quorum; fpaxos pays client -> best leader
+        -> the leader's closest quorum (same math as compute_stats, one
+        protocol at a time)."""
+        n = len(config)
+        clients = list(config) if colocated else self._clients
+        sidx = np.array([self._index[r] for r in config])
+        cidx = np.array([self._index[r] for r in clients])
+        ss = np.sort(self._matrix[np.ix_(sidx, sidx)], axis=1)
+        q = quorum_size(protocol, n, f)
+        assert q <= n, f"{protocol} quorum {q} exceeds n={n}"
+        if protocol == "fpaxos":
+            best = None
+            for leader_pos in range(n):
+                lat = (
+                    self._matrix[np.ix_(cidx, sidx[leader_pos : leader_pos + 1])][:, 0]
+                    + ss[leader_pos, q - 1]
+                )
+                mean = lat.mean()
+                if best is None or mean < best[0]:
+                    best = (mean, lat)
+            assert best is not None
+            return best[1]
+        cs = self._matrix[np.ix_(cidx, sidx)]
+        closest_srv = np.argmin(cs, axis=1)
+        to_closest = cs[np.arange(len(cidx)), closest_srv]
+        return to_closest + ss[closest_srv, q - 1]
+
+    @staticmethod
+    def _objective_value(latencies: np.ndarray, objective: str) -> float:
+        if objective == "mean":
+            return float(latencies.mean())
+        if objective == "p95":
+            return float(np.percentile(latencies, 95))
+        if objective == "p99":
+            return float(np.percentile(latencies, 99))
+        if objective == "max":
+            return float(latencies.max())
+        raise ValueError(f"unknown objective {objective!r}")
+
+    def placement_objective(
+        self,
+        config: Sequence[Region],
+        protocol: str,
+        f: int,
+        objective: str = "mean",
+        colocated: bool = False,
+    ) -> float:
+        return self._objective_value(
+            self.placement_latencies(config, protocol, f, colocated=colocated),
+            objective,
+        )
+
+    def best_placement(
+        self,
+        protocol: str,
+        n: int,
+        f: int,
+        objective: str = "mean",
+        colocated: bool = False,
+    ) -> Placement:
+        """Exhaustive over n-combinations of the candidate servers,
+        minimizing the chosen latency objective.  Deterministic for a
+        fixed candidate set: ties break on the sorted region-name tuple,
+        never on iteration order of anything unordered."""
+        best: Optional[Tuple[float, Tuple[str, ...], Tuple[Region, ...]]] = None
+        for combo in itertools.combinations(self._servers, n):
+            value = self.placement_objective(
+                combo, protocol, f, objective=objective, colocated=colocated
+            )
+            key = (value, tuple(sorted(r.name for r in combo)))
+            if best is None or key < (best[0], best[1]):
+                best = (key[0], key[1], tuple(combo))
+        assert best is not None, "need at least n candidate servers"
+        return Placement(regions=best[2], objective=objective, value=best[0])
 
     # --- ranked search ---
 

@@ -6,6 +6,17 @@ plots rendered through matplotlib (the reference reaches matplotlib via
 pyo3; here it is native).
 """
 
-from fantoch_tpu.plot.db import ExperimentResult, ResultsDB
+from fantoch_tpu.plot.db import (
+    ExperimentResult,
+    ResultsDB,
+    load_curves,
+    save_curves,
+)
 
-__all__ = ["ExperimentResult", "ResultsDB", "plots"]
+__all__ = [
+    "ExperimentResult",
+    "ResultsDB",
+    "load_curves",
+    "plots",
+    "save_curves",
+]

@@ -127,3 +127,23 @@ class ResultsDB:
             if ok and (where is None or where(result)):
                 out.append(result)
         return out
+
+
+# --- scenario-observatory curves document (exp/scenarios.py) ---
+
+
+def save_curves(doc: Dict[str, Any], path: str) -> str:
+    """Persist a throughput-latency curves document as canonical JSON
+    (sorted keys, fixed separators): the artifact is part of the
+    scenario's byte-identity contract, so no timestamps, no float repr
+    drift, no key-order nondeterminism."""
+    with open(path, "w") as fh:
+        fh.write(json.dumps(doc, sort_keys=True, separators=(",", ":")))
+        fh.write("\n")
+    return path
+
+
+def load_curves(path: str) -> Dict[str, Any]:
+    """Inverse of :func:`save_curves` (round-trip tested)."""
+    with open(path) as fh:
+        return json.load(fh)

@@ -13,10 +13,13 @@ import numpy as np
 
 from fantoch_tpu.plot.db import ExperimentResult
 
-# headless: the reference renders to files too (fantoch_plot output dir)
+# headless: the reference renders to files too (fantoch_plot output
+# dir).  force=True pins Agg even when another import (or MPLBACKEND)
+# already selected an interactive backend — CI runs with no display, and
+# a late Qt/Tk selection would crash the first savefig, not the import.
 import matplotlib
 
-matplotlib.use("Agg")
+matplotlib.use("Agg", force=True)
 import matplotlib.pyplot as plt  # noqa: E402
 
 
@@ -244,6 +247,88 @@ def intra_machine_scalability(
     ax.legend()
     ax.grid(alpha=0.3)
     fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
+# --- scenario-observatory saturation curves (exp/scenarios.py) ---
+
+
+def _curve_label(curve: dict) -> str:
+    return f"{curve['protocol']} n={curve['n']} f={curve['f']}"
+
+
+def curve_axes(curve: dict):
+    """(goodput xs, {"p50"|"p95"|"p99": ys}) for one curves-document
+    curve, sorted so the goodput axis is monotone non-decreasing (points
+    arrive in offered-rate order; past the knee goodput can regress, and
+    a latency-vs-goodput line that doubles back is unreadable).  Points
+    with no completed commands (no percentiles) are dropped."""
+    points = [p for p in curve["points"] if p.get("p50_ms") is not None]
+    points = sorted(points, key=lambda p: p["goodput_cmds_per_s"])
+    xs = [p["goodput_cmds_per_s"] for p in points]
+    ys = {
+        "p50": [p["p50_ms"] for p in points],
+        "p95": [p["p95_ms"] for p in points],
+        "p99": [p["p99_ms"] for p in points],
+    }
+    return xs, ys
+
+
+def render_saturation(doc: dict):
+    """Throughput-latency saturation figure for a curves document (the
+    fantoch_plot throughput-latency analog over a scenario's offered-rate
+    sweep): per curve, p50/p95/p99 vs goodput; the detected knee gets a
+    marker (label "knee"); points that shed or ran degraded (PR 8/17
+    counters) get annotations.  Returns the Figure (tests inspect the
+    object model; :func:`saturation_curves` saves it)."""
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    styles = {"p50": "-", "p95": "--", "p99": ":"}
+    for curve in doc["curves"]:
+        xs, ys = curve_axes(curve)
+        if not xs:
+            continue
+        base = None
+        for q, style in styles.items():
+            (line,) = ax.plot(
+                xs, ys[q], style, marker="o", markersize=3,
+                color=base, label=f"{_curve_label(curve)} {q}",
+            )
+            base = line.get_color()
+        knee = curve.get("knee")
+        if knee is not None and knee.get("p99_ms") is not None:
+            ax.plot(
+                [knee["goodput_cmds_per_s"]], [knee["p99_ms"]],
+                marker="X", markersize=12, color=base, linestyle="none",
+                label="knee",
+            )
+        for p in curve["points"]:
+            if p.get("p99_ms") is None:
+                continue
+            tags = []
+            if p.get("sheds"):
+                tags.append(f"shed {p['sheds']}")
+            if p.get("degraded_ms"):
+                tags.append("degraded")
+            if tags:
+                ax.annotate(
+                    ", ".join(tags),
+                    (p["goodput_cmds_per_s"], p["p99_ms"]),
+                    fontsize=7, textcoords="offset points", xytext=(4, 4),
+                )
+    ax.set_xlabel("goodput (cmds/s)")
+    ax.set_ylabel("latency (ms)")
+    ax.set_title(f"{doc['scenario']} ({doc['timeline']} timeline)")
+    ax.legend(fontsize=7)
+    ax.grid(alpha=0.3)
+    fig.tight_layout()
+    return fig
+
+
+def saturation_curves(doc: dict, path: str) -> str:
+    """Render :func:`render_saturation` to ``path``."""
+    fig = render_saturation(doc)
     fig.savefig(path, dpi=120)
     plt.close(fig)
     return path

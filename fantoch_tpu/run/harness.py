@@ -346,6 +346,9 @@ def run_overload_phase(
         "device": device_counters,
         "goodput_cmds_per_s": int(total / wall_s) if wall_s > 0 else 0,
         "p50_ms": round(latencies[total // 2] / 1000.0, 2) if total else None,
+        "p95_ms": (
+            round(latencies[int(total * 0.95)] / 1000.0, 2) if total else None
+        ),
         "p99_ms": (
             round(latencies[int(total * 0.99)] / 1000.0, 2) if total else None
         ),

@@ -1014,3 +1014,43 @@ class Runner:
                 histogram.increment(latency_micros // 1000)  # ms precision (WAN)
             out[region] = (commands, histogram)
         return out
+
+    def serving_summary(self) -> Dict[str, object]:
+        """Post-run serving view for the scenario observatory: completed
+        commands, the cluster-wide serving span (first submit -> last
+        completion, virtual ms — the goodput denominator, same
+        reconstruction as run/harness.run_overload_phase), the pooled
+        sorted µs latency list, and the device fault counters folded
+        across every process's planes."""
+        completed = 0
+        latencies: List[int] = []
+        first_start: Optional[float] = None
+        last_end = 0
+        for client_id in self._client_to_region:
+            client = self._simulation.get_client(client_id)
+            data = client.data()
+            micros = list(data.latency_data())
+            if not micros:
+                continue
+            completed += len(micros)
+            latencies.extend(micros)
+            start, end = data.span_millis()
+            first_start = start if first_start is None else min(first_start, start)
+            last_end = max(last_end, end)
+        latencies.sort()
+        device: Dict[str, float] = {
+            "failovers": 0, "rebuilds": 0, "degraded_ms": 0.0
+        }
+        for _pid, (_process, executor, _pending) in self._simulation.processes():
+            for plane in executor.device_planes():
+                counters = plane.fault_counters()
+                device["failovers"] += counters.get("failovers", 0)
+                device["rebuilds"] += counters.get("rebuilds", 0)
+                device["degraded_ms"] += counters.get("degraded_ms", 0.0)
+        span_ms = (last_end - first_start) if first_start is not None else 0.0
+        return {
+            "completed": completed,
+            "span_ms": span_ms,
+            "latencies_us": latencies,
+            "device": device,
+        }

@@ -1,0 +1,110 @@
+"""Scenario-observatory smoke gate (``make scenario-smoke``): one tiny
+declarative spec drives the whole sweep factory end to end on the
+deterministic sim timeline and asserts the r20 contracts:
+
+- the spec expands deterministically — two expansions of the same spec
+  (and a third through the ``bin/scenario expand`` CLI) are
+  byte-identical;
+- the 3-point offered-rate ladder (EPaxos n=3, open-loop Poisson on
+  virtual time) runs every cell through the sim runner with telemetry
+  capture into per-cell obs dirs that ``plot.db.ResultsDB`` indexes;
+- the resulting throughput-latency curve carries p50/p95/p99 + goodput
+  per point and a DETECTED saturation knee (goodput caps at
+  total_commands / completion-span as the arrival window compresses —
+  real saturation, byte-stable across machines);
+- ``curves.json`` round-trips through ``plot.db`` and the PNG renders
+  headless (Agg);
+- ``bin/obs.py curves`` prints the knee table + typed SLO verdicts and
+  exits 0 on the passing SLO declared in the spec.
+
+CPU-only, a few seconds; the per-push CI step runs it next to the other
+smokes.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> None:
+    from fantoch_tpu.bin import obs, scenario
+    from fantoch_tpu.exp.scenarios import (
+        ScenarioSpec,
+        canonical_expansion,
+        load_spec,
+    )
+    from fantoch_tpu.plot.db import ResultsDB, load_curves
+
+    spec = ScenarioSpec(
+        name="scenario_smoke",
+        protocols=("epaxos",),
+        sites=((3, 1),),
+        timeline="sim",
+        seed=20,
+        clients_per_process=2,
+        commands_per_client=10,
+        rates=(50.0, 400.0, 3200.0),
+        slo={"p99_ms": 2000.0, "min_goodput_cmds_per_s": 10.0},
+    )
+
+    with tempfile.TemporaryDirectory(prefix="scenario_smoke_") as tmp:
+        spec_path = os.path.join(tmp, "spec.json")
+        with open(spec_path, "w") as fh:
+            json.dump(spec.to_dict(), fh)
+
+        # byte-identical re-expansion: in-process twice + the CLI
+        first = canonical_expansion(spec)
+        assert canonical_expansion(load_spec(spec_path)) == first
+        cli_out = os.path.join(tmp, "expansion.json")
+        assert scenario.main(["expand", spec_path, "--out", cli_out]) == 0
+        with open(cli_out) as fh:
+            assert fh.read().rstrip("\n") == first, "CLI expansion diverged"
+        print("scenario-smoke: expansion byte-identical (in-process + CLI)")
+
+        # run the matrix through the CLI (exit 0 = every SLO verdict ok)
+        out_dir = os.path.join(tmp, "obs")
+        rc = scenario.main(["run", spec_path, "--out", out_dir])
+        assert rc == 0, f"scenario run exited {rc}"
+
+        doc = load_curves(os.path.join(out_dir, "curves.json"))
+        (curve,) = doc["curves"]
+        assert len(curve["points"]) == 3, curve
+        for point in curve["points"]:
+            assert point["goodput_cmds_per_s"] > 0, point
+            assert (
+                point["p50_ms"] <= point["p95_ms"] <= point["p99_ms"]
+            ), point
+        knee = curve["knee"]
+        assert knee is not None, "ladder must saturate on the sim timeline"
+        assert knee["offered_cmds_per_s"] > 50.0, knee
+        assert all(v["pass"] for v in curve["slo"]), curve["slo"]
+        print(
+            "scenario-smoke: knee detected at offered "
+            f"{knee['offered_cmds_per_s']}/s (goodput "
+            f"{knee['goodput_cmds_per_s']}/s) over "
+            f"{len(curve['points'])} points"
+        )
+
+        # artifacts: per-cell obs dirs indexable, PNG rendered headless
+        db = ResultsDB(out_dir)
+        assert len(db) == 3, [r.name for r in db.results]
+        for result in db.results:
+            assert os.path.exists(
+                os.path.join(result.path, "telemetry.jsonl")
+            ), result.path
+        assert os.path.getsize(os.path.join(out_dir, "curves.png")) > 1000
+        print("scenario-smoke: 3 cells indexed, curves.png rendered")
+
+        # the capacity/SLO report plane renders and passes
+        rc = obs.main(["curves", out_dir])
+        assert rc == 0, f"obs curves exited {rc}"
+    print("scenario-smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

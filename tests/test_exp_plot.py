@@ -240,3 +240,87 @@ def test_scalability_and_heatmap_plots(tmp_path):
     assert os.path.getsize(p) > 1000
     p = plots.inter_machine_scalability(db.results, str(tmp_path / "inter.png"))
     assert os.path.getsize(p) > 1000
+
+
+# --- scenario-observatory curve rendering (exp/scenarios.py artifacts) ---
+
+
+def test_plots_pins_agg_backend():
+    """Headless CI safety: importing fantoch_tpu.plot.plots must force
+    the Agg backend (force=True — even if something selected an
+    interactive backend first, the first savefig must not need a
+    display)."""
+    import matplotlib
+
+    assert matplotlib.get_backend().lower() == "agg"
+
+
+def synthetic_curves_doc():
+    def point(cell, offered, goodput, p50, p95, p99, sheds=0, degraded=0.0):
+        return {
+            "cell": cell, "offered_cmds_per_s": offered,
+            "goodput_cmds_per_s": goodput, "commands": 60,
+            "p50_ms": p50, "p95_ms": p95, "p99_ms": p99,
+            "sheds": sheds, "queue_depth_hwm": 0,
+            "degraded_ms": degraded, "failovers": 0,
+        }
+
+    # offered-rate order, with goodput REGRESSING past the knee (the
+    # retrograde case the monotone-axis sort exists for)
+    points = [
+        point("c_r50", 50.0, 48.0, 10.0, 20.0, 30.0),
+        point("c_r400", 400.0, 210.0, 15.0, 30.0, 45.0),
+        point("c_r3200", 3200.0, 190.0, 20.0, 40.0, 60.0, sheds=7,
+              degraded=12.5),
+    ]
+    return {
+        "scenario": "synthetic", "timeline": "sim", "seed": 0,
+        "slo": None, "workload": {}, "placements": {},
+        "curves": [{
+            "protocol": "epaxos", "n": 3, "f": 1, "points": points,
+            "knee_index": 1, "knee": points[1],
+            "slo": [],
+        }],
+    }
+
+
+def test_curve_axes_monotone_goodput():
+    doc = synthetic_curves_doc()
+    xs, ys = plots.curve_axes(doc["curves"][0])
+    assert xs == sorted(xs)  # monotone even though r3200 regressed
+    assert xs == [48.0, 190.0, 210.0]
+    # percentiles travel with their point through the sort
+    assert ys["p99"] == [30.0, 60.0, 45.0]
+    assert len(ys["p50"]) == len(ys["p95"]) == len(xs)
+
+
+def test_render_saturation_has_knee_marker_and_annotations(tmp_path):
+    doc = synthetic_curves_doc()
+    fig = plots.render_saturation(doc)
+    try:
+        ax = fig.axes[0]
+        labels = [line.get_label() for line in ax.lines]
+        assert "knee" in labels
+        # p50/p95/p99 series all present for the curve
+        assert sum(1 for l in labels if l.startswith("epaxos n=3")) == 3
+        texts = [t.get_text() for t in ax.texts]
+        assert any("shed 7" in t for t in texts)
+        assert any("degraded" in t for t in texts)
+    finally:
+        import matplotlib.pyplot as plt
+
+        plt.close(fig)
+    # and the file-rendering wrapper produces a real PNG
+    path = plots.saturation_curves(doc, str(tmp_path / "curves.png"))
+    assert os.path.getsize(path) > 1000
+
+
+def test_curves_json_round_trips_through_db(tmp_path):
+    from fantoch_tpu.plot.db import load_curves, save_curves
+
+    doc = synthetic_curves_doc()
+    path = save_curves(doc, str(tmp_path / "curves.json"))
+    assert load_curves(path) == doc
+    # canonical bytes: saving the loaded doc is byte-identical
+    again = save_curves(load_curves(path), str(tmp_path / "again.json"))
+    assert open(path, "rb").read() == open(again, "rb").read()

@@ -1,0 +1,313 @@
+"""Scenario observatory (exp/scenarios.py): deterministic expansion,
+saturation-knee detection, placement-as-output, and the end-to-end
+curve artifacts (curves.json + ResultsDB-indexable per-cell dirs)."""
+
+import json
+import os
+
+import pytest
+
+from fantoch_tpu.core.planet import Planet, Region
+from fantoch_tpu.exp.scenarios import (
+    ScenarioSpec,
+    canonical_expansion,
+    cell_seed,
+    detect_knee,
+    expand,
+    load_spec,
+    run_scenario,
+)
+
+
+def synthetic_planet():
+    """Four regions on an asymmetric line: A - 10 - B - 10 - C - 5 - D,
+    plus a far outlier Z at 1000 from everyone (the placement search must
+    learn to leave it out).  The asymmetry (C/D cluster tighter than A)
+    makes the searched placement strictly beat the identity one for both
+    leaderless and leader-based protocols — a pure line ties fpaxos."""
+    a, b, c, d, z = (Region(x) for x in "ABCDZ")
+    pos = {a: 0, b: 10, c: 20, d: 25}
+    lat = {x: {y: abs(pos[x] - pos[y]) for y in pos} for x in pos}
+    for x in pos:
+        lat[x][z] = 1000
+    lat[z] = {y: 1000 for y in pos}
+    lat[z][z] = 0
+    return Planet.from_latencies(lat), (a, b, c, d, z)
+
+
+def tiny_spec(**overrides):
+    base = dict(
+        name="t",
+        protocols=("epaxos",),
+        sites=((3, 1),),
+        timeline="sim",
+        seed=11,
+        clients_per_process=2,
+        commands_per_client=10,
+        rates=(50.0, 3200.0),
+        slo={"p99_ms": 5000.0, "min_goodput_cmds_per_s": 1.0},
+    )
+    base.update(overrides)
+    return ScenarioSpec(**base)
+
+
+# --- expansion determinism ---
+
+
+def test_expansion_byte_identity_and_seed_derivation():
+    spec = tiny_spec()
+    assert canonical_expansion(spec) == canonical_expansion(spec)
+    manifest = expand(spec)
+    names = [cell["name"] for cell in manifest["cells"]]
+    assert names == ["epaxos_n3_f1_r50", "epaxos_n3_f1_r3200"]
+    seeds = [cell["seed"] for cell in manifest["cells"]]
+    # distinct, stable, derived from sha256 (never Python hash())
+    assert len(set(seeds)) == len(seeds)
+    assert seeds == [cell_seed(spec.seed, name) for name in names]
+    # a different spec seed moves every cell seed
+    other = expand(tiny_spec(seed=12))
+    assert all(
+        a["seed"] != b["seed"]
+        for a, b in zip(manifest["cells"], other["cells"])
+    )
+
+
+def test_spec_json_round_trip(tmp_path):
+    spec = tiny_spec(
+        key_gen="zipf", zipf_coefficient=0.8, keys_per_command=2,
+        knobs={"trace_sample_rate": 1.0},
+    )
+    again = ScenarioSpec.from_dict(json.loads(json.dumps(spec.to_dict())))
+    assert again == spec
+    path = tmp_path / "spec.json"
+    path.write_text(json.dumps(spec.to_dict()))
+    assert load_spec(str(path)) == spec
+    assert canonical_expansion(again) == canonical_expansion(spec)
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="unknown protocol"):
+        ScenarioSpec(name="x", protocols=("paxos9000",))
+    with pytest.raises(ValueError, match="timeline"):
+        ScenarioSpec(name="x", timeline="cloud")
+    with pytest.raises(ValueError, match="sim-only"):
+        ScenarioSpec(name="x", timeline="run", fault_plan={"seed": 1})
+    with pytest.raises(ValueError, match="unknown spec field"):
+        ScenarioSpec.from_dict({"name": "x", "bogus_knob": 1})
+    with pytest.raises(ValueError, match="placement mode"):
+        expand(tiny_spec(placement={"mode": "teleport"}))
+
+
+# --- knee detection (pure) ---
+
+
+def _pts(pairs):
+    return [
+        {"offered_cmds_per_s": o, "goodput_cmds_per_s": g} for o, g in pairs
+    ]
+
+
+def test_detect_knee_unsaturated():
+    assert detect_knee(_pts([(10, 10), (20, 19.5), (40, 39)])) is None
+
+
+def test_detect_knee_efficiency_threshold():
+    # third point's efficiency (0.625) drops under 75% of the first
+    # point's (1.0, capped)
+    assert detect_knee(_pts([(10, 10), (20, 19), (40, 25)])) == 2
+
+
+def test_detect_knee_efficiency_is_relative():
+    # a constant 0.5 efficiency is a fixed serving-span tail (finite
+    # open-loop run), not saturation: the first point calibrates it out
+    assert detect_knee(_pts([(10, 5), (20, 10), (40, 20)])) is None
+    # but a *drop* against that calibration is saturation
+    assert detect_knee(_pts([(10, 5), (20, 10), (40, 12)])) == 2
+
+
+def test_detect_knee_flat_curve():
+    # goodput stops growing while offered doubles: knee even though
+    # each point individually clears the efficiency bar (the 10 -> 11
+    # step stays under the 20% offered-growth floor, so only the
+    # doubling step can trip the flatness rule)
+    assert detect_knee(_pts([(10, 9), (11, 9.1), (24, 9.2)])) == 2
+
+
+def test_detect_knee_ignores_closed_loop_points():
+    points = _pts([(None, 50), (None, 60)])
+    assert detect_knee(points) is None
+
+
+# --- satellite: zipf multi-shard fraction as a planner input ---
+
+
+def test_zipf_expansion_reports_multi_shard_fraction():
+    from fantoch_tpu.bin.shard_distribution import compute_distribution
+
+    spec = tiny_spec(
+        key_gen="zipf", zipf_coefficient=0.7, keys_per_command=2,
+        keys_per_shard=1000, planner_shard_count=4,
+    )
+    workload = expand(spec)["workload"]
+    assert workload["shard_count"] == 4
+    assert workload["multi_shard_pct"] > 0
+    assert workload["multi_key_pct"] > workload["multi_shard_pct"] - 1e-9
+    # exactly the bin/shard_distribution computation, same seed
+    direct = compute_distribution(
+        shard_count=4, keys_per_command=2, coefficient=0.7,
+        keys_per_shard=1000, commands=2000, seed=spec.seed,
+    )
+    assert workload["multi_shard_pct"] == direct["multi_shard_pct"]
+    # conflict_rate specs report the rate instead
+    plain = expand(tiny_spec())["workload"]
+    assert "multi_shard_pct" not in plain
+    assert plain["conflict_rate"] == 50
+
+
+# --- satellite: placement search through a spec ---
+
+
+def test_placement_search_deterministic_and_beats_identity():
+    planet, (a, b, c, d, z) = synthetic_planet()
+    spec = tiny_spec(
+        protocols=("epaxos", "fpaxos"),
+        placement={
+            "mode": "search",
+            "candidates": ["A", "B", "C", "D", "Z"],
+            "clients": ["B", "C", "D"],
+            "objective": "mean",
+        },
+    )
+    first = expand(spec, planet)
+    second = expand(spec, planet)
+    assert first == second  # search output deterministic for spec+seed
+    for site_key in ("epaxos_n3_f1", "fpaxos_n3_f1"):
+        placement = first["placements"][site_key]
+        # identity placement is the first 3 candidates (A, B, C); with
+        # the clients at B/C/D the searched config must do strictly
+        # better on the asymmetric line (B, C, D hugs the clients)
+        assert placement["identity_regions"] == ["A", "B", "C"]
+        assert placement["objective_ms"] < placement["identity_objective_ms"]
+        assert "Z" not in placement["regions"]  # the outlier never helps
+    # the cells inherit the searched regions
+    for cell in first["cells"]:
+        key = f"{cell['protocol']}_n{cell['n']}_f{cell['f']}"
+        assert cell["regions"] == first["placements"][key]["regions"]
+
+
+def test_pinned_placement_mode():
+    planet, _ = synthetic_planet()
+    spec = tiny_spec(
+        placement={"mode": "regions", "regions": ["B", "C", "D"],
+                   "clients": ["A"]},
+    )
+    manifest = expand(spec, planet)
+    cell = manifest["cells"][0]
+    assert cell["regions"] == ["B", "C", "D"]
+    assert cell["client_regions"] == ["A"]
+
+
+# --- end-to-end: run matrix -> curves artifact ---
+
+
+def test_sim_scenario_end_to_end(tmp_path):
+    from fantoch_tpu.plot.db import ResultsDB, load_curves
+
+    spec = tiny_spec()
+    out = str(tmp_path / "obs")
+    doc = run_scenario(spec, out, render=False)
+    # curves.json round-trips byte-exactly through plot/db
+    assert load_curves(os.path.join(out, "curves.json")) == doc
+    # expansion.json holds the canonical bytes
+    with open(os.path.join(out, "expansion.json")) as fh:
+        assert fh.read().rstrip("\n") == canonical_expansion(spec)
+    (curve,) = doc["curves"]
+    assert [p["offered_cmds_per_s"] for p in curve["points"]] == [50.0, 3200.0]
+    for point in curve["points"]:
+        assert point["goodput_cmds_per_s"] > 0
+        assert point["p50_ms"] <= point["p95_ms"] <= point["p99_ms"]
+    # 60 commands over a WAN commit-latency span cap goodput far below
+    # the 3200/s offered point: the sim timeline saturates for real
+    assert curve["knee_index"] == 1
+    assert curve["knee"]["goodput_cmds_per_s"] < 0.75 * 3200
+    # typed SLO verdicts for every cell
+    assert [v["pass"] for v in curve["slo"]] == [True, True]
+    assert curve["slo"][0]["checks"]["p99_ms"]["target"] == 5000.0
+    # the per-cell obs dirs are a queryable ResultsDB root
+    db = ResultsDB(out)
+    assert len(db) == 2
+    (fast,) = db.search(rate_cmds_per_s=50.0)
+    assert fast.config["protocol"] == "epaxos"
+    assert fast.outcome["goodput_cmds_per_s"] == curve["points"][0][
+        "goodput_cmds_per_s"
+    ]
+    # telemetry captured per cell
+    assert os.path.exists(os.path.join(out, fast.name, "telemetry.jsonl"))
+
+
+def test_sim_trace_byte_identity(tmp_path):
+    """Same spec + seed => byte-identical per-cell traces on the sim
+    timeline (the observability determinism contract)."""
+    spec = tiny_spec(
+        rates=(200.0,), knobs={"trace_sample_rate": 1.0},
+    )
+    doc_a = run_scenario(spec, str(tmp_path / "a"), render=False)
+    doc_b = run_scenario(spec, str(tmp_path / "b"), render=False)
+    assert doc_a == doc_b
+    cell = "epaxos_n3_f1_r200"
+    trace_a = (tmp_path / "a" / cell / "trace.jsonl").read_bytes()
+    trace_b = (tmp_path / "b" / cell / "trace.jsonl").read_bytes()
+    assert trace_a and trace_a == trace_b
+
+
+def test_fault_plan_cell(tmp_path):
+    """A spec-carried FaultPlan reaches the sim nemesis (slow process)
+    and the run still completes every command."""
+    from fantoch_tpu.sim.faults import FaultPlan
+
+    plan = FaultPlan(seed=3).with_slow_process(
+        process_id=1, slow_ms=50, from_ms=0, until_ms=10_000
+    )
+    spec = tiny_spec(
+        rates=(100.0,),
+        fault_plan=plan.to_dict(),
+        extra_sim_time_ms=5000,
+    )
+    doc = run_scenario(spec, str(tmp_path / "obs"), render=False)
+    (point,) = doc["curves"][0]["points"]
+    assert point["commands"] == 10 * 2 * 3  # cmds x cpp x regions
+    assert point["goodput_cmds_per_s"] > 0
+
+
+def test_scenario_cli_and_obs_curves(tmp_path, capsys):
+    """bin/scenario expand|run + bin/obs curves drive the whole plane
+    in-process (the make scenario-smoke shape)."""
+    from fantoch_tpu.bin import obs, scenario
+
+    spec = tiny_spec()
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(spec.to_dict()))
+    out = str(tmp_path / "obs")
+
+    assert scenario.main(["expand", str(spec_path)]) == 0
+    expansion_text = capsys.readouterr().out.strip()
+    assert expansion_text == canonical_expansion(spec)
+
+    assert scenario.main(["run", str(spec_path), "--out", out,
+                          "--no-render"]) == 0
+    capsys.readouterr()
+
+    assert obs.main(["curves", out]) == 0
+    report = capsys.readouterr().out
+    assert "knee offered/s" in report
+    assert "slo PASS epaxos_n3_f1_r50" in report
+
+    # a violated SLO turns the exit code
+    strict = tiny_spec(slo={"p99_ms": 0.001})
+    strict_path = tmp_path / "strict.json"
+    strict_path.write_text(json.dumps(strict.to_dict()))
+    strict_out = str(tmp_path / "strict")
+    assert scenario.main(["run", str(strict_path), "--out", strict_out,
+                          "--no-render"]) == 1
+    capsys.readouterr()
+    assert obs.main(["curves", strict_out]) == 1
